@@ -1,0 +1,21 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Capabilities modeled on the Ray reference (see SURVEY.md); architecture is
+TPU-first: JAX/XLA/pjit/Pallas for the tensor plane over ICI/DCN meshes, a
+C++ shared-memory object store + Python control plane for tasks/actors, and
+a library stack (train/data/tune/serve/rl) built on the public task/actor API.
+"""
+
+from ray_tpu._version import __version__  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy top-level API: keep `import ray_tpu` cheap (no jax import).
+    try:
+        from ray_tpu.core import api as _api
+    except ImportError:
+        raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}") from None
+
+    if hasattr(_api, name):
+        return getattr(_api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,88 @@
+"""Native library loader: builds the C++ store on first use and ctypes-wraps it.
+
+The shared library is compiled from ``src/*.cc`` with g++ into
+``ray_tpu/_native/build/`` keyed by a source hash, so editing the C++
+transparently rebuilds. No pip/pybind dependency: plain ``extern "C"`` +
+ctypes, with Python mapping the same /dev/shm file for zero-copy views.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_HERE, "src")
+_BUILD_DIR = os.path.join(_HERE, "build")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _source_files() -> list[str]:
+    return sorted(
+        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR) if f.endswith(".cc")
+    )
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for path in _source_files():
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tag = _source_hash()
+    so_path = os.path.join(_BUILD_DIR, f"libray_tpu_native_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+        "-o", tmp, *_source_files(), "-lpthread", "-lrt",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+    return so_path
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build())
+            u64 = ctypes.c_uint64
+            p64 = ctypes.POINTER(u64)
+            lib.rt_store_create.restype = ctypes.c_void_p
+            lib.rt_store_create.argtypes = [ctypes.c_char_p, u64]
+            lib.rt_store_connect.restype = ctypes.c_void_p
+            lib.rt_store_connect.argtypes = [ctypes.c_char_p]
+            lib.rt_store_close.argtypes = [ctypes.c_void_p]
+            lib.rt_store_destroy.argtypes = [ctypes.c_char_p]
+            lib.rt_store_capacity.restype = u64
+            lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
+            lib.rt_store_bytes_in_use.restype = u64
+            lib.rt_store_bytes_in_use.argtypes = [ctypes.c_void_p]
+            lib.rt_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64, p64]
+            lib.rt_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, p64, p64]
+            lib.rt_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_chan_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64, ctypes.c_uint32, p64]
+            lib.rt_chan_data.argtypes = [ctypes.c_void_p, ctypes.c_char_p, p64, p64]
+            lib.rt_chan_write_acquire.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+            lib.rt_chan_write_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64]
+            lib.rt_chan_read_acquire.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64, ctypes.c_int64, p64, p64]
+            lib.rt_chan_read_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.rt_chan_close.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            _lib = lib
+    return _lib

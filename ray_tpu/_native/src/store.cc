@@ -1,0 +1,720 @@
+// Shared-memory object store: the TPU-native plasma equivalent.
+//
+// Re-designed from the behavior of the reference's per-node object store
+// (ref: src/ray/object_manager/plasma/store.h:55, object_store.h:76,
+// eviction_policy.h, dlmalloc.cc) — create/seal/get/release/delete with
+// blocking gets, LRU eviction of unreferenced sealed objects, and a
+// boundary-tag first-fit allocator inside one mmap'd POSIX shm arena.
+// Unlike plasma there is no client socket protocol: every process on the
+// node maps the arena directly and synchronizes through process-shared
+// robust mutexes — one less hop, which matters because on a TPU host the
+// store's job is feeding host->device transfers at HBM-ingest rate.
+//
+// Also hosts mutable channel objects: the equivalent of the reference's
+// experimental mutable-object protocol for compiled graphs
+// (ref: src/ray/core_worker/experimental_mutable_object_manager.h:44,
+// WriteAcquire/ReadAcquire at :156/:181) — a versioned single-writer,
+// N-reader ring cell with process-shared condvars.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545354'4f524531ull;  // "RTSTORE1"
+constexpr int kIdSize = 20;
+constexpr uint64_t kAlign = 64;
+
+enum EntryState : uint32_t {
+  kFree = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kChannel = 3,
+};
+
+enum Error : int {
+  kOK = 0,
+  kNotFound = -1,
+  kExists = -2,
+  kOutOfMemory = -3,
+  kTimeout = -4,
+  kBadState = -5,
+  kSysError = -6,
+  kClosed = -7,
+};
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  uint64_t offset;  // data offset from arena base
+  uint64_t size;    // user-visible size
+  int32_t refcnt;
+  uint32_t pad;
+  uint64_t lru_seq;
+};
+
+struct Block {  // boundary-tag allocator block header, padded so the user
+                // data that follows it stays 64-byte aligned (DMA/vector
+                // loads; serialization.py promises this alignment)
+  uint64_t size;  // total block size incl. header+footer
+  uint64_t free;  // 1 = free
+  uint8_t pad[kAlign - 2 * sizeof(uint64_t)];
+};
+static_assert(sizeof(Block) == kAlign, "data after Block must stay aligned");
+// footer: uint64_t size at block end - 8
+
+struct ChannelHeader {  // lives at the start of a channel's data block
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  uint64_t version;        // incremented by each WriteRelease
+  uint64_t payload_size;   // bytes written for the current version
+  uint32_t num_readers;    // readers per version
+  int32_t readers_left;    // acks outstanding for current version
+  uint32_t closed;
+  uint32_t pad;
+};
+
+struct StoreHeader {
+  uint64_t magic;
+  uint64_t capacity;     // total file size
+  uint64_t table_off;
+  uint64_t table_slots;
+  uint64_t data_off;
+  uint64_t data_size;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;     // broadcast on seal/delete/release
+  uint64_t lru_clock;
+  uint64_t bytes_in_use;
+  uint32_t closed;
+  uint32_t pad;
+};
+
+struct Handle {
+  StoreHeader* hdr;
+  uint8_t* base;
+  uint64_t capacity;
+  int fd;
+};
+
+uint64_t align_up(uint64_t n, uint64_t a) { return (n + a - 1) & ~(a - 1); }
+
+// ---- locking helpers (robust mutex: survive client crashes) ----
+
+int lock(pthread_mutex_t* mu) {
+  int rc = pthread_mutex_lock(mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// pthread_cond_(timed)wait reacquires the mutex and can itself observe the
+// previous owner's death: repair the mutex or every later lock() fails with
+// ENOTRECOVERABLE and the store is bricked after one client crash.
+int cond_wait(pthread_cond_t* cv, pthread_mutex_t* mu) {
+  int rc = pthread_cond_wait(cv, mu);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+int cond_timedwait(pthread_cond_t* cv, pthread_mutex_t* mu, const timespec* ts) {
+  int rc = pthread_cond_timedwait(cv, mu, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+void init_mutex(pthread_mutex_t* mu) {
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(mu, &ma);
+  pthread_mutexattr_destroy(&ma);
+}
+
+void init_cond(pthread_cond_t* cv) {
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(cv, &ca);
+  pthread_condattr_destroy(&ca);
+}
+
+void deadline_after_ms(int64_t ms, timespec* ts) {
+  clock_gettime(CLOCK_MONOTONIC, ts);
+  ts->tv_sec += ms / 1000;
+  ts->tv_nsec += (ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// ---- allocator: boundary tags, first fit, coalescing ----
+
+constexpr uint64_t kBlockOverhead = sizeof(Block) + sizeof(uint64_t);
+
+uint64_t* footer_of(uint8_t* data_base, Block* b) {
+  return reinterpret_cast<uint64_t*>(reinterpret_cast<uint8_t*>(b) + b->size -
+                                     sizeof(uint64_t));
+}
+
+void write_block(uint8_t* data_base, Block* b, uint64_t size, uint64_t free) {
+  b->size = size;
+  b->free = free;
+  *footer_of(data_base, b) = size;
+}
+
+Block* next_block(uint8_t* data_base, uint64_t data_size, Block* b) {
+  uint8_t* n = reinterpret_cast<uint8_t*>(b) + b->size;
+  if (n >= data_base + data_size) return nullptr;
+  return reinterpret_cast<Block*>(n);
+}
+
+Block* prev_block(uint8_t* data_base, Block* b) {
+  uint8_t* p = reinterpret_cast<uint8_t*>(b);
+  if (p == data_base) return nullptr;
+  uint64_t prev_size = *reinterpret_cast<uint64_t*>(p - sizeof(uint64_t));
+  return reinterpret_cast<Block*>(p - prev_size);
+}
+
+// Allocate `user_size` bytes; returns data offset from arena base or 0.
+uint64_t alloc_locked(Handle* h, uint64_t user_size) {
+  StoreHeader* s = h->hdr;
+  uint8_t* data_base = h->base + s->data_off;
+  uint64_t need = align_up(user_size + kBlockOverhead, kAlign);
+  Block* b = reinterpret_cast<Block*>(data_base);
+  while (b) {
+    if (b->free && b->size >= need) {
+      uint64_t remainder = b->size - need;
+      if (remainder >= kBlockOverhead + kAlign) {
+        write_block(data_base, b, need, 0);
+        Block* rest = next_block(data_base, s->data_size, b);
+        write_block(data_base, rest, remainder, 1);
+      } else {
+        b->free = 0;
+        *footer_of(data_base, b) = b->size;
+      }
+      s->bytes_in_use += b->size;
+      return (reinterpret_cast<uint8_t*>(b) - h->base) + sizeof(Block);
+    }
+    b = next_block(data_base, s->data_size, b);
+  }
+  return 0;
+}
+
+void free_locked(Handle* h, uint64_t data_offset) {
+  StoreHeader* s = h->hdr;
+  uint8_t* data_base = h->base + s->data_off;
+  Block* b = reinterpret_cast<Block*>(h->base + data_offset - sizeof(Block));
+  s->bytes_in_use -= b->size;
+  b->free = 1;
+  // coalesce with next
+  Block* n = next_block(data_base, s->data_size, b);
+  if (n && n->free) write_block(data_base, b, b->size + n->size, 1);
+  // coalesce with prev
+  Block* p = prev_block(data_base, b);
+  if (p && p->free) write_block(data_base, p, p->size + b->size, 1);
+  else *footer_of(data_base, b) = b->size;
+}
+
+// ---- object table: open addressing on id hash ----
+
+uint64_t hash_id(const uint8_t* id) {
+  uint64_t x;
+  memcpy(&x, id, 8);
+  uint64_t y;
+  memcpy(&y, id + 8, 8);
+  uint32_t z;  // ObjectIDs are task_id(16) + return_index(4): the tail must
+  memcpy(&z, id + 16, 4);  // feed the hash or one task's returns all collide
+  x ^= y * 0x9e3779b97f4a7c15ull;
+  x ^= (uint64_t)z * 0xc2b2ae3d27d4eb4full;
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdull;
+  x ^= x >> 33;
+  return x;
+}
+
+Entry* table(Handle* h) {
+  return reinterpret_cast<Entry*>(h->base + h->hdr->table_off);
+}
+
+Entry* find_entry(Handle* h, const uint8_t* id) {
+  Entry* t = table(h);
+  uint64_t slots = h->hdr->table_slots;
+  uint64_t i = hash_id(id) % slots;
+  for (uint64_t probe = 0; probe < slots; ++probe) {
+    Entry* e = &t[i];
+    if (e->state == kFree) return nullptr;
+    if (memcmp(e->id, id, kIdSize) == 0 && e->state != kFree) return e;
+    i = (i + 1) % slots;
+  }
+  return nullptr;
+}
+
+Entry* insert_entry(Handle* h, const uint8_t* id) {
+  Entry* t = table(h);
+  uint64_t slots = h->hdr->table_slots;
+  uint64_t i = hash_id(id) % slots;
+  for (uint64_t probe = 0; probe < slots; ++probe) {
+    Entry* e = &t[i];
+    if (e->state == kFree) {
+      memcpy(e->id, id, kIdSize);
+      return e;
+    }
+    i = (i + 1) % slots;
+  }
+  return nullptr;  // table full
+}
+
+void erase_entry(Handle* h, Entry* e) {
+  // Open addressing deletion: re-insert the rest of the cluster.
+  Entry* t = table(h);
+  uint64_t slots = h->hdr->table_slots;
+  uint64_t i = e - t;
+  e->state = kFree;
+  uint64_t j = (i + 1) % slots;
+  while (t[j].state != kFree) {
+    Entry moved = t[j];
+    t[j].state = kFree;
+    Entry* dst = insert_entry(h, moved.id);
+    uint8_t saved_id[kIdSize];
+    memcpy(saved_id, moved.id, kIdSize);
+    *dst = moved;
+    memcpy(dst->id, saved_id, kIdSize);
+    j = (j + 1) % slots;
+  }
+}
+
+// Evict LRU sealed refcnt==0 objects until at least `need` bytes could fit.
+// Returns 1 if anything was evicted.
+int evict_locked(Handle* h, uint64_t need) {
+  (void)need;
+  Entry* t = table(h);
+  uint64_t slots = h->hdr->table_slots;
+  Entry* victim = nullptr;
+  for (uint64_t i = 0; i < slots; ++i) {
+    Entry* e = &t[i];
+    if (e->state == kSealed && e->refcnt == 0) {
+      if (!victim || e->lru_seq < victim->lru_seq) victim = e;
+    }
+  }
+  if (!victim) return 0;
+  free_locked(h, victim->offset);
+  erase_entry(h, victim);
+  return 1;
+}
+
+ChannelHeader* channel_hdr(Handle* h, Entry* e) {
+  return reinterpret_cast<ChannelHeader*>(h->base + e->offset);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a new store arena backed by /dev/shm/<name>. Returns handle or null.
+void* rt_store_create(const char* name, uint64_t capacity) {
+  // header + minimum 4096-slot table + one block of real space; anything
+  // smaller underflows data_size and scribbles past the mapping.
+  uint64_t min_capacity = align_up(sizeof(StoreHeader), kAlign) +
+                          align_up(4096 * sizeof(Entry), kAlign) + (1u << 20);
+  if (capacity < min_capacity) return nullptr;
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)capacity) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* h = new Handle;
+  h->base = static_cast<uint8_t*>(base);
+  h->hdr = reinterpret_cast<StoreHeader*>(base);
+  h->capacity = capacity;
+  h->fd = fd;
+
+  StoreHeader* s = h->hdr;
+  memset(s, 0, sizeof(StoreHeader));
+  s->capacity = capacity;
+  // size the table at ~1 slot per 16KB of arena, min 4096 slots
+  uint64_t slots = capacity / 16384;
+  if (slots < 4096) slots = 4096;
+  s->table_off = align_up(sizeof(StoreHeader), kAlign);
+  s->table_slots = slots;
+  s->data_off = align_up(s->table_off + slots * sizeof(Entry), kAlign);
+  s->data_size = capacity - s->data_off;
+  memset(h->base + s->table_off, 0, slots * sizeof(Entry));
+  init_mutex(&s->mu);
+  init_cond(&s->cv);
+  // one giant free block
+  uint8_t* data_base = h->base + s->data_off;
+  write_block(data_base, reinterpret_cast<Block*>(data_base), s->data_size, 1);
+  __sync_synchronize();
+  s->magic = kMagic;
+  return h;
+}
+
+void* rt_store_connect(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  auto* h = new Handle;
+  h->base = static_cast<uint8_t*>(base);
+  h->hdr = reinterpret_cast<StoreHeader*>(base);
+  h->capacity = st.st_size;
+  h->fd = fd;
+  if (h->hdr->magic != kMagic) {
+    munmap(base, st.st_size);
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void rt_store_close(void* hv) {
+  auto* h = static_cast<Handle*>(hv);
+  munmap(h->base, h->capacity);
+  close(h->fd);
+  delete h;
+}
+
+int rt_store_destroy(const char* name) { return shm_unlink(name); }
+
+uint64_t rt_store_capacity(void* hv) {
+  return static_cast<Handle*>(hv)->hdr->data_size;
+}
+
+uint64_t rt_store_bytes_in_use(void* hv) {
+  return static_cast<Handle*>(hv)->hdr->bytes_in_use;
+}
+
+// Create an object; returns kOK and sets *offset_out (arena offset of data).
+int rt_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* offset_out) {
+  auto* h = static_cast<Handle*>(hv);
+  StoreHeader* s = h->hdr;
+  lock(&s->mu);
+  if (find_entry(h, id)) {
+    pthread_mutex_unlock(&s->mu);
+    return kExists;
+  }
+  uint64_t off = alloc_locked(h, size);
+  while (off == 0) {
+    if (!evict_locked(h, size)) break;
+    off = alloc_locked(h, size);
+  }
+  if (off == 0) {
+    pthread_mutex_unlock(&s->mu);
+    return kOutOfMemory;
+  }
+  Entry* e = insert_entry(h, id);
+  if (!e) {
+    free_locked(h, off);
+    pthread_mutex_unlock(&s->mu);
+    return kOutOfMemory;
+  }
+  e->state = kCreated;
+  e->offset = off;
+  e->size = size;
+  e->refcnt = 1;  // creator holds a ref until seal+release
+  e->lru_seq = ++s->lru_clock;
+  *offset_out = off;
+  pthread_mutex_unlock(&s->mu);
+  return kOK;
+}
+
+int rt_seal(void* hv, const uint8_t* id) {
+  auto* h = static_cast<Handle*>(hv);
+  StoreHeader* s = h->hdr;
+  lock(&s->mu);
+  Entry* e = find_entry(h, id);
+  if (!e) {
+    pthread_mutex_unlock(&s->mu);
+    return kNotFound;
+  }
+  if (e->state != kCreated) {
+    pthread_mutex_unlock(&s->mu);
+    return kBadState;
+  }
+  e->state = kSealed;
+  e->refcnt -= 1;  // drop creator ref
+  e->lru_seq = ++s->lru_clock;
+  pthread_cond_broadcast(&s->cv);
+  pthread_mutex_unlock(&s->mu);
+  return kOK;
+}
+
+// Blocking get: waits until sealed or timeout; takes a reference.
+int rt_get(void* hv, const uint8_t* id, int64_t timeout_ms, uint64_t* offset_out,
+           uint64_t* size_out) {
+  auto* h = static_cast<Handle*>(hv);
+  StoreHeader* s = h->hdr;
+  timespec deadline;
+  if (timeout_ms >= 0) deadline_after_ms(timeout_ms, &deadline);
+  lock(&s->mu);
+  for (;;) {
+    Entry* e = find_entry(h, id);
+    if (e && e->state == kSealed) {
+      e->refcnt += 1;
+      e->lru_seq = ++s->lru_clock;
+      *offset_out = e->offset;
+      *size_out = e->size;
+      pthread_mutex_unlock(&s->mu);
+      return kOK;
+    }
+    int rc;
+    if (timeout_ms >= 0) {
+      rc = cond_timedwait(&s->cv, &s->mu, &deadline);
+      if (rc == ETIMEDOUT) {
+        pthread_mutex_unlock(&s->mu);
+        return kTimeout;
+      }
+    } else {
+      rc = cond_wait(&s->cv, &s->mu);
+    }
+    if (rc != 0) {
+      pthread_mutex_unlock(&s->mu);
+      return kSysError;
+    }
+  }
+}
+
+// Non-blocking existence check; does NOT take a reference.
+int rt_contains(void* hv, const uint8_t* id) {
+  auto* h = static_cast<Handle*>(hv);
+  lock(&h->hdr->mu);
+  Entry* e = find_entry(h, id);
+  int found = (e && e->state == kSealed) ? 1 : 0;
+  pthread_mutex_unlock(&h->hdr->mu);
+  return found;
+}
+
+int rt_release(void* hv, const uint8_t* id) {
+  auto* h = static_cast<Handle*>(hv);
+  lock(&h->hdr->mu);
+  Entry* e = find_entry(h, id);
+  if (!e) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return kNotFound;
+  }
+  if (e->refcnt > 0) e->refcnt -= 1;
+  pthread_cond_broadcast(&h->hdr->cv);
+  pthread_mutex_unlock(&h->hdr->mu);
+  return kOK;
+}
+
+// Delete: frees now if unreferenced, else marks for no new refs by erasing
+// from the table once refcnt hits zero (here: spin is avoided — caller is the
+// owner and release() of last ref frees the memory).
+int rt_delete(void* hv, const uint8_t* id) {
+  auto* h = static_cast<Handle*>(hv);
+  lock(&h->hdr->mu);
+  Entry* e = find_entry(h, id);
+  if (!e) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return kNotFound;
+  }
+  if (e->refcnt <= 0) {
+    free_locked(h, e->offset);
+    erase_entry(h, e);
+  } else {
+    // keep data alive for readers; demote lru so eviction reclaims it next
+    e->lru_seq = 0;
+  }
+  pthread_cond_broadcast(&h->hdr->cv);
+  pthread_mutex_unlock(&h->hdr->mu);
+  return kOK;
+}
+
+// ---- mutable channel objects (compiled-graph substrate) ----
+
+int rt_chan_create(void* hv, const uint8_t* id, uint64_t size,
+                   uint32_t num_readers, uint64_t* offset_out) {
+  auto* h = static_cast<Handle*>(hv);
+  StoreHeader* s = h->hdr;
+  uint64_t total = align_up(sizeof(ChannelHeader), kAlign) + size;
+  lock(&s->mu);
+  if (find_entry(h, id)) {
+    pthread_mutex_unlock(&s->mu);
+    return kExists;
+  }
+  uint64_t off = alloc_locked(h, total);
+  while (off == 0) {
+    if (!evict_locked(h, total)) break;
+    off = alloc_locked(h, total);
+  }
+  if (off == 0) {
+    pthread_mutex_unlock(&s->mu);
+    return kOutOfMemory;
+  }
+  Entry* e = insert_entry(h, id);
+  if (!e) {
+    free_locked(h, off);
+    pthread_mutex_unlock(&s->mu);
+    return kOutOfMemory;
+  }
+  e->state = kChannel;
+  e->offset = off;
+  e->size = size;
+  e->refcnt = 1;
+  e->lru_seq = ~0ull;  // never evict channels
+  ChannelHeader* ch = reinterpret_cast<ChannelHeader*>(h->base + off);
+  memset(ch, 0, sizeof(ChannelHeader));
+  init_mutex(&ch->mu);
+  init_cond(&ch->cv);
+  ch->num_readers = num_readers;
+  ch->readers_left = 0;
+  ch->version = 0;
+  *offset_out = off + align_up(sizeof(ChannelHeader), kAlign);
+  pthread_mutex_unlock(&s->mu);
+  return kOK;
+}
+
+static int chan_lookup(Handle* h, const uint8_t* id, Entry** e_out) {
+  lock(&h->hdr->mu);
+  Entry* e = find_entry(h, id);
+  pthread_mutex_unlock(&h->hdr->mu);
+  if (!e || e->state != kChannel) return kNotFound;
+  *e_out = e;
+  return kOK;
+}
+
+int rt_chan_data(void* hv, const uint8_t* id, uint64_t* offset_out,
+                 uint64_t* size_out) {
+  auto* h = static_cast<Handle*>(hv);
+  Entry* e;
+  int rc = chan_lookup(h, id, &e);
+  if (rc != kOK) return rc;
+  *offset_out = e->offset + align_up(sizeof(ChannelHeader), kAlign);
+  *size_out = e->size;
+  return kOK;
+}
+
+// Writer: wait until all readers of the previous version have released.
+int rt_chan_write_acquire(void* hv, const uint8_t* id, int64_t timeout_ms) {
+  auto* h = static_cast<Handle*>(hv);
+  Entry* e;
+  int rc = chan_lookup(h, id, &e);
+  if (rc != kOK) return rc;
+  ChannelHeader* ch = channel_hdr(h, e);
+  timespec deadline;
+  if (timeout_ms >= 0) deadline_after_ms(timeout_ms, &deadline);
+  lock(&ch->mu);
+  while (ch->readers_left > 0 && !ch->closed) {
+    int w = timeout_ms >= 0 ? cond_timedwait(&ch->cv, &ch->mu, &deadline)
+                            : cond_wait(&ch->cv, &ch->mu);
+    if (w == ETIMEDOUT) {
+      pthread_mutex_unlock(&ch->mu);
+      return kTimeout;
+    }
+  }
+  int closed = ch->closed;
+  pthread_mutex_unlock(&ch->mu);
+  return closed ? kClosed : kOK;
+}
+
+int rt_chan_write_release(void* hv, const uint8_t* id, uint64_t payload_size) {
+  auto* h = static_cast<Handle*>(hv);
+  Entry* e;
+  int rc = chan_lookup(h, id, &e);
+  if (rc != kOK) return rc;
+  ChannelHeader* ch = channel_hdr(h, e);
+  lock(&ch->mu);
+  ch->version += 1;
+  ch->payload_size = payload_size;
+  ch->readers_left = (int32_t)ch->num_readers;
+  pthread_cond_broadcast(&ch->cv);
+  pthread_mutex_unlock(&ch->mu);
+  return kOK;
+}
+
+// Reader: wait for a version newer than last_version; returns it.
+int rt_chan_read_acquire(void* hv, const uint8_t* id, uint64_t last_version,
+                         int64_t timeout_ms, uint64_t* version_out,
+                         uint64_t* payload_size_out) {
+  auto* h = static_cast<Handle*>(hv);
+  Entry* e;
+  int rc = chan_lookup(h, id, &e);
+  if (rc != kOK) return rc;
+  ChannelHeader* ch = channel_hdr(h, e);
+  timespec deadline;
+  if (timeout_ms >= 0) deadline_after_ms(timeout_ms, &deadline);
+  lock(&ch->mu);
+  while (ch->version <= last_version && !ch->closed) {
+    int w = timeout_ms >= 0 ? cond_timedwait(&ch->cv, &ch->mu, &deadline)
+                            : cond_wait(&ch->cv, &ch->mu);
+    if (w == ETIMEDOUT) {
+      pthread_mutex_unlock(&ch->mu);
+      return kTimeout;
+    }
+  }
+  if (ch->closed) {
+    pthread_mutex_unlock(&ch->mu);
+    return kClosed;
+  }
+  *version_out = ch->version;
+  *payload_size_out = ch->payload_size;
+  pthread_mutex_unlock(&ch->mu);
+  return kOK;
+}
+
+int rt_chan_read_release(void* hv, const uint8_t* id) {
+  auto* h = static_cast<Handle*>(hv);
+  Entry* e;
+  int rc = chan_lookup(h, id, &e);
+  if (rc != kOK) return rc;
+  ChannelHeader* ch = channel_hdr(h, e);
+  lock(&ch->mu);
+  if (ch->readers_left > 0) ch->readers_left -= 1;
+  pthread_cond_broadcast(&ch->cv);
+  pthread_mutex_unlock(&ch->mu);
+  return kOK;
+}
+
+int rt_chan_close(void* hv, const uint8_t* id) {
+  auto* h = static_cast<Handle*>(hv);
+  Entry* e;
+  int rc = chan_lookup(h, id, &e);
+  if (rc != kOK) return rc;
+  ChannelHeader* ch = channel_hdr(h, e);
+  lock(&ch->mu);
+  ch->closed = 1;
+  pthread_cond_broadcast(&ch->cv);
+  pthread_mutex_unlock(&ch->mu);
+  return kOK;
+}
+
+}  // extern "C"

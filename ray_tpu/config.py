@@ -1,0 +1,114 @@
+"""Central flag table for the runtime.
+
+TPU-native equivalent of the reference's ``RAY_CONFIG(type, name, default)``
+table (ref: src/ray/common/ray_config_def.h:22) — a single declarative flag
+registry, overridable per-process with ``RT_<NAME>`` environment variables and
+serialized to every spawned process so the whole cluster agrees on one config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+_ENV_PREFIX = "RT_"
+_SERIALIZED_ENV = "RT_SYSTEM_CONFIG"
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(_ENV_PREFIX + name.upper())
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Config:
+    # --- object store (plasma-equivalent; ref: src/ray/object_manager/plasma) ---
+    object_store_memory: int = 512 * 1024 * 1024  # bytes of shm per node
+    #: objects at or below this many bytes are returned inline in the task
+    #: reply and live in the owner's in-process memory store
+    #: (ref: RAY_CONFIG max_direct_call_object_size, ray_config_def.h:203).
+    max_inline_object_size: int = 100 * 1024
+    #: chunk size for inter-node object transfer
+    object_transfer_chunk_size: int = 4 * 1024 * 1024
+
+    # --- scheduler / raylet ---
+    #: max workers a single raylet will fork
+    max_workers_per_node: int = 64
+    #: idle workers kept warm per node
+    min_idle_workers: int = 1
+    #: seconds before an idle leased worker is returned to the pool
+    worker_lease_timeout_s: float = 10.0
+    #: hybrid scheduling: prefer local node until this utilization fraction
+    #: (ref: hybrid_scheduling_policy.h:50)
+    hybrid_threshold: float = 0.5
+
+    # --- timeouts / health (ref: gcs_health_check_manager.h:59) ---
+    health_check_period_s: float = 1.0
+    health_check_failure_threshold: int = 5
+    rpc_connect_timeout_s: float = 30.0
+    worker_start_timeout_s: float = 60.0
+
+    # --- task / actor fault tolerance ---
+    default_max_task_retries: int = 3
+    default_max_actor_restarts: int = 0
+    #: max bytes of lineage kept per owner for reconstruction
+    #: (ref: task_manager.h:182)
+    lineage_bytes_limit: int = 64 * 1024 * 1024
+
+    # --- observability ---
+    task_events_report_interval_s: float = 1.0
+    log_dir: str = ""
+    temp_dir: str = "/tmp/ray_tpu"
+
+    # --- collective / TPU ---
+    #: default collective timeout
+    collective_timeout_s: float = 120.0
+    #: virtual CPU devices for tests; 0 = use real devices
+    force_cpu_devices: int = 0
+
+    def __post_init__(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    # -- propagation to child processes -------------------------------------
+    def to_env(self) -> dict:
+        """Serialize so spawned processes reconstruct the identical config."""
+        return {_SERIALIZED_ENV: json.dumps(dataclasses.asdict(self))}
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        raw = os.environ.get(_SERIALIZED_ENV)
+        cfg = cls()
+        if raw:
+            for k, v in json.loads(raw).items():
+                if hasattr(cfg, k):
+                    setattr(cfg, k, v)
+            # env vars still win over the serialized blob
+            for f in dataclasses.fields(cfg):
+                setattr(cfg, f.name, _env_override(f.name, getattr(cfg, f.name)))
+        return cfg
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config.from_env()
+    return _global_config
+
+
+def set_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,112 @@
+"""Binary IDs for jobs, tasks, actors, objects and nodes.
+
+Mirrors the reference's ID scheme (ref: src/ray/common/id.h): fixed-size binary
+ids; an ObjectID embeds the id of the task that created it plus a return-index,
+so ownership (which worker's memory store owns the value) is derivable from the
+id itself — the property the reference's ownership-based object directory
+relies on (ref: src/ray/object_manager/ownership_object_directory.cc).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+_TASK_ID_SIZE = 16
+_UNIQUE_ID_SIZE = 16
+_OBJECT_INDEX_SIZE = 4
+_OBJECT_ID_SIZE = _TASK_ID_SIZE + _OBJECT_INDEX_SIZE  # 20 bytes
+
+
+class BaseID:
+    SIZE = _UNIQUE_ID_SIZE
+    __slots__ = ("_bytes",)
+
+    def __init__(self, b: bytes):
+        if len(b) != self.SIZE:
+            raise ValueError(f"{type(self).__name__} needs {self.SIZE} bytes, got {len(b)}")
+        self._bytes = bytes(b)
+
+    @classmethod
+    def generate(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    @classmethod
+    def from_hex(cls, h: str):
+        return cls(bytes.fromhex(h))
+
+    def __hash__(self):
+        return hash(self._bytes)
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()[:12]})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    SIZE = _TASK_ID_SIZE
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        return cls(job_id.binary() + b"\x00" * (cls.SIZE - JobID.SIZE))
+
+
+class ObjectID(BaseID):
+    SIZE = _OBJECT_ID_SIZE
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack("<I", index))
+
+    @classmethod
+    def from_random(cls) -> "ObjectID":
+        # ``put()`` objects: owner task id + random index space (high bit set
+        # to never collide with task returns).
+        return cls(os.urandom(_TASK_ID_SIZE) + struct.pack("<I", 1 << 31))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_TASK_ID_SIZE])
+
+    def return_index(self) -> int:
+        return struct.unpack("<I", self._bytes[_TASK_ID_SIZE:])[0]

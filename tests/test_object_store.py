@@ -1,0 +1,154 @@
+"""Object store tests — modeled on the reference's plasma test coverage
+(ref: src/ray/object_manager/plasma test suite + python/ray/tests/test_object_store.py).
+"""
+
+import multiprocessing
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.core.object_store import (
+    ObjectStoreFullError,
+    ObjectTimeoutError,
+    SharedObjectStore,
+)
+from ray_tpu.utils.ids import ObjectID
+
+
+@pytest.fixture
+def store():
+    name = f"/rt_test_{os.getpid()}_{time.monotonic_ns()}"
+    s = SharedObjectStore(name, capacity=64 * 1024 * 1024, create=True)
+    yield s
+    s.destroy()
+
+
+def test_put_get_roundtrip(store):
+    oid = ObjectID.from_random()
+    value = {"a": 1, "b": [1, 2, 3], "s": "hello"}
+    store.put(oid, value)
+    assert store.get(oid) == value
+
+
+def test_numpy_zero_copy(store):
+    oid = ObjectID.from_random()
+    arr = np.arange(1_000_000, dtype=np.float32)
+    store.put(oid, arr)
+    out = store.get(oid)
+    np.testing.assert_array_equal(out, arr)
+    # zero-copy: the result aliases the shm mapping, not a fresh heap buffer
+    assert not out.flags["OWNDATA"]
+
+
+def test_contains_and_release(store):
+    oid = ObjectID.from_random()
+    assert not store.contains(oid)
+    store.put(oid, 42)
+    assert store.contains(oid)
+    store.release(oid)
+
+
+def test_get_timeout(store):
+    oid = ObjectID.from_random()
+    with pytest.raises(ObjectTimeoutError):
+        store.get(oid, timeout_ms=50)
+
+
+def test_duplicate_create_raises(store):
+    oid = ObjectID.from_random()
+    store.put(oid, 1)
+    from ray_tpu.core.object_store import ObjectStoreError
+
+    with pytest.raises(ObjectStoreError):
+        store.put(oid, 2)
+
+
+def test_delete_then_recreate(store):
+    oid = ObjectID.from_random()
+    store.put(oid, 1)
+    store.get(oid)
+    store.release(oid)  # drop our read ref so delete can free
+    store.delete(oid)
+    assert not store.contains(oid)
+    store.put(oid, 2)
+    assert store.get(oid) == 2
+
+
+def test_lru_eviction_makes_room(store):
+    # fill with unreferenced sealed objects, then allocate something big:
+    # the store must evict LRU victims instead of failing
+    ids = []
+    for i in range(8):
+        oid = ObjectID.from_random()
+        store.put(oid, np.zeros(4 * 1024 * 1024, dtype=np.uint8))
+        ids.append(oid)
+    big = ObjectID.from_random()
+    store.put(big, np.zeros(48 * 1024 * 1024, dtype=np.uint8))
+    assert store.contains(big)
+    assert not all(store.contains(i) for i in ids)
+
+
+def test_oom_when_all_referenced(store):
+    oid = ObjectID.from_random()
+    store.put(oid, np.zeros(40 * 1024 * 1024, dtype=np.uint8))
+    store.get_buffer(oid)  # hold a reference: not evictable
+    with pytest.raises(ObjectStoreFullError):
+        big = ObjectID.from_random()
+        store.put(big, np.zeros(48 * 1024 * 1024, dtype=np.uint8))
+
+
+def _child_put(name, oid_bytes):
+    s = SharedObjectStore(name)
+    s.put(ObjectID(oid_bytes), {"from": "child", "pid": os.getpid()})
+    s.close()
+
+
+def test_cross_process_get(store):
+    oid = ObjectID.from_random()
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_child_put, args=(store._name, oid.binary()))
+    p.start()
+    # blocking get waits for the child's seal
+    value = store.get(oid, timeout_ms=30_000)
+    p.join()
+    assert value["from"] == "child"
+    assert value["pid"] == p.pid
+
+
+def _child_chan_writer(name, oid_bytes, n):
+    s = SharedObjectStore(name)
+    oid = ObjectID(oid_bytes)
+    for i in range(n):
+        buf = s.channel_write_acquire(oid, timeout_ms=30_000)
+        buf[:8] = int(i).to_bytes(8, "little")
+        s.channel_write_release(oid)
+    s.close()
+
+
+def test_mutable_channel_cross_process(store):
+    oid = ObjectID.from_random()
+    store.channel_create(oid, size=64, num_readers=1)
+    n = 100
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_child_chan_writer, args=(store._name, oid.binary(), n))
+    p.start()
+    version = 0
+    seen = []
+    for _ in range(n):
+        buf, version = store.channel_read_acquire(oid, version, timeout_ms=30_000)
+        seen.append(int.from_bytes(buf[:8], "little"))
+        store.channel_read_release(oid)
+    p.join()
+    assert seen == list(range(n))
+
+
+def test_channel_close_unblocks_reader(store):
+    oid = ObjectID.from_random()
+    store.channel_create(oid, size=8, num_readers=1)
+    store.channel_close(oid)
+    from ray_tpu.core.object_store import ChannelClosedError
+
+    with pytest.raises(ChannelClosedError):
+        store.channel_read_acquire(oid, 0, timeout_ms=1000)

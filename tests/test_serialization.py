@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from ray_tpu.utils import serialization
+from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID
+
+
+def test_pack_unpack_basic():
+    for obj in [1, "x", [1, 2], {"a": (1, 2)}, None, b"bytes", 3.14]:
+        assert serialization.unpack(serialization.pack(obj)) == obj
+
+
+def test_pack_numpy_out_of_band():
+    arr = np.random.randn(1000, 10)
+    blob = serialization.pack(arr)
+    out = serialization.unpack(blob)
+    np.testing.assert_array_equal(out, arr)
+    assert not out.flags["OWNDATA"]  # aliases the blob
+
+
+def test_pack_lambda_cloudpickle_fallback():
+    f = lambda x: x * 2  # noqa: E731
+    g = serialization.unpack(serialization.pack(f))
+    assert g(21) == 42
+
+
+def test_pack_jax_array():
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    out = serialization.unpack(serialization.pack(x))
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16.0))
+
+
+def test_ids():
+    t = TaskID.generate()
+    o = ObjectID.for_task_return(t, 3)
+    assert o.task_id() == t
+    assert o.return_index() == 3
+    assert ObjectID.from_random().SIZE == 20
+    j = JobID.generate()
+    assert TaskID.for_driver(j).binary()[:4] == j.binary()
+    a = ActorID.generate()
+    assert ActorID.from_hex(a.hex()) == a
+    assert ActorID.nil().is_nil()
+
+
+def test_id_pickle_roundtrip():
+    import pickle
+
+    t = TaskID.generate()
+    assert pickle.loads(pickle.dumps(t)) == t
