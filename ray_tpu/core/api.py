@@ -1,0 +1,398 @@
+"""Public task/actor API: init, @remote, get/put/wait, actors, placement groups.
+
+Equivalent of the reference's user-facing layer (ref: python/ray/_private/
+worker.py init:1332 get:2757 put:2893 wait:2958 remote:3346,
+remote_function.py:41, actor.py:708). The driver hosts its control-plane
+sockets on a background event loop (EventLoopThread) and bridges the sync
+API onto it.
+"""
+
+from __future__ import annotations
+
+import atexit
+import functools
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Iterable, Sequence
+
+from ray_tpu.config import Config, get_config, set_config
+from ray_tpu.core.core_client import CoreClient
+from ray_tpu.core.ref import ActorHandle, ObjectRef
+from ray_tpu.utils import rpc
+from ray_tpu.utils.ids import PlacementGroupID
+
+_core: CoreClient | None = None
+_io: rpc.EventLoopThread | None = None
+_head_procs: list[subprocess.Popen] = []
+_owned_cluster = None  # in-process Cluster when init() started one
+
+
+def is_initialized() -> bool:
+    return _core is not None
+
+
+def get_core() -> CoreClient:
+    if _core is None:
+        init()
+    return _core
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    object_store_memory: int | None = None,
+    _in_process: bool = True,
+) -> None:
+    """Bring up (or connect to) a cluster and attach this driver.
+
+    Head mode (address=None) starts a GCS and one raylet. With
+    ``_in_process=True`` (default) they run on the driver's background event
+    loop — same wire protocol, no subprocess cost; with False they are real
+    subprocesses like the reference's `ray start` topology
+    (ref: _private/node.py:1479 start_ray_processes).
+    """
+    global _core, _io, _owned_cluster
+    if _core is not None:
+        return
+    cfg = get_config()
+    if object_store_memory:
+        cfg.object_store_memory = object_store_memory
+        set_config(cfg)
+
+    _io = rpc.EventLoopThread()
+
+    if address is None:
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        res.setdefault("CPU", float(os.cpu_count() or 1) * 4)
+        if num_tpus is not None:
+            res["TPU"] = float(num_tpus)
+        else:
+            tpu_chips = _detect_tpu_chips()
+            if tpu_chips:
+                res["TPU"] = float(tpu_chips)
+        if _in_process:
+            from ray_tpu.core.cluster import Cluster
+
+            _owned_cluster = Cluster(io=_io)
+            _owned_cluster.add_node(resources=res)
+            gcs_addr = _owned_cluster.gcs_address
+            raylet_addr = _owned_cluster.raylets[0].server.address
+        else:
+            gcs_addr, raylet_addr = _start_head_processes(res)
+    else:
+        host, port = address.rsplit(":", 1)
+        gcs_addr = (host, int(port))
+        raylet_addr = _find_local_raylet(_io, gcs_addr)
+
+    core = CoreClient(loop=_io.loop)
+    _io.run(core.connect(gcs_addr, raylet_addr), timeout=cfg.rpc_connect_timeout_s + 5)
+    _core = core
+    atexit.register(shutdown)
+
+
+def _detect_tpu_chips() -> int:
+    """TPU autodetection (ref: _private/accelerators/tpu.py:24-61): here via
+    the libtpu/axon env rather than GCE metadata — count visible chips."""
+    if os.environ.get("TPU_SKIP_MDS_QUERY") or os.environ.get("PALLAS_AXON_TPU_GEN"):
+        chips = os.environ.get("TPU_VISIBLE_CHIPS")
+        return len(chips.split(",")) if chips else 1
+    return 0
+
+
+def _start_head_processes(resources) -> tuple[tuple[str, int], tuple[str, int]]:
+    cfg = get_config()
+    tmp = tempfile.mkdtemp(prefix="rt_head_")
+    addr_file = os.path.join(tmp, "gcs_addr")
+    env = dict(os.environ)
+    env.update(cfg.to_env())
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+    gcs = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.gcs", "--address-file", addr_file], env=env
+    )
+    _head_procs.append(gcs)
+    deadline = time.monotonic() + cfg.rpc_connect_timeout_s
+    while not os.path.exists(addr_file):
+        if time.monotonic() > deadline:
+            raise TimeoutError("GCS did not start")
+        time.sleep(0.05)
+    with open(addr_file) as f:
+        host, port = f.read().strip().rsplit(":", 1)
+    gcs_addr = (host, int(port))
+    res_arg = ",".join(f"{k}={v}" for k, v in resources.items() if k not in ("CPU", "TPU"))
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.raylet",
+        "--gcs", f"{host}:{port}",
+        "--num-cpus", str(resources.get("CPU", os.cpu_count() or 1)),
+    ]
+    if resources.get("TPU"):
+        cmd += ["--num-tpus", str(resources["TPU"])]
+    if res_arg:
+        cmd += ["--resources", res_arg]
+    raylet = subprocess.Popen(cmd, env=env)
+    _head_procs.append(raylet)
+    raylet_addr = _find_local_raylet(_io, gcs_addr)
+    return gcs_addr, raylet_addr
+
+
+def _find_local_raylet(io: rpc.EventLoopThread, gcs_addr) -> tuple[str, int]:
+    cfg = get_config()
+
+    async def find():
+        conn = await rpc.connect(*gcs_addr, timeout=cfg.rpc_connect_timeout_s)
+        try:
+            deadline = time.monotonic() + cfg.rpc_connect_timeout_s
+            while time.monotonic() < deadline:
+                cluster = await conn.call("get_cluster", {})
+                if cluster:
+                    return tuple(cluster[0]["address"])
+                import asyncio
+
+                await asyncio.sleep(0.05)
+            raise TimeoutError("no raylet registered with the GCS")
+        finally:
+            await conn.close()
+
+    return io.run(find())
+
+
+def shutdown() -> None:
+    global _core, _io, _owned_cluster
+    if _core is not None and _io is not None:
+        try:
+            _io.run(_core.close(), timeout=10)
+        except Exception:
+            pass
+    _core = None
+    if _owned_cluster is not None:
+        try:
+            _owned_cluster.shutdown()
+        except Exception:
+            pass
+        _owned_cluster = None
+    for p in _head_procs:
+        try:
+            p.terminate()
+        except Exception:
+            pass
+    for p in _head_procs:  # reap: no zombies, and raylets finish shm cleanup
+        try:
+            p.wait(timeout=5)
+        except Exception:
+            try:
+                p.kill()
+                p.wait(timeout=2)
+            except Exception:
+                pass
+    _head_procs.clear()
+    if _io is not None:
+        _io.stop()
+        _io = None
+
+
+# ---------------------------------------------------------------- data plane
+def put(value: Any) -> ObjectRef:
+    return get_core().put_value(value)
+
+
+def get(refs, timeout: float | None = None):
+    core = get_core()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
+    values = core._run_sync(core.get_async(ref_list, timeout), timeout=None)
+    return values[0] if single else values
+
+
+async def _async_get(ref: ObjectRef):
+    core = get_core()
+    values = await core.get_async([ref], None)
+    return values[0]
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+    fetch_local: bool = True,
+):
+    core = get_core()
+    refs = list(refs)
+    if num_returns > len(refs):
+        raise ValueError("num_returns > len(refs)")
+    return core._run_sync(core.wait_async(refs, num_returns, timeout, fetch_local))
+
+
+# ------------------------------------------------------------------- tasks
+class RemoteFunction:
+    """Handle produced by @remote on a function (ref: remote_function.py:41)."""
+
+    def __init__(self, fn, **default_opts):
+        self._fn = fn
+        self._opts = default_opts
+        functools.update_wrapper(self, fn)
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = {**self._opts, **opts}
+        return RemoteFunction(self._fn, **merged)
+
+    def remote(self, *args, **kwargs):
+        o = self._opts
+        resources = dict(o.get("resources") or {})
+        resources["CPU"] = float(o.get("num_cpus", 1.0))
+        if o.get("num_tpus"):
+            resources["TPU"] = float(o["num_tpus"])
+        pg = o.get("placement_group")
+        return get_core().submit_task(
+            self._fn,
+            args,
+            kwargs,
+            num_returns=o.get("num_returns", 1),
+            resources=resources,
+            max_retries=o.get("max_retries"),
+            placement_group=pg.id if isinstance(pg, PlacementGroup) else pg,
+            bundle_index=o.get("placement_group_bundle_index", -1),
+            scheduling_node=o.get("_scheduling_node"),
+            name=o.get("name"),
+        )
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            "remote functions cannot be called directly; use .remote() "
+            "or call the original function"
+        )
+
+
+class ActorClass:
+    """Handle produced by @remote on a class (ref: actor.py:708)."""
+
+    def __init__(self, cls, **default_opts):
+        self._cls = cls
+        self._opts = default_opts
+
+    def options(self, **opts) -> "ActorClass":
+        return ActorClass(self._cls, **{**self._opts, **opts})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        o = self._opts
+        pg = o.get("placement_group")
+        return get_core().create_actor(
+            self._cls,
+            args,
+            kwargs,
+            num_cpus=float(o.get("num_cpus", 1.0)),
+            resources=_actor_resources(o),
+            name=o.get("name"),
+            max_restarts=int(o.get("max_restarts", 0)),
+            max_concurrency=int(o.get("max_concurrency", 1)),
+            placement_group=pg.id if isinstance(pg, PlacementGroup) else pg,
+            bundle_index=o.get("placement_group_bundle_index", -1),
+            get_if_exists=bool(o.get("get_if_exists", False)),
+            lifetime=o.get("lifetime"),
+        )
+
+
+def _actor_resources(o: dict) -> dict:
+    resources = dict(o.get("resources") or {})
+    if o.get("num_tpus"):
+        resources["TPU"] = float(o["num_tpus"])
+    return resources
+
+
+def remote(*args, **options):
+    """@ray_tpu.remote decorator for functions and classes."""
+
+    def wrap(obj):
+        if isinstance(obj, type):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return wrap(args[0])
+    return wrap
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    get_core().kill_actor(actor.actor_id, no_restart=no_restart)
+
+
+def get_actor(name: str) -> ActorHandle:
+    handle = get_core().get_actor_by_name(name)
+    if handle is None:
+        raise ValueError(f"no actor named {name!r}")
+    return handle
+
+
+# --------------------------------------------------------- placement groups
+class PlacementGroup:
+    """(ref: python/ray/util/placement_group.py:42)"""
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: list[dict]):
+        self.id = pg_id
+        self.bundles = bundles
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        core = get_core()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = core._run_sync(core.gcs.call("get_placement_group", {"pg_id": self.id}))
+            if info and info["state"] == "CREATED":
+                return True
+            time.sleep(0.02)
+        return False
+
+    @property
+    def bundle_specs(self):
+        return self.bundles
+
+
+def placement_group(
+    bundles: list[dict[str, float]], strategy: str = "PACK", name: str = ""
+) -> PlacementGroup:
+    core = get_core()
+    pg_id = PlacementGroupID.generate()
+    core._run_sync(
+        core.gcs.call(
+            "create_placement_group",
+            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name},
+        )
+    )
+    return PlacementGroup(pg_id, bundles)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    core = get_core()
+    core._run_sync(core.gcs.call("remove_placement_group", {"pg_id": pg.id}))
+
+
+# ------------------------------------------------------------------ cluster
+def nodes() -> list[dict]:
+    core = get_core()
+    return core._run_sync(core.gcs.call("get_cluster", {}))
+
+
+def cluster_resources() -> dict[str, float]:
+    total: dict[str, float] = {}
+    for n in nodes():
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> dict[str, float]:
+    total: dict[str, float] = {}
+    for n in nodes():
+        for k, v in n["resources_available"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total

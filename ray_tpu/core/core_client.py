@@ -1,0 +1,778 @@
+"""Per-process runtime core shared by drivers and workers.
+
+Equivalent of the reference CoreWorker (ref: src/ray/core_worker/
+core_worker.h:166): owns the in-process memory store for inline objects
+(memory_store.h:45), the shm-store client for large ones
+(plasma_store_provider.h:93), lease-cached task submission
+(normal_task_submitter.cc — leases amortized per scheduling key),
+dependency resolution that inlines ready small args
+(dependency_resolver.cc), direct actor-task submission with per-caller
+ordering (actor_task_submitter.h:75), task retries + result tracking
+(task_manager.h:175), and the owner side of object resolution: every
+process serves ``get_object``/``wait_object`` for objects it owns.
+
+All async code runs on one event loop: the driver hosts it on a background
+thread (utils.rpc.EventLoopThread); workers run it as their main loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import os
+import sys
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+import pickle
+
+from ray_tpu.config import get_config
+from ray_tpu.core.object_store import SharedObjectStore
+from ray_tpu.core.ref import (
+    ActorError,
+    ActorHandle,
+    GetTimeoutError,
+    ObjectRef,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.utils import rpc, serialization
+from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+
+ALIVE = "ALIVE"
+DEAD = "DEAD"
+
+
+@dataclass
+class _MemEntry:
+    value: Any = None
+    packed: bytes | None = None
+    error: Exception | None = None
+    ready: asyncio.Event = field(default_factory=asyncio.Event)
+    in_shm: bool = False  # large result living in some node's shm store
+
+
+@dataclass
+class _LeasedWorker:
+    lease_id: int
+    address: tuple[str, int]
+    worker_id: str
+    raylet_address: tuple[str, int]
+    conn: rpc.Connection | None = None
+    busy: bool = False
+    idle_since: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _SchedulingKeyState:
+    """Per (func, resources) lease pool (ref: SchedulingKey in
+    normal_task_submitter.h — leases are cached and reused)."""
+
+    pending: asyncio.Queue = field(default_factory=asyncio.Queue)
+    workers: list[_LeasedWorker] = field(default_factory=list)
+    lease_request_inflight: bool = False
+    inflight_tasks: int = 0
+
+
+class CoreClient:
+    def __init__(self, loop: asyncio.AbstractEventLoop | None = None):
+        self.cfg = get_config()
+        self.loop = loop or asyncio.get_event_loop()
+        self.worker_id = WorkerID.generate()
+        self.job_id: JobID | None = None
+
+        self.gcs: rpc.Connection | None = None
+        self.raylet: rpc.Connection | None = None
+        self.raylet_address: tuple[str, int] | None = None
+        self.node_id: NodeID | None = None
+        self.store: SharedObjectStore | None = None
+        self.server = rpc.RpcServer("127.0.0.1", 0)
+        self.server.add_routes(self)
+        self.address: tuple[str, int] | None = None
+
+        self.memory_store: dict[ObjectID, _MemEntry] = {}
+        self.sched_keys: dict[tuple, _SchedulingKeyState] = {}
+        self._func_cache: dict[bytes, Any] = {}
+        self._registered_funcs: set[bytes] = set()
+        self._actor_info: dict[ActorID, dict] = {}
+        self._actor_conns: dict[ActorID, rpc.Connection] = {}
+        self._actor_conn_locks: dict[ActorID, asyncio.Lock] = {}
+        self._actor_queues: dict[ActorID, list] = {}
+        self._actor_pump_running: set[ActorID] = set()
+        self._conn_seq: dict[rpc.Connection, int] = {}
+        self._subscribed_actors: set[ActorID] = set()
+        self._task_counter = 0
+        self._closed = False
+
+    # ----------------------------------------------------------- bootstrap
+    async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
+        self.address = await self.server.start()
+        self.gcs = await rpc.connect(*gcs_address, timeout=self.cfg.rpc_connect_timeout_s)
+        self.gcs.on_message = self._on_push
+        self.raylet = await rpc.connect(*raylet_address, timeout=self.cfg.rpc_connect_timeout_s)
+        self.raylet_address = raylet_address
+        info = await self.raylet.call("register_client", {})
+        self.node_id = info["node_id"]
+        self.store = SharedObjectStore(info["store_name"])
+        self.job_id = await self.gcs.call("register_job", {})
+
+    # -------------------------------------------------------------- pubsub
+    def _on_push(self, msg):
+        if msg.get("m") != "pubsub":
+            return
+        channel = msg["p"]["channel"]
+        message = msg["p"]["message"]
+        if channel.startswith("actor:"):
+            actor_id = ActorID.from_hex(channel.split(":", 1)[1])
+            self._actor_info[actor_id] = message
+
+    # ----------------------------------------------------------- ownership
+    def on_owned_ref_deleted(self, oid: ObjectID):
+        """Called from ObjectRef.__del__ on the owner: drop the local value.
+        (Round-1 GC: owner-local release; distributed borrow counting is a
+        later-round refinement — shm copies remain until LRU eviction.)"""
+        if self._closed:
+            return
+        try:
+            self.loop.call_soon_threadsafe(self._free_object, oid)
+        except RuntimeError:
+            pass
+
+    def _free_object(self, oid: ObjectID):
+        self.memory_store.pop(oid, None)
+
+    # ----------------------------------------------------------------- put
+    def put_value(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        meta, buffers = serialization.dumps_with_buffers(value)
+        size = serialization.total_size(meta, buffers)
+        entry = _MemEntry()
+        if size <= self.cfg.max_inline_object_size:
+            entry.packed = _pack_bytes(meta, buffers, size)
+            self.memory_store[oid] = entry
+            entry.ready.set()
+        else:
+            buf = self.store.create(oid, size)
+            serialization.pack_into(meta, buffers, buf)
+            self.store.seal(oid)
+            entry.in_shm = True
+            self.memory_store[oid] = entry
+            entry.ready.set()
+            self.loop.create_task(self._register_location(oid))
+        return ObjectRef(oid, self.address, _core=self)
+
+    async def _register_location(self, oid: ObjectID):
+        holders = {self.node_id.binary()}
+        await self.gcs.call(
+            "kv_put", {"ns": "obj_loc", "key": oid.hex(), "value": pickle.dumps(holders)}
+        )
+
+    # ----------------------------------------------------------------- get
+    async def get_async(self, refs: list[ObjectRef], timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            out.append(await self._get_one(ref, deadline))
+        return out
+
+    async def _get_one(self, ref: ObjectRef, deadline: float | None):
+        oid = ref.id
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"get timed out on {ref}")
+            entry = self.memory_store.get(oid)
+            if entry is not None and entry.ready.is_set():
+                if entry.error is not None:
+                    raise entry.error
+                if not entry.in_shm:
+                    if entry.packed is not None:
+                        return serialization.unpack(entry.packed)
+                    return entry.value
+                # owned shm result — may live on the executing node's store
+                # (spillback): fall through to the shm/pull path below
+            if self.store.contains(oid):
+                return await self.loop.run_in_executor(None, self.store.get, oid, 10_000)
+            if entry is not None:
+                if entry.ready.is_set():  # owned, in_shm, not local: pull it
+                    ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+                    if not ok:
+                        await asyncio.sleep(0.05)
+                    continue
+                # owned, pending task result
+                await _wait_event(entry.ready, remaining)
+                continue
+            # borrowed ref: ask the owner
+            if ref.owner_address is None or tuple(ref.owner_address) == self.address:
+                await asyncio.sleep(0.01)
+                continue
+            try:
+                reply = await self._owner_call(
+                    ref, "get_object", {"object_id": oid.binary()}, remaining
+                )
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"get timed out on {ref}") from None
+            if reply.get("error") is not None:
+                raise reply["error"]
+            if reply.get("inline") is not None:
+                return serialization.unpack(reply["inline"])
+            # large object: pull into local shm through our raylet
+            ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+            if not ok:
+                await asyncio.sleep(0.05)
+                continue
+
+    async def _owner_call(self, ref: ObjectRef, method: str, payload: dict,
+                          timeout: float | None):
+        conn = await rpc.connect(*ref.owner_address, timeout=self.cfg.rpc_connect_timeout_s)
+        try:
+            return await conn.call(method, payload, timeout=timeout)
+        finally:
+            await conn.close()
+
+    # ---------------------------------------------------------------- wait
+    async def wait_async(self, refs, num_returns, timeout, fetch_local=True):
+        pending = list(refs)
+        ready: list = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        async def is_ready(ref) -> bool:
+            entry = self.memory_store.get(ref.id)
+            if entry is not None:
+                return entry.ready.is_set()
+            if self.store.contains(ref.id):
+                return True
+            if ref.owner_address and tuple(ref.owner_address) != self.address:
+                try:
+                    r = await self._owner_call(
+                        ref, "probe_object", {"object_id": ref.id.binary()}, 5.0
+                    )
+                    if r and fetch_local:
+                        # start moving the payload to this node in the
+                        # background (ref: ray.wait fetch_local semantics)
+                        self.loop.create_task(
+                            self.raylet.call("pull_object", {"object_id": ref.id.binary()})
+                        )
+                    return bool(r)
+                except Exception:
+                    return False
+            return False
+
+        while True:
+            still = []
+            for ref in pending:
+                if len(ready) < num_returns and await is_ready(ref):
+                    ready.append(ref)
+                else:
+                    still.append(ref)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                return ready, pending
+            if deadline is not None and time.monotonic() >= deadline:
+                return ready, pending
+            await asyncio.sleep(0.005)
+
+    # -------------------------------------------- owner-side object service
+    async def rpc_get_object(self, conn, p):
+        oid = ObjectID(p["object_id"])
+        entry = self.memory_store.get(oid)
+        if entry is None:
+            if self.store is not None and self.store.contains(oid):
+                return {"shm": True}
+            return {"error": TaskError(f"object {oid} unknown to owner (freed?)")}
+        await entry.ready.wait()
+        if entry.error is not None:
+            return {"error": entry.error}
+        if entry.in_shm:
+            return {"shm": True}
+        if entry.packed is not None:
+            return {"inline": entry.packed}
+        meta, buffers = serialization.dumps_with_buffers(entry.value)
+        return {"inline": _pack_bytes(meta, buffers, serialization.total_size(meta, buffers))}
+
+    async def rpc_probe_object(self, conn, p):
+        oid = ObjectID(p["object_id"])
+        entry = self.memory_store.get(oid)
+        if entry is not None:
+            return entry.ready.is_set()
+        return self.store is not None and self.store.contains(oid)
+
+    # ------------------------------------------------------ task submission
+    def _register_function(self, fn) -> bytes:
+        """Export the function blob to the GCS function table once
+        (ref: remote_function.py pickled-function export). Registration is
+        fire-and-forget: executors retry the table fetch briefly, so a task
+        can never race ahead of its own function blob for long."""
+        cached = getattr(fn, "__rt_func_id__", None)
+        if cached is not None and cached in self._registered_funcs:
+            return cached
+        blob = cloudpickle.dumps(fn)
+        func_id = hashlib.sha1(blob).digest()
+        if func_id not in self._registered_funcs:
+            self._call_on_loop(
+                self.gcs.call(
+                    "kv_put",
+                    {"ns": "funcs", "key": func_id.hex(), "value": blob, "overwrite": False},
+                )
+            )
+            self._registered_funcs.add(func_id)
+        try:
+            fn.__rt_func_id__ = func_id
+        except (AttributeError, TypeError):
+            pass
+        return func_id
+
+    def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
+                    max_retries=None, placement_group=None, bundle_index=-1,
+                    scheduling_node=None, name=None) -> list[ObjectRef] | ObjectRef:
+        """Synchronous entry (driver thread) or loop-thread entry (nested)."""
+        func_id = self._register_function(fn)
+        self._task_counter += 1
+        task_id = TaskID.generate()
+        resources = dict(resources or {"CPU": 1.0})
+        spec = {
+            "task_id": task_id,
+            "name": name or getattr(fn, "__name__", "task"),
+            "func_id": func_id,
+            "args": args,
+            "kwargs": kwargs,
+            "num_returns": num_returns,
+            "resources": resources,
+            "owner_address": self.address,
+            "max_retries": self.cfg.default_max_task_retries if max_retries is None else max_retries,
+            "placement_group": placement_group,
+            "bundle_index": bundle_index,
+            "scheduling_node": scheduling_node,
+        }
+        refs = []
+        for i in range(num_returns):
+            roid = ObjectID.for_task_return(task_id, i)
+            self.memory_store[roid] = _MemEntry()
+            refs.append(ObjectRef(roid, self.address, _core=self))
+        self._call_on_loop(self._submit_async(spec))
+        return refs[0] if num_returns == 1 else refs
+
+    def _call_on_loop(self, coro):
+        if _in_loop(self.loop):
+            self.loop.create_task(coro)
+        else:
+            asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    async def _submit_async(self, spec: dict):
+        try:
+            spec["args"] = await self._resolve_args(spec["args"])
+            spec["kwargs"] = dict(
+                zip(spec["kwargs"].keys(), await self._resolve_args(list(spec["kwargs"].values())))
+            )
+        except Exception as e:
+            self._complete_task_error(spec, e)
+            return
+        key = (
+            spec["func_id"],
+            tuple(sorted(spec["resources"].items())),
+            spec.get("placement_group") and spec["placement_group"].hex(),
+            spec.get("bundle_index"),
+            spec.get("scheduling_node"),
+        )
+        state = self.sched_keys.setdefault(key, _SchedulingKeyState())
+        state.inflight_tasks += 1
+        await state.pending.put(spec)
+        await self._pump(key, state)
+
+    async def _resolve_args(self, args):
+        """Dependency resolution (ref: dependency_resolver.cc): owned inline
+        args become values; everything else ships as a ref descriptor the
+        executor fetches."""
+        out = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                entry = self.memory_store.get(a.id)
+                if entry is not None:
+                    await entry.ready.wait()
+                    if entry.error is not None:
+                        raise entry.error
+                    if not entry.in_shm:
+                        packed = entry.packed
+                        if packed is None:
+                            meta, bufs = serialization.dumps_with_buffers(entry.value)
+                            packed = _pack_bytes(meta, bufs, serialization.total_size(meta, bufs))
+                        out.append(("v", packed))
+                        continue
+                out.append(("r", a.id.binary(), a.owner_address))
+            else:
+                # pack through our serializer (cloudpickle fallback, jax/numpy
+                # out-of-band) — the raw rpc frame uses plain pickle which
+                # would choke on closures/jax values
+                out.append(("v", serialization.pack(a)))
+        return out
+
+    async def _pump(self, key, state: _SchedulingKeyState):
+        """Dispatch pending tasks onto free leased workers; grow leases."""
+        # hand tasks to free workers
+        free = [w for w in state.workers if not w.busy]
+        while free and not state.pending.empty():
+            w = free.pop()
+            spec = state.pending.get_nowait()
+            w.busy = True
+            self.loop.create_task(self._run_on_worker(key, state, w, spec))
+        if not state.pending.empty() and not state.lease_request_inflight:
+            state.lease_request_inflight = True
+            self.loop.create_task(self._request_lease(key, state))
+
+    async def _request_lease(self, key, state: _SchedulingKeyState):
+        try:
+            resources = dict(key[1])
+            pg_hex = key[2]
+            payload = {
+                "resources": resources,
+                "pg_id": None,
+                "bundle_index": key[3],
+            }
+            if pg_hex:
+                from ray_tpu.utils.ids import PlacementGroupID
+
+                payload["pg_id"] = PlacementGroupID.from_hex(pg_hex)
+            raylet_addr = self.raylet_address
+            target_node = key[4]
+            if target_node is not None:
+                payload["no_spill"] = True
+                raylet_addr = tuple(target_node)
+            for _ in range(16):  # follow spillback chain
+                conn = (
+                    self.raylet
+                    if tuple(raylet_addr) == tuple(self.raylet_address)
+                    else await rpc.connect(*raylet_addr)
+                )
+                try:
+                    reply = await conn.call("lease_worker", payload)
+                finally:
+                    if conn is not self.raylet:
+                        await conn.close()
+                if reply.get("granted"):
+                    w = _LeasedWorker(
+                        lease_id=reply["lease_id"],
+                        address=tuple(reply["worker_address"]),
+                        worker_id=reply["worker_id"],
+                        raylet_address=tuple(raylet_addr),
+                    )
+                    w.conn = await rpc.connect(*w.address)
+                    state.workers.append(w)
+                    break
+                raylet_addr = tuple(reply["spill_to"])
+        except Exception:
+            traceback.print_exc()
+        finally:
+            state.lease_request_inflight = False
+            await self._pump(key, state)
+
+    async def _run_on_worker(self, key, state, w: _LeasedWorker, spec: dict):
+        try:
+            reply = await w.conn.call("push_task", {"spec": spec})
+        except rpc.ConnectionLost:
+            await self._on_worker_lost(key, state, w, spec)
+            return
+        except Exception as e:
+            # e.g. an unpicklable task spec: fail the task, free the worker
+            self._complete_task_error(spec, e)
+            state.inflight_tasks -= 1
+            w.busy = False
+            w.idle_since = time.monotonic()
+            await self._pump(key, state)
+            return
+        self._apply_task_reply(spec, reply)
+        state.inflight_tasks -= 1
+        w.busy = False
+        w.idle_since = time.monotonic()
+        await self._pump(key, state)
+        self.loop.create_task(self._maybe_return_lease(key, state, w))
+
+    def _apply_task_reply(self, spec, reply):
+        task_id = spec["task_id"]
+        if reply.get("error") is not None:
+            self._complete_task_error(spec, reply["error"])
+            return
+        for i, result in enumerate(reply["results"]):
+            oid = ObjectID.for_task_return(task_id, i)
+            entry = self.memory_store.get(oid)
+            if entry is None:
+                continue
+            if result.get("inline") is not None:
+                entry.packed = result["inline"]
+            else:
+                entry.in_shm = True
+            entry.ready.set()
+
+    def _complete_task_error(self, spec, error):
+        if not isinstance(error, Exception):
+            error = TaskError(str(error))
+        for i in range(spec["num_returns"]):
+            oid = ObjectID.for_task_return(spec["task_id"], i)
+            entry = self.memory_store.get(oid)
+            if entry is not None:
+                entry.error = error
+                entry.ready.set()
+
+    async def _on_worker_lost(self, key, state, w, spec):
+        """Retry on worker death (ref: task_manager.h retries)."""
+        if w in state.workers:
+            state.workers.remove(w)
+        spec["max_retries"] = spec.get("max_retries", 0) - 1
+        if spec["max_retries"] >= 0:
+            await state.pending.put(spec)
+        else:
+            self._complete_task_error(spec, WorkerCrashedError())
+            state.inflight_tasks -= 1
+        await self._pump(key, state)
+
+    async def _maybe_return_lease(self, key, state: _SchedulingKeyState, w: _LeasedWorker):
+        await asyncio.sleep(self.cfg.worker_lease_timeout_s)
+        if w.busy or w not in state.workers:
+            return
+        if time.monotonic() - w.idle_since < self.cfg.worker_lease_timeout_s * 0.9:
+            return
+        state.workers.remove(w)
+        try:
+            if w.conn is not None:
+                await w.conn.close()
+            conn = (
+                self.raylet
+                if tuple(w.raylet_address) == tuple(self.raylet_address)
+                else await rpc.connect(*w.raylet_address)
+            )
+            try:
+                await conn.call("return_lease", {"lease_id": w.lease_id})
+            finally:
+                if conn is not self.raylet:
+                    await conn.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, *, num_cpus=1.0, resources=None,
+                     name=None, max_restarts=0, max_concurrency=1,
+                     placement_group=None, bundle_index=-1, get_if_exists=False,
+                     lifetime=None) -> ActorHandle:
+        actor_id = ActorID.generate()
+        class_blob = cloudpickle.dumps(cls)
+        res = dict(resources or {})
+        res.setdefault("CPU", num_cpus)
+        spec = {
+            "actor_id": actor_id,
+            "name": name,
+            "class_blob": class_blob,
+            "args": args,
+            "kwargs": kwargs,
+            "resources": res,
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "placement_group": placement_group,
+            "bundle_index": bundle_index,
+            "owner_address": self.address,
+            "get_if_exists": get_if_exists,
+            "lifetime": lifetime,
+        }
+
+        async def _register():
+            spec["args"] = await self._resolve_args(spec["args"])
+            spec["kwargs"] = dict(
+                zip(
+                    spec["kwargs"].keys(),
+                    await self._resolve_args(list(spec["kwargs"].values())),
+                )
+            )
+            view = await self.gcs.call("register_actor", {"spec": spec})
+            self._actor_info[view["actor_id"]] = view
+            return view
+
+        view = self._run_sync(_register())
+        return ActorHandle(view["actor_id"], core=self)
+
+    def submit_actor_task(self, handle: ActorHandle, method: str, args, kwargs,
+                          num_returns=1) -> ObjectRef | list[ObjectRef]:
+        """Submission order is fixed here (sync, caller thread); a per-actor
+        pump coroutine then resolves deps, assigns per-connection sequence
+        numbers and pipelines pushes — the reference's ActorTaskSubmitter
+        shape (ref: actor_task_submitter.h:75, ordered sends + out-of-order
+        replies)."""
+        task_id = TaskID.generate()
+        actor_id = handle.actor_id
+        refs = []
+        for i in range(num_returns):
+            roid = ObjectID.for_task_return(task_id, i)
+            self.memory_store[roid] = _MemEntry()
+            refs.append(ObjectRef(roid, self.address, _core=self))
+        spec = {
+            "task_id": task_id,
+            "actor_id": actor_id,
+            "method": method,
+            "args": args,
+            "kwargs": kwargs,
+            "num_returns": num_returns,
+            "owner_address": self.address,
+            "seq": None,
+        }
+        q = self._actor_queues.setdefault(actor_id, [])
+        q.append(spec)
+        self._call_on_loop(self._ensure_actor_pump(actor_id))
+        return refs[0] if num_returns == 1 else refs
+
+    async def _ensure_actor_pump(self, actor_id: ActorID):
+        if actor_id in self._actor_pump_running:
+            return
+        self._actor_pump_running.add(actor_id)
+        try:
+            q = self._actor_queues.get(actor_id, [])
+            while q:
+                spec = q.pop(0)
+                await self._dispatch_actor_task(spec)
+        finally:
+            self._actor_pump_running.discard(actor_id)
+
+    async def _dispatch_actor_task(self, spec):
+        try:
+            spec["args"] = await self._resolve_args(spec["args"])
+            spec["kwargs"] = dict(
+                zip(spec["kwargs"].keys(), await self._resolve_args(list(spec["kwargs"].values())))
+            )
+            conn = await self._actor_connection(spec["actor_id"])
+            seq = self._conn_seq.get(conn, 0)
+            self._conn_seq[conn] = seq + 1
+            spec["seq"] = seq
+            # pipelined: don't await the reply here, keep the pump moving
+            self.loop.create_task(self._await_actor_reply(conn, spec))
+        except Exception as e:
+            self._complete_task_error(spec, e)
+
+    async def _await_actor_reply(self, conn, spec):
+        try:
+            reply = await conn.call("push_actor_task", {"spec": spec})
+            self._apply_task_reply(spec, reply)
+        except rpc.ConnectionLost:
+            if self._actor_conns.get(spec["actor_id"]) is conn:
+                self._actor_conns.pop(spec["actor_id"], None)
+            self._conn_seq.pop(conn, None)
+            info = await self._refresh_actor(spec["actor_id"])
+            if info and info.get("state") in (ALIVE, "RESTARTING", "PENDING_CREATION"):
+                spec["seq"] = None  # ordering lost across reconnect: send unordered
+                await self._await_actor_reply_retry(spec)
+            else:
+                cause = (info or {}).get("death_cause") or "actor connection lost"
+                self._complete_task_error(spec, ActorError(cause))
+        except Exception as e:
+            self._complete_task_error(spec, e)
+
+    async def _await_actor_reply_retry(self, spec):
+        try:
+            conn = await self._actor_connection(spec["actor_id"])
+            reply = await conn.call("push_actor_task", {"spec": spec})
+            self._apply_task_reply(spec, reply)
+        except Exception as e:
+            if isinstance(e, rpc.ConnectionLost):
+                e = ActorError("actor connection lost during retry")
+            self._complete_task_error(spec, e)
+
+    async def _actor_connection(self, actor_id: ActorID) -> rpc.Connection:
+        lock = self._actor_conn_locks.setdefault(actor_id, asyncio.Lock())
+        async with lock:
+            return await self._actor_connection_locked(actor_id)
+
+    async def _actor_connection_locked(self, actor_id: ActorID) -> rpc.Connection:
+        conn = self._actor_conns.get(actor_id)
+        if conn is not None and not conn._closed:
+            return conn
+        info = self._actor_info.get(actor_id)
+        deadline = time.monotonic() + self.cfg.worker_start_timeout_s
+        while True:
+            if info is not None:
+                if info.get("state") == DEAD:
+                    raise ActorError(info.get("death_cause") or "actor is dead")
+                if info.get("state") == ALIVE and info.get("address"):
+                    break
+            if time.monotonic() > deadline:
+                raise ActorError(f"actor {actor_id} not available in time")
+            if actor_id not in self._subscribed_actors:
+                self._subscribed_actors.add(actor_id)
+                await self.gcs.call("subscribe", {"channel": f"actor:{actor_id.hex()}"})
+            info = await self._refresh_actor(actor_id)
+            if not (info and info.get("state") == ALIVE and info.get("address")):
+                await asyncio.sleep(0.05)
+                info = self._actor_info.get(actor_id)
+        conn = await rpc.connect(*info["address"])
+        self._actor_conns[actor_id] = conn
+        return conn
+
+    async def _refresh_actor(self, actor_id: ActorID):
+        info = await self.gcs.call("get_actor", {"actor_id": actor_id})
+        if info is not None:
+            self._actor_info[actor_id] = info
+        return info
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self._run_sync(self.gcs.call("kill_actor", {"actor_id": actor_id,
+                                                    "no_restart": no_restart}))
+
+    def get_actor_by_name(self, name: str) -> ActorHandle | None:
+        info = self._run_sync(self.gcs.call("get_actor", {"name": name}))
+        if info is None or info.get("state") == DEAD:
+            return None
+        self._actor_info[info["actor_id"]] = info
+        return ActorHandle(info["actor_id"], core=self)
+
+    # ------------------------------------------------------------ helpers
+    def _run_sync(self, coro, timeout=None):
+        if _in_loop(self.loop):
+            raise RuntimeError("sync call from loop thread")
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    async def close(self):
+        self._closed = True
+        # return all leases
+        for key, state in self.sched_keys.items():
+            for w in state.workers:
+                try:
+                    if w.conn:
+                        await w.conn.close()
+                    conn = await rpc.connect(*w.raylet_address, timeout=2)
+                    await conn.call("return_lease", {"lease_id": w.lease_id})
+                    await conn.close()
+                except Exception:
+                    pass
+        for conn in self._actor_conns.values():
+            await conn.close()
+        await self.server.stop()
+        if self.gcs:
+            await self.gcs.close()
+        if self.raylet:
+            await self.raylet.close()
+        if self.store:
+            self.store.close()
+
+
+def _pack_bytes(meta, buffers, size) -> bytes:
+    out = bytearray(size)
+    serialization.pack_into(meta, buffers, memoryview(out))
+    return bytes(out)
+
+
+def _in_loop(loop) -> bool:
+    try:
+        return asyncio.get_running_loop() is loop
+    except RuntimeError:
+        return False
+
+
+async def _wait_event(event: asyncio.Event, timeout: float | None):
+    if timeout is None:
+        await event.wait()
+    else:
+        try:
+            await asyncio.wait_for(event.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass

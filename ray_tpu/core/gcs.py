@@ -1,0 +1,566 @@
+"""Global Control Service: cluster metadata authority.
+
+TPU-native equivalent of the reference GCS server (ref:
+src/ray/gcs/gcs_server/gcs_server.h:90) — node registry + health checks
+(gcs_health_check_manager.h:45), actor manager + scheduler
+(gcs_actor_manager.h:329, gcs_actor_scheduler.h), placement groups with
+two-phase bundle reservation (gcs_placement_group_mgr.h:232,
+LeaseStatusTracker gcs_placement_group_scheduler.h:133), internal KV
+(gcs_kv_manager.h:34), long-poll-free push pubsub (src/ray/pubsub/
+publisher.h:300), and the function table the workers fetch code from.
+
+Runs as its own process (``python -m ray_tpu.core.gcs``); all state is
+in-memory (the reference's default) — a Redis-style persistence backend can
+slot behind the table dicts for GCS fault tolerance in a later iteration.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.config import get_config
+from ray_tpu.utils import rpc
+from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+# actor lifecycle states (ref: gcs.proto ActorTableData.ActorState)
+PENDING = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: tuple[str, int]  # raylet rpc address
+    store_name: str
+    resources_total: dict[str, float]
+    resources_available: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "address": self.address,
+            "store_name": self.store_name,
+            "resources_total": dict(self.resources_total),
+            "resources_available": dict(self.resources_available),
+            "labels": dict(self.labels),
+            "alive": self.alive,
+        }
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: str | None
+    state: str
+    spec: dict  # creation spec (class bytes ref, args, resources, options)
+    address: tuple[str, int] | None = None
+    node_id: NodeID | None = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str | None = None
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "name": self.name,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+        }
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: PlacementGroupID
+    bundles: list[dict[str, float]]
+    strategy: str
+    state: str  # PENDING / CREATED / REMOVED
+    bundle_nodes: list[NodeID] = field(default_factory=list)
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.cfg = get_config()
+        self.server = rpc.RpcServer(host, port)
+        self.server.add_routes(self)
+        self.server.on_disconnect = self._on_disconnect
+
+        self.kv: dict[str, dict[str, bytes]] = {}
+        self.nodes: dict[NodeID, NodeInfo] = {}
+        self.actors: dict[ActorID, ActorInfo] = {}
+        self.named_actors: dict[str, ActorID] = {}
+        self.pgs: dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.job_counter = 0
+        self.task_events: list[dict] = []  # ring buffer of task lifecycle events
+
+        # pubsub: channel -> {Connection}
+        self.subs: dict[str, set[rpc.Connection]] = {}
+        # connections that are raylets (for health/cleanup): conn -> node_id
+        self.raylet_conns: dict[rpc.Connection, NodeID] = {}
+        # actor worker connections for cleanup: conn -> actor_ids
+        self._stopping = False
+
+    # ------------------------------------------------------------------ pubsub
+    async def publish(self, channel: str, message: Any):
+        dead = []
+        for conn in self.subs.get(channel, ()):  # push-based: no long-poll
+            try:
+                await conn.notify("pubsub", {"channel": channel, "message": message})
+            except Exception:
+                dead.append(conn)
+        for conn in dead:
+            self.subs.get(channel, set()).discard(conn)
+
+    async def rpc_subscribe(self, conn, p):
+        self.subs.setdefault(p["channel"], set()).add(conn)
+        return True
+
+    # ---------------------------------------------------------------------- kv
+    async def rpc_kv_put(self, conn, p):
+        ns = self.kv.setdefault(p.get("ns", ""), {})
+        exists = p["key"] in ns
+        if exists and not p.get("overwrite", True):
+            return False
+        ns[p["key"]] = p["value"]
+        return True
+
+    async def rpc_kv_get(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+
+    async def rpc_kv_multi_get(self, conn, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        return {k: ns.get(k) for k in p["keys"]}
+
+    async def rpc_kv_del(self, conn, p):
+        return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
+
+    async def rpc_kv_exists(self, conn, p):
+        return p["key"] in self.kv.get(p.get("ns", ""), {})
+
+    async def rpc_kv_keys(self, conn, p):
+        ns = self.kv.get(p.get("ns", ""), {})
+        prefix = p.get("prefix", "")
+        return [k for k in ns if k.startswith(prefix)]
+
+    # -------------------------------------------------------------------- jobs
+    async def rpc_register_job(self, conn, p):
+        self.job_counter += 1
+        return JobID(self.job_counter.to_bytes(4, "little"))
+
+    # ------------------------------------------------------------------- nodes
+    async def rpc_register_node(self, conn, p):
+        info = NodeInfo(
+            node_id=p["node_id"],
+            address=tuple(p["address"]),
+            store_name=p["store_name"],
+            resources_total=dict(p["resources"]),
+            resources_available=dict(p["resources"]),
+            labels=p.get("labels", {}),
+        )
+        self.nodes[info.node_id] = info
+        self.raylet_conns[conn] = info.node_id
+        await self.publish("nodes", {"event": "added", "node": info.view()})
+        return {"node_id": info.node_id, "cluster": self.cluster_view()}
+
+    async def rpc_heartbeat(self, conn, p):
+        info = self.nodes.get(p["node_id"])
+        if info is None:
+            return {"ok": False}
+        info.last_heartbeat = time.monotonic()
+        if p.get("resources_available") is not None:
+            changed = info.resources_available != p["resources_available"]
+            info.resources_available = dict(p["resources_available"])
+            if changed:
+                # resource-view gossip to all raylets (the RaySyncer role,
+                # ref: ray_syncer.h:83) so spillback decisions stay fresh
+                await self.publish("nodes", {"event": "updated", "node": info.view()})
+        return {"ok": True}
+
+    async def rpc_get_cluster(self, conn, p):
+        return self.cluster_view()
+
+    def cluster_view(self) -> list[dict]:
+        return [n.view() for n in self.nodes.values() if n.alive]
+
+    async def rpc_drain_node(self, conn, p):
+        await self._mark_node_dead(p["node_id"], "drained")
+        return True
+
+    async def _mark_node_dead(self, node_id: NodeID, cause: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        await self.publish("nodes", {"event": "removed", "node_id": node_id, "cause": cause})
+        # fail actors living on that node (ref: gcs_actor_manager.cc OnNodeDead)
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING):
+                await self._on_actor_failure(actor, f"node {node_id} died: {cause}")
+
+    # ------------------------------------------------------------------ actors
+    async def rpc_register_actor(self, conn, p):
+        spec = p["spec"]
+        actor_id = spec["actor_id"]
+        name = spec.get("name")
+        if name:
+            if name in self.named_actors:
+                existing = self.actors.get(self.named_actors[name])
+                if existing is not None and existing.state != DEAD:
+                    if spec.get("get_if_exists"):
+                        return existing.view()
+                    raise ValueError(f"actor name {name!r} already taken")
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            state=PENDING,
+            spec=spec,
+            max_restarts=spec.get("max_restarts", 0),
+        )
+        self.actors[actor_id] = info
+        if name:
+            self.named_actors[name] = actor_id
+        asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        return info.view()
+
+    async def _schedule_actor(self, info: ActorInfo):
+        """GCS-side actor scheduling (ref: gcs_actor_scheduler.h): lease a
+        worker from a raylet chosen by resource fit, then push the creation
+        task to that worker directly."""
+        try:
+            resources = info.spec.get("resources", {"CPU": 1.0})
+            pg_id = info.spec.get("placement_group")
+            bundle_index = info.spec.get("bundle_index", -1)
+            deadline = time.monotonic() + self.cfg.worker_start_timeout_s
+            while True:
+                node = self._pick_node(resources, pg_id, bundle_index)
+                if node is not None:
+                    break
+                if time.monotonic() > deadline:
+                    info.state = DEAD
+                    info.death_cause = f"no node can host actor resources {resources}"
+                    await self.publish("actors", info.view())
+                    return
+                await asyncio.sleep(0.1)
+
+            conn = await rpc.connect(*node.address)
+            try:
+                lease = await conn.call(
+                    "lease_worker",
+                    {"resources": resources, "for_actor": info.actor_id,
+                     "pg_id": pg_id, "bundle_index": bundle_index},
+                    timeout=self.cfg.worker_start_timeout_s,
+                )
+            finally:
+                await conn.close()
+            if not lease.get("granted"):
+                # retry scheduling (resources raced away)
+                await asyncio.sleep(0.05)
+                asyncio.get_running_loop().create_task(self._schedule_actor(info))
+                return
+
+            worker_addr = tuple(lease["worker_address"])
+            wconn = await rpc.connect(*worker_addr)
+            try:
+                await wconn.call(
+                    "create_actor", {"spec": info.spec}, timeout=self.cfg.worker_start_timeout_s
+                )
+            finally:
+                await wconn.close()
+            info.state = ALIVE
+            info.address = worker_addr
+            info.node_id = node.node_id
+            await self.publish("actors", info.view())
+            await self.publish(f"actor:{info.actor_id.hex()}", info.view())
+        except Exception as e:  # scheduling failed terminally
+            info.state = DEAD
+            info.death_cause = f"actor creation failed: {e!r}"
+            await self.publish("actors", info.view())
+            await self.publish(f"actor:{info.actor_id.hex()}", info.view())
+
+    def _pick_node(self, resources, pg_id=None, bundle_index=-1) -> NodeInfo | None:
+        if pg_id is not None:
+            pg = self.pgs.get(pg_id)
+            if pg is None or pg.state != "CREATED":
+                return None
+            candidates = (
+                [pg.bundle_nodes[bundle_index]]
+                if bundle_index >= 0
+                else list(dict.fromkeys(pg.bundle_nodes))
+            )
+            for nid in candidates:
+                node = self.nodes.get(nid)
+                if node and node.alive and _fits(resources, node.resources_available):
+                    return node
+            return None
+        best, best_score = None, -1.0
+        for node in self.nodes.values():
+            if not node.alive or not _fits(resources, node.resources_available):
+                continue
+            # least-loaded: prefer the node with most free capacity left
+            free = sum(node.resources_available.values())
+            if free > best_score:
+                best, best_score = node, free
+        return best
+
+    async def rpc_get_actor(self, conn, p):
+        actor_id = p.get("actor_id")
+        if actor_id is None:
+            actor_id = self.named_actors.get(p["name"])
+            if actor_id is None:
+                return None
+        info = self.actors.get(actor_id)
+        return info.view() if info else None
+
+    async def rpc_list_actors(self, conn, p):
+        return [a.view() for a in self.actors.values()]
+
+    async def rpc_report_actor_death(self, conn, p):
+        info = self.actors.get(p["actor_id"])
+        if info is not None and info.state != DEAD:
+            await self._on_actor_failure(info, p.get("cause", "actor process died"))
+        return True
+
+    async def rpc_kill_actor(self, conn, p):
+        info = self.actors.get(p["actor_id"])
+        if info is None:
+            return False
+        info.max_restarts = 0  # explicit kill never restarts
+        if info.address is not None:
+            try:
+                wconn = await rpc.connect(*info.address, timeout=2)
+                await wconn.notify("exit_worker", {"force": not p.get("no_restart", False)})
+                await wconn.close()
+            except Exception:
+                pass
+        await self._on_actor_failure(info, "killed via kill_actor")
+        return True
+
+    async def _on_actor_failure(self, info: ActorInfo, cause: str):
+        if info.num_restarts < info.max_restarts:
+            info.num_restarts += 1
+            info.state = RESTARTING
+            info.address = None
+            info.node_id = None
+            await self.publish("actors", info.view())
+            await self.publish(f"actor:{info.actor_id.hex()}", info.view())
+            asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        else:
+            info.state = DEAD
+            info.death_cause = cause
+            info.address = None
+            await self.publish("actors", info.view())
+            await self.publish(f"actor:{info.actor_id.hex()}", info.view())
+            if info.name and self.named_actors.get(info.name) == info.actor_id:
+                del self.named_actors[info.name]
+
+    # -------------------------------------------------------- placement groups
+    async def rpc_create_placement_group(self, conn, p):
+        """Two-phase bundle reservation across raylets (ref:
+        gcs_placement_group_scheduler.h:288 prepare/commit protocol)."""
+        pg_id = p["pg_id"]
+        bundles = p["bundles"]
+        strategy = p.get("strategy", "PACK")
+        pg = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy, state="PENDING")
+        self.pgs[pg_id] = pg
+
+        assignment = self._place_bundles(bundles, strategy)
+        if assignment is None:
+            pg.state = "PENDING"  # infeasible now; caller may wait/retry
+            return {"state": "INFEASIBLE"}
+
+        # phase 1: prepare all reservations
+        prepared: list[tuple[NodeInfo, int]] = []
+        ok = True
+        for bundle_index, node in enumerate(assignment):
+            try:
+                c = await rpc.connect(*node.address)
+                r = await c.call(
+                    "prepare_bundle",
+                    {"pg_id": pg_id, "bundle_index": bundle_index,
+                     "resources": bundles[bundle_index]},
+                )
+                await c.close()
+                if not r.get("ok"):
+                    ok = False
+                    break
+                prepared.append((node, bundle_index))
+            except Exception:
+                ok = False
+                break
+        if not ok:  # rollback
+            for node, bundle_index in prepared:
+                try:
+                    c = await rpc.connect(*node.address)
+                    await c.call("return_bundle", {"pg_id": pg_id, "bundle_index": bundle_index})
+                    await c.close()
+                except Exception:
+                    pass
+            return {"state": "INFEASIBLE"}
+        # phase 2: commit
+        for node, bundle_index in prepared:
+            c = await rpc.connect(*node.address)
+            await c.call("commit_bundle", {"pg_id": pg_id, "bundle_index": bundle_index})
+            await c.close()
+        pg.state = "CREATED"
+        pg.bundle_nodes = [n.node_id for n in assignment]
+        return {"state": "CREATED", "bundle_nodes": pg.bundle_nodes}
+
+    def _place_bundles(self, bundles, strategy) -> list[NodeInfo] | None:
+        alive = [n for n in self.nodes.values() if n.alive]
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def take(node, bundle):
+            for k, v in bundle.items():
+                if avail[node.node_id].get(k, 0.0) < v - 1e-9:
+                    return False
+            for k, v in bundle.items():
+                avail[node.node_id][k] -= v
+            return True
+
+        assignment: list[NodeInfo] = []
+        if strategy in ("STRICT_PACK", "PACK"):
+            # try to fit everything on one node first
+            for n in alive:
+                snapshot = dict(avail[n.node_id])
+                if _fits_all(bundles, snapshot):
+                    for b in bundles:
+                        take(n, b)
+                    return [n] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+        if strategy in ("SPREAD", "STRICT_SPREAD", "PACK"):
+            nodes_sorted = sorted(alive, key=lambda n: -sum(avail[n.node_id].values()))
+            used: set[NodeID] = set()
+            for b in bundles:
+                placed = False
+                for n in nodes_sorted:
+                    if strategy == "STRICT_SPREAD" and n.node_id in used:
+                        continue
+                    if take(n, b):
+                        assignment.append(n)
+                        used.add(n.node_id)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return assignment
+        return None
+
+    async def rpc_remove_placement_group(self, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return False
+        for bundle_index, node_id in enumerate(pg.bundle_nodes):
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                continue
+            try:
+                c = await rpc.connect(*node.address)
+                await c.call("return_bundle", {"pg_id": pg.pg_id, "bundle_index": bundle_index})
+                await c.close()
+            except Exception:
+                pass
+        pg.state = "REMOVED"
+        pg.bundle_nodes = []
+        return True
+
+    async def rpc_get_placement_group(self, conn, p):
+        pg = self.pgs.get(p["pg_id"])
+        if pg is None:
+            return None
+        return {"state": pg.state, "bundle_nodes": pg.bundle_nodes, "bundles": pg.bundles,
+                "strategy": pg.strategy}
+
+    # -------------------------------------------------- task events / timeline
+    async def rpc_report_task_events(self, conn, p):
+        self.task_events.extend(p["events"])
+        if len(self.task_events) > 100_000:
+            del self.task_events[: len(self.task_events) - 100_000]
+        return True
+
+    async def rpc_get_task_events(self, conn, p):
+        return list(self.task_events)
+
+    # -------------------------------------------------------------- lifecycle
+    def _on_disconnect(self, conn):
+        for subs in self.subs.values():
+            subs.discard(conn)
+        node_id = self.raylet_conns.pop(conn, None)
+        if node_id is not None:
+            asyncio.get_running_loop().create_task(
+                self._mark_node_dead(node_id, "raylet disconnected")
+            )
+
+    async def _health_loop(self):
+        cfg = self.cfg
+        while not self._stopping:
+            await asyncio.sleep(cfg.health_check_period_s)
+            now = time.monotonic()
+            deadline = cfg.health_check_period_s * cfg.health_check_failure_threshold
+            for info in list(self.nodes.values()):
+                if info.alive and now - info.last_heartbeat > deadline:
+                    await self._mark_node_dead(info.node_id, "health check timeout")
+
+    async def start(self) -> tuple[str, int]:
+        addr = await self.server.start()
+        asyncio.get_running_loop().create_task(self._health_loop())
+        return addr
+
+    async def stop(self):
+        self._stopping = True
+        await self.server.stop()
+
+
+def _fits(req: dict, avail: dict) -> bool:
+    return all(avail.get(k, 0.0) >= v - 1e-9 for k, v in req.items())
+
+
+def _fits_all(bundles: list[dict], avail: dict) -> bool:
+    total: dict[str, float] = {}
+    for b in bundles:
+        for k, v in b.items():
+            total[k] = total.get(k, 0.0) + v
+    return _fits(total, avail)
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--address-file", default=None)
+    args = parser.parse_args()
+
+    async def run():
+        gcs = GcsServer(args.host, args.port)
+        host, port = await gcs.start()
+        line = f"{host}:{port}"
+        if args.address_file:
+            tmp = args.address_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(line)
+            os.replace(tmp, args.address_file)
+        print(f"GCS listening on {line}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

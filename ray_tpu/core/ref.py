@@ -1,0 +1,154 @@
+"""ObjectRef and user-facing error types.
+
+Equivalent of the reference's ObjectRef + error taxonomy
+(ref: python/ray/_raylet.pyx ObjectRef, python/ray/exceptions.py).
+An ObjectRef carries its owner's RPC address — ownership-based object
+resolution (ref: ownership_object_directory.cc): whoever created the object
+serves its metadata and small values.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu.utils.ids import ActorID, ObjectID, TaskID
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray.get (ref: RayTaskError)."""
+
+    def __init__(self, message: str, cause_repr: str = "", traceback_str: str = ""):
+        super().__init__(message)
+        self.cause_repr = cause_repr
+        self.traceback_str = traceback_str
+
+    def __str__(self):
+        base = super().__str__()
+        if self.traceback_str:
+            return f"{base}\n\n--- remote traceback ---\n{self.traceback_str}"
+        return base
+
+
+class ActorError(RayTpuError):
+    """The actor died before/while executing this call (ref: RayActorError)."""
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class WorkerCrashedError(TaskError):
+    def __init__(self, message="worker process died while executing the task"):
+        super().__init__(message)
+
+
+class ObjectLostError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectRef:
+    """Future-like handle to a (possibly pending) remote object."""
+
+    __slots__ = ("id", "owner_address", "_core", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: tuple[str, int] | None = None,
+                 _core=None):
+        self.id = object_id
+        self.owner_address = owner_address
+        self._core = _core  # set only on the owner: enables local GC
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self) -> TaskID:
+        return self.id.task_id()
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __reduce__(self):
+        # Serialized refs (borrowed by other processes) do not carry _core.
+        return (ObjectRef, (self.id, self.owner_address))
+
+    def __del__(self):
+        core = self._core
+        if core is not None:
+            try:
+                core.on_owned_ref_deleted(self.id)
+            except Exception:
+                pass
+
+    # await support inside async actors
+    def __await__(self):
+        from ray_tpu.core import api
+
+        async def _get():
+            return await api._async_get(self)
+
+        return _get().__await__()
+
+
+class ActorHandle:
+    """Typed proxy for remote actor method calls; see core_client.submit_actor_task."""
+
+    def __init__(self, actor_id: ActorID, core=None, method_names: tuple = (),
+                 options: dict | None = None):
+        self._actor_id = actor_id
+        self._core = core
+        self._method_names = method_names
+        self._options = options or {}
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (_rebuild_actor_handle, (self._actor_id, self._method_names, self._options))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+
+class ActorMethod:
+    def __init__(self, handle: ActorHandle, name: str, num_returns: int | None = None):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int | None = None, **kw):
+        m = ActorMethod(self._handle, self._name, num_returns)
+        return m
+
+    def remote(self, *args, **kwargs) -> Any:
+        from ray_tpu.core import api
+
+        core = self._handle._core or api.get_core()
+        return core.submit_actor_task(
+            self._handle, self._name, args, kwargs, num_returns=self._num_returns or 1
+        )
+
+
+def _rebuild_actor_handle(actor_id, method_names, options):
+    return ActorHandle(actor_id, core=None, method_names=method_names, options=options)

@@ -1,0 +1,260 @@
+"""Worker process: executes tasks and hosts actors.
+
+Equivalent of the reference's worker side: task receiver + execution
+callback (ref: src/ray/core_worker/transport/task_receiver.h:50,
+python/ray/_raylet.pyx:1731 execute_task, worker.py:955 main_loop).
+
+Threading model: the asyncio loop owns all sockets and stays responsive
+(serving owner-object requests, accepting new pushes) while user code runs
+on executor threads — sync tasks/actors on a single-thread executor
+(per-caller FIFO preserved: one connection per caller x in-order dispatch x
+one execution thread), async actors directly on the loop, actors with
+max_concurrency > 1 on a wider pool (ref: concurrency groups,
+concurrency_group_manager.cc).
+
+Executing a task also runs a full CoreClient, so tasks can submit nested
+tasks, put objects, and get borrowed refs — same as the reference where
+every worker embeds a CoreWorker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import os
+import sys
+import traceback
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+from ray_tpu.config import get_config
+from ray_tpu.core.core_client import CoreClient, _pack_bytes
+from ray_tpu.core.ref import ObjectRef, TaskError
+from ray_tpu.utils import rpc, serialization
+from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
+
+
+class Worker:
+    def __init__(self):
+        self.cfg = get_config()
+        self.worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
+        self.raylet_address = (
+            os.environ["RT_RAYLET_HOST"],
+            int(os.environ["RT_RAYLET_PORT"]),
+        )
+        self.gcs_address = (os.environ["RT_GCS_HOST"], int(os.environ["RT_GCS_PORT"]))
+        self.node_id = NodeID.from_hex(os.environ["RT_NODE_ID"])
+        self.core: CoreClient | None = None
+        self.executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rt-exec"
+        )
+        self._func_cache: dict[bytes, object] = {}
+        # actor state (a worker hosts at most one actor, like the reference)
+        self.actor_instance = None
+        self.actor_id: ActorID | None = None
+        # keyed by the live Connection object (cleaned on disconnect): an
+        # id()-keyed map could collide after CPython address reuse
+        self._seq_gates: dict[object, dict] = {}
+        self._exit_requested = False
+
+    async def start(self):
+        self.core = CoreClient(loop=asyncio.get_running_loop())
+        # the worker's own server doubles as the task receiver
+        self.core.server.add_routes(self)
+        self.core.server.on_disconnect = lambda conn: self._seq_gates.pop(conn, None)
+        await self.core.connect(self.gcs_address, self.raylet_address)
+        # user code in tasks (ray_tpu.get/put/remote, actor handles) must hit
+        # THIS core, not bootstrap a fresh cluster (ref: worker.py global_worker)
+        from ray_tpu.core import api
+
+        api._core = self.core
+        raylet = self.core.raylet
+        await raylet.call(
+            "worker_ready",
+            {"worker_id": self.worker_id.hex(), "address": self.core.address, "pid": os.getpid()},
+        )
+        # if the raylet connection drops, the node is gone: exit
+        asyncio.get_running_loop().create_task(self._watch_raylet())
+
+    async def _watch_raylet(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if self.core.raylet._closed:
+                os._exit(0)
+
+    # ------------------------------------------------------------ execution
+    async def _load_function(self, func_id: bytes):
+        fn = self._func_cache.get(func_id)
+        if fn is not None:
+            return fn
+        for _ in range(100):  # registration is async on the owner: retry briefly
+            blob = await self.core.gcs.call("kv_get", {"ns": "funcs", "key": func_id.hex()})
+            if blob is not None:
+                fn = cloudpickle.loads(blob)
+                self._func_cache[func_id] = fn
+                return fn
+            await asyncio.sleep(0.05)
+        raise TaskError(f"function {func_id.hex()} never appeared in the GCS table")
+
+    async def _fetch_args(self, packed_args):
+        out = []
+        for a in packed_args:
+            tag = a[0]
+            if tag == "p":  # plain value
+                out.append(a[1])
+            elif tag == "v":  # inlined serialized value
+                out.append(serialization.unpack(a[1]))
+            elif tag == "r":  # ref descriptor: fetch
+                oid = ObjectID(a[1])
+                ref = ObjectRef(oid, tuple(a[2]) if a[2] else None)
+                out.append(await self.core._get_one(ref, None))
+            else:
+                raise TaskError(f"bad arg tag {tag!r}")
+        return out
+
+    async def _store_results(self, task_id, num_returns, values) -> list[dict]:
+        if num_returns == 1:
+            values = (values,)
+        elif num_returns == 0:
+            values = ()
+        else:
+            values = tuple(values)
+            if len(values) != num_returns:
+                raise TaskError(
+                    f"task declared num_returns={num_returns} but returned {len(values)}"
+                )
+        results = []
+        for i, v in enumerate(values):
+            oid = ObjectID.for_task_return(task_id, i)
+            meta, buffers = serialization.dumps_with_buffers(v)
+            size = serialization.total_size(meta, buffers)
+            if size <= self.cfg.max_inline_object_size:
+                results.append({"inline": _pack_bytes(meta, buffers, size)})
+            else:
+                buf = self.core.store.create(oid, size)
+                serialization.pack_into(meta, buffers, buf)
+                self.core.store.seal(oid)
+                import pickle
+
+                holders_blob = await self.core.gcs.call(
+                    "kv_get", {"ns": "obj_loc", "key": oid.hex()}
+                )
+                holders = pickle.loads(holders_blob) if holders_blob else set()
+                holders.add(self.node_id.binary())
+                await self.core.gcs.call(
+                    "kv_put", {"ns": "obj_loc", "key": oid.hex(), "value": pickle.dumps(holders)}
+                )
+                results.append({"shm": True})
+        return results
+
+    async def rpc_push_task(self, conn, p):
+        spec = p["spec"]
+        try:
+            fn = await self._load_function(spec["func_id"])
+            args = await self._fetch_args(spec["args"])
+            kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
+            loop = asyncio.get_running_loop()
+            if inspect.iscoroutinefunction(fn):
+                value = await fn(*args, **kwargs)
+            else:
+                value = await loop.run_in_executor(self.executor, lambda: fn(*args, **kwargs))
+            results = await self._store_results(spec["task_id"], spec["num_returns"], value)
+            return {"results": results}
+        except Exception as e:
+            return {"error": _as_task_error(e)}
+
+    # --------------------------------------------------------------- actors
+    async def rpc_create_actor(self, conn, p):
+        spec = p["spec"]
+        cls = cloudpickle.loads(spec["class_blob"])
+        args = await self._fetch_args(spec["args"])
+        kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
+        max_concurrency = spec.get("max_concurrency", 1)
+        if max_concurrency > 1:
+            self.executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_concurrency, thread_name_prefix="rt-actor"
+            )
+        loop = asyncio.get_running_loop()
+        try:
+            self.actor_instance = await loop.run_in_executor(
+                self.executor, lambda: cls(*args, **kwargs)
+            )
+        except Exception as e:
+            raise _as_task_error(e) from None
+        self.actor_id = spec["actor_id"]
+        return {"ok": True}
+
+    async def rpc_push_actor_task(self, conn, p):
+        """Executes an actor call with per-caller-connection FIFO ordering
+        (ref: actor_scheduling_queue.cc sequence gating): the seq gate is
+        held through arg fetching and work dispatch, then released before
+        awaiting the result — sync methods serialize through the executor
+        thread, async methods start in order but run concurrently."""
+        spec = p["spec"]
+        if self.actor_instance is None:
+            return {"error": TaskError("no actor instance on this worker")}
+        seq = spec.get("seq")
+        gate = self._seq_gates.setdefault(conn, {"next": 0, "events": {}})
+        if seq is not None:
+            while gate["next"] != seq:
+                ev = gate["events"].setdefault(seq, asyncio.Event())
+                await ev.wait()
+        work = None
+        try:
+            method = getattr(self.actor_instance, spec["method"])
+            args = await self._fetch_args(spec["args"])
+            kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
+            if inspect.iscoroutinefunction(method):
+                work = asyncio.get_running_loop().create_task(method(*args, **kwargs))
+            else:
+                loop = asyncio.get_running_loop()
+                work = loop.run_in_executor(self.executor, lambda: method(*args, **kwargs))
+        except Exception as e:
+            return {"error": _as_task_error(e)}
+        finally:
+            if seq is not None:
+                gate["next"] = seq + 1
+                ev = gate["events"].pop(seq + 1, None)
+                if ev is not None:
+                    ev.set()
+        try:
+            value = await work
+            results = await self._store_results(spec["task_id"], spec["num_returns"], value)
+            return {"results": results}
+        except Exception as e:
+            return {"error": _as_task_error(e)}
+
+    async def rpc_exit_worker(self, conn, p):
+        self._exit_requested = True
+        asyncio.get_running_loop().call_later(0.05, os._exit, 0)
+        return True
+
+    async def rpc_ping(self, conn, p):
+        return {"pid": os.getpid(), "actor": self.actor_id}
+
+
+def _as_task_error(e: Exception) -> TaskError:
+    if isinstance(e, TaskError):
+        return e
+    tb = traceback.format_exc()
+    return TaskError(f"{type(e).__name__}: {e}", cause_repr=repr(e), traceback_str=tb)
+
+
+def main():
+    async def run():
+        worker = Worker()
+        await worker.start()
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

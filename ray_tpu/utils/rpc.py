@@ -1,0 +1,275 @@
+"""Asyncio RPC substrate for the control plane.
+
+Fills the role of the reference's gRPC scaffolding (ref: src/ray/rpc/
+grpc_server.h:88, client_call.h:203): request/response with correlation ids,
+one-way notifications, and server-push messages over length-prefixed pickle
+frames on TCP. Interfaces are deliberately service-shaped (method-name
+dispatch) so a future C++/gRPC data plane can slot in behind the same call
+sites.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import pickle
+import struct
+import threading
+from typing import Any, Awaitable, Callable
+
+_LEN = struct.Struct("<Q")
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    try:
+        header = await reader.readexactly(_LEN.size)
+        (n,) = _LEN.unpack(header)
+        payload = await reader.readexactly(n)
+    except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError) as e:
+        raise ConnectionLost(str(e)) from None
+    return pickle.loads(payload)
+
+
+def frame_bytes(msg: Any) -> bytes:
+    payload = pickle.dumps(msg, protocol=5)
+    return _LEN.pack(len(payload)) + payload
+
+
+class Connection:
+    """One bidirectional peer link: concurrent calls, notifications, push."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self.on_message: Callable[[dict], Awaitable[Any] | None] | None = None
+        self._reader_task: asyncio.Task | None = None
+
+    @property
+    def peername(self):
+        try:
+            return self.writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+    def start(self):
+        self._reader_task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        try:
+            while True:
+                msg = await read_frame(self.reader)
+                kind = msg.get("k")
+                if kind == "r":  # response
+                    fut = self._pending.pop(msg["i"], None)
+                    if fut is not None and not fut.done():
+                        if msg.get("e") is not None:
+                            fut.set_exception(msg["e"])
+                        else:
+                            fut.set_result(msg.get("v"))
+                elif self.on_message is not None:
+                    res = self.on_message(msg)
+                    if asyncio.iscoroutine(res):
+                        asyncio.get_running_loop().create_task(res)
+        except (ConnectionLost, asyncio.CancelledError, Exception) as e:
+            self._fail_pending(e if isinstance(e, Exception) else ConnectionLost("closed"))
+
+    def _fail_pending(self, exc: Exception):
+        self._closed = True
+        exc = exc if isinstance(exc, ConnectionLost) else ConnectionLost(repr(exc))
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    async def send(self, msg: dict):
+        data = frame_bytes(msg)
+        async with self._send_lock:
+            if self._closed:
+                raise ConnectionLost("connection closed")
+            self.writer.write(data)
+            await self.writer.drain()
+
+    async def call(self, method: str, payload: Any = None, timeout: float | None = None):
+        i = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[i] = fut
+        await self.send({"k": "c", "i": i, "m": method, "p": payload})
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def notify(self, method: str, payload: Any = None):
+        await self.send({"k": "n", "m": method, "p": payload})
+
+    async def respond(self, msg_id: int, value: Any = None, error: Exception | None = None):
+        await self.send({"k": "r", "i": msg_id, "v": value, "e": error})
+
+    async def close(self):
+        self._closed = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        try:
+            self.writer.close()
+            # wait_closed() can hang indefinitely when the reader task was
+            # cancelled mid-frame; bound it — the fd is closed either way.
+            await asyncio.wait_for(self.writer.wait_closed(), timeout=1.0)
+        except (Exception, asyncio.TimeoutError):
+            pass
+
+
+class RpcServer:
+    """Method-dispatch server. Handlers: async def h(conn, payload) -> value."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server: asyncio.base_events.Server | None = None
+        self._handlers: dict[str, Callable] = {}
+        self._conns: set[Connection] = set()
+        self.on_disconnect: Callable[[Connection], None] | None = None
+
+    def route(self, name: str):
+        def deco(fn):
+            self._handlers[name] = fn
+            return fn
+
+        return deco
+
+    def add_routes(self, obj: Any, prefix: str = ""):
+        """Register every ``rpc_<name>`` coroutine method of ``obj``."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self._handlers[prefix + attr[4:]] = getattr(obj, attr)
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._on_client, self._host, self._port)
+        sock = self._server.sockets[0]
+        self._host, self._port = sock.getsockname()[:2]
+        return self._host, self._port
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._host, self._port
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                msg = await read_frame(reader)
+                kind = msg.get("k")
+                if kind in ("c", "n"):
+                    asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
+                elif kind == "r":
+                    fut = conn._pending.pop(msg["i"], None)
+                    if fut is not None and not fut.done():
+                        if msg.get("e") is not None:
+                            fut.set_exception(msg["e"])
+                        else:
+                            fut.set_result(msg.get("v"))
+        except (ConnectionLost, ConnectionResetError):
+            pass
+        finally:
+            self._conns.discard(conn)
+            conn._fail_pending(ConnectionLost("peer disconnected"))
+            if self.on_disconnect is not None:
+                try:
+                    self.on_disconnect(conn)
+                except Exception:
+                    pass
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, conn: Connection, msg: dict):
+        handler = self._handlers.get(msg["m"])
+        if msg["k"] == "n":
+            if handler is not None:
+                try:
+                    await handler(conn, msg.get("p"))
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+            return
+        try:
+            if handler is None:
+                raise RpcError(f"no handler for {msg['m']!r}")
+            value = await handler(conn, msg.get("p"))
+            await conn.respond(msg["i"], value=value)
+        except ConnectionLost:
+            pass
+        except Exception as e:
+            try:
+                await conn.respond(msg["i"], error=e)
+            except Exception:
+                pass
+
+    async def stop(self):
+        # close live connections first: their handler coroutines sit in
+        # read_frame(), and 3.12's wait_closed() waits for handlers to finish
+        for conn in list(self._conns):
+            await conn.close()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except asyncio.TimeoutError:
+                pass
+
+
+async def connect(host: str, port: int, timeout: float = 30.0) -> Connection:
+    deadline = asyncio.get_running_loop().time() + timeout
+    last_err: Exception | None = None
+    while asyncio.get_running_loop().time() < deadline:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+            conn = Connection(reader, writer)
+            conn.start()
+            return conn
+        except (ConnectionRefusedError, OSError) as e:
+            last_err = e
+            await asyncio.sleep(0.05)
+    raise ConnectionLost(f"could not connect to {host}:{port}: {last_err}")
+
+
+class EventLoopThread:
+    """A dedicated asyncio loop on a daemon thread; sync<->async bridge.
+
+    The driver-side equivalent of the reference CoreWorker's io_service
+    thread — all control-plane sockets live here while the user thread
+    blocks in the sync API (ref: core_worker.h:166 io_service_).
+    """
+
+    def __init__(self, name: str = "rt-io"):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout: float | None = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def spawn(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)

@@ -1,0 +1,241 @@
+"""End-to-end task/actor API tests (modeled on the reference's
+python/ray/tests/test_basic.py coverage)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_put_get(rt):
+    ref = rt.put({"x": 1})
+    assert rt.get(ref) == {"x": 1}
+
+
+def test_put_get_large_numpy(rt):
+    arr = np.random.randn(1_000_000)  # 8MB: goes through shm
+    ref = rt.put(arr)
+    out = rt.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_simple_task(rt):
+    @rt.remote
+    def add(a, b):
+        return a + b
+
+    assert rt.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(rt):
+    @rt.remote
+    def double(x):
+        return x * 2
+
+    ref = rt.put(21)
+    assert rt.get(double.remote(ref)) == 42
+
+
+def test_task_chain(rt):
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(5):
+        ref = inc.remote(ref)
+    assert rt.get(ref) == 6
+
+
+def test_many_parallel_tasks(rt):
+    @rt.remote
+    def square(i):
+        return i * i
+
+    refs = [square.remote(i) for i in range(50)]
+    assert rt.get(refs) == [i * i for i in range(50)]
+
+
+def test_task_large_return(rt):
+    @rt.remote
+    def big():
+        return np.ones(500_000)  # 4MB
+
+    out = rt.get(big.remote())
+    assert out.sum() == 500_000
+
+
+def test_task_exception_propagates(rt):
+    @rt.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    from ray_tpu.core.ref import TaskError
+
+    with pytest.raises(TaskError, match="kaboom"):
+        rt.get(boom.remote())
+
+
+def test_num_returns(rt):
+    @rt.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    r1, r2 = two.remote()
+    assert rt.get(r1) == 1
+    assert rt.get(r2) == 2
+
+
+def test_nested_tasks(rt):
+    @rt.remote
+    def inner(x):
+        return x + 1
+
+    @rt.remote
+    def outer(x):
+        import ray_tpu as rtw
+
+        return rtw.get(inner.remote(x)) + 10
+
+    assert rt.get(outer.remote(0)) == 11
+
+
+def test_wait(rt):
+    @rt.remote
+    def fast():
+        return "fast"
+
+    @rt.remote
+    def slow():
+        time.sleep(2.0)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = rt.wait([f, s], num_returns=1, timeout=10)
+    assert ready == [f]
+    assert pending == [s]
+    ready, pending = rt.wait([f, s], num_returns=2, timeout=10)
+    assert len(ready) == 2
+
+
+def test_actor_basics(rt):
+    @rt.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert rt.get(c.inc.remote()) == 11
+    assert rt.get(c.inc.remote(5)) == 16
+    assert rt.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(rt):
+    @rt.remote
+    class Accumulator:
+        def __init__(self):
+            self.items = []
+
+        def add(self, i):
+            self.items.append(i)
+
+        def items_list(self):
+            return self.items
+
+    a = Accumulator.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert rt.get(a.items_list.remote()) == list(range(20))
+
+
+def test_async_actor(rt):
+    @rt.remote
+    class AsyncWorker:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    assert rt.get(w.work.remote(21)) == 42
+
+
+def test_named_actor(rt):
+    @rt.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="the-registry").remote()
+    h = rt.get_actor("the-registry")
+    assert rt.get(h.ping.remote()) == "pong"
+
+
+def test_actor_exception(rt):
+    @rt.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor-boom")
+
+    from ray_tpu.core.ref import TaskError
+
+    b = Bad.remote()
+    with pytest.raises(TaskError, match="actor-boom"):
+        rt.get(b.fail.remote())
+
+
+def test_kill_actor(rt):
+    @rt.remote
+    class Victim:
+        def ping(self):
+            return "ok"
+
+    v = Victim.remote()
+    assert rt.get(v.ping.remote()) == "ok"
+    rt.kill(v)
+    from ray_tpu.core.ref import ActorError
+
+    time.sleep(0.5)
+    with pytest.raises(ActorError):
+        rt.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_handle_passed_to_task(rt):
+    @rt.remote
+    class Holder:
+        def __init__(self):
+            self.v = 7
+
+        def get_v(self):
+            return self.v
+
+    @rt.remote
+    def reads_actor(h):
+        import ray_tpu as rtw
+
+        return rtw.get(h.get_v.remote())
+
+    h = Holder.remote()
+    assert rt.get(reads_actor.remote(h)) == 7
+
+
+def test_cluster_resources(rt):
+    total = rt.cluster_resources()
+    assert total.get("CPU", 0) >= 8
