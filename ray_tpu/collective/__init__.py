@@ -1,0 +1,25 @@
+"""Collective communication for TPU meshes — the `xla_collective_group`.
+
+API surface mirrors the reference's ``ray.util.collective``
+(ref: python/ray/util/collective/collective.py:123-604) with the NCCL/cupy
+backend replaced by XLA collectives over ICI/DCN (jit + shard_map psum /
+all_gather / reduce_scatter / ppermute) and a CPU cross-process fake for
+tests (the reference's CPUCommunicator pattern,
+ref: experimental/channel/cpu_communicator.py:92).
+"""
+
+from ray_tpu.collective.collective import (  # noqa: F401
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_group_handle,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import ReduceOp  # noqa: F401

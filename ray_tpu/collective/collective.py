@@ -1,0 +1,146 @@
+"""Process-group management + module-level collective API.
+
+Mirror of the reference's public surface (ref: python/ray/util/collective/
+collective.py — GroupManager :40, init_collective_group :123,
+create_collective_group :160, allreduce :268, barrier :308, reduce :321,
+broadcast :383, allgather :433, reducescatter :482, send :541, recv :604).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ray_tpu.collective.communicator import Communicator
+from ray_tpu.collective.types import Backend, ReduceOp
+
+_COORD_ACTOR_PREFIX = "rt_collective_coord::"
+
+
+class GroupManager:
+    def __init__(self):
+        self._groups: dict[str, Communicator] = {}
+        self._lock = threading.Lock()
+
+    def create_group(
+        self, backend: str, world_size: int, rank: int, group_name: str
+    ) -> Communicator:
+        with self._lock:
+            if group_name in self._groups:
+                return self._groups[group_name]
+        if backend == Backend.CPU:
+            group = self._make_cpu_group(world_size, rank, group_name)
+        elif backend == Backend.XLA:
+            from ray_tpu.collective.xla_group import XlaCollectiveGroup
+
+            group = XlaCollectiveGroup(world_size, rank, group_name)
+        else:
+            raise ValueError(f"unknown collective backend {backend!r}")
+        with self._lock:
+            self._groups[group_name] = group
+        return group
+
+    def _make_cpu_group(self, world_size, rank, group_name) -> Communicator:
+        import ray_tpu
+        from ray_tpu.collective.cpu_group import CollectiveCoordinator, CpuCollectiveGroup
+
+        coordinator = ray_tpu.remote(CollectiveCoordinator).options(
+            name=_COORD_ACTOR_PREFIX + group_name, get_if_exists=True, num_cpus=0.0
+        ).remote(world_size)
+        return CpuCollectiveGroup(world_size, rank, group_name, coordinator)
+
+    def get(self, group_name: str) -> Communicator:
+        group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this "
+                "process; call init_collective_group first"
+            )
+        return group
+
+    def destroy(self, group_name: str):
+        group = self._groups.pop(group_name, None)
+        if group is not None:
+            group.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = Backend.XLA,
+    group_name: str = "default",
+) -> Communicator:
+    """Join this process into a collective group (call once per member)."""
+    if backend == Backend.XLA and world_size > 1:
+        # multi-host: rendezvous through the GCS KV then jax.distributed
+        from ray_tpu.collective.xla_group import maybe_init_distributed
+        from ray_tpu.core import api
+
+        core = api.get_core()
+
+        def gcs_call(method, payload):
+            return core._run_sync(core.gcs.call(method, payload))
+
+        maybe_init_distributed(gcs_call, group_name, world_size, rank)
+    return _manager.create_group(backend, world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: list,
+    world_size: int,
+    ranks: list[int],
+    backend: str = Backend.CPU,
+    group_name: str = "default",
+):
+    """Declarative variant (ref: collective.py:160): tell N actors to join."""
+    import ray_tpu
+
+    refs = [
+        actor._setup_collective_group.remote(world_size, rank, backend, group_name)
+        for actor, rank in zip(actors, ranks)
+    ]
+    ray_tpu.get(refs)
+
+
+def get_group_handle(group_name: str = "default") -> Communicator:
+    return _manager.get(group_name)
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get(group_name).broadcast(tensor, src_rank)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    return _manager.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def barrier(group_name: str = "default"):
+    _manager.get(group_name).barrier()
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    _manager.get(group_name).send(tensor, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default") -> Any:
+    return _manager.get(group_name).recv(src_rank)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)

@@ -1,0 +1,147 @@
+"""Cross-process CPU collective backend — the test fake.
+
+Plays the role of the reference's CPUCommunicator + GLOO group
+(ref: python/ray/experimental/channel/cpu_communicator.py:92,
+util/collective/collective_group/gloo_collective_group.py): functionally
+correct collectives between actor/driver processes with no accelerator,
+so multi-worker training logic can run in CI. Data moves through a named
+coordinator actor (the reference rendezvouses NCCL ids through a named
+actor the same way, ref: nccl_collective_group.py:29-80).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.collective.communicator import Communicator
+from ray_tpu.collective.types import ReduceOp
+
+
+def _reduce_arrays(arrays: list[np.ndarray], op: ReduceOp) -> np.ndarray:
+    stack = np.stack([np.asarray(a) for a in arrays])
+    if op == ReduceOp.SUM:
+        return stack.sum(0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(0)
+    if op == ReduceOp.MAX:
+        return stack.max(0)
+    if op == ReduceOp.MIN:
+        return stack.min(0)
+    if op == ReduceOp.MEAN:
+        return stack.mean(0)
+    raise ValueError(f"unsupported op {op}")
+
+
+class CollectiveCoordinator:
+    """Named async actor all group members talk to. One instance per group."""
+
+    def __init__(self, world_size: int):
+        import asyncio
+
+        self.world_size = world_size
+        self.rounds: dict = {}  # (kind, round_id) -> {"data": {rank: val}, "event": Event}
+        self.mailbox: dict = {}  # (src, dst, tag) -> value
+        self.mail_events: dict = {}
+        self._asyncio = asyncio
+
+    def _slot(self, key):
+        slot = self.rounds.get(key)
+        if slot is None:
+            slot = {"data": {}, "event": self._asyncio.Event(), "result": None}
+            self.rounds[key] = slot
+        return slot
+
+    async def gather(self, kind: str, round_id: int, rank: int, value):
+        """Collect one contribution per rank; returns the full dict to all."""
+        key = (kind, round_id)
+        slot = self._slot(key)
+        slot["data"][rank] = value
+        if len(slot["data"]) == self.world_size:
+            slot["result"] = slot["data"]
+            slot["event"].set()
+        await slot["event"].wait()
+        result = slot["result"]
+        # last leaver cleans up
+        slot.setdefault("left", 0)
+        slot["left"] += 1
+        if slot["left"] == self.world_size:
+            del self.rounds[key]
+        return result
+
+    async def put_mail(self, src: int, dst: int, tag: int, value):
+        key = (src, dst, tag)
+        self.mailbox[key] = value
+        ev = self.mail_events.pop(key, None)
+        if ev is not None:
+            ev.set()
+        return True
+
+    async def take_mail(self, src: int, dst: int, tag: int):
+        key = (src, dst, tag)
+        while key not in self.mailbox:
+            ev = self.mail_events.setdefault(key, self._asyncio.Event())
+            await ev.wait()
+        return self.mailbox.pop(key)
+
+
+class CpuCollectiveGroup(Communicator):
+    def __init__(self, world_size: int, rank: int, group_name: str, coordinator):
+        super().__init__(world_size, rank, group_name)
+        self._coord = coordinator
+        self._round = 0
+        self._p2p_tags: dict = {}
+
+    def _next_round(self) -> int:
+        self._round += 1
+        return self._round
+
+    def _gather(self, kind: str, value):
+        import ray_tpu
+
+        round_id = self._next_round()
+        return ray_tpu.get(
+            self._coord.gather.remote(kind, round_id, self._rank, value)
+        )
+
+    def allreduce(self, value, op: ReduceOp = ReduceOp.SUM):
+        data = self._gather("allreduce", np.asarray(value))
+        return _reduce_arrays([data[r] for r in range(self._world_size)], op)
+
+    def allgather(self, value):
+        data = self._gather("allgather", np.asarray(value))
+        return np.stack([data[r] for r in range(self._world_size)])
+
+    def reducescatter(self, value, op: ReduceOp = ReduceOp.SUM):
+        data = self._gather("reducescatter", np.asarray(value))
+        reduced = _reduce_arrays([data[r] for r in range(self._world_size)], op)
+        chunks = np.split(reduced, self._world_size, axis=0)
+        return chunks[self._rank]
+
+    def broadcast(self, value, src_rank: int = 0):
+        data = self._gather("broadcast", np.asarray(value) if value is not None else None)
+        return data[src_rank]
+
+    def reduce(self, value, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM):
+        data = self._gather("reduce", np.asarray(value))
+        if self._rank == dst_rank:
+            return _reduce_arrays([data[r] for r in range(self._world_size)], op)
+        return np.asarray(value)
+
+    def barrier(self) -> None:
+        self._gather("barrier", None)
+
+    def send(self, value, dst_rank: int) -> None:
+        import ray_tpu
+
+        tag = self._p2p_tags.get((self._rank, dst_rank), 0)
+        self._p2p_tags[(self._rank, dst_rank)] = tag + 1
+        ray_tpu.get(
+            self._coord.put_mail.remote(self._rank, dst_rank, tag, np.asarray(value))
+        )
+
+    def recv(self, src_rank: int):
+        import ray_tpu
+
+        tag = self._p2p_tags.get((src_rank, self._rank), 0)
+        self._p2p_tags[(src_rank, self._rank)] = tag + 1
+        return ray_tpu.get(self._coord.take_mail.remote(src_rank, self._rank, tag))

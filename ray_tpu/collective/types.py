@@ -1,0 +1,18 @@
+"""Collective op types (ref: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"
+
+
+class Backend:
+    XLA = "xla"  # ICI/DCN via XLA collectives (the NCCL replacement)
+    CPU = "cpu"  # cross-process test fake

@@ -1,0 +1,20 @@
+"""ray_tpu.data — distributed datasets feeding device meshes.
+
+The reference's Data library shape (ref: SURVEY §2.5 Data: lazy logical
+plan -> streaming executor over blocks) at the scale this framework needs
+for training input pipelines: lazy ops, task-parallel block transforms
+with bounded in-flight streaming, arrow/numpy blocks, and
+``streaming_split`` so each train worker pulls its own shard of one
+stream (ref: data/dataset.py:1731 streaming_split).
+"""
+
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    from_items,
+    from_numpy,
+    range as range_,  # noqa: A001
+    read_csv,
+    read_parquet,
+)
+
+range = range_  # noqa: A001  (mirror ray.data.range naming)

@@ -1,0 +1,3 @@
+"""Model zoo: flagship Llama-family transformer, ResNet, MLP."""
+
+from ray_tpu.models.llama import LlamaConfig, llama_forward, llama_init  # noqa: F401

@@ -1,0 +1,194 @@
+"""Flagship model: Llama-family decoder-only transformer, TPU-native.
+
+Functional pytree implementation (no framework Module state): params are a
+dict keyed so `parallel.sharding.PartitionRules.llama()` maps every weight
+to its TP/FSDP axes by path regex, attention dispatches to
+plain/flash/ring/ulysses by mesh (ops/attention.py), each block is wrapped
+in jax.checkpoint (remat) to trade FLOPs for HBM, and optional MoE layers
+use the expert-parallel dispatch from parallel/moe.py. Matches the model
+families the reference serves through vLLM (Llama-2/3 in BASELINE.json
+north-star configs) but as a native JAX program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.basic import rms_norm, rope, rope_freqs, swiglu
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+    # MoE: 0 experts = dense; else every `moe_every`-th layer is MoE
+    n_experts: int = 0
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def tiny(cls, **kw) -> "LlamaConfig":
+        return cls(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                   n_kv_heads=2, d_ff=128, max_seq_len=128, dtype="float32", **kw)
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=32, d_ff=11008, max_seq_len=4096)
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+                   n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+                   rope_theta=500000.0)
+
+
+def _dense(key, d_in, d_out, dtype):
+    scale = (2.0 / (d_in + d_out)) ** 0.5
+    return {"kernel": (jax.random.normal(key, (d_in, d_out)) * scale).astype(dtype)}
+
+
+def _is_moe_layer(cfg: LlamaConfig, i: int) -> bool:
+    return cfg.n_experts > 0 and (i % cfg.moe_every == cfg.moe_every - 1)
+
+
+def llama_init(key, cfg: LlamaConfig) -> dict:
+    dtype = jnp.dtype(cfg.dtype)
+    hd = cfg.head_dim
+    keys = jax.random.split(key, cfg.n_layers * 8 + 3)
+    ki = iter(range(len(keys)))
+    params: dict = {
+        "tok": {
+            "embedding": (
+                jax.random.normal(keys[next(ki)], (cfg.vocab_size, cfg.d_model)) * 0.02
+            ).astype(dtype)
+        }
+    }
+    for i in range(cfg.n_layers):
+        layer = {
+            "attn_norm": {"scale": jnp.ones((cfg.d_model,), dtype)},
+            "wq": _dense(keys[next(ki)], cfg.d_model, cfg.n_heads * hd, dtype),
+            "wk": _dense(keys[next(ki)], cfg.d_model, cfg.n_kv_heads * hd, dtype),
+            "wv": _dense(keys[next(ki)], cfg.d_model, cfg.n_kv_heads * hd, dtype),
+            "wo": _dense(keys[next(ki)], cfg.n_heads * hd, cfg.d_model, dtype),
+            "ffn_norm": {"scale": jnp.ones((cfg.d_model,), dtype)},
+        }
+        if _is_moe_layer(cfg, i):
+            e = cfg.n_experts
+            k1, k2, k3 = jax.random.split(keys[next(ki)], 3)
+            layer["moe"] = {
+                "gate": {"kernel": (jax.random.normal(k1, (cfg.d_model, e)) * 0.02).astype(dtype)},
+                "w_up": {"kernel": (jax.random.normal(k2, (e, cfg.d_model, cfg.d_ff)) * 0.02).astype(dtype)},
+                "w_down": {"kernel": (jax.random.normal(k3, (e, cfg.d_ff, cfg.d_model)) * 0.02).astype(dtype)},
+            }
+        else:
+            layer["w_gate"] = _dense(keys[next(ki)], cfg.d_model, cfg.d_ff, dtype)
+            layer["w_up"] = _dense(keys[next(ki)], cfg.d_model, cfg.d_ff, dtype)
+            layer["w_down"] = _dense(keys[next(ki)], cfg.d_ff, cfg.d_model, dtype)
+        params[f"layers_{i}"] = layer
+    params["norm"] = {"scale": jnp.ones((cfg.d_model,), dtype)}
+    params["lm_head"] = _dense(keys[next(ki)], cfg.d_model, cfg.vocab_size, dtype)
+    return params
+
+
+def _block(layer, x, cos, sin, cfg: LlamaConfig, mesh, attn_impl, seq_axis):
+    B, T, D = x.shape
+    hd = cfg.head_dim
+    h = rms_norm(x, layer["attn_norm"]["scale"])
+    q = (h @ layer["wq"]["kernel"]).reshape(B, T, cfg.n_heads, hd)
+    k = (h @ layer["wk"]["kernel"]).reshape(B, T, cfg.n_kv_heads, hd)
+    v = (h @ layer["wv"]["kernel"]).reshape(B, T, cfg.n_kv_heads, hd)
+    q = rope(q, cos, sin)
+    k = rope(k, cos, sin)
+    att = attention(q, k, v, causal=True, mesh=mesh, seq_axis=seq_axis, impl=attn_impl)
+    x = x + att.reshape(B, T, cfg.n_heads * hd) @ layer["wo"]["kernel"]
+
+    h = rms_norm(x, layer["ffn_norm"]["scale"])
+    if "moe" in layer:
+        from ray_tpu.parallel.moe import moe_ffn
+
+        out, aux = moe_ffn(
+            h,
+            layer["moe"]["gate"]["kernel"],
+            layer["moe"]["w_up"]["kernel"],
+            layer["moe"]["w_down"]["kernel"],
+            capacity_factor=cfg.capacity_factor,
+            mesh=mesh,
+        )
+        x = x + out
+    else:
+        aux = 0.0
+        x = x + swiglu(h, layer["w_gate"]["kernel"], layer["w_up"]["kernel"],
+                       layer["w_down"]["kernel"])
+    return x, aux
+
+
+def llama_forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
+                  attn_impl: str = "auto", seq_axis: str | None = "sp"):
+    """tokens: [B, T] int32 -> logits [B, T, V]."""
+    if mesh is not None and (seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1):
+        seq_axis = None
+    cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    x = params["tok"]["embedding"][tokens]
+    aux_total = 0.0
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            _block, static_argnums=(4, 5, 6, 7),
+            policy=jax.checkpoint_policies.nothing_saveable,
+        )
+    for i in range(cfg.n_layers):
+        x, aux = block(params[f"layers_{i}"], x, cos, sin, cfg, mesh, attn_impl, seq_axis)
+        aux_total = aux_total + aux
+    x = rms_norm(x, params["norm"]["scale"])
+    logits = x @ params["lm_head"]["kernel"]
+    return logits, aux_total
+
+
+def llama_loss(params, batch, cfg: LlamaConfig, *, mesh=None, attn_impl="auto"):
+    """Next-token cross entropy; batch: {"tokens": [B, T+1]}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits, aux = llama_forward(params, inputs, cfg, mesh=mesh, attn_impl=attn_impl)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + 0.01 * aux
+
+
+def make_train_step(cfg: LlamaConfig, optimizer, *, mesh=None, attn_impl="auto",
+                    donate: bool = True):
+    """Returns jitted (params, opt_state, batch) -> (params, opt_state, loss).
+
+    Shard via jit's in_shardings at the call site (train/ wires this to
+    PartitionRules.llama over the worker-group mesh).
+    """
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama_loss(p, batch, cfg, mesh=mesh, attn_impl=attn_impl)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,4 @@
+"""Core tensor ops: norms, rotary embeddings, attention dispatch, pallas kernels."""
+
+from ray_tpu.ops.basic import rms_norm, rope, swiglu  # noqa: F401
+from ray_tpu.ops.attention import attention  # noqa: F401

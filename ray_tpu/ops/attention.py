@@ -1,0 +1,56 @@
+"""Attention dispatch: one entry point, backend picked by mesh/hardware.
+
+- plain exact attention (XLA fuses well at short T)
+- pallas flash attention on TPU (ops/flash_attention.py) for long T
+- ring attention over the sp mesh axis when sequence is sharded
+- ulysses all-to-all variant for head-divisible meshes
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.parallel.ring_attention import reference_attention
+
+
+def attention(q, k, v, *, causal: bool = True, sm_scale=None, mesh=None,
+              seq_axis: str | None = None, impl: str = "auto"):
+    """q/k/v: [B, T, H, D] (kv may have fewer heads — GQA broadcast here).
+
+    impl: auto | plain | flash | ring | ulysses
+    """
+    if k.shape[2] != q.shape[2]:  # grouped-query: repeat kv heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    if impl == "auto":
+        if mesh is not None and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+            impl = "ring"
+        else:
+            impl = _default_local_impl(q)
+
+    if impl == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh, axis_name=seq_axis or "sp",
+                              causal=causal, sm_scale=sm_scale)
+    if impl == "ulysses":
+        from ray_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, mesh, axis_name=seq_axis or "sp",
+                                 causal=causal, sm_scale=sm_scale)
+    if impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def _default_local_impl(q) -> str:
+    from ray_tpu.utils.device import is_tpu
+
+    B, T, H, D = q.shape
+    if is_tpu() and T >= 1024 and T % 512 == 0 and D in (64, 128, 256):
+        return "flash"
+    return "plain"

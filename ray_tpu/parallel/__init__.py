@@ -1,0 +1,17 @@
+"""Parallelism library: meshes, sharding recipes, SP/CP/PP/EP modules.
+
+The reference outsources TP/PP to vLLM and FSDP/DDP to torch
+(ref: SURVEY §2.3); sequence/context parallelism is absent in-tree
+(ref: SURVEY §5.7). Here they are first-class, TPU-native: a device mesh +
+partition-spec recipe layer (DP/FSDP/TP), ring attention and Ulysses
+all-to-all over a sequence axis, a collective-permute pipeline schedule,
+and expert-parallel MoE dispatch — all as shard_map/pjit building blocks
+that compose inside one jitted train step.
+"""
+
+from ray_tpu.parallel.mesh import MeshSpec, get_abstract_mesh  # noqa: F401
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    PartitionRules,
+    shard_pytree,
+    specs_for_pytree,
+)

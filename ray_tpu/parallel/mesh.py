@@ -1,0 +1,78 @@
+"""Device meshes with named parallelism axes.
+
+The TPU-native replacement for the reference's process-group bookkeeping
+(torch.distributed world sizes / NCCL subgroups): parallel dimensions are
+axes of one device mesh, and every collective is addressed by axis name.
+Axis vocabulary (order matters for ICI locality — innermost axes get
+physically adjacent chips):
+
+    dp    data parallel (gradient psum)
+    fsdp  fully-sharded parameter axis (ZeRO-equivalent; ref SURVEY §2.3)
+    pp    pipeline stages (collective_permute hops)
+    tp    tensor parallel (activation/weight matmul sharding)
+    sp    sequence/context parallel (ring attention / Ulysses)
+    ep    expert parallel (MoE all_to_all)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ray_tpu.utils.device import configure_jax
+
+AXIS_ORDER = ("dp", "fsdp", "pp", "tp", "sp", "ep")
+
+
+@dataclass
+class MeshSpec:
+    """Declarative mesh: axis name -> size; 1-sized axes are kept so
+    PartitionSpecs stay valid across scaling changes."""
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def axes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXIS_ORDER}
+
+    @property
+    def size(self) -> int:
+        out = 1
+        for v in self.axes.values():
+            out *= v
+        return out
+
+    def build(self, devices=None):
+        """Materialize a jax.sharding.Mesh over real (or given) devices."""
+        configure_jax()
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < self.size:
+            raise ValueError(
+                f"mesh needs {self.size} devices ({self.axes}), have {len(devices)}"
+            )
+        arr = np.array(devices[: self.size]).reshape(*self.axes.values())
+        return Mesh(arr, AXIS_ORDER)
+
+    @classmethod
+    def infer(cls, n_devices: int, *, tp: int = 1, pp: int = 1, sp: int = 1,
+              ep: int = 1, fsdp: int = 1) -> "MeshSpec":
+        """Fill the dp axis with whatever devices remain."""
+        denom = tp * pp * sp * ep * fsdp
+        if n_devices % denom:
+            raise ValueError(f"{n_devices} devices not divisible by {denom}")
+        return cls(dp=n_devices // denom, fsdp=fsdp, pp=pp, tp=tp, sp=sp, ep=ep)
+
+
+def get_abstract_mesh(spec: MeshSpec):
+    """Mesh of that shape over however many devices exist (tests/dryrun)."""
+    return spec.build()

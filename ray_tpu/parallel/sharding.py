@@ -1,0 +1,104 @@
+"""Partition-spec recipes: map pytree paths to mesh axes.
+
+DP / FSDP (ZeRO) / TP in the reference are three different torch stacks
+(DDP wrap ref: rllib/core/learner/torch/torch_learner.py:432; FSDP via
+user code ref: SURVEY §2.3); on TPU they are all the same thing — a
+PartitionSpec per parameter — so one rules table covers them. Rules are
+(path_regex -> PartitionSpec) in priority order, in the style t5x/flax
+established for TPU sharding.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+class PartitionRules:
+    def __init__(self, rules: list[tuple[str, tuple]]):
+        """rules: [(path_regex, spec_tuple)] — first match wins; spec axis
+        entries are mesh axis names, None, or tuples of axis names."""
+        self._rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, ndim: int):
+        from jax.sharding import PartitionSpec as P
+
+        for pat, spec in self._rules:
+            if pat.search(path):
+                return P(*tuple(spec)[:ndim])  # unmentioned trailing dims replicate
+        return P()  # replicated by default
+
+    @classmethod
+    def data_parallel(cls) -> "PartitionRules":
+        return cls([])  # params replicated; batch sharded on dp at the step
+
+    @classmethod
+    def fsdp(cls) -> "PartitionRules":
+        """ZeRO-equivalent: shard the largest axis of every weight on fsdp."""
+        return cls([(r"(kernel|embedding|scale|w[0-9]?)$", ("fsdp",))])
+
+    @classmethod
+    def llama(cls) -> "PartitionRules":
+        """2D TP x FSDP sharding for transformer blocks (megatron-style
+        column/row split expressed as specs; SURVEY §2.3 TP mapping)."""
+        return cls(
+            [
+                (r"embedding$", (("fsdp",), "tp")),          # [vocab, d] -> vocab on fsdp, d on tp
+                (r"(wq|wk|wv|w_gate|w_up)/kernel$", ("fsdp", "tp")),   # column parallel
+                (r"(wo|w_down)/kernel$", ("tp", "fsdp")),    # row parallel
+                (r"lm_head/kernel$", ("fsdp", "tp")),
+                (r"(norm|ln|rms)", ()),                      # replicated norms
+            ]
+        )
+
+
+def _tree_paths(tree, prefix=""):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, leaf in flat:
+        path = "/".join(_key_str(k) for k in keypath)
+        out.append((path, leaf))
+    return out, treedef
+
+
+def _key_str(k) -> str:
+    import jax
+
+    if isinstance(k, jax.tree_util.DictKey):
+        return str(k.key)
+    if isinstance(k, jax.tree_util.SequenceKey):
+        return str(k.idx)
+    if isinstance(k, jax.tree_util.GetAttrKey):
+        return str(k.name)
+    return str(k)
+
+
+def specs_for_pytree(tree, rules: PartitionRules):
+    """PartitionSpec pytree matching ``tree``'s structure."""
+    import jax
+
+    flat, treedef = _tree_paths(tree)
+    specs = [rules.spec_for(path, getattr(leaf, "ndim", 0)) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_pytree(tree, rules: PartitionRules, mesh):
+    """device_put every leaf with its rule's NamedSharding."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = specs_for_pytree(tree, rules)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def batch_spec(mesh_spec: MeshSpec):
+    """Canonical input-batch sharding: batch over (dp, fsdp), sequence over sp."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(("dp", "fsdp"), "sp")

@@ -1,0 +1,22 @@
+"""Distributed training: JaxTrainer over actor worker groups.
+
+The reference's Train stack re-imagined TPU-first (ref: SURVEY §2.5 Train
+v1/v2): a controller drives a worker group of actors (one per TPU host),
+workers rendezvous into one jax.distributed world, and the training step
+itself is a single pjit program over the pod mesh — DDP/FSDP/TP become
+partition specs (parallel/sharding.py), not wrapper modules.
+"""
+
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result, TrainingFailedError  # noqa: F401

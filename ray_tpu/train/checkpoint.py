@@ -1,0 +1,96 @@
+"""Checkpoint: directory-of-files abstraction + top-K retention.
+
+(ref: python/ray/train/_checkpoint.py Checkpoint,
+_internal/checkpoint_manager.py CheckpointManager). Model state uses
+orbax/msgpack-free numpy save under the hood via to_directory; jax pytrees
+are handled with ray_tpu.utils.serialization (host numpy representation).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        """Convenience: persist a pytree dict (host numpy) as a checkpoint."""
+        import jax
+        import numpy as np
+
+        tmp = tempfile.mkdtemp(prefix="rt_ckpt_")
+        host = jax.tree.map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x, data
+        )
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            pickle.dump(host, f, protocol=5)
+        return cls(tmp)
+
+    def to_dict(self) -> dict:
+        with open(os.path.join(self.path, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Top-K retention on a storage path (ref: checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: int | None = None,
+                 score_attribute: str | None = None, score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self.checkpoints: list[tuple[float, str, dict]] = []  # (score, path, metrics)
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
+        name = f"checkpoint_{int(time.time() * 1000)}_{len(self.checkpoints)}"
+        dest = os.path.join(self.storage_path, name)
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            shutil.copytree(checkpoint.path, dest)
+        score = self._score(metrics)
+        self.checkpoints.append((score, dest, dict(metrics)))
+        self._evict()
+        return Checkpoint(dest)
+
+    def _score(self, metrics: dict) -> float:
+        if self.score_attribute and self.score_attribute in metrics:
+            v = float(metrics[self.score_attribute])
+            return v if self.score_order == "max" else -v
+        return float(len(self.checkpoints))  # recency
+
+    def _evict(self):
+        if self.num_to_keep is None:
+            return
+        while len(self.checkpoints) > self.num_to_keep:
+            self.checkpoints.sort(key=lambda t: t[0])
+            score, path, _ = self.checkpoints.pop(0)
+            shutil.rmtree(path, ignore_errors=True)
+
+    def best(self) -> Checkpoint | None:
+        if not self.checkpoints:
+            return None
+        return Checkpoint(max(self.checkpoints, key=lambda t: t[0])[1])
+
+    def latest(self) -> Checkpoint | None:
+        if not self.checkpoints:
+            return None
+        return Checkpoint(self.checkpoints[-1][1])

@@ -1,0 +1,52 @@
+"""Training configs (ref: python/ray/air/config.py ScalingConfig/RunConfig/
+CheckpointConfig/FailureConfig — same shape, TPU resource vocabulary)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    #: resources per worker actor, e.g. {"TPU": 4.0}
+    resources_per_worker: dict[str, float] | None = None
+    #: PG strategy: STRICT_PACK = one ICI domain (ref: SURVEY §7 step 2)
+    placement_strategy: str = "PACK"
+    #: per-worker collective backend: "xla" on TPU pods, "cpu" for tests
+    collective_backend: str | None = None
+
+    def worker_resources(self) -> dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", 1.0)
+        return res
+
+    def backend(self) -> str:
+        if self.collective_backend:
+            return self.collective_backend
+        return "xla" if self.use_tpu else "cpu"
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None
+    checkpoint_score_attribute: str | None = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    #: worker-group restarts before giving up (-1 = unlimited)
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str | None = None
+    storage_path: str | None = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)

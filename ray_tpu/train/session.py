@@ -1,0 +1,68 @@
+"""Per-worker training session (ref: python/ray/train/_internal/session.py:
+report / get_checkpoint / world_rank live here)."""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+from typing import Any
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: "TrainSession | None" = None
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    local_rank: int
+    trial_name: str
+    collective_group: str
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+
+class TrainSession:
+    def __init__(self, context: TrainContext, checkpoint: Checkpoint | None = None):
+        self.context = context
+        self.starting_checkpoint = checkpoint
+        self.reports: list[dict] = []
+        #: (metrics, checkpoint) tuples drained by the controller poll
+        self.outbox: queue.Queue = queue.Queue()
+
+    def report(self, metrics: dict, checkpoint: Checkpoint | None = None):
+        self.reports.append(metrics)
+        self.outbox.put((dict(metrics), checkpoint))
+
+
+def init_session(context: TrainContext, checkpoint: Checkpoint | None = None) -> TrainSession:
+    global _session
+    _session = TrainSession(context, checkpoint)
+    return _session
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError("not inside a ray_tpu.train worker")
+    return _session
+
+
+def get_context() -> TrainContext:
+    return get_session().context
+
+
+def report(metrics: dict, checkpoint: Checkpoint | None = None) -> None:
+    """Report metrics (+ optional checkpoint) to the trainer controller."""
+    get_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Checkpoint | None:
+    return get_session().starting_checkpoint

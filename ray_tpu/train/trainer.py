@@ -1,0 +1,226 @@
+"""JaxTrainer: controller + worker-group actors.
+
+The reference's Train-v2 controller shape (ref: train/v2/_internal/execution/
+controller/controller.py:93 run:469 — poll workers, apply FailurePolicy;
+worker group ref: worker_group.py:105; v1 BackendExecutor ref:
+_internal/backend_executor.py:146): a driver-side controller creates N
+worker actors in a placement group, initializes the collective rendezvous
+(GCS-KV -> jax.distributed on pods; named-actor CPU fake in tests), runs
+``train_loop_per_worker`` on each, streams back report()s, keeps top-K
+checkpoints, and restarts the whole group at the same world size on worker
+failure up to FailureConfig.max_failures (elastic world-size changes imply
+an XLA recompile, so group restart is the honest recovery unit —
+SURVEY §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.core.ref import ActorError, TaskError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.session import TrainContext, init_session
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class Result:
+    def __init__(self, metrics: dict, checkpoint: Checkpoint | None,
+                 metrics_history: list[dict], error: Exception | None = None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.metrics_history = metrics_history
+        self.error = error
+
+    def __repr__(self):
+        return f"Result(metrics={self.metrics}, checkpoint={self.checkpoint})"
+
+
+class TrainWorker:
+    """Actor hosting one training process (one TPU host's worth of chips)."""
+
+    def __init__(self, rank: int, world_size: int, trial_name: str, backend: str,
+                 group_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.trial_name = trial_name
+        self.backend = backend
+        self.group_name = group_name
+        self._done = False
+        self._result: Any = None
+        self._error: str | None = None
+        self._session = None
+
+    def setup(self, checkpoint_path: str | None):
+        import ray_tpu.collective as collective
+        from ray_tpu.utils.device import configure_jax
+
+        configure_jax()
+        ckpt = Checkpoint.from_directory(checkpoint_path) if checkpoint_path else None
+        context = TrainContext(
+            world_rank=self.rank,
+            world_size=self.world_size,
+            local_rank=0,
+            trial_name=self.trial_name,
+            collective_group=self.group_name,
+        )
+        self._session = init_session(context, ckpt)
+        if self.world_size > 1 or self.backend == "xla":
+            collective.init_collective_group(
+                self.world_size, self.rank, backend=self.backend,
+                group_name=self.group_name,
+            )
+        return True
+
+    def run(self, train_loop, config: dict):
+        """Blocking execution of the user loop (runs on the actor's executor
+        thread; poll() is served concurrently by the async loop)."""
+        try:
+            self._result = train_loop(config) if config is not None else train_loop()
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001
+            self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            return {"ok": False, "error": self._error}
+        finally:
+            self._done = True
+
+    def poll(self):
+        """Drain report() outbox (ref: controller _poll_workers :249)."""
+        out = []
+        if self._session is not None:
+            while not self._session.outbox.empty():
+                metrics, ckpt = self._session.outbox.get_nowait()
+                out.append((metrics, ckpt.path if ckpt else None))
+        return {"reports": out, "done": self._done, "error": self._error}
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+        resume_from_checkpoint: Checkpoint | None = None,
+        datasets: dict | None = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        name = self.run_config.name or f"train_{int(time.time())}"
+        storage = self.run_config.storage_path or f"/tmp/ray_tpu/{name}"
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            storage,
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        history: list[dict] = []
+        while True:
+            try:
+                metrics = self._run_attempt(name, attempt, manager, history)
+                return Result(metrics, manager.latest(), history)
+            except (ActorError, TaskError, TrainingFailedError) as e:
+                attempt += 1
+                if max_failures >= 0 and attempt > max_failures:
+                    return Result(
+                        history[-1] if history else {}, manager.latest(), history,
+                        error=TrainingFailedError(str(e)),
+                    )
+                # elastic restart of the whole group (same world size)
+                time.sleep(0.5)
+
+    def _run_attempt(self, name: str, attempt: int, manager: CheckpointManager,
+                     history: list[dict]) -> dict:
+        scaling = self.scaling
+        n = scaling.num_workers
+        group_name = f"{name}_g{attempt}"
+
+        pg = ray_tpu.placement_group(
+            [scaling.worker_resources() for _ in range(n)],
+            strategy=scaling.placement_strategy,
+        )
+        pg.ready(timeout=60)
+        WorkerCls = ray_tpu.remote(TrainWorker)
+        workers = [
+            WorkerCls.options(
+                num_cpus=scaling.worker_resources().get("CPU", 1.0),
+                resources={k: v for k, v in scaling.worker_resources().items()
+                           if k != "CPU"},
+                placement_group=pg,
+                placement_group_bundle_index=i,
+                # poll() must be servable while run() blocks an executor thread
+                max_concurrency=2,
+            ).remote(i, n, name, scaling.backend(), group_name)
+            for i in range(n)
+        ]
+        try:
+            resume = manager.latest() or self.resume_from_checkpoint
+            ray_tpu.get(
+                [w.setup.remote(resume.path if resume else None) for w in workers],
+                timeout=120,
+            )
+            run_refs = [
+                w.run.remote(self.train_loop, self.train_loop_config) for w in workers
+            ]
+            final = self._poll_loop(workers, run_refs, manager, history)
+            return final
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            try:
+                ray_tpu.remove_placement_group(pg)
+            except Exception:
+                pass
+
+    def _poll_loop(self, workers, run_refs, manager: CheckpointManager,
+                   history: list[dict]) -> dict:
+        """Controller loop (ref: TrainController.run :469)."""
+        last_metrics: dict = {}
+        pending = list(run_refs)
+        while True:
+            # surface early run() failures (submission/unpickling errors)
+            # instead of polling a worker that never started
+            done_now, _ = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.01)
+            for ref in done_now:
+                r = ray_tpu.get(ref)
+                if not r.get("ok"):
+                    raise TrainingFailedError(r.get("error", "unknown"))
+            polls = ray_tpu.get([w.poll.remote() for w in workers], timeout=60)
+            for rank, poll in enumerate(polls):
+                for metrics, ckpt_path in poll["reports"]:
+                    metrics = {**metrics, "world_rank": rank}
+                    history.append(metrics)
+                    last_metrics = metrics
+                    if ckpt_path and rank == 0:
+                        manager.register(Checkpoint(ckpt_path), metrics)
+                if poll["error"]:
+                    raise TrainingFailedError(f"worker {rank}: {poll['error']}")
+            if all(p["done"] for p in polls):
+                results = ray_tpu.get(pending, timeout=60)
+                for r in results:
+                    if not r.get("ok"):
+                        raise TrainingFailedError(r.get("error", "unknown"))
+                return last_metrics
+            time.sleep(0.05)

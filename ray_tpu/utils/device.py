@@ -1,0 +1,55 @@
+"""JAX platform/device configuration helpers.
+
+Centralizes backend selection so tests and workers can force the virtual
+CPU mesh (``RT_FORCE_CPU_DEVICES=N``) before any jax backend initialization.
+The axon TPU plugin pins ``jax_platforms`` regardless of the JAX_PLATFORMS
+env var, so forcing must go through jax.config before first device use.
+"""
+
+from __future__ import annotations
+
+import os
+
+_configured = False
+
+
+def configure_jax() -> None:
+    """Apply RT_FORCE_CPU_DEVICES if set. Call before any jax backend use."""
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    n = int(os.environ.get("RT_FORCE_CPU_DEVICES", "0") or 0)
+    if n > 0:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def devices():
+    configure_jax()
+    import jax
+
+    return jax.devices()
+
+
+def local_device_count() -> int:
+    return len(devices())
+
+
+def is_tpu() -> bool:
+    configure_jax()
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False

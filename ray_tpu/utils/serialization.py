@@ -41,13 +41,25 @@ def _restore_jax(np_val):
 
 
 class _Pickler(pickle.Pickler):
-    """Pickler with a jax.Array reducer (only when jax is already imported)."""
+    """Pickler with a jax.Array reducer (only when jax is already imported).
+
+    Functions/classes defined in ``__main__`` force the cloudpickle path:
+    plain pickle happily serializes them *by reference* as ``__main__.f``,
+    which resolves to the wrong module inside a worker process — the classic
+    driver-script pitfall the reference avoids by always cloudpickling
+    function payloads."""
 
     jax_array_type = None
 
     def reducer_override(self, obj):
+        import types
+
         if self.jax_array_type is not None and isinstance(obj, self.jax_array_type):
             return (_restore_jax, (np.asarray(obj),))
+        if isinstance(obj, (types.FunctionType, type)) and getattr(
+            obj, "__module__", None
+        ) in ("__main__", None):
+            raise pickle.PicklingError("defined in __main__: needs cloudpickle")
         return NotImplemented
 
 

@@ -1,0 +1,127 @@
+"""Collective backend tests (modeled on the reference's
+util/collective/tests single-node CPU suite)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=16)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def spawn_group(rt):
+    """Per-test group factory; kills member actors at teardown so later
+    tests' groups can schedule (actors hold CPU slots for their lifetime)."""
+    spawned = []
+
+    def factory(rt_, n, group_name):
+        ws = _spawn_group(rt_, n, group_name)
+        spawned.extend(ws)
+        return ws
+
+    yield factory
+    for w in spawned:
+        try:
+            rt.kill(w)
+        except Exception:
+            pass
+
+
+def _spawn_group(rt, n, group_name):
+    @rt.remote
+    class W:
+        def __init__(self, rank):
+            import ray_tpu.collective as col
+
+            self.col = col
+            self.rank = rank
+            col.init_collective_group(n, rank, backend="cpu", group_name=group_name)
+
+        def allreduce(self, v):
+            return self.col.allreduce(np.asarray(v, np.float64), group_name=group_name)
+
+        def allgather(self, v):
+            return self.col.allgather(np.asarray(v, np.float64), group_name=group_name)
+
+        def reducescatter(self, v):
+            return self.col.reducescatter(np.asarray(v, np.float64), group_name=group_name)
+
+        def broadcast(self, v):
+            return self.col.broadcast(np.asarray(v, np.float64), group_name=group_name)
+
+        def barrier_then(self, x):
+            self.col.barrier(group_name=group_name)
+            return x
+
+        def send_to(self, v, dst):
+            self.col.send(np.asarray(v, np.float64), dst, group_name=group_name)
+            return True
+
+        def recv_from(self, src):
+            return self.col.recv(src, group_name=group_name)
+
+    return [W.remote(i) for i in range(n)]
+
+
+def test_cpu_allreduce(rt, spawn_group):
+    ws = spawn_group(rt, 4, "ar")
+    outs = rt.get([w.allreduce.remote([1.0 * (i + 1)] * 3) for i, w in enumerate(ws)])
+    for out in outs:
+        np.testing.assert_allclose(out, [10.0, 10.0, 10.0])
+
+
+def test_cpu_allgather(rt, spawn_group):
+    ws = spawn_group(rt, 3, "ag")
+    outs = rt.get([w.allgather.remote([float(i)]) for i, w in enumerate(ws)])
+    for out in outs:
+        np.testing.assert_allclose(out, [[0.0], [1.0], [2.0]])
+
+
+def test_cpu_reducescatter(rt, spawn_group):
+    ws = spawn_group(rt, 2, "rs")
+    # each rank contributes [r, r+1, r+2, r+3]; sum = [1, 3, 5, 7]
+    outs = rt.get(
+        [w.reducescatter.remote([float(i + j) for j in range(4)]) for i, w in enumerate(ws)]
+    )
+    np.testing.assert_allclose(outs[0], [1.0, 3.0])
+    np.testing.assert_allclose(outs[1], [5.0, 7.0])
+
+
+def test_cpu_broadcast(rt, spawn_group):
+    ws = spawn_group(rt, 3, "bc")
+    outs = rt.get([w.broadcast.remote([7.0 + i]) for i, w in enumerate(ws)])
+    for out in outs:
+        np.testing.assert_allclose(out, [7.0])  # src_rank=0's value
+
+
+def test_cpu_send_recv(rt, spawn_group):
+    ws = spawn_group(rt, 2, "p2p")
+    r = ws[1].recv_from.remote(0)
+    s = ws[0].send_to.remote([3.0, 4.0], 1)
+    assert rt.get(s)
+    np.testing.assert_allclose(rt.get(r), [3.0, 4.0])
+
+
+def test_cpu_barrier(rt, spawn_group):
+    ws = spawn_group(rt, 3, "bar")
+    outs = rt.get([w.barrier_then.remote(i) for i, w in enumerate(ws)])
+    assert outs == [0, 1, 2]
+
+
+def test_xla_single_process_group():
+    """world_size=1 xla group: all ops are local identities."""
+    from ray_tpu.collective.xla_group import XlaCollectiveGroup
+    from ray_tpu.collective.types import ReduceOp
+
+    g = XlaCollectiveGroup(1, 0, "solo")
+    x = np.arange(4.0)
+    np.testing.assert_allclose(g.allreduce(x), x)
+    np.testing.assert_allclose(g.allgather(x), x[None])
+    np.testing.assert_allclose(g.broadcast(x), x)
+    g.barrier()

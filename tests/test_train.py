@@ -1,0 +1,161 @@
+"""JaxTrainer end-to-end tests: 2-worker data-parallel training with
+gradient allreduce over the cpu collective fake — the FashionMNIST-DDP
+north-star config shape (BASELINE.md row 1) at test scale."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=16)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _dp_train_loop(config):
+    """Runs inside each worker actor: tiny linear-regression DP training."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu.collective as collective
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+
+    rng = np.random.RandomState(42 + rank)  # different data per worker
+    true_w = np.arange(1, 5, dtype=np.float64)
+    X = rng.randn(64, 4)
+    y = X @ true_w
+
+    w = jnp.zeros(4, dtype=jnp.float64) if False else jnp.zeros(4)
+    start = train.get_checkpoint()
+    start_step = 0
+    if start is not None:
+        state = start.to_dict()
+        w = jnp.asarray(state["w"])
+        start_step = state["step"]
+
+    def loss_fn(w):
+        pred = X @ w
+        return jnp.mean((pred - y) ** 2)
+
+    grad_fn = jax.grad(loss_fn)
+    lr = config["lr"]
+    for step in range(start_step, config["steps"]):
+        g = np.asarray(grad_fn(w))
+        # DDP: average gradients across workers through the collective
+        g = collective.allreduce(g, group_name=ctx.collective_group) / world
+        w = w - lr * g
+        if step % 5 == 4 or step == config["steps"] - 1:
+            ckpt = Checkpoint.from_dict({"w": np.asarray(w), "step": step + 1})
+            train.report({"loss": float(loss_fn(w)), "step": step}, checkpoint=ckpt)
+    return float(loss_fn(w))
+
+
+def test_jax_trainer_dp(rt, tmp_path):
+    trainer = JaxTrainer(
+        _dp_train_loop,
+        train_loop_config={"lr": 0.05, "steps": 20},
+        scaling_config=ScalingConfig(num_workers=2, collective_backend="cpu"),
+        run_config=RunConfig(
+            name="dp_test",
+            storage_path=str(tmp_path / "ckpts"),
+            checkpoint_config=CheckpointConfig(num_to_keep=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] < 1.0
+    assert result.checkpoint is not None
+    state = result.checkpoint.to_dict()
+    np.testing.assert_allclose(state["w"], [1, 2, 3, 4], atol=0.5)
+    # top-K retention
+    assert len(os.listdir(tmp_path / "ckpts")) <= 2
+
+
+def test_jax_trainer_single_worker(rt, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"answer": config["x"] * 2})
+        return None
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"x": 21},
+        scaling_config=ScalingConfig(num_workers=1, collective_backend="cpu"),
+        run_config=RunConfig(storage_path=str(tmp_path / "c2")),
+    )
+    result = trainer.fit()
+    assert result.metrics["answer"] == 42
+
+
+def test_jax_trainer_worker_failure_restarts(rt, tmp_path):
+    """FailureConfig path: worker 1 dies once, group restarts and resumes
+    from the last checkpoint (ref: Train v2 FailurePolicy semantics)."""
+    marker = str(tmp_path / "crashed_once")
+
+    def flaky_loop(config):
+        import os
+
+        import numpy as np
+
+        from ray_tpu import train
+        from ray_tpu.train import Checkpoint
+
+        ctx = train.get_context()
+        start = train.get_checkpoint()
+        step0 = start.to_dict()["step"] if start else 0
+        for step in range(step0, 6):
+            if step == 3 and ctx.get_world_rank() == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)  # hard crash, not an exception
+            ckpt = Checkpoint.from_dict({"step": step + 1})
+            train.report({"step": step}, checkpoint=ckpt)
+        return "done"
+
+    trainer = JaxTrainer(
+        flaky_loop,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=2, collective_backend="cpu"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "c3"),
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert os.path.exists(marker)  # crash really happened
+    assert result.metrics["step"] == 5  # and training still completed
+
+
+def test_trainer_failure_exhausts(rt, tmp_path):
+    def always_fails(config):
+        raise RuntimeError("nope")
+
+    trainer = JaxTrainer(
+        always_fails,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1, collective_backend="cpu"),
+        run_config=RunConfig(
+            storage_path=str(tmp_path / "c4"),
+            failure_config=FailureConfig(max_failures=1),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is not None
