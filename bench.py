@@ -1,0 +1,409 @@
+"""Perf harness: core microbenchmarks + single-chip Llama train step.
+
+Mirrors the reference's microbenchmark suite
+(ref: python/ray/_private/ray_perf.py:1, release/microbenchmark/run_microbenchmark.py)
+and compares against the checked-in expectations in BASELINE.md
+(release/perf_metrics/microbenchmark.json, v2.46.0).
+
+Usage:
+    python bench.py               # full run; prints ONE headline JSON line
+    python bench.py --micro       # microbenchmarks only
+    python bench.py --model       # model benchmark only
+    python bench.py --quick       # short windows (CI smoke)
+
+Side effect: writes BENCHVS.md (ours-vs-reference table) and
+bench_results.json (all raw numbers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Reference numbers from BASELINE.md (release/perf_metrics/microbenchmark.json).
+BASELINE = {
+    "single_client_get_calls": 10_723.0,
+    "single_client_put_calls": 5_113.0,
+    "single_client_put_gigabytes": 20.1,
+    "single_client_tasks_sync": 970.0,
+    "single_client_tasks_async": 8_081.0,
+    "multi_client_tasks_async": 21_960.0,
+    "1_1_actor_calls_sync": 2_020.0,
+    "1_1_actor_calls_async": 7_484.0,
+    "1_n_actor_calls_async": 8_318.0,
+    "n_n_actor_calls_async": 27_465.0,
+    "1_1_async_actor_calls_sync": 1_484.0,
+    "1_1_async_actor_calls_async": 4_133.0,
+    "single_client_wait_1k_refs": 4.8,
+    "placement_group_create_removal": 769.0,
+}
+
+HEADLINE = "single_client_tasks_async"
+
+# bf16 peak FLOP/s per chip by device kind (public TPU specs).
+TPU_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def timeit(fn, *, window: float, multiplier: int = 1, trials: int = 2) -> float:
+    """Run fn repeatedly for ``window`` seconds per trial; return best
+    ops/sec (ops = calls * multiplier). Mirrors the reference's
+    ray_microbenchmark_helpers.timeit shape."""
+    fn()  # warmup
+    best = 0.0
+    for _ in range(trials):
+        count = 0
+        start = time.perf_counter()
+        while True:
+            fn()
+            count += 1
+            elapsed = time.perf_counter() - start
+            if elapsed >= window:
+                break
+        best = max(best, count * multiplier / elapsed)
+    return best
+
+
+def run_micro(window: float) -> dict[str, float]:
+    import numpy as np
+
+    import ray_tpu
+
+    results: dict[str, float] = {}
+    ray_tpu.init(num_cpus=max(16, 2 * (os.cpu_count() or 8)))
+
+    try:
+        # ------------------------------------------------------ object plane
+        small = {"k": 1}
+        results["single_client_put_calls"] = timeit(
+            lambda: ray_tpu.put(small), window=window
+        )
+
+        ref = ray_tpu.put(b"ok")
+        results["single_client_get_calls"] = timeit(
+            lambda: ray_tpu.get(ref), window=window
+        )
+
+        big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)  # 100 MB
+        results["single_client_put_gigabytes"] = timeit(
+            lambda: ray_tpu.put(big), window=max(window, 2.0)
+        ) * (big.nbytes / 1e9)
+
+        # ------------------------------------------------------------- tasks
+        @ray_tpu.remote
+        def small_value():
+            return b"ok"
+
+        results["single_client_tasks_sync"] = timeit(
+            lambda: ray_tpu.get(small_value.remote()), window=window
+        )
+
+        def batch_tasks(n=1000):
+            ray_tpu.get([small_value.remote() for _ in range(n)])
+
+        results["single_client_tasks_async"] = timeit(
+            batch_tasks, window=max(window, 2.0), multiplier=1000
+        )
+
+        @ray_tpu.remote
+        def task_fanout(n):
+            import ray_tpu as rt
+
+            rt.get([small_value.remote() for _ in range(n)])
+            return 0
+
+        def multi_client(n=500, clients=4):
+            ray_tpu.get([task_fanout.remote(n) for _ in range(clients)])
+
+        results["multi_client_tasks_async"] = timeit(
+            multi_client, window=max(window, 2.0), multiplier=2000
+        )
+
+        # ------------------------------------------------------------ actors
+        @ray_tpu.remote(num_cpus=0)
+        class Actor:
+            def small_value(self):
+                return b"ok"
+
+        a = Actor.remote()
+        ray_tpu.get(a.small_value.remote())
+        results["1_1_actor_calls_sync"] = timeit(
+            lambda: ray_tpu.get(a.small_value.remote()), window=window
+        )
+
+        def actor_batch(n=500):
+            ray_tpu.get([a.small_value.remote() for _ in range(n)])
+
+        results["1_1_actor_calls_async"] = timeit(
+            actor_batch, window=max(window, 2.0), multiplier=500
+        )
+
+        n_servers = 4
+        servers = [Actor.remote() for _ in range(n_servers)]
+        ray_tpu.get([s.small_value.remote() for s in servers])
+
+        def one_n(n=250):
+            refs = []
+            for s in servers:
+                refs.extend(s.small_value.remote() for _ in range(n))
+            ray_tpu.get(refs)
+
+        results["1_n_actor_calls_async"] = timeit(
+            one_n, window=max(window, 2.0), multiplier=250 * n_servers
+        )
+
+        @ray_tpu.remote(num_cpus=0)
+        class Client:
+            def __init__(self, server):
+                self.server = server
+
+            def batch(self, n):
+                import ray_tpu as rt
+
+                rt.get([self.server.small_value.remote() for _ in range(n)])
+
+        clients = [Client.remote(s) for s in servers]
+
+        def n_n(n=250):
+            ray_tpu.get([c.batch.remote(n) for c in clients])
+
+        results["n_n_actor_calls_async"] = timeit(
+            n_n, window=max(window, 2.0), multiplier=250 * n_servers
+        )
+
+        @ray_tpu.remote(num_cpus=0, max_concurrency=8)
+        class AsyncActor:
+            async def small_value(self):
+                return b"ok"
+
+        aa = AsyncActor.remote()
+        ray_tpu.get(aa.small_value.remote())
+        results["1_1_async_actor_calls_sync"] = timeit(
+            lambda: ray_tpu.get(aa.small_value.remote()), window=window
+        )
+
+        def async_actor_batch(n=500):
+            ray_tpu.get([aa.small_value.remote() for _ in range(n)])
+
+        results["1_1_async_actor_calls_async"] = timeit(
+            async_actor_batch, window=max(window, 2.0), multiplier=500
+        )
+
+        # ------------------------------------------------------------- wait
+        refs_1k = [ray_tpu.put(i) for i in range(1000)]
+
+        def wait_1k():
+            ray_tpu.wait(refs_1k, num_returns=len(refs_1k))
+
+        results["single_client_wait_1k_refs"] = timeit(wait_1k, window=window)
+
+        # -------------------------------------------------- placement groups
+        def pg_cycle():
+            pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+            pg.ready(timeout=5)
+            ray_tpu.remove_placement_group(pg)
+
+        results["placement_group_create_removal"] = timeit(pg_cycle, window=window)
+    finally:
+        ray_tpu.shutdown()
+    return results
+
+
+def _tpu_peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in sorted(TPU_PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return flops
+    if "tpu" in kind or device.platform == "tpu":
+        return 197e12  # conservative default
+    return None
+
+
+def run_model(quick: bool) -> dict:
+    """Single-chip Llama train step: tokens/s and MFU, attn_impl='auto' so the
+    Pallas flash kernel is on the measured path (VERDICT r1 #3)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    peak = _tpu_peak_flops(dev)
+
+    if on_tpu and not quick:
+        cfg = LlamaConfig(
+            vocab_size=32_000,
+            d_model=1536,
+            n_layers=12,
+            n_heads=12,
+            n_kv_heads=12,
+            d_ff=6144,
+            max_seq_len=8192,
+            dtype="bfloat16",
+        )
+        seqs = [512, 2048, 8192]
+        tokens_per_step = 16_384
+        steps = 10
+    else:  # CPU smoke shape
+        cfg = LlamaConfig(
+            vocab_size=512,
+            d_model=128,
+            n_layers=2,
+            n_heads=4,
+            n_kv_heads=4,
+            d_ff=256,
+            max_seq_len=1024,
+            dtype="float32",
+        )
+        seqs = [256]
+        tokens_per_step = 512
+        steps = 3
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(params)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama_loss(p, {"tokens": tokens}, cfg, mesh=None, attn_impl="auto")
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+
+    out = {"params": n_params, "device": getattr(dev, "device_kind", str(dev)),
+           "platform": dev.platform, "seq": {}}
+    for T in seqs:
+        B = max(1, tokens_per_step // T)
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size, dtype=jnp.int32
+        )
+        import numpy as np
+
+        def fence(params, loss):
+            # device→host copies as the completion fence: block_until_ready
+            # can return early under the axon plugin's async dispatch (it
+            # only waits on work already submitted to the device queue), but
+            # a d2h read of the *last* update's outputs cannot.
+            np.asarray(loss)
+            np.asarray(jax.tree.leaves(params)[0]).ravel()[0]
+
+        params, opt_state, loss = jit_step(params, opt_state, toks)  # compile
+        fence(params, loss)
+        start = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = jit_step(params, opt_state, toks)
+        fence(params, loss)
+        dt = (time.perf_counter() - start) / steps
+        tok_s = B * T / dt
+        # train FLOPs/token ≈ 6N (matmuls, fwd+bwd) + 6·L·d_model·T (causal
+        # attention scores fwd+bwd) — the scaling-book accounting.
+        flops_per_token = 6 * n_params + 6 * cfg.n_layers * cfg.d_model * T
+        entry = {"tokens_per_s": tok_s, "step_ms": dt * 1e3,
+                 "loss": float(loss)}
+        if peak:
+            entry["mfu_pct"] = 100.0 * tok_s * flops_per_token / peak
+        out["seq"][str(T)] = entry
+    return out
+
+
+def write_benchvs(micro: dict, model: dict | None) -> None:
+    lines = [
+        "# BENCHVS — ours vs reference (BASELINE.md, Ray 2.46.0 release metrics)",
+        "",
+        "Reference hardware: single 64-vCPU m5.16xlarge node. Ours: this machine "
+        f"({os.cpu_count()} cpus). Produced by `python bench.py`.",
+        "",
+        "| Metric | Ours | Reference | Ratio |",
+        "|---|---:|---:|---:|",
+    ]
+    for name, value in micro.items():
+        base = BASELINE.get(name)
+        unit = "GB/s" if "gigabytes" in name else "/s"
+        ratio = f"{value / base:.2f}×" if base else "—"
+        base_s = f"{base:,.1f}" if base else "—"
+        lines.append(f"| {name} | {value:,.1f} {unit} | {base_s} | {ratio} |")
+    if model:
+        lines += [
+            "",
+            "## Model: Llama single-chip train step "
+            f"({model['params']/1e6:.0f}M params, {model['device']}, "
+            f"platform={model['platform']})",
+            "",
+            "| Seq len | tokens/s | step ms | MFU % |",
+            "|---:|---:|---:|---:|",
+        ]
+        for T, e in model["seq"].items():
+            mfu = f"{e['mfu_pct']:.1f}" if "mfu_pct" in e else "—"
+            lines.append(
+                f"| {T} | {e['tokens_per_s']:,.0f} | {e['step_ms']:.1f} | {mfu} |"
+            )
+        lines += [
+            "",
+            "No reference model-throughput numbers are checked in "
+            "(BASELINE.md: 'No ML-model numbers'); MFU is vs chip bf16 peak.",
+        ]
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCHVS.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micro", action="store_true")
+    ap.add_argument("--model", action="store_true")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    do_micro = args.micro or not args.model
+    do_model = args.model or not args.micro
+
+    window = 0.5 if args.quick else 2.0
+    micro = run_micro(window) if do_micro else {}
+    model = None
+    if do_model:
+        try:
+            model = run_model(args.quick)
+        except Exception as e:  # model bench must not sink the micro numbers
+            print(f"model bench failed: {e!r}", file=sys.stderr)
+
+    raw = {"micro": micro, "model": model}
+    root = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(root, "bench_results.json"), "w") as f:
+        json.dump(raw, f, indent=2)
+    if micro:
+        write_benchvs(micro, model)
+
+    value = micro.get(HEADLINE)
+    if value is not None:
+        print(json.dumps({
+            "metric": HEADLINE,
+            "value": round(value, 1),
+            "unit": "tasks/s",
+            "vs_baseline": round(value / BASELINE[HEADLINE], 3),
+        }))
+    elif model:
+        first = next(iter(model["seq"].values()))
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_s",
+            "value": round(first["tokens_per_s"], 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(first.get("mfu_pct", 0) / 100, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

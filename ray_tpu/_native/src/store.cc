@@ -38,6 +38,9 @@ enum EntryState : uint32_t {
   kCreated = 1,
   kSealed = 2,
   kChannel = 3,
+  kEvicted = 4,  // tombstone: data freed by LRU; id remembered so a later
+                 // get() fails fast (ObjectLostError / lineage reconstruction)
+                 // instead of blocking forever
 };
 
 enum Error : int {
@@ -49,6 +52,7 @@ enum Error : int {
   kBadState = -5,
   kSysError = -6,
   kClosed = -7,
+  kLost = -8,  // object was evicted after having been sealed
 };
 
 struct Entry {
@@ -268,7 +272,9 @@ Entry* find_entry(Handle* h, const uint8_t* id) {
   return nullptr;
 }
 
-Entry* insert_entry(Handle* h, const uint8_t* id) {
+void erase_entry(Handle* h, Entry* e);
+
+Entry* insert_entry_once(Handle* h, const uint8_t* id) {
   Entry* t = table(h);
   uint64_t slots = h->hdr->table_slots;
   uint64_t i = hash_id(id) % slots;
@@ -281,6 +287,28 @@ Entry* insert_entry(Handle* h, const uint8_t* id) {
     i = (i + 1) % slots;
   }
   return nullptr;  // table full
+}
+
+Entry* insert_entry(Handle* h, const uint8_t* id) {
+  Entry* e = insert_entry_once(h, id);
+  if (e) return e;
+  // Table full: reclaim eviction tombstones (they exist only to fail lookups
+  // fast; dropping them under table pressure is safe). erase_entry's cluster
+  // re-insertion can relocate a not-yet-visited tombstone into an already-
+  // scanned slot, so sweep until a full pass finds none.
+  Entry* t = table(h);
+  uint64_t slots = h->hdr->table_slots;
+  bool erased_any = true;
+  while (erased_any) {
+    erased_any = false;
+    for (uint64_t i = 0; i < slots; ++i) {
+      if (t[i].state == kEvicted) {
+        erase_entry(h, &t[i]);
+        erased_any = true;
+      }
+    }
+  }
+  return insert_entry_once(h, id);
 }
 
 void erase_entry(Handle* h, Entry* e) {
@@ -317,12 +345,13 @@ int evict_locked(Handle* h, uint64_t need) {
   }
   if (!victim) return 0;
   free_locked(h, victim->offset);
-  erase_entry(h, victim);
+  // Leave a tombstone instead of erasing: a live ObjectRef (or a stale GCS
+  // location entry) may still point here, and a blocking get must see "lost",
+  // not wait forever (ADVICE r1: eviction vs. live refs).
+  victim->state = kEvicted;
+  victim->offset = 0;
+  victim->refcnt = 0;
   return 1;
-}
-
-ChannelHeader* channel_hdr(Handle* h, Entry* e) {
-  return reinterpret_cast<ChannelHeader*>(h->base + e->offset);
 }
 
 }  // namespace
@@ -427,7 +456,8 @@ int rt_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* offset_out) 
   auto* h = static_cast<Handle*>(hv);
   StoreHeader* s = h->hdr;
   lock(&s->mu);
-  if (find_entry(h, id)) {
+  Entry* existing = find_entry(h, id);
+  if (existing && existing->state != kEvicted) {
     pthread_mutex_unlock(&s->mu);
     return kExists;
   }
@@ -440,7 +470,9 @@ int rt_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* offset_out) 
     pthread_mutex_unlock(&s->mu);
     return kOutOfMemory;
   }
-  Entry* e = insert_entry(h, id);
+  // Resurrect an evicted id in place (lineage reconstruction re-creates the
+  // same ObjectID); otherwise claim a fresh slot.
+  Entry* e = existing ? existing : insert_entry(h, id);
   if (!e) {
     free_locked(h, off);
     pthread_mutex_unlock(&s->mu);
@@ -495,6 +527,10 @@ int rt_get(void* hv, const uint8_t* id, int64_t timeout_ms, uint64_t* offset_out
       pthread_mutex_unlock(&s->mu);
       return kOK;
     }
+    if (e && e->state == kEvicted) {
+      pthread_mutex_unlock(&s->mu);
+      return kLost;  // fail fast: caller raises ObjectLostError / reconstructs
+    }
     int rc;
     if (timeout_ms >= 0) {
       rc = cond_timedwait(&s->cv, &s->mu, &deadline);
@@ -547,7 +583,9 @@ int rt_delete(void* hv, const uint8_t* id) {
     pthread_mutex_unlock(&h->hdr->mu);
     return kNotFound;
   }
-  if (e->refcnt <= 0) {
+  if (e->state == kEvicted) {
+    erase_entry(h, e);  // tombstone: data already freed
+  } else if (e->refcnt <= 0) {
     free_locked(h, e->offset);
     erase_entry(h, e);
   } else {
@@ -603,33 +641,46 @@ int rt_chan_create(void* hv, const uint8_t* id, uint64_t size,
   return kOK;
 }
 
-static int chan_lookup(Handle* h, const uint8_t* id, Entry** e_out) {
+// Copies the channel's arena offset/size out under the store mutex. Entry*
+// must never be held across the unlock: erase_entry's open-addressing cluster
+// re-insertion relocates entries, so a cached pointer can dangle (ADVICE r1).
+// The *data* never moves — channels are never evicted — so the copied offset
+// stays valid for the blocking waits below.
+static int chan_lookup(Handle* h, const uint8_t* id, uint64_t* off_out,
+                       uint64_t* size_out) {
   lock(&h->hdr->mu);
   Entry* e = find_entry(h, id);
+  if (!e || e->state != kChannel) {
+    pthread_mutex_unlock(&h->hdr->mu);
+    return kNotFound;
+  }
+  *off_out = e->offset;
+  if (size_out) *size_out = e->size;
   pthread_mutex_unlock(&h->hdr->mu);
-  if (!e || e->state != kChannel) return kNotFound;
-  *e_out = e;
   return kOK;
+}
+
+static ChannelHeader* chan_hdr_at(Handle* h, uint64_t off) {
+  return reinterpret_cast<ChannelHeader*>(h->base + off);
 }
 
 int rt_chan_data(void* hv, const uint8_t* id, uint64_t* offset_out,
                  uint64_t* size_out) {
   auto* h = static_cast<Handle*>(hv);
-  Entry* e;
-  int rc = chan_lookup(h, id, &e);
+  uint64_t off;
+  int rc = chan_lookup(h, id, &off, size_out);
   if (rc != kOK) return rc;
-  *offset_out = e->offset + align_up(sizeof(ChannelHeader), kAlign);
-  *size_out = e->size;
+  *offset_out = off + align_up(sizeof(ChannelHeader), kAlign);
   return kOK;
 }
 
 // Writer: wait until all readers of the previous version have released.
 int rt_chan_write_acquire(void* hv, const uint8_t* id, int64_t timeout_ms) {
   auto* h = static_cast<Handle*>(hv);
-  Entry* e;
-  int rc = chan_lookup(h, id, &e);
+  uint64_t off;
+  int rc = chan_lookup(h, id, &off, nullptr);
   if (rc != kOK) return rc;
-  ChannelHeader* ch = channel_hdr(h, e);
+  ChannelHeader* ch = chan_hdr_at(h, off);
   timespec deadline;
   if (timeout_ms >= 0) deadline_after_ms(timeout_ms, &deadline);
   lock(&ch->mu);
@@ -648,10 +699,10 @@ int rt_chan_write_acquire(void* hv, const uint8_t* id, int64_t timeout_ms) {
 
 int rt_chan_write_release(void* hv, const uint8_t* id, uint64_t payload_size) {
   auto* h = static_cast<Handle*>(hv);
-  Entry* e;
-  int rc = chan_lookup(h, id, &e);
+  uint64_t off;
+  int rc = chan_lookup(h, id, &off, nullptr);
   if (rc != kOK) return rc;
-  ChannelHeader* ch = channel_hdr(h, e);
+  ChannelHeader* ch = chan_hdr_at(h, off);
   lock(&ch->mu);
   ch->version += 1;
   ch->payload_size = payload_size;
@@ -666,10 +717,10 @@ int rt_chan_read_acquire(void* hv, const uint8_t* id, uint64_t last_version,
                          int64_t timeout_ms, uint64_t* version_out,
                          uint64_t* payload_size_out) {
   auto* h = static_cast<Handle*>(hv);
-  Entry* e;
-  int rc = chan_lookup(h, id, &e);
+  uint64_t off;
+  int rc = chan_lookup(h, id, &off, nullptr);
   if (rc != kOK) return rc;
-  ChannelHeader* ch = channel_hdr(h, e);
+  ChannelHeader* ch = chan_hdr_at(h, off);
   timespec deadline;
   if (timeout_ms >= 0) deadline_after_ms(timeout_ms, &deadline);
   lock(&ch->mu);
@@ -693,10 +744,10 @@ int rt_chan_read_acquire(void* hv, const uint8_t* id, uint64_t last_version,
 
 int rt_chan_read_release(void* hv, const uint8_t* id) {
   auto* h = static_cast<Handle*>(hv);
-  Entry* e;
-  int rc = chan_lookup(h, id, &e);
+  uint64_t off;
+  int rc = chan_lookup(h, id, &off, nullptr);
   if (rc != kOK) return rc;
-  ChannelHeader* ch = channel_hdr(h, e);
+  ChannelHeader* ch = chan_hdr_at(h, off);
   lock(&ch->mu);
   if (ch->readers_left > 0) ch->readers_left -= 1;
   pthread_cond_broadcast(&ch->cv);
@@ -706,10 +757,10 @@ int rt_chan_read_release(void* hv, const uint8_t* id) {
 
 int rt_chan_close(void* hv, const uint8_t* id) {
   auto* h = static_cast<Handle*>(hv);
-  Entry* e;
-  int rc = chan_lookup(h, id, &e);
+  uint64_t off;
+  int rc = chan_lookup(h, id, &off, nullptr);
   if (rc != kOK) return rc;
-  ChannelHeader* ch = channel_hdr(h, e);
+  ChannelHeader* ch = chan_hdr_at(h, off);
   lock(&ch->mu);
   ch->closed = 1;
   pthread_cond_broadcast(&ch->cv);

@@ -34,11 +34,13 @@ except ImportError:  # pragma: no cover
 import pickle
 
 from ray_tpu.config import get_config
+from ray_tpu.core import object_store
 from ray_tpu.core.object_store import SharedObjectStore
 from ray_tpu.core.ref import (
     ActorError,
     ActorHandle,
     GetTimeoutError,
+    ObjectLostError,
     ObjectRef,
     TaskError,
     WorkerCrashedError,
@@ -199,11 +201,29 @@ class CoreClient:
                 # owned shm result — may live on the executing node's store
                 # (spillback): fall through to the shm/pull path below
             if self.store.contains(oid):
-                return await self.loop.run_in_executor(None, self.store.get, oid, 10_000)
+                try:
+                    return await self.loop.run_in_executor(None, self.store.get, oid, 10_000)
+                except object_store.ObjectEvictedError:
+                    # Local copy was LRU-evicted under memory pressure between
+                    # contains() and get(): re-pull from another holder (the
+                    # raylet consults the GCS directory); no holder → lost.
+                    ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+                    if not ok:
+                        raise ObjectLostError(
+                            f"{ref} was evicted and no other copy exists"
+                        ) from None
+                    continue
             if entry is not None:
                 if entry.ready.is_set():  # owned, in_shm, not local: pull it
                     ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
                     if not ok:
+                        # distinguish "not there yet" from "gone": a local
+                        # eviction tombstone + no pullable holder means the
+                        # object is lost, not late
+                        if self.store.is_evicted(oid):
+                            raise ObjectLostError(
+                                f"{ref} was evicted and no other copy exists"
+                            )
                         await asyncio.sleep(0.05)
                     continue
                 # owned, pending task result
@@ -313,7 +333,7 @@ class CoreClient:
         cached = getattr(fn, "__rt_func_id__", None)
         if cached is not None and cached in self._registered_funcs:
             return cached
-        blob = cloudpickle.dumps(fn)
+        blob = serialization.ship_dumps(fn)
         func_id = hashlib.sha1(blob).digest()
         if func_id not in self._registered_funcs:
             self._call_on_loop(
@@ -451,6 +471,8 @@ class CoreClient:
                     else await rpc.connect(*raylet_addr)
                 )
                 try:
+                    # persistent conn → raylet may reap the lease if we die
+                    payload["owner_bound"] = conn is self.raylet
                     reply = await conn.call("lease_worker", payload)
                 finally:
                     if conn is not self.raylet:
@@ -560,7 +582,7 @@ class CoreClient:
                      placement_group=None, bundle_index=-1, get_if_exists=False,
                      lifetime=None) -> ActorHandle:
         actor_id = ActorID.generate()
-        class_blob = cloudpickle.dumps(cls)
+        class_blob = serialization.ship_dumps(cls)
         res = dict(resources or {})
         res.setdefault("CPU", num_cpus)
         spec = {
@@ -724,6 +746,25 @@ class CoreClient:
             return None
         self._actor_info[info["actor_id"]] = info
         return ActorHandle(info["actor_id"], core=self)
+
+    # ------------------------------------------------------ compiled DAGs
+    def start_dag_loop(self, handle: ActorHandle, schedule: dict):
+        """Kick off an actor's compiled-DAG loop; the RPC reply arrives when
+        the loop exits at teardown (ref: compiled_dag_node.py actor loops).
+        Returns a concurrent.futures.Future with the loop's summary."""
+
+        async def go():
+            conn = await self._actor_connection(handle.actor_id)
+            reply = await conn.call("start_dag_loop", {"schedule": schedule},
+                                    timeout=None)
+            if isinstance(reply, dict) and reply.get("error") is not None:
+                raise reply["error"]
+            return reply.get("result") if isinstance(reply, dict) else reply
+
+        return asyncio.run_coroutine_threadsafe(go(), self.loop)
+
+    def wait_dag_loop(self, fut, timeout: float | None = None):
+        return fut.result(timeout)
 
     # ------------------------------------------------------------ helpers
     def _run_sync(self, coro, timeout=None):

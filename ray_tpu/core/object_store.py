@@ -34,6 +34,12 @@ class ChannelClosedError(ObjectStoreError):
     pass
 
 
+class ObjectEvictedError(ObjectStoreError):
+    """The object was sealed, then LRU-evicted: it is gone from this node.
+    Callers surface ObjectLostError / trigger lineage reconstruction instead
+    of blocking forever on a get."""
+
+
 _ERRNAMES = {
     -1: "not found",
     -2: "already exists",
@@ -42,6 +48,7 @@ _ERRNAMES = {
     -5: "bad state",
     -6: "system error",
     -7: "closed",
+    -8: "evicted",
 }
 
 
@@ -54,6 +61,8 @@ def _check(rc: int, what: str):
         raise ObjectTimeoutError(what)
     if rc == -7:
         raise ChannelClosedError(what)
+    if rc == -8:
+        raise ObjectEvictedError(what)
     raise ObjectStoreError(f"{what}: {_ERRNAMES.get(rc, rc)}")
 
 
@@ -126,6 +135,18 @@ class SharedObjectStore:
 
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.rt_contains(self._handle, object_id.binary()))
+
+    def is_evicted(self, object_id: ObjectID) -> bool:
+        """True if this id was sealed here and later LRU-evicted (tombstone)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rt_get(
+            self._handle, object_id.binary(), 0, ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc == 0:  # present after all — drop the ref we just took
+            self.release(object_id)
+            return False
+        return rc == -8
 
     def release(self, object_id: ObjectID) -> None:
         self._lib.rt_release(self._handle, object_id.binary())

@@ -48,6 +48,7 @@ class Lease:
     resources: dict[str, float]
     worker: WorkerHandle
     pg_key: tuple | None = None  # (pg_id, bundle_index) if inside a bundle
+    owner_conn: object = None  # requester's connection: leases die with it
 
 
 class ResourceLedger:
@@ -339,7 +340,13 @@ class Raylet:
         w.lease_id = lease_id
         if p.get("for_actor") is not None:
             w.actor_id = p["for_actor"]
-        self.leases[lease_id] = Lease(lease_id, resources, w, pg_key)
+        # A lease dies with its owner's connection only when the owner says
+        # so (core_client sets owner_bound on its persistent raylet conn).
+        # Actor leases and spillback leases arrive over transient connections
+        # that close right after the grant — reaping those would kill the
+        # worker we just handed out.
+        owner_conn = conn if p.get("owner_bound") else None
+        self.leases[lease_id] = Lease(lease_id, resources, w, pg_key, owner_conn)
         return {
             "granted": True,
             "lease_id": lease_id,
@@ -378,6 +385,24 @@ class Raylet:
             if waiter_conn is conn and not fut.done():
                 fut.cancel()
         self._lease_waiters = [w for w in self._lease_waiters if w[3] is not conn]
+        # Reclaim *granted* leases whose owner died without return_lease:
+        # otherwise the worker and its resources leak forever (ref: raylet
+        # disposes of leased workers when the lease owner dies).
+        dead = [l for l in self.leases.values() if l.owner_conn is conn]
+        for lease in dead:
+            self.leases.pop(lease.lease_id, None)
+            self._free_lease_resources(lease)
+            w = lease.worker
+            w.lease_id = None
+            # the worker may be mid-task for a dead owner — terminate rather
+            # than recycle (actor workers are single-purpose anyway)
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+            self.all_workers.pop(w.worker_id, None)
+        if dead:
+            self._grant_waiters()
 
     def _pick_spillback(self, resources, p):
         """Hybrid-policy spillback: if we can never or not-now satisfy but a

@@ -149,6 +149,13 @@ class ActorMethod:
             self._handle, self._name, args, kwargs, num_returns=self._num_returns or 1
         )
 
+    def bind(self, *args) -> Any:
+        """Author a compiled-graph node for this method
+        (ref: dag/dag_node.py bind API)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args)
+
 
 def _rebuild_actor_handle(actor_id, method_names, options):
     return ActorHandle(actor_id, core=None, method_names=method_names, options=options)

@@ -228,6 +228,28 @@ class Worker:
         except Exception as e:
             return {"error": _as_task_error(e)}
 
+    async def rpc_start_dag_loop(self, conn, p):
+        """Run a compiled-DAG static schedule until its channels close
+        (ref: compiled_dag_node.py actor loop). Dedicated thread: blocking
+        channel waits must not stall the actor's normal method surface."""
+        if self.actor_instance is None:
+            return {"error": TaskError("no actor instance on this worker")}
+        from ray_tpu.dag.runner import run_dag_loop
+
+        loop = asyncio.get_running_loop()
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rt-dag"
+        )
+        try:
+            result = await loop.run_in_executor(
+                ex, lambda: run_dag_loop(self, p["schedule"])
+            )
+            return {"result": result}
+        except Exception as e:
+            return {"error": _as_task_error(e)}
+        finally:
+            ex.shutdown(wait=False)
+
     async def rpc_exit_worker(self, conn, p):
         self._exit_requested = True
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)

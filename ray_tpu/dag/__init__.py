@@ -1,0 +1,24 @@
+"""Compiled graphs (aDAG equivalent): static dataflow over actors on shm
+channels (ref: python/ray/dag/ + python/ray/experimental/channel/)."""
+
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+    bind,
+)
+
+__all__ = [
+    "ChannelClosed",
+    "ShmChannel",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "ClassMethodNode",
+    "DAGNode",
+    "InputNode",
+    "MultiOutputNode",
+    "bind",
+]

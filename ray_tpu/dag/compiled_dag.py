@@ -1,0 +1,228 @@
+"""CompiledDAG: static per-actor schedules over shm channels.
+
+The TPU-native equivalent of the reference's compiled graphs
+(ref: python/ray/dag/compiled_dag_node.py:805 CompiledDAG._get_or_compile
+:1542, execute :2536; dag/dag_node_operation.py:14 READ/COMPUTE/WRITE op
+schedules). Compilation:
+
+  1. walk the authored graph (topological — DFS postorder),
+  2. allocate one native shm channel per cross-process edge
+     (num_readers = #consumer processes; same-actor edges pass values
+     in-process with no channel),
+  3. ship each actor a static schedule [{read chans -> method -> write chan}]
+     executed by a long-running loop (worker.rpc_start_dag_loop) — ZERO
+     per-iteration task submissions, the reference's whole point,
+  4. driver I/O: execute() writes the input channel, result refs read the
+     leaf channels.
+
+Single-node by design for now: channels live in the node's shm arena (the
+reference's cross-node channel registration, core_worker.proto:577, is the
+round-3+ extension; multi-host TPU pipelines run *inside* one jitted SPMD
+program over the mesh instead — see parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+from ray_tpu.core import api
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.utils.ids import ObjectID
+
+
+def _as_bytes(node_id) -> bytes:
+    return node_id.binary() if hasattr(node_id, "binary") else bytes(node_id)
+
+
+class CompiledDAGRef:
+    """Future for one execute() iteration (ref: compiled_dag_ref.py:37)."""
+
+    def __init__(self, dag: "CompiledDAG", version: int):
+        self._dag = dag
+        self._version = version
+        self._value = None
+        self._done = False
+
+    def get(self, timeout: float | None = None):
+        if not self._done:
+            self._value = self._dag._read_output(self._version, timeout)
+            self._done = True
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, *, buffer_size_bytes: int = 8 << 20,
+                 timeout_s: float = 30.0):
+        self.root = root
+        self.buffer_size = buffer_size_bytes
+        self.timeout_s = timeout_s
+        self._compiled = False
+        self._torn_down = False
+        self._exec_version = 0
+        self._read_version = 0
+        self._read_lock = threading.Lock()
+        self._loop_futures: list = []
+        self._compile()
+
+    # ------------------------------------------------------------- compile
+    def _compile(self) -> None:
+        core = api.get_core()
+        nodes = list(self.root.walk())
+        self.input_node = None
+        for n in nodes:
+            if isinstance(n, InputNode):
+                if self.input_node is not None:
+                    raise ValueError("compiled DAG supports exactly one InputNode")
+                self.input_node = n
+        if self.input_node is None:
+            raise ValueError("DAG has no InputNode")
+        if isinstance(self.root, MultiOutputNode):
+            self.leaves = self.root.outputs
+            body = [n for n in nodes if isinstance(n, ClassMethodNode)]
+        else:
+            if not isinstance(self.root, ClassMethodNode):
+                raise ValueError("DAG root must be a ClassMethodNode or MultiOutputNode")
+            self.leaves = [self.root]
+            body = [n for n in nodes if isinstance(n, ClassMethodNode)]
+        if not body:
+            raise ValueError("DAG has no actor method nodes")
+
+        # consumer processes per producer node ("driver" or actor_id bytes)
+        consumers: dict[int, set] = {id(n): set() for n in nodes}
+        for n in body:
+            akey = n.actor_handle.actor_id.binary()
+            for a in n.args:
+                if isinstance(a, DAGNode):
+                    consumers[id(a)].add(akey)
+        for leaf in self.leaves:
+            if not isinstance(leaf, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor method nodes")
+            consumers[id(leaf)].add("driver")
+
+        # verify all actors are on this node (shm channels are node-local)
+        my_node = core.node_id.binary()
+        for n in body:
+            info = core._run_sync(
+                core.gcs.call("get_actor", {"actor_id": n.actor_handle.actor_id})
+            )
+            if info is None:
+                raise ValueError(f"actor {n.actor_handle.actor_id!r} not found")
+            node_id = info.get("node_id")
+            if node_id is not None and _as_bytes(node_id) != my_node:
+                raise NotImplementedError(
+                    "compiled DAGs currently require all actors on the "
+                    "driver's node (shm channels; cross-node channels are the "
+                    "DCN extension)"
+                )
+
+        store = core.store
+        # one channel per node that has at least one *cross-process* consumer
+        self.channels: dict[int, ShmChannel] = {}
+        node_actor = {id(n): n.actor_handle.actor_id.binary() for n in body}
+
+        def needs_channel(n) -> set:
+            """Remote consumer set for node n (producers never read their own
+            channel: same-actor edges are passed in-process)."""
+            owner = node_actor.get(id(n), "driver")
+            return {c for c in consumers[id(n)] if c != owner}
+
+        for n in [self.input_node] + body:
+            remote = needs_channel(n)
+            if remote:
+                cid = ObjectID.from_random()
+                self.channels[id(n)] = ShmChannel(
+                    store, cid, size=self.buffer_size,
+                    num_readers=len(remote), create=True,
+                )
+
+        # build per-actor schedules in topo order
+        node_index = {id(n): i for i, n in enumerate(nodes)}
+        schedules: dict[bytes, list] = {}
+        for n in body:
+            akey = node_actor[id(n)]
+            args_spec = []
+            for a in n.args:
+                if isinstance(a, DAGNode):
+                    if node_actor.get(id(a)) == akey:
+                        args_spec.append(("local", node_index[id(a)]))
+                    else:
+                        ch = self.channels[id(a)]
+                        args_spec.append(("chan", ch.chan_id.binary()))
+                else:
+                    args_spec.append(("static", a))
+            out = self.channels.get(id(n))
+            schedules.setdefault(akey, []).append({
+                "node_index": node_index[id(n)],
+                "method": n.method_name,
+                "args": args_spec,
+                "out_chan": out.chan_id.binary() if out else None,
+            })
+        for sched in schedules.values():
+            sched.sort(key=lambda t: t["node_index"])
+
+        # start the per-actor loops (long-running RPC; replies on teardown)
+        self.input_channel = self.channels[id(self.input_node)]
+        self.leaf_channels = [self.channels[id(leaf)] for leaf in self.leaves]
+        self._actor_handles = {node_actor[id(n)]: n.actor_handle for n in body}
+        for akey, sched in schedules.items():
+            handle = self._actor_handles[akey]
+            fut = core.start_dag_loop(handle, {"tasks": sched,
+                                               "chan_size": self.buffer_size})
+            self._loop_futures.append(fut)
+        # give loops a beat to attach to channels before first execute
+        time.sleep(0.05)
+        self._compiled = True
+
+    # ------------------------------------------------------------- execute
+    def execute(self, value: Any) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        self.input_channel.write(value, timeout_ms=int(self.timeout_s * 1000))
+        self._exec_version += 1
+        return CompiledDAGRef(self, self._exec_version)
+
+    def _read_output(self, version: int, timeout: float | None):
+        deadline_ms = int((timeout or self.timeout_s) * 1000)
+        with self._read_lock:
+            if version != self._read_version + 1:
+                raise RuntimeError(
+                    "compiled DAG results must be read in execute order "
+                    f"(asked v{version}, next is v{self._read_version + 1})"
+                )
+            vals = [ch.read(timeout_ms=deadline_ms) for ch in self.leaf_channels]
+            self._read_version = version
+        if isinstance(self.root, MultiOutputNode):
+            return vals
+        return vals[0]
+
+    # ------------------------------------------------------------ teardown
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self.channels.values():
+            try:
+                ch.close()
+            except Exception:
+                pass
+        # loops observe the close and reply; drain their results
+        core = api.get_core()
+        for fut in self._loop_futures:
+            try:
+                core.wait_dag_loop(fut, timeout=5.0)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

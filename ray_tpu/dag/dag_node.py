@@ -1,0 +1,86 @@
+"""DAG authoring: bind actor methods into a static dataflow graph.
+
+TPU-native equivalent of the reference's Compiled Graphs authoring surface
+(ref: python/ray/dag/dag_node.py:265 experimental_compile entry,
+dag/class_node.py ClassMethodNode, dag/input_node.py, dag/output_node.py).
+The node graph is pure description — no execution happens until
+``experimental_compile()`` turns it into per-actor static schedules over
+shared-memory channels (see compiled_dag.py).
+
+Design difference from the reference: no FunctionNode / per-call task DAGs —
+the compiled path is the only path (the reference's dynamic DAG execute is
+its classic task API, which we already have as plain tasks). Tensor
+transport over ICI is expressed at the JAX level (the compiled loop runs
+jitted SPMD steps), not as a channel type.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class DAGNode:
+    """Base: a node in the authored graph."""
+
+    def __init__(self, upstream: list["DAGNode"]):
+        self.upstream = upstream
+
+    def experimental_compile(self, *, buffer_size_bytes: int = 8 << 20,
+                             timeout_s: float = 30.0):
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
+                           timeout_s=timeout_s)
+
+    # -- traversal helpers ---------------------------------------------------
+    def walk(self, seen: set | None = None):
+        if seen is None:
+            seen = set()
+        if id(self) in seen:
+            return
+        seen.add(id(self))
+        for up in self.upstream:
+            yield from up.walk(seen)
+        yield self
+
+
+class InputNode(DAGNode):
+    """The driver-fed input (ref: dag/input_node.py). Context manager so the
+    authoring block reads naturally:
+
+        with InputNode() as inp:
+            x = actor_a.step.bind(inp)
+            dag = actor_b.step.bind(x)
+    """
+
+    def __init__(self):
+        super().__init__([])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    """One actor-method invocation per iteration (ref: dag/class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str, args: tuple):
+        upstream = [a for a in args if isinstance(a, DAGNode)]
+        super().__init__(upstream)
+        self.actor_handle = actor_handle
+        self.method_name = method_name
+        self.args = args  # mix of DAGNode and static values
+
+
+class MultiOutputNode(DAGNode):
+    """Wraps N leaves so execute() returns a list (ref: dag/output_node.py)."""
+
+    def __init__(self, outputs: list[DAGNode]):
+        super().__init__(list(outputs))
+        self.outputs = list(outputs)
+
+
+def bind(actor_handle, method_name: str, *args: Any) -> ClassMethodNode:
+    return ClassMethodNode(actor_handle, method_name, args)

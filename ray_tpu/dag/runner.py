@@ -1,0 +1,52 @@
+"""Worker-side compiled-DAG execution loop.
+
+The per-actor static schedule executor (ref: compiled_dag_node.py
+ExecutableTask :481 + the actor's _execute_until loop): runs on a dedicated
+thread inside the actor's worker process, blocking on native channel
+conditions (ctypes calls release the GIL), so the actor's normal RPC surface
+stays live. Zero per-iteration task submissions — each iteration is
+READ(chans) → COMPUTE(method) → WRITE(chan) straight against shared memory.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.dag.channel import ChannelClosed, ShmChannel
+from ray_tpu.utils.ids import ObjectID
+
+
+def run_dag_loop(worker, schedule: dict) -> dict:
+    store = worker.core.store
+    chans: dict[bytes, ShmChannel] = {}
+
+    def chan(cid: bytes) -> ShmChannel:
+        c = chans.get(cid)
+        if c is None:
+            c = chans[cid] = ShmChannel(store, ObjectID(cid),
+                                        size=schedule.get("chan_size", 8 << 20))
+        return c
+
+    tasks = schedule["tasks"]
+    iterations = 0
+    try:
+        while True:
+            read_cache: dict[bytes, object] = {}  # one read per chan per iter
+            local_vals: dict[int, object] = {}
+            for t in tasks:
+                args = []
+                for kind, v in t["args"]:
+                    if kind == "chan":
+                        if v not in read_cache:
+                            read_cache[v] = chan(v).read()
+                        args.append(read_cache[v])
+                    elif kind == "local":
+                        args.append(local_vals[v])
+                    else:  # static
+                        args.append(v)
+                method = getattr(worker.actor_instance, t["method"])
+                out = method(*args)
+                local_vals[t["node_index"]] = out
+                if t["out_chan"] is not None:
+                    chan(t["out_chan"]).write(out)
+            iterations += 1
+    except ChannelClosed:
+        return {"iterations": iterations}

@@ -16,8 +16,12 @@ which is the TPU-idiomatic stance (device buffers are not addressable shm).
 from __future__ import annotations
 
 import io
+import os
 import pickle
 import struct
+import sys
+import sysconfig
+import types
 from typing import Any
 
 import numpy as np
@@ -34,6 +38,116 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+# --------------------------------------------------------------- code shipping
+#
+# Functions/classes whose defining module is NOT importable inside a worker
+# (driver scripts, pytest test modules, anything outside site-packages and the
+# ray_tpu tree) must travel *by value*, matching the reference's
+# always-cloudpickle behavior for function payloads
+# (ref: python/ray/remote_function.py:41 pickled-function export,
+# python/ray/_private/runtime_env/working_dir.py:1 motivates the importability
+# test). cloudpickle pickles by reference whenever the module resolves in the
+# *driver*, which is exactly wrong for test modules — so we register such
+# modules with cloudpickle.register_pickle_by_value before dumping.
+
+_INSTALLED_PREFIXES: tuple | None = None
+_BY_VALUE_REGISTERED: set = set()
+
+
+def _installed_prefixes() -> tuple:
+    global _INSTALLED_PREFIXES
+    if _INSTALLED_PREFIXES is None:
+        paths = sysconfig.get_paths()
+        prefs = {
+            paths.get("purelib"),
+            paths.get("platlib"),
+            paths.get("stdlib"),
+            paths.get("platstdlib"),
+        }
+        # pip --user / venv / distro site dirs live outside the sysconfig
+        # scheme on some installs — anything importable from a site dir is
+        # importable in workers too, so it must NOT ship by value
+        try:
+            import site
+
+            prefs.update(site.getsitepackages())
+            prefs.add(site.getusersitepackages())
+        except Exception:  # pragma: no cover - site can be absent (embedded)
+            pass
+        # trailing sep so /usr/lib/python3.12 doesn't match .../python3.12-foo
+        _INSTALLED_PREFIXES = tuple(
+            os.path.realpath(p) + os.sep for p in prefs if p
+        )
+    return _INSTALLED_PREFIXES
+
+
+def module_ships_by_value(modname) -> bool:
+    """True when a worker process cannot be assumed to import ``modname``."""
+    if modname in ("__main__", "__mp_main__", None):
+        return True
+    root = modname.split(".")[0]
+    if root == "ray_tpu":
+        return False  # workers always have the package tree on sys.path
+    m = sys.modules.get(root)
+    if m is None:
+        return True
+    f = getattr(m, "__file__", None)
+    if f is None:
+        return False  # builtin / frozen — present everywhere
+    f = os.path.realpath(f)
+    return not any(f.startswith(p) for p in _installed_prefixes())
+
+
+def _register_by_value(modname) -> None:
+    if cloudpickle is None or not hasattr(cloudpickle, "register_pickle_by_value"):
+        return
+    root = (modname or "__main__").split(".")[0]
+    if root in _BY_VALUE_REGISTERED or root in ("__main__", "__mp_main__"):
+        return
+    m = sys.modules.get(root)
+    if m is not None and module_ships_by_value(modname):
+        try:
+            cloudpickle.register_pickle_by_value(m)
+        except Exception:
+            pass
+    _BY_VALUE_REGISTERED.add(root)
+
+
+def _referenced_modules(obj, depth: int, seen: set):
+    """Module names of ``obj`` and of functions/classes it references."""
+    if id(obj) in seen or depth < 0:
+        return
+    seen.add(id(obj))
+    if not isinstance(obj, (types.FunctionType, type)):
+        return
+    yield getattr(obj, "__module__", None)
+    if isinstance(obj, types.FunctionType):
+        refs = []
+        for cell in obj.__closure__ or ():
+            try:
+                refs.append(cell.cell_contents)
+            except ValueError:
+                pass
+        g = obj.__globals__
+        refs.extend(g[n] for n in obj.__code__.co_names if n in g)
+        for r in refs:
+            yield from _referenced_modules(r, depth - 1, seen)
+
+
+def ship_dumps(obj) -> bytes:
+    """cloudpickle.dumps that forces by-value pickling of user modules.
+
+    Used for the GCS function table and actor class blobs; also backs the
+    per-object reducer in _Pickler so functions passed as task/actor-call
+    *arguments* (the JaxTrainer train_loop path) survive the trip to a worker
+    that cannot import the driver's module."""
+    if cloudpickle is None:  # pragma: no cover
+        return pickle.dumps(obj)
+    for mod in _referenced_modules(obj, depth=3, seen=set()):
+        _register_by_value(mod)
+    return cloudpickle.dumps(obj)
+
+
 def _restore_jax(np_val):
     import jax
 
@@ -43,23 +157,22 @@ def _restore_jax(np_val):
 class _Pickler(pickle.Pickler):
     """Pickler with a jax.Array reducer (only when jax is already imported).
 
-    Functions/classes defined in ``__main__`` force the cloudpickle path:
-    plain pickle happily serializes them *by reference* as ``__main__.f``,
-    which resolves to the wrong module inside a worker process — the classic
-    driver-script pitfall the reference avoids by always cloudpickling
-    function payloads."""
+    Functions/classes from modules a worker cannot import (``__main__``,
+    driver scripts, test modules) are rerouted through ship_dumps so they
+    travel by value — the classic driver-script pitfall the reference avoids
+    by always cloudpickling function payloads."""
 
     jax_array_type = None
 
     def reducer_override(self, obj):
-        import types
-
         if self.jax_array_type is not None and isinstance(obj, self.jax_array_type):
             return (_restore_jax, (np.asarray(obj),))
-        if isinstance(obj, (types.FunctionType, type)) and getattr(
-            obj, "__module__", None
-        ) in ("__main__", None):
-            raise pickle.PicklingError("defined in __main__: needs cloudpickle")
+        if isinstance(obj, (types.FunctionType, type)) and module_ships_by_value(
+            getattr(obj, "__module__", None)
+        ):
+            if cloudpickle is not None:
+                return (cloudpickle.loads, (ship_dumps(obj),))
+            raise pickle.PicklingError("user-module object needs cloudpickle")
         return NotImplemented
 
 

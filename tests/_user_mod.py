@@ -1,0 +1,23 @@
+"""A 'user module' that worker processes cannot import (tests/ is not on the
+worker sys.path) — exercises by-value code shipping (serialization.ship_dumps;
+ref: python/ray/_private/runtime_env/working_dir.py:1 motivation)."""
+
+SCALE = 3
+
+
+def helper(x):
+    return x * SCALE
+
+
+def double_plus(x):
+    # references another function in this module: shipping must carry it too
+    return helper(x) + x
+
+
+class Accumulator:
+    def __init__(self):
+        self.total = 0
+
+    def add(self, v):
+        self.total += helper(v)
+        return self.total

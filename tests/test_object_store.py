@@ -152,3 +152,57 @@ def test_channel_close_unblocks_reader(store):
 
     with pytest.raises(ChannelClosedError):
         store.channel_read_acquire(oid, 0, timeout_ms=1000)
+
+
+def test_evicted_object_raises_lost_not_hang(store):
+    """LRU eviction leaves a tombstone: get() on an evicted id fails fast
+    with ObjectEvictedError instead of blocking forever (ADVICE r1)."""
+    from ray_tpu.core.object_store import ObjectEvictedError
+
+    ids = []
+    for _ in range(8):
+        oid = ObjectID.from_random()
+        store.put(oid, np.zeros(4 * 1024 * 1024, dtype=np.uint8))
+        ids.append(oid)
+    big = ObjectID.from_random()
+    store.put(big, np.zeros(48 * 1024 * 1024, dtype=np.uint8))
+    evicted = [i for i in ids if not store.contains(i)]
+    assert evicted
+    with pytest.raises(ObjectEvictedError):
+        store.get_buffer(evicted[0], timeout_ms=50)
+
+
+def test_evicted_id_can_be_recreated(store):
+    """Lineage reconstruction re-creates the same ObjectID after eviction."""
+    ids = []
+    for _ in range(8):
+        oid = ObjectID.from_random()
+        store.put(oid, np.zeros(4 * 1024 * 1024, dtype=np.uint8))
+        ids.append(oid)
+    big = ObjectID.from_random()
+    store.put(big, np.zeros(48 * 1024 * 1024, dtype=np.uint8))
+    evicted = [i for i in ids if not store.contains(i)][0]
+    store.delete(big)  # make room
+    store.put(evicted, {"reborn": True})
+    assert store.get(evicted) == {"reborn": True}
+
+
+def test_channel_survives_neighbor_erase(store):
+    """Regression for the stale-Entry* bug: erasing objects that share the
+    channel's hash-probe cluster must not corrupt channel ops (the offset is
+    re-resolved under the store mutex on every call)."""
+    chan = ObjectID.from_random()
+    store.channel_create(chan, 1024, num_readers=1)
+    # churn the table hard: create + delete many objects to force cluster
+    # re-insertions around the channel's slot
+    for _ in range(200):
+        oid = ObjectID.from_random()
+        store.put(oid, b"x" * 64)
+        store.delete(oid)
+    buf = store.channel_write_acquire(chan, timeout_ms=1000)
+    buf[:5] = b"hello"
+    store.channel_write_release(chan, 5)
+    payload, version = store.channel_read_acquire(chan, 0, timeout_ms=1000)
+    assert bytes(payload) == b"hello"
+    assert version == 1
+    store.channel_read_release(chan)

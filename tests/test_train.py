@@ -70,7 +70,7 @@ def _dp_train_loop(config):
 def test_jax_trainer_dp(rt, tmp_path):
     trainer = JaxTrainer(
         _dp_train_loop,
-        train_loop_config={"lr": 0.05, "steps": 20},
+        train_loop_config={"lr": 0.1, "steps": 40},
         scaling_config=ScalingConfig(num_workers=2, collective_backend="cpu"),
         run_config=RunConfig(
             name="dp_test",
