@@ -3,18 +3,41 @@
 The reference's Data library shape (ref: SURVEY §2.5 Data: lazy logical
 plan -> streaming executor over blocks) at the scale this framework needs
 for training input pipelines: lazy ops, task-parallel block transforms
-with bounded in-flight streaming, arrow/numpy blocks, and
+with bounded in-flight streaming, numpy/pandas/pyarrow blocks, and
 ``streaming_split`` so each train worker pulls its own shard of one
 stream (ref: data/dataset.py:1731 streaming_split).
 """
 
+from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     Dataset,
+    from_arrow,
     from_items,
     from_numpy,
-    range as range_,  # noqa: A001
+    from_pandas,
     read_csv,
+    read_json,
+    read_numpy,
     read_parquet,
+    read_text,
 )
+from ray_tpu.data.dataset import range as _range
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
 
-range = range_  # noqa: A001  (mirror ray.data.range naming)
+range = _range  # noqa: A001  (mirror ray.data.range naming)
+
+__all__ = [
+    "BlockAccessor",
+    "DataIterator",
+    "Dataset",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

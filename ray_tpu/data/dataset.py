@@ -1,0 +1,406 @@
+"""Dataset: lazy, streaming, task-parallel datasets.
+
+Public face of ray_tpu.data (ref: python/ray/data/dataset.py:160 Dataset;
+read API read_api.py; iteration iterator.py). Every transform is lazy —
+consumption drives the streaming executor (executor.py) which keeps a
+bounded number of block tasks in flight.
+
+The TPU-relevant endpoints are ``iter_batches(batch_format="numpy")`` (host
+columnar → jax.device_put) and ``streaming_split(n)`` (one coordinator
+actor feeding n train workers; ref: dataset.py:1731 streaming_split,
+stream_split_iterator.py:37).
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import itertools
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, normalize_block, rows_to_columns
+from ray_tpu.data.executor import (
+    LimitOp,
+    MapBlocks,
+    Plan,
+    RepartitionOp,
+    ShuffleOp,
+    SortOp,
+)
+
+DEFAULT_BLOCK_ROWS = 1000
+
+
+class Dataset:
+    def __init__(self, plan: Plan):
+        self._plan = plan
+
+    # ---------------------------------------------------------- transforms
+    def map_batches(self, fn: Callable, *, batch_size: int | None = None,
+                    batch_format: str | None = "numpy",
+                    fn_kwargs: dict | None = None) -> "Dataset":
+        """Apply fn to whole blocks rendered as ``batch_format``
+        (ref: dataset.py map_batches). batch_size re-chunks first when given."""
+        kwargs = fn_kwargs or {}
+
+        def apply(block):
+            batch = BlockAccessor.for_block(block).to_batch(batch_format)
+            return fn(batch, **kwargs) if kwargs else fn(batch)
+
+        ds = self
+        if batch_size is not None:
+            ds = ds.repartition_by_rows(batch_size)
+        return Dataset(ds._plan.with_op(MapBlocks("map_batches", apply)))
+
+    def map(self, fn: Callable) -> "Dataset":
+        def apply(block):
+            rows = [fn(r) for r in BlockAccessor.for_block(block).rows()]
+            return rows_to_columns(rows) if rows and isinstance(rows[0], dict) else rows
+
+        return Dataset(self._plan.with_op(MapBlocks("map", apply)))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def apply(block):
+            rows = [o for r in BlockAccessor.for_block(block).rows() for o in fn(r)]
+            return rows_to_columns(rows) if rows and isinstance(rows[0], dict) else rows
+
+        return Dataset(self._plan.with_op(MapBlocks("flat_map", apply)))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def apply(block):
+            if isinstance(block, dict):
+                mask = np.asarray(
+                    [bool(fn(r)) for r in BlockAccessor.for_block(block).rows()]
+                )
+                return {k: np.asarray(v)[mask] for k, v in block.items()}
+            return [r for r in block if fn(r)]
+
+        return Dataset(self._plan.with_op(MapBlocks("filter", apply)))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def apply(batch):
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(apply, batch_format="numpy")
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: v for k, v in b.items() if k not in set(cols)},
+            batch_format="numpy",
+        )
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self.map_batches(
+            lambda b: {k: b[k] for k in cols}, batch_format="numpy"
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(self._plan.with_op(LimitOp(n)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(self._plan.with_op(RepartitionOp(num_blocks)))
+
+    def repartition_by_rows(self, rows_per_block: int) -> "Dataset":
+        """Helper used by map_batches(batch_size=...): barrier + resize."""
+        total = self.count()
+        blocks = max(1, -(-total // rows_per_block))
+        return self.repartition(blocks)
+
+    def random_shuffle(self, *, seed: int | None = None) -> "Dataset":
+        return Dataset(self._plan.with_op(ShuffleOp(seed)))
+
+    def sort(self, key=None, descending: bool = False) -> "Dataset":
+        return Dataset(self._plan.with_op(SortOp(key, descending)))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        if self._plan.ops or other._plan.ops:
+            # materialize both sides into read tasks
+            left = self.materialize()
+            right = other.materialize()
+            return Dataset(Plan(left._plan.read_tasks + right._plan.read_tasks))
+        return Dataset(Plan(self._plan.read_tasks + other._plan.read_tasks))
+
+    # ---------------------------------------------------------- execution
+    def iter_block_refs(self) -> Iterable:
+        stream, self._last_stats = self._plan.execute()
+        return stream
+
+    def iter_blocks(self) -> Iterable:
+        for ref in self.iter_block_refs():
+            yield ray_tpu.get(ref)
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds its blocks (ref: MaterializedDataset)."""
+        blocks = list(self.iter_blocks())
+        return Dataset(Plan([_HoldBlock(b) for b in blocks]))
+
+    def stats(self) -> str:
+        st = getattr(self, "_last_stats", None)
+        if not st:
+            return "(not executed yet)"
+        return "\n".join(s.row() for s in st)
+
+    # --------------------------------------------------------- consumption
+    def take(self, n: int = 20) -> list:
+        out: list = []
+        for block in self.limit(n).iter_blocks():
+            out.extend(BlockAccessor.for_block(block).rows())
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def take_all(self) -> list:
+        out: list = []
+        for block in self.iter_blocks():
+            out.extend(BlockAccessor.for_block(block).rows())
+        return out
+
+    def count(self) -> int:
+        return sum(
+            BlockAccessor.for_block(b).num_rows() for b in self.iter_blocks()
+        )
+
+    def schema(self):
+        for block in self.iter_blocks():
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows():
+                return acc.schema()
+        return None
+
+    def _column_agg(self, on: str | None, agg: Callable):
+        vals: list = []
+        for block in self.iter_blocks():
+            acc = BlockAccessor.for_block(block)
+            if isinstance(block, dict):
+                col = on or next(iter(block))
+                if acc.num_rows():
+                    vals.append(np.asarray(block[col]))
+            else:
+                rows = [r[on] if on else r for r in acc.rows()]
+                if rows:
+                    vals.append(np.asarray(rows))
+        if not vals:
+            return None
+        return agg(np.concatenate(vals))
+
+    def sum(self, on: str | None = None):
+        v = self._column_agg(on, np.sum)
+        return None if v is None else v.item()
+
+    def min(self, on: str | None = None):
+        v = self._column_agg(on, np.min)
+        return None if v is None else v.item()
+
+    def max(self, on: str | None = None):
+        v = self._column_agg(on, np.max)
+        return None if v is None else v.item()
+
+    def mean(self, on: str | None = None):
+        v = self._column_agg(on, np.mean)
+        return None if v is None else v.item()
+
+    def iter_rows(self) -> Iterable:
+        for block in self.iter_blocks():
+            yield from BlockAccessor.for_block(block).rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str | None = "numpy",
+                     drop_last: bool = False, prefetch_blocks: int = 2):
+        from ray_tpu.data.iterator import iter_batches_over_refs
+
+        return iter_batches_over_refs(
+            self.iter_block_refs(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch=prefetch_blocks,
+        )
+
+    def iter_torch_batches(self, *, batch_size: int = 256, drop_last=False):
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: torch.as_tensor(np.ascontiguousarray(v)) for k, v in batch.items()}
+            else:
+                yield torch.as_tensor(np.ascontiguousarray(batch))
+
+    # ----------------------------------------------------------- splitting
+    def split(self, n: int) -> list["Dataset"]:
+        """Materialized equal split (ref: dataset.py split)."""
+        parts = self.repartition(n).materialize()
+        tasks = parts._plan.read_tasks
+        per = max(1, len(tasks) // n)
+        out = []
+        for i in range(n):
+            chunk = tasks[i * per: (i + 1) * per] if i < n - 1 else tasks[(n - 1) * per:]
+            out.append(Dataset(Plan(list(chunk))))
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        locality_hints=None) -> list:
+        """n coordinated iterators over ONE execution of this dataset
+        (ref: dataset.py:1731, stream_split_iterator.py:37): a
+        SplitCoordinator actor runs the stream and hands blocks round-robin
+        to consumers — the JaxTrainer input path."""
+        from ray_tpu.data.split import make_stream_splits
+
+        return make_stream_splits(self, n, equal=equal)
+
+    def __repr__(self):
+        ops = " -> ".join(op.name for op in self._plan.ops) or "source"
+        return f"Dataset({len(self._plan.read_tasks)} read tasks, {ops})"
+
+
+class _HoldBlock:
+    """Picklable closure holding a materialized block as a read task."""
+
+    def __init__(self, block):
+        self.block = block
+
+    def __call__(self):
+        return self.block
+
+
+# ------------------------------------------------------------------ sources
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    if parallelism <= 0:
+        parallelism = max(1, min(8, n // DEFAULT_BLOCK_ROWS or 1))
+    edges = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make(lo: int, hi: int):
+        return lambda: {"id": np.arange(lo, hi, dtype=np.int64)}
+
+    return Dataset(Plan([make(int(lo), int(hi))
+                         for lo, hi in zip(edges[:-1], edges[1:]) if hi > lo]))
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    items = list(items)
+    if parallelism <= 0:
+        parallelism = max(1, min(8, len(items) // DEFAULT_BLOCK_ROWS or 1))
+    chunks = np.array_split(np.arange(len(items)), parallelism)
+
+    def make(chunk_items):
+        return lambda: list(chunk_items)
+
+    return Dataset(Plan([make([items[i] for i in c]) for c in chunks if len(c)]))
+
+
+def from_numpy(arr, *, parallelism: int = -1) -> Dataset:
+    if isinstance(arr, dict):
+        n = len(next(iter(arr.values())))
+        cols = {k: np.asarray(v) for k, v in arr.items()}
+    else:
+        arr = np.asarray(arr)
+        n = len(arr)
+        cols = {"data": arr}
+    if parallelism <= 0:
+        parallelism = max(1, min(8, n // DEFAULT_BLOCK_ROWS or 1))
+    edges = np.linspace(0, n, parallelism + 1, dtype=np.int64)
+
+    def make(lo, hi):
+        return lambda: {k: v[lo:hi] for k, v in cols.items()}
+
+    return Dataset(Plan([make(int(lo), int(hi))
+                         for lo, hi in zip(edges[:-1], edges[1:]) if hi > lo]))
+
+
+def from_pandas(df) -> Dataset:
+    return Dataset(Plan([functools.partial(normalize_block, df)]))
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset(Plan([functools.partial(normalize_block, table)]))
+
+
+def _expand_paths(paths) -> list[str]:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")
+            ))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def read_csv(paths, **pandas_kwargs) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            import pandas as pd
+
+            return normalize_block(pd.read_csv(path, **pandas_kwargs))
+
+        return read
+
+    return Dataset(Plan([make(p) for p in files]))
+
+
+def read_parquet(paths, columns: list[str] | None = None) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            import pyarrow.parquet as pq
+
+            return normalize_block(pq.read_table(path, columns=columns))
+
+        return read
+
+    return Dataset(Plan([make(p) for p in files]))
+
+
+def read_json(paths, *, lines: bool = True) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            import json
+
+            with open(path) as f:
+                if lines:
+                    return [json.loads(line) for line in f if line.strip()]
+                data = json.load(f)
+                return data if isinstance(data, list) else [data]
+
+        return read
+
+    return Dataset(Plan([make(p) for p in files]))
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            with open(path) as f:
+                return [{"text": line.rstrip("\n")} for line in f]
+
+        return read
+
+    return Dataset(Plan([make(p) for p in files]))
+
+
+def read_numpy(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def make(path):
+        return lambda: {"data": np.load(path)}
+
+    return Dataset(Plan([make(p) for p in files]))

@@ -140,6 +140,23 @@ def _block(layer, x, cos, sin, cfg: LlamaConfig, mesh, attn_impl, seq_axis):
     return x, aux
 
 
+def _maybe_remat_block(cfg: LlamaConfig):
+    """One remat policy for all forward paths (dense, pipelined)."""
+    if not cfg.remat:
+        return _block
+    return jax.checkpoint(
+        _block, static_argnums=(4, 5, 6, 7),
+        policy=jax.checkpoint_policies.nothing_saveable,
+    )
+
+
+def _ce_loss(logits, targets):
+    """Next-token cross entropy shared by llama_loss / llama_pp_loss."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0].mean()
+
+
 def llama_forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
                   attn_impl: str = "auto", seq_axis: str | None = "sp"):
     """tokens: [B, T] int32 -> logits [B, T, V]."""
@@ -148,12 +165,7 @@ def llama_forward(params, tokens, cfg: LlamaConfig, *, mesh=None,
     cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     x = params["tok"]["embedding"][tokens]
     aux_total = 0.0
-    block = _block
-    if cfg.remat:
-        block = jax.checkpoint(
-            _block, static_argnums=(4, 5, 6, 7),
-            policy=jax.checkpoint_policies.nothing_saveable,
-        )
+    block = _maybe_remat_block(cfg)
     for i in range(cfg.n_layers):
         x, aux = block(params[f"layers_{i}"], x, cos, sin, cfg, mesh, attn_impl, seq_axis)
         aux_total = aux_total + aux
@@ -167,10 +179,60 @@ def llama_loss(params, batch, cfg: LlamaConfig, *, mesh=None, attn_impl="auto"):
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits, aux = llama_forward(params, inputs, cfg, mesh=mesh, attn_impl=attn_impl)
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + 0.01 * aux
+    return _ce_loss(logits, targets) + 0.01 * aux
+
+
+# ------------------------------------------------------- pipelined variant
+def llama_pp_init(key, cfg: LlamaConfig, n_stages: int) -> dict:
+    """Init with transformer layers stacked for pipeline parallelism:
+    ``stages`` leaves carry a leading [n_stages, layers_per_stage] axis
+    (sharded on the ``pp`` mesh axis by pipeline_apply); embedding/norm/head
+    stay in ``dense`` and run outside the pipeline body. Dense layers only
+    (MoE composes with ep/fsdp meshes on the non-pipelined path)."""
+    if cfg.n_experts:
+        raise ValueError("pipelined llama requires dense layers (n_experts=0)")
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"{cfg.n_layers} layers not divisible by {n_stages} stages")
+    params = llama_init(key, cfg)
+    per = cfg.n_layers // n_stages
+    layers = [params.pop(f"layers_{i}") for i in range(cfg.n_layers)]
+    stages = []
+    for s in range(n_stages):
+        chunk = layers[s * per: (s + 1) * per]
+        stages.append(jax.tree.map(lambda *xs: jnp.stack(xs), *chunk))  # [per,...]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stages)  # [pp, per, ...]
+    return {"dense": params, "stages": stacked}
+
+
+def llama_pp_loss(params, batch, cfg: LlamaConfig, mesh, *, n_microbatches: int,
+                  attn_impl: str = "plain", batch_axis: str | None = "dp"):
+    """Next-token CE through a GPipe pipeline over the mesh's pp axis
+    (ref: SURVEY §2.3 PP — the reference only gets PP via vLLM config or
+    compiled-graph p2p channels; here the pipeline is one jitted SPMD
+    program, see parallel/pipeline.py)."""
+    from jax import lax
+
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    dense = params["dense"]
+    x = dense["tok"]["embedding"][inputs]
+    cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    block = _maybe_remat_block(cfg)
+
+    def stage_fn(stage_params, h):
+        def layer_step(h, layer):
+            h, _ = block(layer, h, cos, sin, cfg, None, attn_impl, None)
+            return h, None
+
+        h, _ = lax.scan(layer_step, h, stage_params)
+        return h
+
+    x = pipeline_apply(stage_fn, params["stages"], x, mesh,
+                       n_microbatches=n_microbatches, batch_axis=batch_axis)
+    x = rms_norm(x, dense["norm"]["scale"])
+    return _ce_loss(x @ dense["lm_head"]["kernel"], targets)
 
 
 def make_train_step(cfg: LlamaConfig, optimizer, *, mesh=None, attn_impl="auto",

@@ -73,21 +73,26 @@ def pipeline_spmd_local(stage_fn, stage_params, x_micro, *, axis_name: str = "pp
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh, *, n_microbatches: int,
-                   axis_name: str = "pp"):
+                   axis_name: str = "pp", batch_axis: str | None = None):
     """Run a GPipe pipeline over ``mesh``'s ``axis_name``.
 
     stacked_params: pytree whose leaves have a leading stage axis of size
         n_stages, sharded on ``axis_name`` (see stack_stage_params).
-    x: [B_total, ...] input batch (replicated across pp).
-    Returns [B_total, ...] final-stage outputs, replicated.
+    x: [B_total, ...] input batch.
+    batch_axis: optional mesh axis to shard the WITHIN-microbatch batch dim
+        over (dp) — pp x dp composition: each dp slice runs its own pipeline
+        instance on B_total/n_microbatches/dp rows per step (so
+        B_total/n_microbatches must divide by the dp size; the
+        microbatch-step dim itself stays replicated).
+    Returns [B_total, ...] final-stage outputs.
     """
-    n_stages = mesh.shape[axis_name]
     B = x.shape[0]
     if B % n_microbatches:
         raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
     x_micro = x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
 
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    x_spec = P(None, batch_axis) if batch_axis else P()
 
     def body(params, xm):
         squeezed = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
@@ -96,8 +101,8 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, *, n_microbatches: int,
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
         check_vma=False,
     )
     out_micro = fn(stacked_params, x_micro)

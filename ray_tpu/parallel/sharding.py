@@ -45,6 +45,11 @@ class PartitionRules:
         column/row split expressed as specs; SURVEY §2.3 TP mapping)."""
         return cls(
             [
+                # MoE expert weights: expert axis on ep, then row/col TP
+                # (must precede the generic w_up/w_down rules below)
+                (r"moe/gate/kernel$", ("fsdp",)),            # [d, E]
+                (r"moe/w_up/kernel$", ("ep", "fsdp", "tp")),   # [E, d, ff]
+                (r"moe/w_down/kernel$", ("ep", "tp", "fsdp")),  # [E, ff, d]
                 (r"embedding$", (("fsdp",), "tp")),          # [vocab, d] -> vocab on fsdp, d on tp
                 (r"(wq|wk|wv|w_gate|w_up)/kernel$", ("fsdp", "tp")),   # column parallel
                 (r"(wo|w_down)/kernel$", ("tp", "fsdp")),    # row parallel

@@ -1,0 +1,201 @@
+"""ray_tpu.data tests — streaming executor, transforms, iteration, and the
+streaming_split → JaxTrainer feed (ref: python/ray/data/tests coverage at
+test scale; VERDICT r1 #5 done-criteria)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rtd
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=32)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(rt):
+    ds = rtd.range(1000)
+    assert ds.count() == 1000
+    rows = ds.take(5)
+    assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+
+def test_from_items_map_filter(rt):
+    ds = rtd.from_items(list(range(100)))
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 10 == 0).take_all()
+    assert out == [x * 2 for x in range(100) if (x * 2) % 10 == 0]
+
+
+def test_map_batches_numpy_format(rt):
+    ds = rtd.range(100).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2}, batch_format="numpy"
+    )
+    rows = ds.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+
+def test_map_batches_pandas_and_pyarrow(rt):
+    import pandas as pd
+
+    ds = rtd.range(50).map_batches(
+        lambda df: df.assign(neg=-df["id"]), batch_format="pandas"
+    )
+    assert ds.take(3)[2]["neg"] == -2
+
+    ds2 = rtd.range(50).map_batches(lambda t: t, batch_format="pyarrow")
+    assert ds2.count() == 50
+
+
+def test_flat_map_and_limit(rt):
+    ds = rtd.from_items([1, 2, 3]).flat_map(lambda x: [x] * x)
+    assert ds.take_all() == [1, 2, 2, 3, 3, 3]
+    assert rtd.range(1000).limit(17).count() == 17
+
+
+def test_repartition(rt):
+    ds = rtd.range(100, parallelism=7).repartition(3)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 3
+    sizes = [len(b["id"]) for b in blocks]
+    assert sum(sizes) == 100
+    assert max(sizes) - min(sizes) <= 1
+    # content preserved and ordered
+    all_ids = np.concatenate([b["id"] for b in blocks])
+    np.testing.assert_array_equal(all_ids, np.arange(100))
+
+
+def test_random_shuffle_deterministic(rt):
+    a = rtd.range(200).random_shuffle(seed=7).take_all()
+    b = rtd.range(200).random_shuffle(seed=7).take_all()
+    c = rtd.range(200).random_shuffle(seed=8).take_all()
+    ids = lambda rows: [r["id"] for r in rows]  # noqa: E731
+    assert ids(a) == ids(b)
+    assert ids(a) != ids(c)
+    assert sorted(ids(a)) == list(range(200))
+
+
+def test_sort(rt):
+    ds = rtd.from_items([{"k": x % 5, "v": x} for x in range(50)]).sort("k")
+    ks = [r["k"] for r in ds.take_all()]
+    assert ks == sorted(ks)
+    desc = rtd.range(20).sort("id", descending=True).take(3)
+    assert [r["id"] for r in desc] == [19, 18, 17]
+
+
+def test_aggregates(rt):
+    ds = rtd.range(101)
+    assert ds.sum("id") == 5050
+    assert ds.min("id") == 0
+    assert ds.max("id") == 100
+    assert ds.mean("id") == 50.0
+
+
+def test_iter_batches_sizes_and_leftover(rt):
+    batches = list(rtd.range(250).iter_batches(batch_size=64))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [64, 64, 64, 58]
+    batches = list(rtd.range(250).iter_batches(batch_size=64, drop_last=True))
+    assert [len(b["id"]) for b in batches] == [64, 64, 64]
+
+
+def test_iter_torch_batches(rt):
+    import torch
+
+    batch = next(iter(rtd.range(64).iter_torch_batches(batch_size=32)))
+    assert isinstance(batch["id"], torch.Tensor)
+    assert batch["id"].shape == (32,)
+
+
+def test_read_csv_json_text(rt, tmp_path):
+    import pandas as pd
+
+    pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]}).to_csv(
+        tmp_path / "f.csv", index=False
+    )
+    ds = rtd.read_csv(str(tmp_path / "f.csv"))
+    assert ds.count() == 3
+    assert ds.take(1)[0]["a"] == 1
+
+    with open(tmp_path / "f.jsonl", "w") as f:
+        f.write('{"x": 1}\n{"x": 2}\n')
+    assert rtd.read_json(str(tmp_path / "f.jsonl")).sum("x") == 3
+
+    with open(tmp_path / "t.txt", "w") as f:
+        f.write("hello\nworld\n")
+    assert [r["text"] for r in rtd.read_text(str(tmp_path / "t.txt")).take_all()] == [
+        "hello", "world",
+    ]
+
+
+def test_read_parquet_roundtrip(rt, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"v": list(range(10))}), tmp_path / "p.parquet")
+    ds = rtd.read_parquet(str(tmp_path / "p.parquet"))
+    assert ds.sum("v") == 45
+
+
+def test_streaming_split_two_consumers(rt):
+    splits = rtd.range(400, parallelism=8).streaming_split(2)
+    seen = [[], []]
+    for i, it in enumerate(splits):
+        for batch in it.iter_batches(batch_size=50):
+            seen[i].extend(batch["id"].tolist())
+    assert len(seen[0]) + len(seen[1]) == 400
+    assert sorted(seen[0] + seen[1]) == list(range(400))
+    assert seen[0] and seen[1]  # both consumers got data
+
+
+def test_streaming_split_feeds_jax_trainer(rt, tmp_path):
+    """e2e: Dataset -> streaming_split -> 2 DP JaxTrainer workers
+    (VERDICT r1 #5 done-criterion)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    X = np.random.RandomState(0).randn(256, 4).astype(np.float32)
+    true_w = np.array([1.0, -2.0, 3.0, 0.5], dtype=np.float32)
+    y = X @ true_w
+    ds = rtd.from_numpy({"x": X, "y": y}, parallelism=4)
+    splits = ds.streaming_split(2)
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import ray_tpu.collective as collective
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        it = config["splits"][rank]
+        w = jnp.zeros(4)
+        grad_fn = jax.grad(
+            lambda w, x, y: jnp.mean((x @ w - y) ** 2)
+        )
+        rows = 0
+        for batch in it.iter_batches(batch_size=16):
+            g = np.asarray(grad_fn(w, batch["x"], batch["y"]))
+            g = collective.allreduce(g, group_name=ctx.collective_group) / world
+            w = w - 0.1 * g
+            rows += len(batch["x"])
+        X_full, y_full = config["eval"]
+        loss = float(jnp.mean((jnp.asarray(X_full) @ w - jnp.asarray(y_full)) ** 2))
+        train.report({"rows": rows, "loss": loss})
+        return None
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={"splits": splits, "eval": (X, y)},
+        scaling_config=ScalingConfig(num_workers=2, collective_backend="cpu"),
+        run_config=RunConfig(storage_path=str(tmp_path / "ck")),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows"] > 0
+    assert result.metrics["loss"] < 8.0  # w=0 baseline ~15

@@ -173,3 +173,61 @@ class TestMoE:
         logits = jnp.stack([jnp.array([10.0, 0.0])] * 6)  # all tokens -> expert 0
         dispatch, combine, aux = top1_gating(logits, 2, capacity=2)
         assert float(dispatch.sum()) == 2.0  # only capacity survives
+
+
+class TestLlamaPipeline:
+    def test_pp_loss_matches_sequential(self, eight_devices):
+        """llama_pp_loss (GPipe over pp axis) == llama_loss on the same
+        weights (same init seed; stages are just restacked layers)."""
+        import jax
+        import numpy as np
+
+        from ray_tpu.models.llama import (
+            LlamaConfig,
+            llama_init,
+            llama_loss,
+            llama_pp_init,
+            llama_pp_loss,
+        )
+        from ray_tpu.parallel.mesh import MeshSpec
+
+        cfg = LlamaConfig(vocab_size=128, d_model=32, n_layers=4, n_heads=4,
+                          n_kv_heads=4, d_ff=64, max_seq_len=64,
+                          dtype="float32", remat=False)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 17), 0, 128,
+                                    dtype=jax.numpy.int32)
+        batch = {"tokens": tokens}
+
+        ref = float(llama_loss(llama_init(jax.random.PRNGKey(0), cfg), batch,
+                               cfg, mesh=None, attn_impl="plain"))
+
+        spec = MeshSpec(dp=2, pp=2)
+        mesh = spec.build(jax.devices()[:4])
+        pp_params = llama_pp_init(jax.random.PRNGKey(0), cfg, 2)
+        got = float(llama_pp_loss(pp_params, batch, cfg, mesh,
+                                  n_microbatches=2))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_pp_grad_finite(self, eight_devices):
+        import jax
+
+        from ray_tpu.models.llama import LlamaConfig, llama_pp_init, llama_pp_loss
+        from ray_tpu.parallel.mesh import MeshSpec
+
+        cfg = LlamaConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                          n_kv_heads=4, d_ff=64, max_seq_len=64, dtype="float32")
+        spec = MeshSpec(dp=2, pp=2)
+        mesh = spec.build(jax.devices()[:4])
+        params = llama_pp_init(jax.random.PRNGKey(0), cfg, 2)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, 64,
+                                    dtype=jax.numpy.int32)
+        grads = jax.grad(
+            lambda p: llama_pp_loss(p, {"tokens": tokens}, cfg, mesh,
+                                    n_microbatches=2)
+        )(params)
+        flat = jax.tree.leaves(grads)
+        assert all(bool(jax.numpy.all(jax.numpy.isfinite(g))) for g in flat)
+        # the PIPELINE stage weights specifically received gradient signal
+        # (dense head grads are nonzero even if the pp backward breaks)
+        stage_flat = jax.tree.leaves(grads["stages"])
+        assert any(float(jax.numpy.abs(g).max()) > 0 for g in stage_flat)
