@@ -45,7 +45,7 @@ from ray_tpu.core.ref import (
     TaskError,
     WorkerCrashedError,
 )
-from ray_tpu.utils import rpc, serialization
+from ray_tpu.utils import aio, rpc, serialization
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 
 ALIVE = "ALIVE"
@@ -112,6 +112,7 @@ class CoreClient:
         self._subscribed_actors: set[ActorID] = set()
         self._task_counter = 0
         self._closed = False
+        self._bg = aio.TaskGroup()
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -167,7 +168,7 @@ class CoreClient:
             entry.in_shm = True
             self.memory_store[oid] = entry
             entry.ready.set()
-            self.loop.create_task(self._register_location(oid))
+            self._call_on_loop(self._register_location(oid))
         return ObjectRef(oid, self.address, _core=self)
 
     async def _register_location(self, oid: ObjectID):
@@ -381,9 +382,9 @@ class CoreClient:
 
     def _call_on_loop(self, coro):
         if _in_loop(self.loop):
-            self.loop.create_task(coro)
+            self._bg.spawn(coro, self.loop)
         else:
-            asyncio.run_coroutine_threadsafe(coro, self.loop)
+            self.loop.call_soon_threadsafe(self._bg.spawn, coro, self.loop)
 
     async def _submit_async(self, spec: dict):
         try:
@@ -441,10 +442,10 @@ class CoreClient:
             w = free.pop()
             spec = state.pending.get_nowait()
             w.busy = True
-            self.loop.create_task(self._run_on_worker(key, state, w, spec))
+            self._bg.spawn(self._run_on_worker(key, state, w, spec), self.loop)
         if not state.pending.empty() and not state.lease_request_inflight:
             state.lease_request_inflight = True
-            self.loop.create_task(self._request_lease(key, state))
+            self._bg.spawn(self._request_lease(key, state), self.loop)
 
     async def _request_lease(self, key, state: _SchedulingKeyState):
         try:
@@ -513,7 +514,7 @@ class CoreClient:
         w.busy = False
         w.idle_since = time.monotonic()
         await self._pump(key, state)
-        self.loop.create_task(self._maybe_return_lease(key, state, w))
+        self._bg.spawn(self._maybe_return_lease(key, state, w), self.loop)
 
     def _apply_task_reply(self, spec, reply):
         task_id = spec["task_id"]
@@ -668,7 +669,7 @@ class CoreClient:
             self._conn_seq[conn] = seq + 1
             spec["seq"] = seq
             # pipelined: don't await the reply here, keep the pump moving
-            self.loop.create_task(self._await_actor_reply(conn, spec))
+            self._bg.spawn(self._await_actor_reply(conn, spec), self.loop)
         except Exception as e:
             self._complete_task_error(spec, e)
 
@@ -712,21 +713,34 @@ class CoreClient:
         info = self._actor_info.get(actor_id)
         deadline = time.monotonic() + self.cfg.worker_start_timeout_s
         while True:
-            if info is not None:
-                if info.get("state") == DEAD:
-                    raise ActorError(info.get("death_cause") or "actor is dead")
-                if info.get("state") == ALIVE and info.get("address"):
-                    break
-            if time.monotonic() > deadline:
-                raise ActorError(f"actor {actor_id} not available in time")
-            if actor_id not in self._subscribed_actors:
-                self._subscribed_actors.add(actor_id)
-                await self.gcs.call("subscribe", {"channel": f"actor:{actor_id.hex()}"})
-            info = await self._refresh_actor(actor_id)
-            if not (info and info.get("state") == ALIVE and info.get("address")):
-                await asyncio.sleep(0.05)
-                info = self._actor_info.get(actor_id)
-        conn = await rpc.connect(*info["address"])
+            while True:
+                if info is not None:
+                    if info.get("state") == DEAD:
+                        raise ActorError(info.get("death_cause") or "actor is dead")
+                    if info.get("state") == ALIVE and info.get("address"):
+                        break
+                if time.monotonic() > deadline:
+                    raise ActorError(f"actor {actor_id} not available in time")
+                if actor_id not in self._subscribed_actors:
+                    self._subscribed_actors.add(actor_id)
+                    await self.gcs.call("subscribe", {"channel": f"actor:{actor_id.hex()}"})
+                info = await self._refresh_actor(actor_id)
+                if not (info and info.get("state") == ALIVE and info.get("address")):
+                    await asyncio.sleep(0.05)
+                    info = self._actor_info.get(actor_id)
+            try:
+                conn = await rpc.connect(*info["address"], timeout=1.0)
+                break
+            except rpc.ConnectionLost:
+                # GCS can briefly advertise ALIVE at the old address after a
+                # hard crash (reaper period lag); treat as stale and keep
+                # waiting for the restarted actor to publish a reachable
+                # address.
+                if time.monotonic() > deadline:
+                    raise ActorError(f"actor {actor_id} not reachable in time")
+                await asyncio.sleep(0.1)
+                self._actor_info.pop(actor_id, None)
+                info = None
         self._actor_conns[actor_id] = conn
         return conn
 
@@ -774,6 +788,7 @@ class CoreClient:
 
     async def close(self):
         self._closed = True
+        await self._bg.cancel_all()
         # return all leases
         for key, state in self.sched_keys.items():
             for w in state.workers:

@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ray_tpu.config import get_config
-from ray_tpu.utils import rpc
+from ray_tpu.utils import aio, rpc
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
 
 # actor lifecycle states (ref: gcs.proto ActorTableData.ActorState)
@@ -111,6 +111,7 @@ class GcsServer:
         self.raylet_conns: dict[rpc.Connection, NodeID] = {}
         # actor worker connections for cleanup: conn -> actor_ids
         self._stopping = False
+        self._bg = aio.TaskGroup()
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, message: Any):
@@ -231,7 +232,7 @@ class GcsServer:
         self.actors[actor_id] = info
         if name:
             self.named_actors[name] = actor_id
-        asyncio.get_running_loop().create_task(self._schedule_actor(info))
+        self._bg.spawn(self._schedule_actor(info))
         return info.view()
 
     async def _schedule_actor(self, info: ActorInfo):
@@ -267,7 +268,7 @@ class GcsServer:
             if not lease.get("granted"):
                 # retry scheduling (resources raced away)
                 await asyncio.sleep(0.05)
-                asyncio.get_running_loop().create_task(self._schedule_actor(info))
+                self._bg.spawn(self._schedule_actor(info))
                 return
 
             worker_addr = tuple(lease["worker_address"])
@@ -355,7 +356,7 @@ class GcsServer:
             info.node_id = None
             await self.publish("actors", info.view())
             await self.publish(f"actor:{info.actor_id.hex()}", info.view())
-            asyncio.get_running_loop().create_task(self._schedule_actor(info))
+            self._bg.spawn(self._schedule_actor(info))
         else:
             info.state = DEAD
             info.death_cause = cause
@@ -499,9 +500,7 @@ class GcsServer:
             subs.discard(conn)
         node_id = self.raylet_conns.pop(conn, None)
         if node_id is not None:
-            asyncio.get_running_loop().create_task(
-                self._mark_node_dead(node_id, "raylet disconnected")
-            )
+            self._bg.spawn(self._mark_node_dead(node_id, "raylet disconnected"))
 
     async def _health_loop(self):
         cfg = self.cfg
@@ -515,11 +514,12 @@ class GcsServer:
 
     async def start(self) -> tuple[str, int]:
         addr = await self.server.start()
-        asyncio.get_running_loop().create_task(self._health_loop())
+        self._bg.spawn(self._health_loop())
         return addr
 
     async def stop(self):
         self._stopping = True
+        await self._bg.cancel_all()
         await self.server.stop()
 
 

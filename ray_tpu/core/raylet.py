@@ -27,7 +27,7 @@ from dataclasses import dataclass, field
 
 from ray_tpu.config import get_config
 from ray_tpu.core.object_store import SharedObjectStore
-from ray_tpu.utils import rpc
+from ray_tpu.utils import aio, rpc
 from ray_tpu.utils.ids import NodeID, ObjectID, WorkerID
 
 
@@ -162,6 +162,7 @@ class Raylet:
         self._lease_waiters: list[tuple[dict, asyncio.Future, tuple | None]] = []
         self.cluster_view: list[dict] = []
         self._stopping = False
+        self._bg = aio.TaskGroup()
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> tuple[str, int]:
@@ -180,9 +181,8 @@ class Raylet:
         )
         self.cluster_view = reply["cluster"]
         await self.gcs.call("subscribe", {"channel": "nodes"})
-        loop = asyncio.get_running_loop()
-        loop.create_task(self._heartbeat_loop())
-        loop.create_task(self._reaper_loop())
+        self._bg.spawn(self._heartbeat_loop())
+        self._bg.spawn(self._reaper_loop())
         return addr
 
     def _on_gcs_push(self, msg):
@@ -502,6 +502,7 @@ class Raylet:
 
     async def stop(self):
         self._stopping = True
+        await self._bg.cancel_all()
         for w in self.all_workers.values():
             try:
                 w.proc.terminate()

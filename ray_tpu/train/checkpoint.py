@@ -59,10 +59,12 @@ class CheckpointManager:
         self.score_attribute = score_attribute
         self.score_order = score_order
         self.checkpoints: list[tuple[float, str, dict]] = []  # (score, path, metrics)
+        self._seq = 0  # monotonic: len(checkpoints) shrinks on evict and would collide
         os.makedirs(storage_path, exist_ok=True)
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> Checkpoint:
-        name = f"checkpoint_{int(time.time() * 1000)}_{len(self.checkpoints)}"
+        self._seq += 1
+        name = f"checkpoint_{int(time.time() * 1000)}_{self._seq:06d}"
         dest = os.path.join(self.storage_path, name)
         if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
             shutil.copytree(checkpoint.path, dest)
