@@ -578,18 +578,16 @@ class CoreClient:
             pass
 
     # ------------------------------------------------------------- actors
-    def create_actor(self, cls, args, kwargs, *, num_cpus=1.0, resources=None,
-                     name=None, max_restarts=0, max_concurrency=1,
-                     placement_group=None, bundle_index=-1, get_if_exists=False,
-                     lifetime=None) -> ActorHandle:
-        actor_id = ActorID.generate()
-        class_blob = serialization.ship_dumps(cls)
+    def _build_actor_spec(self, cls, args, kwargs, *, num_cpus=1.0, resources=None,
+                          name=None, max_restarts=0, max_concurrency=1,
+                          placement_group=None, bundle_index=-1,
+                          get_if_exists=False, lifetime=None) -> dict:
         res = dict(resources or {})
         res.setdefault("CPU", num_cpus)
-        spec = {
-            "actor_id": actor_id,
+        return {
+            "actor_id": ActorID.generate(),
             "name": name,
-            "class_blob": class_blob,
+            "class_blob": serialization.ship_dumps(cls),
             "args": args,
             "kwargs": kwargs,
             "resources": res,
@@ -602,20 +600,48 @@ class CoreClient:
             "lifetime": lifetime,
         }
 
-        async def _register():
-            spec["args"] = await self._resolve_args(spec["args"])
-            spec["kwargs"] = dict(
-                zip(
-                    spec["kwargs"].keys(),
-                    await self._resolve_args(list(spec["kwargs"].values())),
-                )
+    async def _register_actor(self, spec: dict) -> dict:
+        spec["args"] = await self._resolve_args(spec["args"])
+        spec["kwargs"] = dict(
+            zip(
+                spec["kwargs"].keys(),
+                await self._resolve_args(list(spec["kwargs"].values())),
             )
-            view = await self.gcs.call("register_actor", {"spec": spec})
-            self._actor_info[view["actor_id"]] = view
-            return view
+        )
+        view = await self.gcs.call("register_actor", {"spec": spec})
+        self._actor_info[view["actor_id"]] = view
+        return view
 
-        view = self._run_sync(_register())
+    def create_actor(self, cls, args, kwargs, **opts) -> ActorHandle:
+        spec = self._build_actor_spec(cls, args, kwargs, **opts)
+        if _in_loop(self.loop):
+            # Called from the event loop (e.g. an async actor creating other
+            # actors): can't block. The actor_id is chosen client-side, so
+            # the handle is valid immediately; registration completes in the
+            # background and callers wait for ALIVE via _actor_connection.
+            if spec["get_if_exists"]:
+                raise RuntimeError(
+                    "get_if_exists=True requires the registration reply and "
+                    "cannot be used from the event-loop thread; await "
+                    "create_actor_async instead"
+                )
+            self._bg.spawn(self._register_actor(spec), self.loop)
+            return ActorHandle(spec["actor_id"], core=self)
+        view = self._run_sync(self._register_actor(spec))
         return ActorHandle(view["actor_id"], core=self)
+
+    async def create_actor_async(self, cls, args, kwargs, **opts) -> ActorHandle:
+        """Event-loop-safe actor creation (supports get_if_exists)."""
+        spec = self._build_actor_spec(cls, args, kwargs, **opts)
+        view = await self._register_actor(spec)
+        return ActorHandle(view["actor_id"], core=self)
+
+    async def get_actor_by_name_async(self, name: str) -> ActorHandle | None:
+        info = await self.gcs.call("get_actor", {"name": name})
+        if info is None or info.get("state") == DEAD:
+            return None
+        self._actor_info[info["actor_id"]] = info
+        return ActorHandle(info["actor_id"], core=self)
 
     def submit_actor_task(self, handle: ActorHandle, method: str, args, kwargs,
                           num_returns=1) -> ObjectRef | list[ObjectRef]:

@@ -1,0 +1,173 @@
+"""ray_tpu.serve — model serving on the ray_tpu runtime.
+
+TPU-native equivalent of Ray Serve (ref: python/ray/serve/): a controller
+actor reconciles replica sets (controller.py:87, deployment_state.py:1266),
+handle-side routers balance with power-of-two-choices over in-flight counts
+(request_router/pow_2_router.py:27), queue-depth autoscaling
+(autoscaling_policy.py), @serve.batch coalesces concurrent requests into
+MXU-sized batches, and an optional aiohttp ingress proxies HTTP
+(proxy.py:1137).
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(Model.bind())
+    ray_tpu.get(handle.remote(21))  # -> 42
+"""
+from __future__ import annotations
+
+import time
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.deployment import Application, Deployment, build_specs, deployment
+from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+
+__all__ = [
+    "AutoscalingConfig",
+    "Application",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "RayServeException",
+    "batch",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start",
+    "start_http_proxy",
+    "status",
+]
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple:
+    """Start the aiohttp ingress actor (ref: serve proxy per node)."""
+    from ray_tpu.serve.http_proxy import start_http_proxy as _start
+
+    start()
+    return _start(host, port)
+
+
+def _get_or_create_controller():
+    import ray_tpu
+    from ray_tpu.core.api import remote
+
+    handle = ray_tpu.get_core().get_actor_by_name(CONTROLLER_NAME)
+    if handle is not None:
+        return handle
+    return (
+        remote(ServeController)
+        .options(name=CONTROLLER_NAME, get_if_exists=True, num_cpus=0.1,
+                 max_restarts=3)
+        .remote()
+    )
+
+
+def start():
+    """Bring up the Serve control plane without deploying anything."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    return _get_or_create_controller()
+
+
+def run(app: Application, *, name: str = "default", timeout_s: float = 120.0,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy a bound application graph; returns the ingress handle
+    (ref: serve/api.py:675 serve.run)."""
+    import ray_tpu
+
+    if not isinstance(app, Application):
+        raise TypeError("serve.run takes a bound application: Deployment.bind(...)")
+    controller = start()
+    ingress, specs = build_specs(app, name)
+    refs = [
+        controller.deploy.remote(name, dep_name, spec)
+        for dep_name, spec in specs.items()
+    ]
+    ray_tpu.get(refs, timeout=30)
+    if _blocking:
+        for dep_name in specs:
+            ok = ray_tpu.get(
+                controller.wait_ready.remote(name, dep_name, timeout_s),
+                timeout=timeout_s + 10,
+            )
+            if not ok:
+                raise RayServeException(
+                    f"deployment {name}/{dep_name} failed to become ready "
+                    f"within {timeout_s}s"
+                )
+    return DeploymentHandle(ingress, app_name=name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name=app_name)
+
+
+def status() -> dict:
+    import ray_tpu
+
+    controller = ray_tpu.get_core().get_actor_by_name(CONTROLLER_NAME)
+    if controller is None:
+        return {}
+    return ray_tpu.get(controller.get_status.remote(), timeout=30)
+
+
+def delete(app_name: str = "default", timeout_s: float = 30.0):
+    import ray_tpu
+
+    controller = ray_tpu.get_core().get_actor_by_name(CONTROLLER_NAME)
+    if controller is None:
+        return
+    ray_tpu.get(controller.delete_app.remote(app_name), timeout=30)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        st = status()
+        if app_name not in st or not st[app_name]:
+            return
+        time.sleep(0.1)
+
+
+def shutdown():
+    """Tear down all applications and the controller."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        return
+    from ray_tpu.serve.http_proxy import PROXY_NAME
+
+    proxy = ray_tpu.get_core().get_actor_by_name(PROXY_NAME)
+    if proxy is not None:
+        try:
+            ray_tpu.get(proxy.shutdown.remote(), timeout=10)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(proxy)
+        except Exception:
+            pass
+    controller = ray_tpu.get_core().get_actor_by_name(CONTROLLER_NAME)
+    if controller is None:
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+    except Exception:
+        pass
+    try:
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    from ray_tpu.serve import handle as _handle_mod
+
+    with _handle_mod._routers_lock:
+        for r in _handle_mod._routers.values():
+            r.stop()
+        _handle_mod._routers.clear()

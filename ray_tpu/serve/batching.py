@@ -1,0 +1,95 @@
+"""@serve.batch — coalesce concurrent requests into one batched call.
+
+TPU-native equivalent of the reference's batching helper (ref:
+python/ray/serve/batching.py _BatchQueue). On TPU this is the single most
+important serving primitive: the MXU wants large batched matmuls, so N
+concurrent decode requests should hit the model as ONE batch-N forward
+pass, not N batch-1 passes. The wrapped method must be async and take a
+list of requests, returning a list of results of the same length.
+"""
+from __future__ import annotations
+
+import asyncio
+import functools
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.queue: list[tuple[tuple, dict, asyncio.Future]] = []
+        self._flusher: asyncio.Task | None = None
+
+    async def submit(self, args: tuple, kwargs: dict):
+        fut = asyncio.get_running_loop().create_future()
+        self.queue.append((args, kwargs, fut))
+        if len(self.queue) >= self.max_batch_size:
+            self._flush_now()
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.get_running_loop().create_task(self._wait_flush())
+        return await fut
+
+    async def _wait_flush(self):
+        await asyncio.sleep(self.batch_wait_timeout_s)
+        self._flush_now()
+
+    def _flush_now(self):
+        if self._flusher is not None and not self._flusher.done():
+            self._flusher.cancel()
+        self._flusher = None
+        batch, self.queue = self.queue, []
+        if batch:
+            asyncio.get_running_loop().create_task(self._run(batch))
+
+    async def _run(self, batch):
+        # the batched fn receives the list of first positional args — the
+        # reference's convention: `async def handler(self, requests: list)`
+        requests = [a[0] if a else None for a, _, _ in batch]
+        try:
+            results = await self.fn(requests)
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"batched function returned {len(results)} results "
+                    f"for a batch of {len(batch)}"
+                )
+            for (_, _, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as e:
+            for _, _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """Decorator for an async method taking a list of requests."""
+
+    def wrap(f):
+        if not asyncio.iscoroutinefunction(f):
+            raise TypeError("@serve.batch requires an async function")
+        queues: dict[int, _BatchQueue] = {}
+
+        @functools.wraps(f)
+        async def wrapper(self_or_first, *rest, **kwargs):
+            # bound-method case: first arg is `self`; free-function case:
+            # first arg is the request itself
+            if hasattr(type(self_or_first), f.__name__):
+                bound = functools.partial(f, self_or_first)
+                key = id(self_or_first)
+                request_args = rest
+            else:
+                bound = f
+                key = 0
+                request_args = (self_or_first, *rest)
+            q = queues.get(key)
+            if q is None:
+                q = queues[key] = _BatchQueue(bound, max_batch_size, batch_wait_timeout_s)
+            return await q.submit(request_args, kwargs)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -1,0 +1,53 @@
+"""Serve configuration dataclasses.
+
+TPU-native equivalents of the reference Serve config surface
+(ref: python/ray/serve/config.py AutoscalingConfig, DeploymentConfig;
+python/ray/serve/_private/autoscaling_state.py). Kept as plain picklable
+dataclasses so they travel through the GCS/actor plane unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-depth-driven replica autoscaling (ref: serve/config.py
+    AutoscalingConfig, _private/autoscaling_policy.py).
+
+    desired = ceil(total_ongoing_requests / target_ongoing_requests),
+    clamped to [min_replicas, max_replicas], applied only after the decision
+    has been stable for upscale_delay_s / downscale_delay_s.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 1.0
+    downscale_delay_s: float = 5.0
+    metrics_interval_s: float = 0.25
+
+    def __post_init__(self):
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError("need 0 <= min_replicas <= max_replicas, max >= 1")
+        if self.target_ongoing_requests <= 0:
+            raise ValueError("target_ongoing_requests must be > 0")
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Per-deployment behavior (ref: serve/config.py DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8  # per-replica concurrency cap
+    autoscaling_config: AutoscalingConfig | None = None
+    user_config: dict | None = None
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+
+    def initial_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            return max(self.autoscaling_config.min_replicas, 1)
+        return self.num_replicas

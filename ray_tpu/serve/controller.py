@@ -1,0 +1,338 @@
+"""Serve controller: the reconciliation brain of the serving layer.
+
+TPU-native equivalent of the reference ServeController (ref:
+python/ray/serve/_private/controller.py:87) + DeploymentState reconciler
+(deployment_state.py:1266) + autoscaling state manager
+(_private/autoscaling_state.py) + LongPollHost config fan-out
+(long_poll.py:222). One async actor: a reconcile loop drives replica sets
+toward target counts, health-checks replicas, polls their queue depth, and
+applies the queue-depth autoscaling policy; routers long-poll
+get_routing_info for membership changes.
+"""
+from __future__ import annotations
+
+import asyncio
+import math
+import time
+import uuid
+
+CONTROLLER_NAME = "SERVE::controller"
+
+
+class _DeploymentState:
+    def __init__(self, app_name: str, name: str, spec: dict):
+        self.app_name = app_name
+        self.name = name
+        self.spec = spec  # serialized_cls, init_args, init_kwargs, config
+        self.target_replicas: int = spec["config"].initial_replicas()
+        self.replicas: dict[str, dict] = {}  # replica_id -> {handle, healthy}
+        self.metrics: dict[str, int] = {}  # replica_id -> ongoing
+        # demand reported by handle-side routers that cannot route (e.g.
+        # scaled to zero): router_id -> (queued_count, monotonic_ts).
+        # This is the scale-from-zero signal (ref: serve handle-side
+        # queued-request metrics feeding autoscaling_state.py).
+        self.handle_queued: dict[str, tuple[int, float]] = {}
+        self.deleting = False
+        # autoscaling decision smoothing
+        self._pending_decision: int | None = None
+        self._pending_since: float = 0.0
+        self._last_health_check: float = 0.0
+
+    @property
+    def key(self) -> str:
+        return f"{self.app_name}/{self.name}"
+
+
+class ServeController:
+    """Async actor; methods run concurrently on the worker's event loop."""
+
+    def __init__(self):
+        self._deployments: dict[str, _DeploymentState] = {}
+        self._version = 0
+        self._changed: asyncio.Condition | None = None  # created on the loop
+        self._loop_task = None
+        self._stopping = False
+
+    # -------------------------------------------------------------- helpers
+    async def _ensure_loop(self):
+        if self._changed is None:
+            self._changed = asyncio.Condition()
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(self._reconcile_loop())
+
+    async def _bump_version(self):
+        self._version += 1
+        async with self._changed:
+            self._changed.notify_all()
+
+    # ------------------------------------------------------------ deploy API
+    async def deploy(self, app_name: str, name: str, spec: dict) -> bool:
+        """Create or update a deployment (ref: controller.py deploy_apps)."""
+        await self._ensure_loop()
+        key = f"{app_name}/{name}"
+        existing = self._deployments.get(key)
+        if existing is not None and not existing.deleting:
+            # in-place update: new code/config. Unpublish the old replicas
+            # FIRST (version bump) so routers stop sending to them, then
+            # drain+kill them in the background — the deploy RPC must not
+            # block on graceful shutdown.
+            old = existing.replicas
+            existing.spec = spec
+            existing.target_replicas = spec["config"].initial_replicas()
+            existing.replicas = {}
+            existing.metrics = {}
+            await self._bump_version()
+
+            async def drain_old():
+                for rid, rec in old.items():
+                    await self._stop_replica(existing, rid, rec, drain=True)
+
+            asyncio.get_running_loop().create_task(drain_old())
+            return True
+        self._deployments[key] = _DeploymentState(app_name, name, spec)
+        await self._bump_version()
+        return True
+
+    async def delete_app(self, app_name: str) -> bool:
+        for st in list(self._deployments.values()):
+            if st.app_name == app_name:
+                st.deleting = True
+        await self._bump_version()
+        return True
+
+    async def get_status(self) -> dict:
+        out: dict = {}
+        for st in self._deployments.values():
+            out.setdefault(st.app_name, {})[st.name] = {
+                "target_replicas": st.target_replicas,
+                "replicas": [
+                    {"replica_id": rid, "healthy": rec["healthy"]}
+                    for rid, rec in st.replicas.items()
+                ],
+                "ongoing": sum(st.metrics.values()),
+                "deleting": st.deleting,
+            }
+        return out
+
+    async def get_routing_info(self, app_name: str, name: str,
+                               known_version: int = -1, timeout_s: float = 10.0) -> dict:
+        """Long-poll: return immediately when the table differs from
+        known_version, else block until a change or timeout (ref:
+        long_poll.py:222 LongPollHost.listen_for_change)."""
+        await self._ensure_loop()
+        if self._version == known_version:
+            async with self._changed:
+                try:
+                    await asyncio.wait_for(
+                        self._changed.wait_for(lambda: self._version != known_version),
+                        timeout_s,
+                    )
+                except asyncio.TimeoutError:
+                    pass
+        st = self._deployments.get(f"{app_name}/{name}")
+        replicas = []
+        if st is not None and not st.deleting:
+            replicas = [
+                {"replica_id": rid, "actor_name": rec["actor_name"]}
+                for rid, rec in st.replicas.items()
+                if rec["healthy"] and rec.get("ready")
+            ]
+        return {"version": self._version, "replicas": replicas}
+
+    async def report_handle_queued(self, app_name: str, name: str,
+                                   router_id: str, queued: int) -> bool:
+        """Routers report requests they cannot place (no replicas); feeds
+        the autoscaler so min_replicas=0 deployments can scale from zero."""
+        st = self._deployments.get(f"{app_name}/{name}")
+        if st is None:
+            return False
+        if queued <= 0:
+            st.handle_queued.pop(router_id, None)
+        else:
+            st.handle_queued[router_id] = (queued, time.monotonic())
+        return True
+
+    async def wait_ready(self, app_name: str, name: str, timeout_s: float = 60.0) -> bool:
+        """Block until the deployment has its target count of ready replicas."""
+        deadline = time.monotonic() + timeout_s
+        key = f"{app_name}/{name}"
+        while time.monotonic() < deadline:
+            st = self._deployments.get(key)
+            if st is not None:
+                ready = sum(
+                    1 for r in st.replicas.values() if r["healthy"] and r.get("ready")
+                )
+                if ready >= st.target_replicas:
+                    return True
+            await asyncio.sleep(0.05)
+        return False
+
+    # -------------------------------------------------------- reconcile loop
+    async def _reconcile_loop(self):
+        while not self._stopping:
+            try:
+                for st in list(self._deployments.values()):
+                    await self._reconcile_one(st)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            await asyncio.sleep(0.1)
+
+    async def _reconcile_one(self, st: _DeploymentState):
+        import ray_tpu
+
+        if st.deleting:
+            for rid, rec in list(st.replicas.items()):
+                await self._stop_replica(st, rid, rec, drain=True)
+            st.replicas.clear()
+            self._deployments.pop(st.key, None)
+            await self._bump_version()
+            return
+
+        # 1. start missing replicas
+        cfg = st.spec["config"]
+        while len(st.replicas) < st.target_replicas:
+            rid = f"{st.name}#{uuid.uuid4().hex[:8]}"
+            actor_name = f"SERVE_REPLICA::{st.app_name}/{rid}"
+            from ray_tpu.serve.replica import Replica
+
+            opts = dict(cfg.ray_actor_options)
+            opts.setdefault("num_cpus", 0.1)
+            handle = (
+                ray_tpu.remote(Replica)
+                .options(
+                    name=actor_name,
+                    max_concurrency=max(8, cfg.max_ongoing_requests + 2),
+                    **opts,
+                )
+                .remote(
+                    st.spec["serialized_cls"],
+                    st.spec["init_args"],
+                    st.spec["init_kwargs"],
+                    st.name,
+                    rid,
+                    cfg.max_ongoing_requests,
+                    cfg.user_config,
+                )
+            )
+            st.replicas[rid] = {
+                "handle": handle,
+                "actor_name": actor_name,
+                "healthy": True,
+                "ready": False,
+                "ping": None,
+            }
+
+        # 2. stop surplus replicas (prefer the least-loaded)
+        while len(st.replicas) > st.target_replicas:
+            rid = min(st.replicas, key=lambda r: st.metrics.get(r, 0))
+            rec = st.replicas.pop(rid)
+            st.metrics.pop(rid, None)
+            await self._stop_replica(st, rid, rec, drain=True)
+            await self._bump_version()
+
+        # 3. health + readiness + metrics probe (fan-out)
+        interval = cfg.health_check_period_s
+        if cfg.autoscaling_config is not None:
+            interval = min(interval, cfg.autoscaling_config.metrics_interval_s)
+        if any(not r.get("ready") for r in st.replicas.values()):
+            interval = min(interval, 0.25)  # fast-poll only while converging
+        now = time.monotonic()
+        if now - st._last_health_check >= interval:
+            st._last_health_check = now
+            await self._probe_replicas(st)
+
+        # 4. autoscaling decision
+        self._autoscale(st)
+
+    async def _probe_replicas(self, st: _DeploymentState):
+        from ray_tpu.core.api import get_core
+
+        core = get_core()
+        cfg = st.spec["config"]
+
+        async def probe(rid, rec):
+            try:
+                ref = rec["handle"].get_metrics.remote()
+                (m,) = await asyncio.wait_for(
+                    core.get_async([ref], cfg.health_check_timeout_s),
+                    cfg.health_check_timeout_s + 1,
+                )
+                st.metrics[rid] = int(m["ongoing"])
+                if not rec.get("ready"):
+                    rec["ready"] = True
+                    await self._bump_version()
+                rec["fails"] = 0
+            except Exception:
+                rec["fails"] = rec.get("fails", 0) + 1
+                # a constructing replica is not failed: only count after ready
+                if rec.get("ready") and rec["fails"] >= 2:
+                    rec["healthy"] = False
+                    st.replicas.pop(rid, None)
+                    st.metrics.pop(rid, None)
+                    await self._stop_replica(st, rid, rec, drain=False)
+                    await self._bump_version()
+
+        await asyncio.gather(*(probe(r, rec) for r, rec in list(st.replicas.items())))
+
+    def _autoscale(self, st: _DeploymentState):
+        cfg = st.spec["config"]
+        auto = cfg.autoscaling_config
+        if auto is None:
+            return
+        now = time.monotonic()
+        for rid, (_, ts) in list(st.handle_queued.items()):
+            if now - ts > 3.0:  # stale reporter
+                st.handle_queued.pop(rid, None)
+        total = sum(st.metrics.values()) + sum(q for q, _ in st.handle_queued.values())
+        desired = math.ceil(total / auto.target_ongoing_requests)
+        desired = max(auto.min_replicas, min(auto.max_replicas, desired))
+        if desired == st.target_replicas:
+            st._pending_decision = None
+            return
+        now = time.monotonic()
+        if st._pending_decision != desired:
+            st._pending_decision = desired
+            st._pending_since = now
+            return
+        delay = auto.upscale_delay_s if desired > st.target_replicas else auto.downscale_delay_s
+        if st.target_replicas == 0 and desired > 0:
+            delay = 0.0  # scale-from-zero: requests are blocked, act now
+        if now - st._pending_since >= delay:
+            st.target_replicas = desired
+            st._pending_decision = None
+
+    async def _stop_replica(self, st: _DeploymentState, rid: str, rec: dict, drain: bool):
+        from ray_tpu.core.api import get_core
+
+        core = get_core()
+        cfg = st.spec["config"]
+        try:
+            if drain and rec.get("ready"):
+                ref = rec["handle"].prepare_for_shutdown.remote(
+                    cfg.graceful_shutdown_timeout_s
+                )
+                await asyncio.wait_for(
+                    core.get_async([ref], cfg.graceful_shutdown_timeout_s + 1),
+                    cfg.graceful_shutdown_timeout_s + 2,
+                )
+        except Exception:
+            pass
+        try:
+            await core.gcs.call(
+                "kill_actor", {"actor_id": rec["handle"].actor_id, "no_restart": True}
+            )
+        except Exception:
+            pass
+
+    async def shutdown(self) -> bool:
+        self._stopping = True
+        for st in list(self._deployments.values()):
+            st.deleting = True
+            for rid, rec in list(st.replicas.items()):
+                await self._stop_replica(st, rid, rec, drain=False)
+            st.replicas.clear()
+        self._deployments.clear()
+        await self._bump_version()
+        return True

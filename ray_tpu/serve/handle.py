@@ -1,0 +1,301 @@
+"""DeploymentHandle + request router.
+
+TPU-native equivalent of the reference handle/router pair (ref:
+python/ray/serve/handle.py:633 DeploymentHandle, _private/router.py:337
+Router.assign_request, request_router/pow_2_router.py:27
+PowerOfTwoChoicesRequestRouter). The router long-polls the controller for
+replica membership and picks between two random replicas by locally
+tracked in-flight counts — the same ongoing-requests signal the reference
+router uses, with no per-request probe RPC on the hot path.
+
+Handles work from two call sites with different blocking rules:
+- driver / plain threads: .remote() routes synchronously, returns ObjectRef
+- inside async actors (deployment composition): the event loop must not
+  block, so .remote() returns an awaitable response that finishes routing
+  asynchronously (the reference's DeploymentResponse shape)
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import threading
+import time
+
+from ray_tpu.serve.controller import CONTROLLER_NAME
+
+
+class RayServeException(Exception):
+    pass
+
+
+def _core():
+    from ray_tpu.core.api import get_core
+
+    return get_core()
+
+
+def _on_core_loop() -> bool:
+    core = _core()
+    try:
+        return asyncio.get_running_loop() is core.loop
+    except RuntimeError:
+        return False
+
+
+class _Router:
+    """Shared per-(app, deployment) routing state; thread-safe because
+    .remote() may be called from the driver thread or any actor loop."""
+
+    def __init__(self, app_name: str, deployment_name: str):
+        self.app_name = app_name
+        self.deployment_name = deployment_name
+        self.version = -1
+        self.replicas: list[dict] = []  # {replica_id, actor_name}
+        self.handles: dict[str, object] = {}  # replica_id -> ActorHandle
+        self.inflight: dict[str, int] = {}
+        self.lock = threading.Lock()
+        self._poll_started = False
+        self._stopped = False
+        self._controller_handle = None
+        self._router_id = f"router-{id(self):x}-{random.getrandbits(32):08x}"
+        self._waiting = 0  # requests blocked on empty membership
+
+    # ----------------------------------------------------------- membership
+    async def _controller(self):
+        if self._controller_handle is None:
+            self._controller_handle = await _core().get_actor_by_name_async(
+                CONTROLLER_NAME
+            )
+            if self._controller_handle is None:
+                raise RayServeException("Serve controller is not running")
+        return self._controller_handle
+
+    async def _refresh_once(self, known_version: int, long_poll_s: float):
+        core = _core()
+        controller = await self._controller()
+        ref = controller.get_routing_info.remote(
+            self.app_name, self.deployment_name, known_version, long_poll_s
+        )
+        (info,) = await core.get_async([ref], long_poll_s + 15.0)
+        with self.lock:
+            self._apply(info)
+
+    def _apply(self, info: dict):
+        self.version = info["version"]
+        self.replicas = info["replicas"]
+        live = {r["replica_id"] for r in self.replicas}
+        for rid in list(self.handles):
+            if rid not in live:
+                self.handles.pop(rid, None)
+                self.inflight.pop(rid, None)
+
+    def _ensure_poll_loop(self):
+        """Background long-poll keeping membership fresh (the LongPollClient
+        role, ref: long_poll.py LongPollClient)."""
+        with self.lock:
+            if self._poll_started:
+                return
+            self._poll_started = True
+
+        async def poll():
+            failures = 0
+            while not self._stopped:
+                try:
+                    await self._refresh_once(self.version, 10.0)
+                    failures = 0
+                except RayServeException:
+                    # controller gone (serve.shutdown): stop polling; a
+                    # later request restarts the loop
+                    break
+                except Exception:
+                    failures += 1
+                    if failures >= 20:
+                        break
+                    await asyncio.sleep(0.5)
+            with self.lock:
+                self._poll_started = False
+            self._controller_handle = None
+
+        _core()._call_on_loop(poll())
+
+    def stop(self):
+        self._stopped = True
+
+    async def _wait_for_replicas(self, timeout_s: float = 30.0):
+        deadline = time.monotonic() + timeout_s
+        self._waiting += 1
+        try:
+            while time.monotonic() < deadline:
+                with self.lock:
+                    if self.replicas:
+                        return
+                # report unplaceable demand: the scale-from-zero signal
+                try:
+                    controller = await self._controller()
+                    controller.report_handle_queued.remote(
+                        self.app_name, self.deployment_name,
+                        self._router_id, self._waiting,
+                    )
+                except Exception:
+                    pass
+                try:
+                    await self._refresh_once(self.version, 1.0)
+                except Exception:
+                    await asyncio.sleep(0.2)
+            raise RayServeException(
+                f"no ready replicas for {self.app_name}/{self.deployment_name}"
+            )
+        finally:
+            self._waiting -= 1
+            if self._waiting == 0:
+                try:
+                    controller = await self._controller()
+                    controller.report_handle_queued.remote(
+                        self.app_name, self.deployment_name, self._router_id, 0
+                    )
+                except Exception:
+                    pass
+
+    # -------------------------------------------------------------- routing
+    def _choose(self) -> dict | None:
+        """Power-of-two-choices over locally tracked in-flight counts."""
+        with self.lock:
+            reps = list(self.replicas)
+            if not reps:
+                return None
+            if len(reps) == 1:
+                return reps[0]
+            a, b = random.sample(reps, 2)
+            return (
+                a
+                if self.inflight.get(a["replica_id"], 0)
+                <= self.inflight.get(b["replica_id"], 0)
+                else b
+            )
+
+    async def route_async(self, method: str, args: tuple, kwargs: dict):
+        """Loop-thread path: full async routing; returns the result."""
+        self._ensure_poll_loop()
+        if self._choose() is None:
+            await self._wait_for_replicas()
+        chosen = self._choose()
+        if chosen is None:
+            raise RayServeException("no replicas available")
+        rid = chosen["replica_id"]
+        with self.lock:
+            actor = self.handles.get(rid)
+        if actor is None:
+            actor = await _core().get_actor_by_name_async(chosen["actor_name"])
+            if actor is None:
+                raise RayServeException(f"replica actor {chosen['actor_name']} gone")
+            with self.lock:
+                self.handles[rid] = actor
+        ref = actor.handle_request.remote(method, args, kwargs)
+        self.track(rid, ref)
+        return await ref
+
+    def route_sync(self, method: str, args: tuple, kwargs: dict):
+        """Driver-thread path: block briefly for membership; returns ObjectRef."""
+        import ray_tpu
+
+        self._ensure_poll_loop()
+        chosen = self._choose()
+        if chosen is None:
+            core = _core()
+            fut = asyncio.run_coroutine_threadsafe(self._wait_for_replicas(), core.loop)
+            fut.result(35.0)
+            chosen = self._choose()
+            if chosen is None:
+                raise RayServeException("no replicas available")
+        rid = chosen["replica_id"]
+        with self.lock:
+            actor = self.handles.get(rid)
+        if actor is None:
+            actor = ray_tpu.get_actor(chosen["actor_name"])
+            with self.lock:
+                self.handles[rid] = actor
+        ref = actor.handle_request.remote(method, args, kwargs)
+        self.track(rid, ref)
+        return ref
+
+    def track(self, rid: str, ref):
+        """Count the request against the replica until its result is ready."""
+        core = _core()
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+
+        async def watch():
+            try:
+                entry = core.memory_store.get(ref.id)
+                if entry is not None:
+                    await entry.ready.wait()
+            finally:
+                with self.lock:
+                    if self.inflight.get(rid, 0) > 0:
+                        self.inflight[rid] -= 1
+
+        core._call_on_loop(watch())
+
+
+_routers: dict[tuple[str, str], _Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _router_for(app_name: str, deployment_name: str) -> _Router:
+    key = (app_name, deployment_name)
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None:
+            r = _routers[key] = _Router(app_name, deployment_name)
+        return r
+
+
+class DeploymentResponse:
+    """Awaitable returned by handle calls made on an event loop (async
+    actors composing deployments); ref: serve/handle.py DeploymentResponse."""
+
+    def __init__(self, router: _Router, method: str, args: tuple, kwargs: dict):
+        self._router = router
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+
+    def __await__(self):
+        return self._router.route_async(self._method, self._args, self._kwargs).__await__()
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    """User-facing handle; composable across deployments (ref:
+    serve/handle.py:633). From the driver, ``handle.method.remote(*a)``
+    returns an ObjectRef for ray_tpu.get; inside async actors it returns an
+    awaitable DeploymentResponse."""
+
+    def __init__(self, deployment_name: str, app_name: str = "default"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def remote(self, *args, **kwargs):
+        return self._invoke("__call__", args, kwargs)
+
+    def _invoke(self, method: str, args: tuple, kwargs: dict):
+        router = _router_for(self.app_name, self.deployment_name)
+        if _on_core_loop():
+            return DeploymentResponse(router, method, args, kwargs)
+        return router.route_sync(method, args, kwargs)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name))

@@ -1,0 +1,115 @@
+"""HTTP ingress proxy for ray_tpu.serve.
+
+TPU-native equivalent of the reference ProxyActor / HTTPProxy (ref:
+python/ray/serve/_private/proxy.py:1137, HTTPProxy :750 — uvicorn/
+starlette there, aiohttp here since it ships in this image). One async
+actor runs an aiohttp server; requests route through the same
+DeploymentHandle/router path as native handle calls:
+
+    POST /{app}/{deployment}        body = JSON args -> __call__(body)
+    POST /{app}/{deployment}/{m}    -> method m(body)
+    GET  /-/healthz                 liveness
+    GET  /-/routes                  routing table
+"""
+from __future__ import annotations
+
+import asyncio
+
+PROXY_NAME = "SERVE::http_proxy"
+
+
+class HttpProxy:
+    """Async actor hosting the aiohttp ingress."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._started = False
+
+    async def ready(self) -> tuple[str, int]:
+        """Start the server (idempotent); returns the bound address."""
+        if self._started:
+            return (self.host, self.port)
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_get("/-/healthz", self._healthz)
+        app.router.add_get("/-/routes", self._routes)
+        app.router.add_route("*", "/{app}/{deployment}", self._handle)
+        app.router.add_route("*", "/{app}/{deployment}/{method}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = self._runner.addresses[0][1]
+        self._started = True
+        return (self.host, self.port)
+
+    async def _healthz(self, request):
+        from aiohttp import web
+
+        return web.json_response({"status": "ok"})
+
+    async def _routes(self, request):
+        # loop-safe status: the proxy runs ON the core loop, so the sync
+        # serve.status() path (_run_sync) is off-limits here
+        from aiohttp import web
+
+        from ray_tpu.core.api import get_core
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        core = get_core()
+        controller = await core.get_actor_by_name_async(CONTROLLER_NAME)
+        if controller is None:
+            return web.json_response({})
+        ref = controller.get_status.remote()
+        (status,) = await core.get_async([ref], 10.0)
+        return web.json_response({app: list(deps) for app, deps in status.items()})
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+
+        app_name = request.match_info["app"]
+        deployment = request.match_info["deployment"]
+        method = request.match_info.get("method") or "__call__"
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                body = (await request.read()).decode()
+        else:
+            body = None
+        handle = DeploymentHandle(deployment, app_name=app_name)
+        try:
+            args = (body,) if body is not None else ()
+            result = await handle._invoke(method, args, {})
+            return web.json_response({"result": result})
+        except RayServeException as e:
+            return web.json_response({"error": str(e)}, status=503)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def shutdown(self) -> bool:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._started = False
+        return True
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 8000) -> tuple[str, int]:
+    """Start (or find) the HTTP proxy actor; returns its bound address."""
+    import ray_tpu
+    from ray_tpu.core.api import remote
+
+    handle = ray_tpu.get_core().get_actor_by_name(PROXY_NAME)
+    if handle is None:
+        handle = (
+            remote(HttpProxy)
+            .options(name=PROXY_NAME, get_if_exists=True, num_cpus=0.1)
+            .remote(host, port)
+        )
+    return tuple(ray_tpu.get(handle.ready.remote(), timeout=30))

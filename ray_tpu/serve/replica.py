@@ -1,0 +1,112 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+TPU-native equivalent of the reference ReplicaActor (ref:
+python/ray/serve/_private/replica.py:925, user-code wrapper :1170). The
+wrapper tracks ongoing-request counts (the autoscaling signal), enforces
+the per-replica concurrency cap, resolves handle markers in init args so
+deployments compose (ref: serve deployment graph .bind), and applies
+user_config via the user class's optional ``reconfigure`` method.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+
+class HandleMarker:
+    """Placeholder in init args for a handle to another deployment; the
+    replica swaps it for a live DeploymentHandle at construction time."""
+
+    def __init__(self, deployment_name: str, app_name: str):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+
+
+class Replica:
+    """Generic replica wrapper: created as an actor per replica by the
+    controller; all requests flow through handle_request."""
+
+    def __init__(self, serialized_cls: bytes, init_args: tuple, init_kwargs: dict,
+                 deployment_name: str, replica_id: str, max_ongoing_requests: int,
+                 user_config: dict | None = None):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        cls = cloudpickle.loads(serialized_cls)
+        init_args = tuple(self._resolve(a, DeploymentHandle) for a in init_args)
+        init_kwargs = {k: self._resolve(v, DeploymentHandle) for k, v in init_kwargs.items()}
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self.max_ongoing_requests = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        self._gate = None  # asyncio.Semaphore, created lazily on the actor loop
+        # sync user methods run here so the cap, not the worker's executor
+        # width, bounds real concurrency
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, max_ongoing_requests), thread_name_prefix="rt-serve"
+        )
+        self.user = cls(*init_args, **init_kwargs) if isinstance(cls, type) else cls
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    @staticmethod
+    def _resolve(arg, handle_cls):
+        if isinstance(arg, HandleMarker):
+            return handle_cls(arg.deployment_name, app_name=arg.app_name)
+        return arg
+
+    def _apply_user_config(self, user_config: dict):
+        fn = getattr(self.user, "reconfigure", None)
+        if fn is None:
+            raise AttributeError(
+                f"{type(self.user).__name__} got user_config but defines no "
+                "reconfigure(user_config) method"
+            )
+        fn(user_config)
+
+    # ------------------------------------------------------------- requests
+    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+        if self._gate is None:
+            self._gate = asyncio.Semaphore(self.max_ongoing_requests)
+        self._ongoing += 1
+        self._total += 1
+        try:
+            async with self._gate:
+                fn = getattr(self.user, method) if method else self.user
+                if inspect.iscoroutinefunction(fn):
+                    return await fn(*args, **kwargs)
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(self._pool, lambda: fn(*args, **kwargs))
+        finally:
+            self._ongoing -= 1
+
+    # ------------------------------------------------------------ lifecycle
+    def get_metrics(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "ongoing": self._ongoing,
+            "total": self._total,
+        }
+
+    def check_health(self) -> bool:
+        fn = getattr(self.user, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def reconfigure(self, user_config: dict) -> bool:
+        self._apply_user_config(user_config)
+        return True
+
+    async def prepare_for_shutdown(self, timeout_s: float) -> bool:
+        """Drain: wait for ongoing requests to finish (bounded)."""
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while self._ongoing > 0 and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+        return self._ongoing == 0

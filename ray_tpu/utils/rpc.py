@@ -138,6 +138,7 @@ class RpcServer:
         self._server: asyncio.base_events.Server | None = None
         self._handlers: dict[str, Callable] = {}
         self._conns: set[Connection] = set()
+        self._dispatch_tasks: set[asyncio.Task] = set()
         self.on_disconnect: Callable[[Connection], None] | None = None
 
     def route(self, name: str):
@@ -171,7 +172,9 @@ class RpcServer:
                 msg = await read_frame(reader)
                 kind = msg.get("k")
                 if kind in ("c", "n"):
-                    asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
+                    t = asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
+                    self._dispatch_tasks.add(t)
+                    t.add_done_callback(self._dispatch_tasks.discard)
                 elif kind == "r":
                     fut = conn._pending.pop(msg["i"], None)
                     if fut is not None and not fut.done():
@@ -223,6 +226,10 @@ class RpcServer:
         # read_frame(), and 3.12's wait_closed() waits for handlers to finish
         for conn in list(self._conns):
             await conn.close()
+        for t in list(self._dispatch_tasks):
+            t.cancel()
+        if self._dispatch_tasks:
+            await asyncio.gather(*self._dispatch_tasks, return_exceptions=True)
         if self._server is not None:
             self._server.close()
             try:

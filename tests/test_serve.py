@@ -1,0 +1,257 @@
+"""Serve end-to-end tests: deploy/route/compose/batch/autoscale/recover
+(ref test strategy: python/ray/serve/tests/test_standalone.py,
+test_autoscaling_policy.py — behavior parity at test scale)."""
+
+import concurrent.futures
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=32)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(rt):
+    yield
+    # tear down everything between tests so replica sets don't leak across
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+def test_basic_deploy_and_route(rt):
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return (os.getpid(), x * 2)
+
+    handle = serve.run(Echo.bind(), name="echo")
+    refs = [handle.remote(i) for i in range(100)]
+    results = ray_tpu.get(refs, timeout=60)
+    pids = {pid for pid, _ in results}
+    values = [v for _, v in results]
+    assert values == [i * 2 for i in range(100)]
+    # 100 requests over 2 replicas: pow-2 routing must touch both
+    assert len(pids) == 2, f"expected both replicas used, got {pids}"
+
+
+def test_method_calls_and_user_config(rt):
+    @serve.deployment(user_config={"scale": 10})
+    class Scaler:
+        def __init__(self):
+            self.scale = 1
+
+        def reconfigure(self, cfg):
+            self.scale = cfg["scale"]
+
+        def apply(self, x):
+            return x * self.scale
+
+    handle = serve.run(Scaler.bind(), name="scaler")
+    assert ray_tpu.get(handle.apply.remote(4), timeout=30) == 40
+
+
+def test_composition_nested_handles(rt):
+    """Deployment graph: ingress calls a bound child via its handle
+    (ref: serve deployment graph .bind composition)."""
+
+    @serve.deployment
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, adder):
+            self.adder = adder
+
+        async def __call__(self, x):
+            return await self.adder.remote(x) * 2
+
+    handle = serve.run(Ingress.bind(Adder.bind(100)), name="graph")
+    assert ray_tpu.get(handle.remote(1), timeout=60) == 202
+
+
+def test_batching_coalesces(rt):
+    """@serve.batch: concurrent requests arrive as ONE batched call —
+    the TPU-native serving hot path (batch the MXU, not the queue)."""
+
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        async def handler(self, xs: list):
+            self.batch_sizes.append(len(xs))
+            return [x + 1 for x in xs]
+
+        async def __call__(self, x):
+            return await self.handler(x)
+
+        def seen_batches(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind(), name="batched")
+    refs = [handle.remote(i) for i in range(16)]
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [i + 1 for i in range(16)]
+    sizes = ray_tpu.get(handle.seen_batches.remote(), timeout=30)
+    assert sum(sizes) == 16
+    assert max(sizes) > 1, f"no coalescing happened: {sizes}"
+
+
+def test_autoscale_up_under_load(rt):
+    """Queue-depth autoscaling: sustained load over target_ongoing_requests
+    grows the replica set (ref: autoscaling_policy.py upscale path)."""
+
+    @serve.deployment(
+        max_ongoing_requests=4,
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_ongoing_requests": 1.0,
+            "upscale_delay_s": 0.3,
+            "downscale_delay_s": 60.0,
+            "metrics_interval_s": 0.1,
+        },
+    )
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind(), name="autoscale")
+    assert serve.status()["autoscale"]["Slow"]["target_replicas"] == 1
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=12) as pool:
+        futs = [
+            pool.submit(lambda i=i: ray_tpu.get(handle.remote(i), timeout=120))
+            for i in range(48)
+        ]
+        done = [f.result() for f in futs]
+    assert sorted(done) == list(range(48))
+    st = serve.status()["autoscale"]["Slow"]
+    assert st["target_replicas"] > 1, f"no upscale happened: {st}"
+
+
+def test_scale_from_zero(rt):
+    """min_replicas=0: idle deployment drops to zero replicas; a new request
+    reports handle-side queueing and wakes it back up (ref: serve
+    scale-from-zero via handle queued-request metrics)."""
+
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 0,
+            "max_replicas": 2,
+            "target_ongoing_requests": 2.0,
+            "upscale_delay_s": 0.2,
+            "downscale_delay_s": 0.3,
+            "metrics_interval_s": 0.1,
+        },
+    )
+    class Idle:
+        def __call__(self, x):
+            return x + 1
+
+    handle = serve.run(Idle.bind(), name="zero")
+    assert ray_tpu.get(handle.remote(1), timeout=30) == 2
+
+    # idle -> controller downscales to zero
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = serve.status()["zero"]["Idle"]
+        if st["target_replicas"] == 0 and not st["replicas"]:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"never scaled to zero: {serve.status()}")
+
+    # cold request scales it back from zero
+    assert ray_tpu.get(handle.remote(41), timeout=60) == 42
+
+
+def test_replica_failure_recovers(rt):
+    """Router + controller recover when a replica dies mid-service
+    (ref: deployment_state replica recovery)."""
+
+    @serve.deployment(num_replicas=2)
+    class Fragile:
+        def pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Fragile.bind(), name="fragile")
+    # kill one replica out from under the router
+    try:
+        ray_tpu.get(handle.die.remote(), timeout=10)
+    except Exception:
+        pass
+    # service continues: the healthy replica answers while the controller
+    # replaces the dead one
+    deadline = time.monotonic() + 60
+    ok = 0
+    while time.monotonic() < deadline and ok < 20:
+        try:
+            assert ray_tpu.get(handle.remote(ok), timeout=15) == ok
+            ok += 1
+        except Exception:
+            time.sleep(0.2)
+    assert ok == 20
+    # controller heals the set back to 2 replicas
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        reps = serve.status()["fragile"]["Fragile"]["replicas"]
+        if len(reps) == 2 and all(r["healthy"] for r in reps):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"replica set never healed: {serve.status()}")
+
+
+def test_http_proxy(rt):
+    """aiohttp ingress routes HTTP to deployments (ref: proxy.py HTTPProxy)."""
+    import json
+    import urllib.request
+
+    @serve.deployment
+    class Api:
+        def __call__(self, body):
+            return {"doubled": body["x"] * 2}
+
+        def info(self, body=None):
+            return "info-ok"
+
+    serve.run(Api.bind(), name="api")
+    host, port = serve.start_http_proxy()
+
+    req = urllib.request.Request(
+        f"http://{host}:{port}/api/Api",
+        data=json.dumps({"x": 21}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.loads(resp.read())["result"] == {"doubled": 42}
+
+    with urllib.request.urlopen(f"http://{host}:{port}/-/healthz", timeout=10) as resp:
+        assert json.loads(resp.read())["status"] == "ok"
+
+    with urllib.request.urlopen(f"http://{host}:{port}/-/routes", timeout=10) as resp:
+        routes = json.loads(resp.read())
+        assert "Api" in routes.get("api", []), routes
