@@ -1,0 +1,229 @@
+"""ray_tpu.tune — hyperparameter search on the ray_tpu runtime.
+
+TPU-native equivalent of Ray Tune (ref: python/ray/tune/): Tuner.fit
+(tuner.py:43, fit :312) drives a TuneController event loop
+(execution/tune_controller.py:68) over actor-per-trial trainables with
+PG-per-trial placement, basic variant generation (grid + random sampling),
+and ASHA / median-stopping early termination (schedulers/).
+
+    from ray_tpu import tune
+
+    def trainable(config):
+        for step in range(10):
+            tune.report({"loss": config["lr"] * step})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(num_samples=8, metric="loss", mode="min"),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401  (re-export)
+from ray_tpu.tune.controller import (
+    ERRORED,
+    STOPPED,
+    TERMINATED,
+    Trial,
+    TuneController,
+)
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.search import (
+    choice,
+    generate_variants,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.session import get_checkpoint, report
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "Result",
+    "ResultGrid",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "uniform",
+]
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """(ref: tune/tune_config.py TuneConfig)"""
+
+    metric: str | None = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int | None = None
+    scheduler: object | None = None
+    seed: int | None = None
+    max_failures_per_trial: int = 0
+
+
+class Result:
+    def __init__(self, trial: Trial):
+        self.trial_id = trial.trial_id
+        self.config = trial.config
+        self.metrics = trial.metrics
+        self.metrics_history = trial.history
+        self.checkpoint = (
+            Checkpoint(trial.checkpoint_path) if trial.checkpoint_path else None
+        )
+        self.error = trial.error
+        self.status = trial.status
+
+    def __repr__(self):
+        return f"Result({self.trial_id}, status={self.status}, metrics={self.metrics})"
+
+
+class ResultGrid:
+    """(ref: tune/result_grid.py ResultGrid)"""
+
+    def __init__(self, results: list[Result], metric: str | None, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> list[Result]:
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: str | None = None, mode: str | None = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given to get_best_result or TuneConfig")
+        scored = [r for r in self._results if metric in (r.metrics or {})]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        rows = [
+            {"trial_id": r.trial_id, **{f"config/{k}": v for k, v in r.config.items()},
+             **(r.metrics or {})}
+            for r in self._results
+        ]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+class Tuner:
+    """(ref: tune/tuner.py:43; restore/resume is the experiment_state.json
+    written by the controller)"""
+
+    def __init__(self, trainable: Callable | object, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None, run_config=None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        trainable, resources = _as_trainable(self.trainable)
+        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+        if not variants:
+            variants = [{}]
+        storage = None
+        if self.run_config is not None:
+            storage = getattr(self.run_config, "storage_path", None)
+            name = getattr(self.run_config, "name", None)
+        else:
+            name = None
+        if storage is None:
+            import uuid as _uuid
+
+            storage = f"/tmp/ray_tpu/tune/{name or 'exp'}_{_uuid.uuid4().hex[:8]}"
+        controller = TuneController(
+            trainable,
+            variants,
+            scheduler=tc.scheduler,
+            metric=tc.metric,
+            mode=tc.mode,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            resources_per_trial=resources,
+            storage_path=storage,
+            max_failures_per_trial=tc.max_failures_per_trial,
+        )
+        trials = controller.run()
+        return ResultGrid([Result(t) for t in trials], tc.metric, tc.mode)
+
+
+def _as_trainable(obj) -> tuple[Callable, dict]:
+    """Accept a plain function(config) or a JaxTrainer (Tune-over-Train,
+    ref: BaseTrainer.fit wrapping itself as a Trainable, base_trainer.py:808)."""
+    from ray_tpu.train.trainer import JaxTrainer
+
+    if isinstance(obj, JaxTrainer):
+        trainer = obj
+
+        def trainable(config: dict):
+            import dataclasses as _dc
+            import os as _os
+
+            from ray_tpu import tune
+            from ray_tpu.train.trainer import JaxTrainer as _JT
+            from ray_tpu.tune.session import get_session
+
+            merged = dict(trainer.train_loop_config or {})
+            merged.update(config.get("train_loop_config", config))
+            # per-trial run name + storage subdir: concurrent trials must
+            # not share checkpoint dirs or collective group namespaces
+            trial_id = get_session().trial_id
+            run_cfg = _dc.replace(trainer.run_config)
+            run_cfg.name = f"{run_cfg.name or 'tune'}_{trial_id}"
+            if run_cfg.storage_path:
+                run_cfg.storage_path = _os.path.join(run_cfg.storage_path, trial_id)
+            t = _JT(
+                trainer.train_loop,
+                train_loop_config=merged,
+                scaling_config=trainer.scaling,
+                run_config=run_cfg,
+            )
+            result = t.fit()
+            if result.error is not None:
+                raise result.error
+            tune.report(result.metrics, checkpoint=result.checkpoint)
+            return result.metrics
+
+        # the trial actor itself is light; its nested train workers carry
+        # the real resources
+        return trainable, {"CPU": 0.5}
+    return obj, {"CPU": 1.0}

@@ -1,0 +1,271 @@
+"""TuneController: drives trials as actors, applies scheduler decisions.
+
+TPU-native equivalent of the reference TuneController (ref:
+python/ray/tune/execution/tune_controller.py:68 — event loop step :666,
+actor management _schedule_trial_actor :964) with PG-per-trial resources
+(tune/execution/placement_groups.py PlacementGroupFactory). Trials run as
+TrialActor actors; the controller polls their report outboxes, feeds the
+scheduler, early-stops losers, and retries failed trials up to
+max_failures_per_trial.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+STOPPED = "STOPPED"  # early-stopped by the scheduler
+ERRORED = "ERRORED"
+
+
+class TrialActor:
+    """Actor hosting one trial's trainable function."""
+
+    def __init__(self, trial_id: str, storage_path: str):
+        from ray_tpu.tune import session as tune_session
+
+        self.trial_id = trial_id
+        self.storage_path = storage_path
+        self._done = False
+        self._error: str | None = None
+        self._session = None
+        self._tune_session_mod = tune_session
+
+    def run(self, trainable: Callable, config: dict,
+            checkpoint_path: str | None = None, start_iteration: int = 0):
+        """Blocking trainable execution (executor thread; poll() stays
+        servable on the actor loop — same split as TrainWorker.run)."""
+        from ray_tpu.tune.session import TrialStopped, init_session
+
+        ckpt = Checkpoint.from_directory(checkpoint_path) if checkpoint_path else None
+        self._session = init_session(self.trial_id, config, ckpt)
+        # resumed trials continue their iteration count so schedulers don't
+        # re-record rungs the trial already passed
+        self._session.iteration = start_iteration
+        try:
+            out = trainable(config)
+            return {"ok": True, "result": out}
+        except TrialStopped:
+            return {"ok": True, "stopped": True}
+        except Exception as e:  # noqa: BLE001
+            self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            return {"ok": False, "error": self._error}
+        finally:
+            self._done = True
+
+    def poll(self):
+        # read _done BEFORE draining: a report enqueued between the drain
+        # and the done-check would otherwise be lost on the final poll
+        done = self._done
+        out = []
+        if self._session is not None:
+            while not self._session.outbox.empty():
+                metrics, ckpt = self._session.outbox.get_nowait()
+                out.append((metrics, ckpt.path if ckpt else None))
+        return {"reports": out, "done": done, "error": self._error}
+
+    def request_stop(self):
+        if self._session is not None:
+            self._session.stop_requested = True
+        return True
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: dict
+    status: str = PENDING
+    metrics: dict = field(default_factory=dict)
+    history: list = field(default_factory=list)
+    checkpoint_path: str | None = None
+    error: str | None = None
+    failures: int = 0
+    actor: Any = None
+    run_ref: Any = None
+    pg: Any = None
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, variants: list[dict], *,
+                 scheduler=None, metric: str | None = None, mode: str = "max",
+                 max_concurrent_trials: int | None = None,
+                 resources_per_trial: dict | None = None,
+                 storage_path: str, max_failures_per_trial: int = 0):
+        self.trainable = trainable
+        self.scheduler = scheduler or sched_mod.FIFOScheduler()
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent_trials or 4
+        self.resources = dict(resources_per_trial or {"CPU": 1.0})
+        self.storage_path = storage_path
+        self.max_failures = max_failures_per_trial
+        self.trials = [
+            Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", config=cfg)
+            for i, cfg in enumerate(variants)
+        ]
+        os.makedirs(storage_path, exist_ok=True)
+
+    # -------------------------------------------------------------- run loop
+    def run(self) -> list[Trial]:
+        """Event loop (ref: tune_controller.py step :666)."""
+        while True:
+            self._start_pending()
+            running = [t for t in self.trials if t.status == RUNNING]
+            if not running:
+                if all(t.status in (TERMINATED, STOPPED, ERRORED) for t in self.trials):
+                    break
+                time.sleep(0.02)
+                continue
+            self._poll_running(running)
+            time.sleep(0.02)
+        self._write_experiment_state()
+        return self.trials
+
+    def _start_pending(self):
+        running = sum(1 for t in self.trials if t.status == RUNNING)
+        for trial in self.trials:
+            if running >= self.max_concurrent:
+                break
+            if trial.status != PENDING:
+                continue
+            try:
+                self._launch(trial)
+                running += 1
+            except Exception as e:  # cluster can't host it right now
+                trial.error = str(e)
+                trial.status = ERRORED
+
+    def _launch(self, trial: Trial):
+        # PG-per-trial so multi-resource trials get gang placement
+        # (ref: tune/execution/placement_groups.py)
+        trial.pg = ray_tpu.placement_group([dict(self.resources)], strategy="PACK")
+        if not trial.pg.ready(timeout=60):
+            raise RuntimeError(
+                f"trial {trial.trial_id}: placement group {self.resources} "
+                "not placeable on this cluster"
+            )
+        cpus = self.resources.get("CPU", 1.0)
+        other = {k: v for k, v in self.resources.items() if k != "CPU"}
+        trial.actor = (
+            ray_tpu.remote(TrialActor)
+            .options(
+                num_cpus=cpus,
+                resources=other,
+                placement_group=trial.pg,
+                placement_group_bundle_index=0,
+                max_concurrency=2,  # poll() while run() occupies the executor
+            )
+            .remote(trial.trial_id, self.storage_path)
+        )
+        trial.run_ref = trial.actor.run.remote(
+            self.trainable, trial.config, trial.checkpoint_path, len(trial.history)
+        )
+        trial.status = RUNNING
+
+    def _poll_running(self, running: list[Trial]):
+        polls = []
+        for t in running:
+            try:
+                polls.append(ray_tpu.get(t.actor.poll.remote(), timeout=30))
+            except Exception:
+                polls.append(None)  # actor died
+        for trial, poll in zip(running, polls):
+            if poll is None:
+                self._on_trial_failed(trial, "trial actor died")
+                continue
+            for metrics, ckpt_path in poll["reports"]:
+                trial.metrics = metrics
+                trial.history.append(metrics)
+                if ckpt_path:
+                    trial.checkpoint_path = ckpt_path
+                decision = self.scheduler.on_result(trial.trial_id, metrics)
+                if decision == STOP:
+                    self._stop_trial(trial)
+                    break
+            if trial.status == RUNNING and poll["done"]:
+                self._finish_trial(trial, poll)
+
+    def _finish_trial(self, trial: Trial, poll: dict):
+        try:
+            r = ray_tpu.get(trial.run_ref, timeout=30)
+        except Exception as e:
+            self._on_trial_failed(trial, str(e))
+            return
+        if not r.get("ok"):
+            self._on_trial_failed(trial, r.get("error", "unknown"))
+            return
+        trial.status = STOPPED if r.get("stopped") else TERMINATED
+        self.scheduler.on_trial_complete(trial.trial_id, trial.metrics or None)
+        self._teardown(trial)
+
+    def _stop_trial(self, trial: Trial):
+        """Scheduler early-stop: ask the trainable to raise at next report."""
+        try:
+            ray_tpu.get(trial.actor.request_stop.remote(), timeout=10)
+        except Exception:
+            pass
+        trial.status = STOPPED
+        self.scheduler.on_trial_complete(trial.trial_id, trial.metrics or None)
+        self._teardown(trial)
+
+    def _on_trial_failed(self, trial: Trial, error: str):
+        trial.failures += 1
+        self._teardown(trial)
+        if trial.failures <= self.max_failures:
+            trial.status = PENDING  # retry (resumes from its last checkpoint)
+        else:
+            trial.status = ERRORED
+            trial.error = error
+            self.scheduler.on_trial_complete(trial.trial_id, None)
+
+    def _teardown(self, trial: Trial):
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        if trial.pg is not None:
+            try:
+                ray_tpu.remove_placement_group(trial.pg)
+            except Exception:
+                pass
+            trial.pg = None
+
+    # ------------------------------------------------------------ experiment
+    def _write_experiment_state(self):
+        """Persist trial table for post-hoc analysis / resumability
+        (ref: tune/execution/experiment_state.py)."""
+        state = [
+            {
+                "trial_id": t.trial_id,
+                "config": _jsonable(t.config),
+                "status": t.status,
+                "metrics": _jsonable(t.metrics),
+                "checkpoint_path": t.checkpoint_path,
+                "error": t.error,
+            }
+            for t in self.trials
+        ]
+        with open(os.path.join(self.storage_path, "experiment_state.json"), "w") as f:
+            json.dump(state, f, indent=2, default=str)
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)

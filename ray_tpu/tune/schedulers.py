@@ -1,0 +1,127 @@
+"""Trial schedulers: early stopping of unpromising trials.
+
+TPU-native equivalents of the reference schedulers (ref:
+python/ray/tune/schedulers/async_hyperband.py AsyncHyperBandScheduler —
+the ASHA algorithm, median_stopping_rule.py, trial_scheduler.py
+FIFOScheduler). Decisions are made on each reported result:
+CONTINUE or STOP.
+"""
+from __future__ import annotations
+
+import collections
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """No early stopping (ref: trial_scheduler.py FIFOScheduler)."""
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        pass
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving (ref: async_hyperband.py:19 — the
+    ASHA paper's algorithm): rungs at grace_period * reduction_factor^k;
+    a trial reaching a rung continues only if its metric is in the top
+    1/reduction_factor of results recorded at that rung."""
+
+    def __init__(self, metric: str, mode: str = "max", time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4, max_t: int = 100):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung milestone -> list of recorded metric values
+        self.rungs: dict[int, list[float]] = collections.defaultdict(list)
+        # rung milestone -> trial_ids already recorded there (trials report
+        # at arbitrary strides; each crosses a rung at most once)
+        self._recorded: dict[int, set[str]] = collections.defaultdict(set)
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self.milestones = milestones
+
+    def _val(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return CONTINUE
+        t = int(result[self.time_attr])
+        v = self._val(result)
+        decision = CONTINUE
+        # evaluate every rung the trial has crossed (t >= milestone, not
+        # equality — trials may report in strides; matches the reference's
+        # largest-milestone-<=-t behavior)
+        for milestone in self.milestones:
+            if t >= milestone and trial_id not in self._recorded[milestone]:
+                self._recorded[milestone].add(trial_id)
+                recorded = self.rungs[milestone]
+                recorded.append(v)
+                # continue only in the top 1/rf at this rung: cutoff is the
+                # (1 - 1/rf) percentile of recorded values (matches the
+                # reference _Bracket.cutoff, async_hyperband.py)
+                import numpy as np
+
+                cutoff = float(np.nanpercentile(recorded, (1 - 1 / self.rf) * 100))
+                if v < cutoff:
+                    decision = STOP
+        return decision
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        pass
+
+
+class MedianStoppingRule:
+    """Stop a trial whose running-average metric falls below the median of
+    other trials' averages at the same step (ref:
+    median_stopping_rule.py:18)."""
+
+    def __init__(self, metric: str, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self._history: dict[str, list[float]] = collections.defaultdict(list)
+
+    def _val(self, result: dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_result(self, trial_id: str, result: dict) -> str:
+        if self.metric not in result or self.time_attr not in result:
+            return CONTINUE
+        t = int(result[self.time_attr])
+        self._history[trial_id].append(self._val(result))
+        if t < self.grace_period:
+            return CONTINUE
+        others = [
+            sum(h) / len(h)
+            for tid, h in self._history.items()
+            if tid != trial_id and h
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        import statistics
+
+        mine = self._history[trial_id]
+        my_avg = sum(mine) / len(mine)
+        return STOP if my_avg < statistics.median(others) else CONTINUE
+
+    def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
+        self._history.pop(trial_id, None)

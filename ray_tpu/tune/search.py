@@ -1,0 +1,108 @@
+"""Search-space primitives + basic variant generation.
+
+TPU-native equivalent of the reference search surface (ref:
+python/ray/tune/search/sample.py uniform/loguniform/choice/randint,
+search/basic_variant.py BasicVariantGenerator, search/grid_search).
+Grid dimensions expand to a cross-product; sampling dimensions draw
+num_samples independent variants — matching the reference's semantics
+where num_samples multiplies the grid.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class _Sampler:
+    fn: Callable[[random.Random], Any]
+    repr_name: str
+
+    def sample(self, rng: random.Random):
+        return self.fn(rng)
+
+    def __repr__(self):
+        return self.repr_name
+
+
+def uniform(low: float, high: float) -> _Sampler:
+    return _Sampler(lambda rng: rng.uniform(low, high), f"uniform({low}, {high})")
+
+
+def loguniform(low: float, high: float) -> _Sampler:
+    import math
+
+    lo, hi = math.log(low), math.log(high)
+    return _Sampler(lambda rng: math.exp(rng.uniform(lo, hi)), f"loguniform({low}, {high})")
+
+
+def randint(low: int, high: int) -> _Sampler:
+    return _Sampler(lambda rng: rng.randrange(low, high), f"randint({low}, {high})")
+
+
+def choice(options: list) -> _Sampler:
+    opts = list(options)
+    return _Sampler(lambda rng: rng.choice(opts), f"choice({opts})")
+
+
+def quniform(low: float, high: float, q: float) -> _Sampler:
+    return _Sampler(
+        lambda rng: round(rng.uniform(low, high) / q) * q, f"quniform({low}, {high}, {q})"
+    )
+
+
+class grid_search(dict):
+    """Marker: expand this dimension as a grid (ref: tune grid_search)."""
+
+    def __init__(self, values: list):
+        super().__init__(grid_search=list(values))
+
+    @property
+    def values(self):
+        return self["grid_search"]
+
+
+def generate_variants(param_space: dict, num_samples: int = 1,
+                      seed: int | None = None) -> list[dict]:
+    """Expand a param space into concrete trial configs
+    (ref: basic_variant.py BasicVariantGenerator)."""
+    rng = random.Random(seed)
+    grid_keys: list[tuple[tuple, list]] = []
+    _collect_grids(param_space, (), grid_keys)
+    grid_axes = [vals for _, vals in grid_keys]
+    combos = list(itertools.product(*grid_axes)) if grid_axes else [()]
+    variants = []
+    for _ in range(num_samples):
+        for combo in combos:
+            cfg = _materialize(param_space, rng)
+            for (path, _), value in zip(grid_keys, combo):
+                _set_path(cfg, path, value)
+            variants.append(cfg)
+    return variants
+
+
+def _collect_grids(node, path, out):
+    if isinstance(node, grid_search):
+        out.append((path, node.values))
+    elif isinstance(node, dict):
+        for k, v in node.items():
+            _collect_grids(v, path + (k,), out)
+
+
+def _materialize(node, rng):
+    if isinstance(node, grid_search):
+        return None  # placeholder; overwritten by _set_path
+    if isinstance(node, _Sampler):
+        return node.sample(rng)
+    if isinstance(node, dict):
+        return {k: _materialize(v, rng) for k, v in node.items()}
+    return node
+
+
+def _set_path(cfg: dict, path: tuple, value):
+    node = cfg
+    for k in path[:-1]:
+        node = node[k]
+    node[path[-1]] = value

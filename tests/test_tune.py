@@ -1,0 +1,174 @@
+"""Tune tests: variant generation, trial execution, ASHA early stopping,
+checkpoint/retry, Tune-over-Train (ref test strategy:
+python/ray/tune/tests/test_tune_controller.py, test_trial_scheduler.py)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP, ASHAScheduler
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=32)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- search space
+def test_generate_variants_grid_and_samples():
+    space = {
+        "lr": tune.grid_search([0.1, 0.2]),
+        "wd": tune.uniform(0.0, 1.0),
+        "net": {"depth": tune.grid_search([2, 4])},
+    }
+    variants = tune.generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 12  # 2 x 2 grid x 3 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.2}
+    assert {v["net"]["depth"] for v in variants} == {2, 4}
+    assert all(0.0 <= v["wd"] <= 1.0 for v in variants)
+    # deterministic under a seed
+    assert variants == tune.generate_variants(space, num_samples=3, seed=0)
+
+
+def test_sampler_primitives():
+    import random
+
+    rng = random.Random(0)
+    assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+    assert tune.randint(0, 5).sample(rng) in range(5)
+    assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+    assert tune.quniform(0, 1, 0.25).sample(rng) in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+# ---------------------------------------------------------------- schedulers
+def test_asha_stops_losers():
+    asha = ASHAScheduler(metric="acc", mode="max", grace_period=1,
+                         reduction_factor=2, max_t=8)
+    # rung t=1: continue only in the top 1/rf (the reference's percentile
+    # cutoff: with one recorded value a trial always continues)
+    assert asha.on_result("t0", {"training_iteration": 1, "acc": 0.9}) == CONTINUE
+    # 0.8 is below the median of {0.9, 0.8} -> stopped
+    assert asha.on_result("t1", {"training_iteration": 1, "acc": 0.8}) == STOP
+    assert asha.on_result("t2", {"training_iteration": 1, "acc": 0.1}) == STOP
+    # a new best always continues
+    assert asha.on_result("t3", {"training_iteration": 1, "acc": 0.95}) == CONTINUE
+
+
+# ------------------------------------------------------------ e2e execution
+def test_tuner_grid_fit(rt):
+    def trainable(config):
+        for step in range(3):
+            tune.report({"score": config["x"] * 10 + step})
+        return "ok"
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=3),
+    )
+    results = tuner.fit()
+    assert len(results) == 3
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 32  # x=3, last step=2
+
+
+def test_tuner_asha_early_stops(rt):
+    """Weak trials get early-stopped at rung boundaries, strong ones finish
+    (ref: ASHA semantics in async_hyperband.py)."""
+
+    def trainable(config):
+        import time as _t
+
+        # strong configs are also faster — they reach rungs first and set
+        # the cutoff, the canonical async-ASHA early-stop scenario
+        delay = 0.05 if config["quality"] > 0.5 else 0.3
+        for step in range(8):
+            _t.sleep(delay)
+            tune.report({"acc": config["quality"] + step * 0.001})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([0.1, 0.2, 0.9, 0.95])},
+        tune_config=tune.TuneConfig(
+            metric="acc",
+            mode="max",
+            max_concurrent_trials=4,
+            scheduler=ASHAScheduler(metric="acc", mode="max", grace_period=2,
+                                    reduction_factor=2, max_t=8),
+        ),
+    )
+    results = tuner.fit()
+    assert not results.errors
+    statuses = {r.config["quality"]: r.status for r in results}
+    assert statuses[0.95] == "TERMINATED"
+    # at least one weak trial must have been early-stopped
+    assert any(s == "STOPPED" for q, s in statuses.items() if q < 0.5), statuses
+    assert results.get_best_result().config["quality"] == 0.95
+
+
+def test_tuner_checkpoint_and_retry(rt, tmp_path):
+    """A crashing trial retries and resumes from its last checkpoint
+    (ref: tune trial fault tolerance + restore path)."""
+    marker = str(tmp_path / "crashed")
+
+    def trainable(config):
+        import os
+
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        start = 0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            start = ckpt.to_dict()["step"] + 1
+        for step in range(start, 6):
+            if step == 3 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                os._exit(1)
+            tune.report(
+                {"step": step}, checkpoint=Checkpoint.from_dict({"step": step})
+            )
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"marker": marker},
+        tune_config=tune.TuneConfig(metric="step", mode="max",
+                                    max_failures_per_trial=1),
+    )
+    results = tuner.fit()
+    assert not results.errors
+    assert os.path.exists(marker)
+    assert results[0].metrics["step"] == 5
+
+
+def test_tune_over_train(rt, tmp_path):
+    """Tuner(JaxTrainer): each trial runs a full (1-worker) training job
+    (ref: BaseTrainer-as-Trainable, base_trainer.py:808)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def train_loop(config):
+        from ray_tpu import train
+
+        train.report({"loss": 100.0 / config["lr"]})
+
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"lr": 1.0},
+        scaling_config=ScalingConfig(num_workers=1, collective_backend="cpu"),
+        run_config=RunConfig(storage_path=str(tmp_path / "t")),
+    )
+    tuner = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {"lr": tune.grid_search([1.0, 10.0])}},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=1),
+    )
+    results = tuner.fit()
+    assert not results.errors
+    assert results.get_best_result().config["train_loop_config"]["lr"] == 10.0
