@@ -42,6 +42,7 @@ from ray_tpu.core.ref import (
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,
+    ObjectRefGenerator,
     TaskError,
     WorkerCrashedError,
 )
@@ -59,6 +60,17 @@ class _MemEntry:
     error: Exception | None = None
     ready: asyncio.Event = field(default_factory=asyncio.Event)
     in_shm: bool = False  # large result living in some node's shm store
+
+
+@dataclass
+class _GenState:
+    """Owner-side state for one streaming task (ref: task_manager.cc
+    ObjectRefStream): items arrive via rpc_generator_item pushes."""
+
+    items: list = field(default_factory=list)
+    event: asyncio.Event = field(default_factory=asyncio.Event)
+    done: bool = False
+    error: Exception | None = None
 
 
 @dataclass
@@ -111,6 +123,7 @@ class CoreClient:
         self._conn_seq: dict[rpc.Connection, int] = {}
         self._subscribed_actors: set[ActorID] = set()
         self._task_counter = 0
+        self._gen_states: dict[TaskID, _GenState] = {}
         self._closed = False
         self._bg = aio.TaskGroup()
 
@@ -372,6 +385,10 @@ class CoreClient:
             "bundle_index": bundle_index,
             "scheduling_node": scheduling_node,
         }
+        if num_returns == "streaming":
+            self._gen_states[task_id] = _GenState()
+            self._call_on_loop(self._submit_async(spec))
+            return ObjectRefGenerator(task_id, self)
         refs = []
         for i in range(num_returns):
             roid = ObjectID.for_task_return(task_id, i)
@@ -535,6 +552,13 @@ class CoreClient:
     def _complete_task_error(self, spec, error):
         if not isinstance(error, Exception):
             error = TaskError(str(error))
+        if spec["num_returns"] == "streaming":
+            state = self._gen_states.get(spec["task_id"])
+            if state is not None and not state.done:
+                state.error = error
+                state.done = True
+                state.event.set()
+            return
         for i in range(spec["num_returns"]):
             oid = ObjectID.for_task_return(spec["task_id"], i)
             entry = self.memory_store.get(oid)
@@ -542,10 +566,77 @@ class CoreClient:
                 entry.error = error
                 entry.ready.set()
 
+    # -------------------------------------------------- streaming generators
+    async def rpc_generator_item(self, conn, p):
+        """Executor reports one yielded item (ref: core_worker.proto:498
+        ReportGeneratorItemReturns); the awaited ack is the backpressure
+        (generator_waiter.h role: producer can't run far ahead)."""
+        task_id = p["task_id"]
+        state = self._gen_states.get(task_id)
+        if state is None:
+            return {"ok": False, "cancelled": True}  # consumer gone: stop
+        if p.get("item") is not None:
+            item = p["item"]
+            oid = ObjectID.for_task_return(task_id, p["index"])
+            entry = _MemEntry()
+            if item.get("inline") is not None:
+                entry.packed = item["inline"]
+            else:
+                entry.in_shm = True
+            entry.ready.set()
+            self.memory_store[oid] = entry
+            state.items.append(ObjectRef(oid, self.address, _core=self))
+        if p.get("done"):
+            state.done = True
+            if p.get("error") is not None:
+                state.error = p["error"]
+        state.event.set()
+        return {"ok": True}
+
+    async def gen_next(self, task_id: TaskID, timeout: float | None = None):
+        """Next item ref, or None when the stream ends (async side)."""
+        state = self._gen_states.get(task_id)
+        if state is None:
+            return None
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            if state.items:
+                return state.items.pop(0)
+            if state.error is not None:
+                err = state.error
+                raise err if isinstance(err, Exception) else TaskError(str(err))
+            if state.done:
+                return None
+            state.event.clear()
+            try:
+                remain = (deadline - time.monotonic()) if deadline else None
+                if remain is not None and remain <= 0:
+                    raise GetTimeoutError(f"generator {task_id} timed out")
+                await asyncio.wait_for(state.event.wait(), remain)
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"generator {task_id} timed out") from None
+
+    def gen_next_sync(self, task_id: TaskID, timeout: float | None = None):
+        return self._run_sync(self.gen_next(task_id, timeout))
+
+    def gen_completed(self, task_id: TaskID) -> bool:
+        state = self._gen_states.get(task_id)
+        return state is None or (state.done and not state.items)
+
+    def gen_release(self, task_id: TaskID):
+        self._gen_states.pop(task_id, None)
+
     async def _on_worker_lost(self, key, state, w, spec):
-        """Retry on worker death (ref: task_manager.h retries)."""
+        """Retry on worker death (ref: task_manager.h retries). Streaming
+        tasks don't replay: already-consumed items can't be un-delivered,
+        so the stream fails fast instead."""
         if w in state.workers:
             state.workers.remove(w)
+        if spec["num_returns"] == "streaming":
+            self._complete_task_error(spec, WorkerCrashedError())
+            state.inflight_tasks -= 1
+            await self._pump(key, state)
+            return
         spec["max_retries"] = spec.get("max_retries", 0) - 1
         if spec["max_retries"] >= 0:
             await state.pending.put(spec)
@@ -652,11 +743,15 @@ class CoreClient:
         replies)."""
         task_id = TaskID.generate()
         actor_id = handle.actor_id
+        streaming = num_returns == "streaming"
         refs = []
-        for i in range(num_returns):
-            roid = ObjectID.for_task_return(task_id, i)
-            self.memory_store[roid] = _MemEntry()
-            refs.append(ObjectRef(roid, self.address, _core=self))
+        if streaming:
+            self._gen_states[task_id] = _GenState()
+        else:
+            for i in range(num_returns):
+                roid = ObjectID.for_task_return(task_id, i)
+                self.memory_store[roid] = _MemEntry()
+                refs.append(ObjectRef(roid, self.address, _core=self))
         spec = {
             "task_id": task_id,
             "actor_id": actor_id,
@@ -670,6 +765,8 @@ class CoreClient:
         q = self._actor_queues.setdefault(actor_id, [])
         q.append(spec)
         self._call_on_loop(self._ensure_actor_pump(actor_id))
+        if streaming:
+            return ObjectRefGenerator(task_id, self)
         return refs[0] if num_returns == 1 else refs
 
     async def _ensure_actor_pump(self, actor_id: ActorID):
@@ -707,6 +804,14 @@ class CoreClient:
             if self._actor_conns.get(spec["actor_id"]) is conn:
                 self._actor_conns.pop(spec["actor_id"], None)
             self._conn_seq.pop(conn, None)
+            if spec["num_returns"] == "streaming":
+                # never replay a generator: already-consumed items would
+                # duplicate into the live stream (same policy as
+                # _on_worker_lost for streaming tasks)
+                self._complete_task_error(
+                    spec, ActorError("actor connection lost mid-stream")
+                )
+                return
             info = await self._refresh_actor(spec["actor_id"])
             if info and info.get("state") in (ALIVE, "RESTARTING", "PENDING_CREATION"):
                 spec["seq"] = None  # ordering lost across reconnect: send unordered

@@ -105,6 +105,49 @@ class ObjectRef:
         return _get().__await__()
 
 
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs a streaming task yields one by one
+    (ref: python/ray/_raylet.pyx:282 ObjectRefGenerator; items are reported
+    back to the owner as they are produced, core_worker.proto:498
+    ReportGeneratorItemReturns). Works as a sync iterator on driver
+    threads and an async iterator inside async actors."""
+
+    def __init__(self, task_id: TaskID, core):
+        self._task_id = task_id
+        self._core = core
+
+    # ------------------------------------------------------------------ sync
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        ref = self._core.gen_next_sync(self._task_id)
+        if ref is None:
+            raise StopIteration
+        return ref
+
+    # ----------------------------------------------------------------- async
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        ref = await self._core.gen_next(self._task_id)
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    def completed(self) -> bool:
+        return self._core.gen_completed(self._task_id)
+
+    def __del__(self):
+        core = self._core
+        if core is not None:
+            try:
+                core.gen_release(self._task_id)
+            except Exception:
+                pass
+
+
 class ActorHandle:
     """Typed proxy for remote actor method calls; see core_client.submit_actor_task."""
 

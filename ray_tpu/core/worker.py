@@ -128,6 +128,12 @@ class Worker:
                     f"task declared num_returns={num_returns} but returned {len(values)}"
                 )
         results = []
+        for v in values:
+            if inspect.isgenerator(v) or inspect.isasyncgen(v):
+                raise TaskError(
+                    "task returned a generator; declare it with "
+                    "num_returns='streaming' to stream its items"
+                )
         for i, v in enumerate(values):
             oid = ObjectID.for_task_return(task_id, i)
             meta, buffers = serialization.dumps_with_buffers(v)
@@ -157,15 +163,151 @@ class Worker:
             fn = await self._load_function(spec["func_id"])
             args = await self._fetch_args(spec["args"])
             kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
+            if spec["num_returns"] == "streaming":
+                return await self._execute_streaming(spec, fn, args, kwargs)
             loop = asyncio.get_running_loop()
             if inspect.iscoroutinefunction(fn):
                 value = await fn(*args, **kwargs)
             else:
                 value = await loop.run_in_executor(self.executor, lambda: fn(*args, **kwargs))
+                if inspect.isgenerator(value):
+                    # legacy generator semantics (ref: old num_returns=N
+                    # generators): materialize; N>1 distributes the items
+                    value = await loop.run_in_executor(self.executor, list, value)
+                    if spec["num_returns"] == 1:
+                        pass  # a single list return
+                    else:
+                        value = tuple(value)
             results = await self._store_results(spec["task_id"], spec["num_returns"], value)
             return {"results": results}
         except Exception as e:
             return {"error": _as_task_error(e)}
+
+    async def _execute_streaming(self, spec, fn, args, kwargs):
+        """Run a (sync or async) generator, reporting each item to the
+        owner as it is produced (ref: _raylet.pyx:1363
+        execute_streaming_generator_sync/async; item report RPC
+        core_worker.proto:498).
+
+        A sync generator occupies ONE executor job for its entire run (a
+        driver thread iterating it), preserving the one-method-at-a-time
+        actor invariant — other method calls cannot interleave between
+        yields on a max_concurrency=1 actor. Backpressure: the driver
+        thread blocks on a small semaphore window that the sender releases
+        per owner ack (the generator_waiter.h role)."""
+        task_id = spec["task_id"]
+        owner = await rpc.connect(*spec["owner_address"], timeout=10)
+        loop = asyncio.get_running_loop()
+        index = 0
+        try:
+            gen = fn(*args, **kwargs)
+            if inspect.isasyncgen(gen):
+                async def items():
+                    async for v in gen:
+                        yield v
+
+                item_iter = items()
+                release = lambda: None  # noqa: E731  (async gen self-paces)
+            elif inspect.isgenerator(gen):
+                import threading
+
+                window = threading.Semaphore(2)
+                out_q: asyncio.Queue = asyncio.Queue()
+                ctl = {"stop": False}
+
+                def drive():
+                    try:
+                        for v in gen:
+                            window.acquire()
+                            if ctl["stop"]:
+                                gen.close()  # runs GeneratorExit on THIS thread
+                                break
+                            loop.call_soon_threadsafe(out_q.put_nowait, ("item", v))
+                        loop.call_soon_threadsafe(out_q.put_nowait, ("end", None))
+                    except BaseException as e:  # noqa: BLE001
+                        loop.call_soon_threadsafe(out_q.put_nowait, ("error", e))
+
+                driver = loop.run_in_executor(self.executor, drive)
+
+                async def items():
+                    while True:
+                        kind, v = await out_q.get()
+                        if kind == "item":
+                            yield v
+                        elif kind == "error":
+                            raise v
+                        else:
+                            await driver
+                            return
+
+                async def cancel():
+                    ctl["stop"] = True
+                    window.release()
+                    await driver
+
+                item_iter = items()
+                release = window.release
+            else:
+                raise TaskError(
+                    "num_returns='streaming' requires a generator function"
+                )
+            if inspect.isasyncgen(gen):
+                async def cancel():  # noqa: F811
+                    try:
+                        await gen.aclose()
+                    except Exception:
+                        pass
+            async for value in item_iter:
+                item = await self._pack_item(task_id, index, value)
+                reply = await owner.call(
+                    "generator_item", {"task_id": task_id, "index": index, "item": item}
+                )
+                index += 1
+                release()
+                if not reply.get("ok"):
+                    await cancel()  # consumer dropped the generator
+                    break
+            await owner.call("generator_item", {"task_id": task_id, "done": True})
+            return {"results": [], "streaming": True, "count": index}
+        except Exception as e:
+            err = _as_task_error(e)
+            try:
+                await owner.call(
+                    "generator_item", {"task_id": task_id, "done": True, "error": err}
+                )
+            except Exception:
+                pass
+            return {"error": err}
+        finally:
+            await owner.close()
+
+    async def _pack_item(self, task_id, index: int, value) -> dict:
+        """Serialize one yielded item: small inline, large via shm +
+        location registration (same split as _store_results)."""
+        meta, buffers = serialization.dumps_with_buffers(value)
+        size = serialization.total_size(meta, buffers)
+        if size <= self.cfg.max_inline_object_size:
+            return {"inline": _pack_bytes(meta, buffers, size)}
+        oid = ObjectID.for_task_return(task_id, index)
+        await self._store_shm_object(oid, meta, buffers)
+        return {"shm": True}
+
+    async def _store_shm_object(self, oid, meta, buffers):
+        """Seal a large value into local shm and register this node as a
+        holder in the GCS object directory (shared by task returns and
+        streamed items)."""
+        size = serialization.total_size(meta, buffers)
+        buf = self.core.store.create(oid, size)
+        serialization.pack_into(meta, buffers, buf)
+        self.core.store.seal(oid)
+        import pickle
+
+        holders_blob = await self.core.gcs.call("kv_get", {"ns": "obj_loc", "key": oid.hex()})
+        holders = pickle.loads(holders_blob) if holders_blob else set()
+        holders.add(self.node_id.binary())
+        await self.core.gcs.call(
+            "kv_put", {"ns": "obj_loc", "key": oid.hex(), "value": pickle.dumps(holders)}
+        )
 
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, conn, p):
@@ -204,11 +346,16 @@ class Worker:
                 ev = gate["events"].setdefault(seq, asyncio.Event())
                 await ev.wait()
         work = None
+        streaming = spec.get("num_returns") == "streaming"
         try:
             method = getattr(self.actor_instance, spec["method"])
             args = await self._fetch_args(spec["args"])
             kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
-            if inspect.iscoroutinefunction(method):
+            if streaming:
+                work = asyncio.get_running_loop().create_task(
+                    self._execute_streaming(spec, method, args, kwargs)
+                )
+            elif inspect.iscoroutinefunction(method):
                 work = asyncio.get_running_loop().create_task(method(*args, **kwargs))
             else:
                 loop = asyncio.get_running_loop()
@@ -223,6 +370,8 @@ class Worker:
                     ev.set()
         try:
             value = await work
+            if streaming:
+                return value  # _execute_streaming builds the full reply
             results = await self._store_results(spec["task_id"], spec["num_returns"], value)
             return {"results": results}
         except Exception as e:

@@ -91,13 +91,16 @@ class TrainWorker:
             self._done = True
 
     def poll(self):
-        """Drain report() outbox (ref: controller _poll_workers :249)."""
+        """Drain report() outbox (ref: controller _poll_workers :249).
+        _done is read BEFORE draining: a report enqueued between the drain
+        and the done-check would otherwise be lost on the final poll."""
+        done = self._done
         out = []
         if self._session is not None:
             while not self._session.outbox.empty():
                 metrics, ckpt = self._session.outbox.get_nowait()
                 out.append((metrics, ckpt.path if ckpt else None))
-        return {"reports": out, "done": self._done, "error": self._error}
+        return {"reports": out, "done": done, "error": self._error}
 
 
 class JaxTrainer:
