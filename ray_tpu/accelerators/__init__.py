@@ -1,0 +1,8 @@
+"""Accelerator managers (ref: python/ray/_private/accelerators/)."""
+from ray_tpu.accelerators.tpu import (  # noqa: F401
+    TPUAcceleratorManager,
+    get_num_tpu_visible_chips_per_host,
+    get_tpu_cores_per_chip,
+    pod_head_resource,
+    slice_placement_group,
+)

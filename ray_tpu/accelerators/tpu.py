@@ -1,0 +1,267 @@
+"""TPU topology as a first-class scheduling resource.
+
+TPU-native equivalent of the reference TPUAcceleratorManager (ref:
+python/ray/_private/accelerators/tpu.py:24-61 chip detection + env
+isolation, :232 set_current_process_visible_accelerator_ids, :236
+_get_current_node_tpu_pod_type, :416 get_current_node_additional_resources
+pod-head resources). Differences by design:
+
+- Detection is env-first (GKE-style TPU_* env vars and this image's
+  axon/pallas env) with /dev/accel* and /dev/vfio as fallbacks — no GCE
+  metadata-server dependency (zero-egress environments).
+- Topology is also exposed as node LABELS (tpu-pod-type / tpu-name /
+  tpu-worker-id) so label-aware placement can gang-schedule a slice, not
+  just count chips.
+
+Node resources produced for a v4-16 worker 0 host:
+    {"TPU": 4, "TPU-V4": 4, "my-tpu": 1, "TPU-v4-16-head": 1}
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+TPU_VALID_CHIP_OPTIONS = (1, 2, 4, 8)
+TPU_ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v4-16"
+TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+TPU_NAME_ENV = "TPU_NAME"
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+NOSET_TPU_VISIBLE_CHIPS_ENV = "RT_NOSET_TPU_VISIBLE_CHIPS"
+TPU_CHIPS_PER_HOST_BOUNDS_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"
+TPU_HOST_BOUNDS_ENV = "TPU_HOST_BOUNDS"
+_CHIPS_PER_HOST_BOUNDS_1 = "1,1,1"
+_CHIPS_PER_HOST_BOUNDS_2 = "1,2,1"
+_SINGLE_HOST_BOUNDS = "1,1,1"
+
+# v2/v3/v4/v5p: 4 chips/host, 2 cores/chip; v5e(=v5litepod)/v6e: 8 chips, 1 core
+_8_CHIP_TYPES = ("v5litepod", "v5e", "v6e")
+_1_CORE_TYPES = ("v5litepod", "v5e", "v6e")
+VALID_TPU_TYPES = ("v2", "v3", "v4", "v5p", "v5litepod", "v5e", "v6e")
+
+
+def _accelerator_type_check(accelerator_type: str) -> None:
+    # accept anything shaped v{generation}[variant]-{cores}: unknown future
+    # generations fall back to the 4-chip/2-core default rather than
+    # crashing node detection
+    if not re.match(r"^v\d+[a-zA-Z]*(-\d+)?$", accelerator_type):
+        raise ValueError(
+            f"Invalid accelerator type: {accelerator_type!r}; expected "
+            f"v<generation>-<cores>, e.g. one of {VALID_TPU_TYPES}"
+        )
+
+
+def get_num_tpu_visible_chips_per_host(accelerator_type: str) -> int:
+    _accelerator_type_check(accelerator_type)
+    return 8 if accelerator_type.startswith(_8_CHIP_TYPES) else 4
+
+
+def get_tpu_cores_per_chip(accelerator_type: str) -> int:
+    _accelerator_type_check(accelerator_type)
+    return 1 if accelerator_type.startswith(_1_CORE_TYPES) else 2
+
+
+class TPUAcceleratorManager:
+    """Static env/topology introspection (one instance per process)."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "TPU"
+
+    # ---------------------------------------------------------- detection
+    @staticmethod
+    def get_current_process_visible_accelerator_ids() -> list[str] | None:
+        visible = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if visible is None:
+            return None
+        if visible == "":
+            return []
+        return visible.split(",")
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        """Chips on this host: explicit env, axon/pallas tunnel, then
+        device files (ref: get_current_node_num_accelerators :137)."""
+        visible = TPUAcceleratorManager.get_current_process_visible_accelerator_ids()
+        if visible is not None:
+            return len(visible)
+        pod_type = os.environ.get(TPU_ACCELERATOR_TYPE_ENV)
+        if pod_type and TPUAcceleratorManager.is_valid_tpu_accelerator_type(pod_type):
+            # explicit topology env wins over the axon tunnel fallback
+            per_host = get_num_tpu_visible_chips_per_host(pod_type)
+            cores = int(pod_type.split("-")[1])
+            total_chips = cores // get_tpu_cores_per_chip(pod_type)
+            return min(per_host, total_chips)
+        if os.environ.get("PALLAS_AXON_TPU_GEN"):
+            return 1  # axon tunnel exposes a single chip
+        accel = glob.glob("/dev/accel*")
+        if accel:
+            return len(accel)
+        try:
+            return len([e for e in os.listdir("/dev/vfio") if e.isdigit()])
+        except FileNotFoundError:
+            return 0
+
+    @staticmethod
+    def is_valid_tpu_accelerator_type(tpu_accelerator_type: str) -> bool:
+        """v{generation}{variant}-{cores} shape check (ref: :158)."""
+        return re.match(r"^v\d+[a-zA-Z]*-\d+$", tpu_accelerator_type) is not None
+
+    @staticmethod
+    def get_current_node_tpu_pod_type() -> str | None:
+        """The slice topology string, e.g. 'v4-16' (ref: :236)."""
+        t = os.environ.get(TPU_ACCELERATOR_TYPE_ENV, "")
+        if not t and os.environ.get("PALLAS_AXON_TPU_GEN"):
+            # axon exposes generation only; a single tunneled chip is its own
+            # single-host "slice"
+            gen = os.environ["PALLAS_AXON_TPU_GEN"].lower().lstrip("v")
+            t = f"v{gen}-1"
+        if t and TPUAcceleratorManager.is_valid_tpu_accelerator_type(t):
+            return t
+        return None
+
+    @staticmethod
+    def get_current_node_tpu_name() -> str | None:
+        return os.environ.get(TPU_NAME_ENV) or None
+
+    @staticmethod
+    def get_current_node_tpu_worker_id() -> int | None:
+        w = os.environ.get(TPU_WORKER_ID_ENV)
+        try:
+            return int(w) if w is not None else None
+        except ValueError:
+            return None
+
+    @staticmethod
+    def get_num_workers_in_current_tpu_pod() -> int | None:
+        """Hosts in this slice (ref: :316): ceil(total_cores / cores_per_host)."""
+        pod_type = TPUAcceleratorManager.get_current_node_tpu_pod_type()
+        if not pod_type:
+            return None
+        return slice_shape(pod_type)[0]
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> str | None:
+        """Generation marker resource, e.g. 'TPU-V4' (ref: :330)."""
+        pod_type = TPUAcceleratorManager.get_current_node_tpu_pod_type()
+        if pod_type is None:
+            return None
+        return "TPU-" + pod_type.split("-")[0].upper()
+
+    # ---------------------------------------------------------- resources
+    @staticmethod
+    def get_current_node_tpu_resources() -> dict[str, float]:
+        """Full TPU resource dict for node registration: chip count,
+        generation marker, slice name, and the pod-head marker on worker 0
+        (ref: get_current_node_additional_resources :416)."""
+        n = TPUAcceleratorManager.get_current_node_num_accelerators()
+        if n <= 0:
+            return {}
+        resources: dict[str, float] = {"TPU": float(n)}
+        gen = TPUAcceleratorManager.get_current_node_accelerator_type()
+        if gen:
+            resources[gen] = float(n)
+        name = TPUAcceleratorManager.get_current_node_tpu_name()
+        worker_id = TPUAcceleratorManager.get_current_node_tpu_worker_id()
+        pod_type = TPUAcceleratorManager.get_current_node_tpu_pod_type()
+        if name and worker_id is not None and pod_type:
+            resources[name] = 1.0
+            if worker_id == 0:
+                resources[f"TPU-{pod_type}-head"] = 1.0
+        return resources
+
+    @staticmethod
+    def get_current_node_tpu_labels() -> dict[str, str]:
+        """Topology labels for label-aware slice placement."""
+        labels: dict[str, str] = {}
+        pod_type = TPUAcceleratorManager.get_current_node_tpu_pod_type()
+        if pod_type:
+            labels["tpu-pod-type"] = pod_type
+        name = TPUAcceleratorManager.get_current_node_tpu_name()
+        if name:
+            labels["tpu-name"] = name
+        worker_id = TPUAcceleratorManager.get_current_node_tpu_worker_id()
+        if worker_id is not None:
+            labels["tpu-worker-id"] = str(worker_id)
+        return labels
+
+    # ---------------------------------------------------------- isolation
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float) -> tuple[bool, str | None]:
+        if quantity not in TPU_VALID_CHIP_OPTIONS:
+            return (
+                False,
+                f"requested TPU={quantity}, but only chip configurations "
+                f"{TPU_VALID_CHIP_OPTIONS} map onto TPU hosts",
+            )
+        return True, None
+
+    @staticmethod
+    def set_current_process_visible_accelerator_ids(visible_chips: list[str]) -> None:
+        """Restrict this process to a chip subset via the env triplet the
+        XLA runtime reads at first init (ref: :195 — the documented
+        TPU_VISIBLE_CHIPS / *_BOUNDS combination; must run before jax
+        touches the backend)."""
+        if os.environ.get(NOSET_TPU_VISIBLE_CHIPS_ENV):
+            return
+        n = len(visible_chips)
+        if n == TPUAcceleratorManager.get_current_node_num_accelerators():
+            os.environ.pop(TPU_CHIPS_PER_HOST_BOUNDS_ENV, None)
+            os.environ.pop(TPU_HOST_BOUNDS_ENV, None)
+            return
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in visible_chips)
+        if n == 1:
+            os.environ[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = _CHIPS_PER_HOST_BOUNDS_1
+            os.environ[TPU_HOST_BOUNDS_ENV] = _SINGLE_HOST_BOUNDS
+        elif n == 2:
+            os.environ[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = _CHIPS_PER_HOST_BOUNDS_2
+            os.environ[TPU_HOST_BOUNDS_ENV] = _SINGLE_HOST_BOUNDS
+        elif n == 4:
+            # half of an 8-chip host (the documented jax chip-subset shape)
+            os.environ[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = "2,2,1"
+            os.environ[TPU_HOST_BOUNDS_ENV] = _SINGLE_HOST_BOUNDS
+        else:
+            # no published bounds config for this subset: clear stale values
+            # rather than leaving a previous lease's triplet behind
+            os.environ.pop(TPU_CHIPS_PER_HOST_BOUNDS_ENV, None)
+            os.environ.pop(TPU_HOST_BOUNDS_ENV, None)
+
+
+# ------------------------------------------------------------------ helpers
+def slice_shape(accelerator_type: str) -> tuple[int, int, str]:
+    """(num_hosts, chips_per_bundle_host, generation_marker) for a slice —
+    the one place the host math lives (ScalingConfig.topology,
+    slice_placement_group, and pod-worker counting all call this)."""
+    _accelerator_type_check(accelerator_type)
+    chips_per_host = get_num_tpu_visible_chips_per_host(accelerator_type)
+    cores_per_chip = get_tpu_cores_per_chip(accelerator_type)
+    cores_per_host = chips_per_host * cores_per_chip
+    num_cores = int(accelerator_type.split("-")[1])
+    num_hosts = max(1, (num_cores + cores_per_host - 1) // cores_per_host)
+    host_chips = max(1, min(chips_per_host, num_cores // cores_per_chip))
+    gen = "TPU-" + accelerator_type.split("-")[0].upper()
+    return num_hosts, host_chips, gen
+
+
+def slice_placement_group(accelerator_type: str, *, strategy: str = "STRICT_SPREAD"):
+    """Placement group spanning every host of one TPU slice: one bundle per
+    host, each requesting that host's full chip count plus the generation
+    marker (the TPU-first answer to 'STRICT_PACK = one contiguous slice').
+
+    Usage:
+        pg = slice_placement_group("v4-16")
+        # bundle i -> host i of the slice
+    """
+    import ray_tpu
+
+    num_hosts, host_chips, gen = slice_shape(accelerator_type)
+    bundles = [
+        {"TPU": float(host_chips), gen: float(host_chips)} for _ in range(num_hosts)
+    ]
+    return ray_tpu.placement_group(bundles, strategy=strategy)
+
+
+def pod_head_resource(accelerator_type: str) -> dict[str, float]:
+    """Resource dict targeting worker 0 of a slice, for launch-once pod
+    coordination tasks (ref: the TPU-{pod}-head pattern, tpu.py:404)."""
+    return {f"TPU-{accelerator_type}-head": 1.0}

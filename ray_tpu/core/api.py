@@ -69,24 +69,30 @@ def init(
 
     if address is None:
         res = dict(resources or {})
+        labels: dict[str, str] = {}
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)
         res.setdefault("CPU", float(os.cpu_count() or 1) * 4)
         if num_tpus is not None:
             res["TPU"] = float(num_tpus)
         else:
-            tpu_chips = _detect_tpu_chips()
-            if tpu_chips:
-                res["TPU"] = float(tpu_chips)
+            # full topology autodetection: chips + generation marker +
+            # slice name + pod-head resource + topology labels
+            # (ref: _private/accelerators/tpu.py:24-61)
+            from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+            for k, v in TPUAcceleratorManager.get_current_node_tpu_resources().items():
+                res.setdefault(k, v)
+            labels.update(TPUAcceleratorManager.get_current_node_tpu_labels())
         if _in_process:
             from ray_tpu.core.cluster import Cluster
 
             _owned_cluster = Cluster(io=_io)
-            _owned_cluster.add_node(resources=res)
+            _owned_cluster.add_node(resources=res, labels=labels)
             gcs_addr = _owned_cluster.gcs_address
             raylet_addr = _owned_cluster.raylets[0].server.address
         else:
-            gcs_addr, raylet_addr = _start_head_processes(res)
+            gcs_addr, raylet_addr = _start_head_processes(res, labels)
     else:
         host, port = address.rsplit(":", 1)
         gcs_addr = (host, int(port))
@@ -98,16 +104,9 @@ def init(
     atexit.register(shutdown)
 
 
-def _detect_tpu_chips() -> int:
-    """TPU autodetection (ref: _private/accelerators/tpu.py:24-61): here via
-    the libtpu/axon env rather than GCE metadata — count visible chips."""
-    if os.environ.get("TPU_SKIP_MDS_QUERY") or os.environ.get("PALLAS_AXON_TPU_GEN"):
-        chips = os.environ.get("TPU_VISIBLE_CHIPS")
-        return len(chips.split(",")) if chips else 1
-    return 0
 
 
-def _start_head_processes(resources) -> tuple[tuple[str, int], tuple[str, int]]:
+def _start_head_processes(resources, labels=None) -> tuple[tuple[str, int], tuple[str, int]]:
     cfg = get_config()
     tmp = tempfile.mkdtemp(prefix="rt_head_")
     addr_file = os.path.join(tmp, "gcs_addr")
@@ -137,6 +136,8 @@ def _start_head_processes(resources) -> tuple[tuple[str, int], tuple[str, int]]:
         cmd += ["--num-tpus", str(resources["TPU"])]
     if res_arg:
         cmd += ["--resources", res_arg]
+    if labels:
+        cmd += ["--labels", ",".join(f"{k}={v}" for k, v in labels.items())]
     raylet = subprocess.Popen(cmd, env=env)
     _head_procs.append(raylet)
     raylet_addr = _find_local_raylet(_io, gcs_addr)

@@ -82,6 +82,7 @@ class _LeasedWorker:
     conn: rpc.Connection | None = None
     busy: bool = False
     idle_since: float = field(default_factory=time.monotonic)
+    tpu_chips: list | None = None  # chip ids the lease granted
 
 
 @dataclass
@@ -501,6 +502,7 @@ class CoreClient:
                         address=tuple(reply["worker_address"]),
                         worker_id=reply["worker_id"],
                         raylet_address=tuple(raylet_addr),
+                        tpu_chips=reply.get("tpu_chips"),
                     )
                     w.conn = await rpc.connect(*w.address)
                     state.workers.append(w)
@@ -514,6 +516,8 @@ class CoreClient:
 
     async def _run_on_worker(self, key, state, w: _LeasedWorker, spec: dict):
         try:
+            if w.tpu_chips:
+                spec["tpu_chips"] = w.tpu_chips
             reply = await w.conn.call("push_task", {"spec": spec})
         except rpc.ConnectionLost:
             await self._on_worker_lost(key, state, w, spec)

@@ -275,7 +275,9 @@ class GcsServer:
             wconn = await rpc.connect(*worker_addr)
             try:
                 await wconn.call(
-                    "create_actor", {"spec": info.spec}, timeout=self.cfg.worker_start_timeout_s
+                    "create_actor",
+                    {"spec": info.spec, "tpu_chips": lease.get("tpu_chips")},
+                    timeout=self.cfg.worker_start_timeout_s,
                 )
             finally:
                 await wconn.close()

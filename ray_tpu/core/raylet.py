@@ -49,6 +49,7 @@ class Lease:
     worker: WorkerHandle
     pg_key: tuple | None = None  # (pg_id, bundle_index) if inside a bundle
     owner_conn: object = None  # requester's connection: leases die with it
+    tpu_chips: list | None = None  # chip ids granted to this lease
 
 
 class ResourceLedger:
@@ -135,6 +136,13 @@ class Raylet:
         self.gcs_address = gcs_address
         self.host = host
         self.labels = labels or {}
+        # per-chip TPU instance tracking (ref: the reference's per-slot
+        # resource_instance_set; chips are handed to leases by id so workers
+        # can isolate via TPU_VISIBLE_CHIPS)
+        self._tpu_chips_free: list[str] = [
+            str(i) for i in range(int((resources or {}).get("TPU", 0)))
+        ]
+        self._worker_chips: dict = {}  # worker_id -> list[str]
         self.session = session or f"s{os.getpid()}"
 
         if resources is None:
@@ -276,6 +284,14 @@ class Raylet:
         self.all_workers[worker_id] = w
         return w
 
+    async def rpc_get_lease_env(self, conn, p):
+        """Worker-side query for its accelerator assignment (applied as
+        TPU_VISIBLE_CHIPS before the first user code runs)."""
+        from ray_tpu.utils.ids import WorkerID as _WID
+
+        chips = self._worker_chips.get(_WID.from_hex(p["worker_id"]))
+        return {"tpu_chips": chips}
+
     async def rpc_worker_ready(self, conn, p):
         w = self.all_workers.get(WorkerID.from_hex(p["worker_id"]))
         if w is None:
@@ -338,6 +354,11 @@ class Raylet:
             raise
         lease_id = next(self._lease_ids)
         w.lease_id = lease_id
+        tpu_chips = None
+        n_tpu = int(resources.get("TPU", 0))
+        if n_tpu > 0 and self._tpu_chips_free:
+            tpu_chips = [self._tpu_chips_free.pop(0) for _ in range(min(n_tpu, len(self._tpu_chips_free)))]
+            self._worker_chips[w.worker_id] = tpu_chips
         if p.get("for_actor") is not None:
             w.actor_id = p["for_actor"]
         # A lease dies with its owner's connection only when the owner says
@@ -346,13 +367,14 @@ class Raylet:
         # that close right after the grant — reaping those would kill the
         # worker we just handed out.
         owner_conn = conn if p.get("owner_bound") else None
-        self.leases[lease_id] = Lease(lease_id, resources, w, pg_key, owner_conn)
+        self.leases[lease_id] = Lease(lease_id, resources, w, pg_key, owner_conn, tpu_chips)
         return {
             "granted": True,
             "lease_id": lease_id,
             "worker_address": w.address,
             "worker_id": w.worker_id.hex(),
             "node_id": self.node_id,
+            "tpu_chips": tpu_chips,
         }
 
     def _try_allocate(self, resources, pg_key) -> bool:
@@ -368,6 +390,31 @@ class Raylet:
 
     def _free_lease_resources(self, lease: Lease):
         self._free_resources(lease.resources, lease.pg_key)
+        if lease.tpu_chips:
+            self._worker_chips.pop(lease.worker.worker_id, None)
+            self._release_chips(lease.worker, list(lease.tpu_chips))
+
+    def _release_chips(self, w: WorkerHandle, chips: list):
+        """Chips return to the pool only after the worker process actually
+        exits — its XLA runtime holds the devices until then."""
+        if w.proc.poll() is not None:
+            self._tpu_chips_free.extend(chips)
+            self._grant_waiters()
+            return
+
+        async def wait_exit():
+            deadline = time.monotonic() + 5.0
+            while w.proc.poll() is None:
+                if time.monotonic() > deadline:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.05)
+            self._tpu_chips_free.extend(chips)
+            self._grant_waiters()
+
+        self._bg.spawn(wait_exit())
 
     def _grant_waiters(self):
         still: list = []
@@ -425,7 +472,9 @@ class Raylet:
         self._free_lease_resources(lease)
         w = lease.worker
         w.lease_id = None
-        if p.get("kill") or w.actor_id is not None:
+        if p.get("kill") or w.actor_id is not None or lease.tpu_chips:
+            # TPU workers are single-assignment: the XLA runtime pinned its
+            # chip set at first init, so recycling would leak the old chips
             w.proc.terminate()
             self.all_workers.pop(w.worker_id, None)
         elif w.proc.poll() is None:
@@ -525,17 +574,28 @@ def main():
     parser.add_argument("--num-cpus", type=float, default=float(os.cpu_count() or 1))
     parser.add_argument("--num-tpus", type=float, default=0.0)
     parser.add_argument("--resources", default="", help="k=v,k=v extra resources")
+    parser.add_argument("--labels", default="", help="k=v,k=v node labels")
     parser.add_argument("--store-capacity", type=int, default=0)
     parser.add_argument("--session", default="")
     args = parser.parse_args()
 
     host, port = args.gcs.rsplit(":", 1)
     resources = {"CPU": args.num_cpus}
+    labels: dict[str, str] = {}
     if args.num_tpus:
         resources["TPU"] = args.num_tpus
+    else:
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+        for k, v in TPUAcceleratorManager.get_current_node_tpu_resources().items():
+            resources.setdefault(k, v)
+        labels.update(TPUAcceleratorManager.get_current_node_tpu_labels())
     for kv in filter(None, args.resources.split(",")):
         k, v = kv.split("=")
         resources[k] = float(v)
+    for kv in filter(None, args.labels.split(",")):
+        k, v = kv.split("=")
+        labels[k] = v
 
     raylet_box: list[Raylet] = []
 
@@ -564,6 +624,7 @@ def main():
             (host, int(port)),
             resources=resources,
             store_capacity=args.store_capacity or None,
+            labels=labels,
             session=args.session,
         )
         raylet_box.append(raylet)

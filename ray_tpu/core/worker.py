@@ -87,6 +87,20 @@ class Worker:
                 os._exit(0)
 
     # ------------------------------------------------------------ execution
+    def _apply_accel_env(self, chips):
+        """Apply the lease's TPU chip assignment (TPU_VISIBLE_CHIPS +
+        bounds) before any user code can initialize jax (ref: worker-side
+        accelerator env setup, _private/worker.py set_visible_accelerator_ids
+        path). The assignment rides in on the first task/actor push — TPU
+        workers are single-assignment (the raylet terminates them at lease
+        return), so first-write wins."""
+        if not chips or getattr(self, "_accel_env_applied", False):
+            return
+        self._accel_env_applied = True
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+        TPUAcceleratorManager.set_current_process_visible_accelerator_ids(chips)
+
     async def _load_function(self, func_id: bytes):
         fn = self._func_cache.get(func_id)
         if fn is not None:
@@ -160,6 +174,7 @@ class Worker:
     async def rpc_push_task(self, conn, p):
         spec = p["spec"]
         try:
+            self._apply_accel_env(spec.get("tpu_chips"))
             fn = await self._load_function(spec["func_id"])
             args = await self._fetch_args(spec["args"])
             kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
@@ -312,6 +327,7 @@ class Worker:
     # --------------------------------------------------------------- actors
     async def rpc_create_actor(self, conn, p):
         spec = p["spec"]
+        self._apply_accel_env(p.get("tpu_chips"))
         cls = cloudpickle.loads(spec["class_blob"])
         args = await self._fetch_args(spec["args"])
         kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))

@@ -17,6 +17,26 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     #: per-worker collective backend: "xla" on TPU pods, "cpu" for tests
     collective_backend: str | None = None
+    #: TPU slice topology, e.g. "v4-16": one worker per slice host, each
+    #: taking the host's full chip count + generation marker, gang-placed
+    #: STRICT_SPREAD (the TPU-first 'one contiguous slice' request)
+    topology: str | None = None
+
+    def __post_init__(self):
+        if self.topology:
+            from ray_tpu.accelerators.tpu import TPUAcceleratorManager, slice_shape
+
+            if not TPUAcceleratorManager.is_valid_tpu_accelerator_type(self.topology):
+                raise ValueError(f"invalid TPU topology {self.topology!r}")
+            self.use_tpu = True
+            num_hosts, host_chips, gen = slice_shape(self.topology)
+            self.num_workers = num_hosts
+            if self.resources_per_worker is None:
+                self.resources_per_worker = {
+                    "TPU": float(host_chips),
+                    gen: float(host_chips),
+                }
+            self.placement_strategy = "STRICT_SPREAD"
 
     def worker_resources(self) -> dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -1,0 +1,171 @@
+"""TPU accelerator-manager tests with faked topology env
+(ref test strategy: python/ray/tests/accelerators/test_tpu.py)."""
+
+import pytest
+
+from ray_tpu.accelerators import tpu as tpu_mod
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager as Mgr
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    for var in (
+        "TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID", "TPU_NAME",
+        "TPU_VISIBLE_CHIPS", "TPU_CHIPS_PER_HOST_BOUNDS", "TPU_HOST_BOUNDS",
+        "PALLAS_AXON_TPU_GEN",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+def test_pod_type_and_generation(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    assert Mgr.get_current_node_tpu_pod_type() == "v4-16"
+    assert Mgr.get_current_node_accelerator_type() == "TPU-V4"
+    assert Mgr.get_num_workers_in_current_tpu_pod() == 2  # 16 cores / 8 per host
+
+
+def test_chips_per_host_by_generation():
+    assert tpu_mod.get_num_tpu_visible_chips_per_host("v4-8") == 4
+    assert tpu_mod.get_num_tpu_visible_chips_per_host("v5litepod-16") == 8
+    assert tpu_mod.get_tpu_cores_per_chip("v4-8") == 2
+    assert tpu_mod.get_tpu_cores_per_chip("v5litepod-16") == 1
+    with pytest.raises(ValueError):
+        tpu_mod.get_num_tpu_visible_chips_per_host("h100-8")
+
+
+def test_accelerator_type_validation():
+    assert Mgr.is_valid_tpu_accelerator_type("v4-16")
+    assert Mgr.is_valid_tpu_accelerator_type("v5litepod-256")
+    assert not Mgr.is_valid_tpu_accelerator_type("v4")
+    assert not Mgr.is_valid_tpu_accelerator_type("tpu-v4-16")
+    assert not Mgr.is_valid_tpu_accelerator_type("v4-16-x")
+
+
+def test_node_resources_worker0(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    monkeypatch.setenv("TPU_NAME", "my-tpu")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = Mgr.get_current_node_tpu_resources()
+    assert res == {
+        "TPU": 4.0,
+        "TPU-V4": 4.0,
+        "my-tpu": 1.0,
+        "TPU-v4-16-head": 1.0,
+    }
+    labels = Mgr.get_current_node_tpu_labels()
+    assert labels == {
+        "tpu-pod-type": "v4-16",
+        "tpu-name": "my-tpu",
+        "tpu-worker-id": "0",
+    }
+
+
+def test_node_resources_worker1_no_head(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    monkeypatch.setenv("TPU_NAME", "my-tpu")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = Mgr.get_current_node_tpu_resources()
+    assert "TPU-v4-16-head" not in res
+    assert res["my-tpu"] == 1.0
+
+
+def test_axon_single_chip(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "5e")
+    assert Mgr.get_current_node_num_accelerators() == 1
+    assert Mgr.get_current_node_tpu_pod_type() == "v5e-1"
+
+
+def test_visible_chips_isolation(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")  # 4 chips on this host
+    import os
+
+    Mgr.set_current_process_visible_accelerator_ids(["1"])
+    assert os.environ["TPU_VISIBLE_CHIPS"] == "1"
+    assert os.environ["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,1,1"
+    assert os.environ["TPU_HOST_BOUNDS"] == "1,1,1"
+
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS")
+    Mgr.set_current_process_visible_accelerator_ids(["0", "1"])
+    assert os.environ["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+
+
+def test_visible_chips_full_host_resets(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")  # 4 chips per host
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "1,1,1")
+    monkeypatch.setenv("TPU_HOST_BOUNDS", "1,1,1")
+    import os
+
+    Mgr.set_current_process_visible_accelerator_ids(["0", "1", "2", "3"])
+    assert "TPU_CHIPS_PER_HOST_BOUNDS" not in os.environ
+    assert "TPU_HOST_BOUNDS" not in os.environ
+
+
+def test_chip_quantity_validation():
+    ok, _ = Mgr.validate_resource_request_quantity(4)
+    assert ok
+    bad, msg = Mgr.validate_resource_request_quantity(3)
+    assert not bad and "chip configurations" in msg
+
+
+def test_scaling_config_topology():
+    from ray_tpu.train import ScalingConfig
+
+    sc = ScalingConfig(topology="v4-16")
+    assert sc.num_workers == 2
+    assert sc.use_tpu
+    assert sc.placement_strategy == "STRICT_SPREAD"
+    assert sc.worker_resources()["TPU"] == 4.0
+    assert sc.worker_resources()["TPU-V4"] == 4.0
+    assert sc.backend() == "xla"
+
+    sc = ScalingConfig(topology="v5litepod-16")  # 16 chips, 8 per host
+    assert sc.num_workers == 2
+    assert sc.worker_resources()["TPU"] == 8.0
+
+
+def test_slice_placement_group_shape(monkeypatch):
+    """slice_placement_group builds one bundle per slice host without
+    needing a live cluster (patch placement_group)."""
+    captured = {}
+
+    def fake_pg(bundles, strategy="PACK", name=""):
+        captured["bundles"] = bundles
+        captured["strategy"] = strategy
+        return "PG"
+
+    import ray_tpu.core.api as api
+
+    monkeypatch.setattr(api, "placement_group", fake_pg)
+    assert tpu_mod.slice_placement_group("v4-16") == "PG"
+    assert captured["strategy"] == "STRICT_SPREAD"
+    assert captured["bundles"] == [
+        {"TPU": 4.0, "TPU-V4": 4.0},
+        {"TPU": 4.0, "TPU-V4": 4.0},
+    ]
+
+
+def test_e2e_chip_isolation_through_lease():
+    """A task leasing TPU:2 on a 4-chip node runs with TPU_VISIBLE_CHIPS
+    set to its 2 granted chip ids (ref: worker-side accelerator env
+    isolation); chips return to the pool with the lease."""
+    import os
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, num_tpus=4)
+    try:
+
+        @ray_tpu.remote(num_tpus=2)
+        def which_chips():
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+        chips = ray_tpu.get(which_chips.remote(), timeout=60)
+        assert chips is not None and len(chips.split(",")) == 2
+
+        # both 2-chip leases can be live at once on a 4-chip node
+        a, b = which_chips.remote(), which_chips.remote()
+        got = ray_tpu.get([a, b], timeout=60)
+        assert all(g is not None and len(g.split(",")) == 2 for g in got)
+    finally:
+        ray_tpu.shutdown()
