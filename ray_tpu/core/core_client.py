@@ -46,7 +46,7 @@ from ray_tpu.core.ref import (
     TaskError,
     WorkerCrashedError,
 )
-from ray_tpu.utils import aio, rpc, serialization
+from ray_tpu.utils import aio, metrics, rpc, serialization
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 
 ALIVE = "ALIVE"
@@ -96,6 +96,47 @@ class _SchedulingKeyState:
     inflight_tasks: int = 0
 
 
+class _TaskEventBuffer:
+    """Batches task lifecycle events and flushes them (with a metrics
+    snapshot) to the GCS on an interval (ref: task_event_buffer.h:225 —
+    same drop-oldest bound, fire-and-forget flush)."""
+
+    MAX_BUFFER = 10_000
+
+    def __init__(self, core: "CoreClient"):
+        self.core = core
+        self.events: list[dict] = []
+
+    def emit(self, **ev):
+        ev.setdefault("ts", time.time())
+        if len(self.events) >= self.MAX_BUFFER:
+            del self.events[0]  # drop-oldest: keep the newest (terminal) states
+        self.events.append(ev)
+
+    async def _flush_loop(self):
+        interval = self.core.cfg.task_events_report_interval_s
+        while not self.core._closed:
+            await asyncio.sleep(interval)
+            await self.flush()
+
+    async def flush(self):
+        if self.core.gcs is None or self.core.gcs._closed:
+            return
+        try:
+            if self.events:
+                batch, self.events = self.events, []
+                await self.core.gcs.notify("report_task_events", {"events": batch})
+            # metrics publish is independent of task activity (a put-only
+            # process still reports its counters)
+            await self.core.gcs.call(
+                "kv_put",
+                {"ns": "metrics", "key": self.core.worker_id.hex(),
+                 "value": pickle.dumps(metrics.registry().snapshot())},
+            )
+        except Exception:
+            pass
+
+
 class CoreClient:
     def __init__(self, loop: asyncio.AbstractEventLoop | None = None):
         self.cfg = get_config()
@@ -127,6 +168,7 @@ class CoreClient:
         self._gen_states: dict[TaskID, _GenState] = {}
         self._closed = False
         self._bg = aio.TaskGroup()
+        self.task_events = _TaskEventBuffer(self)
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -139,6 +181,7 @@ class CoreClient:
         self.node_id = info["node_id"]
         self.store = SharedObjectStore(info["store_name"])
         self.job_id = await self.gcs.call("register_job", {})
+        self._bg.spawn(self.task_events._flush_loop(), self.loop)
 
     # -------------------------------------------------------------- pubsub
     def _on_push(self, msg):
@@ -170,6 +213,8 @@ class CoreClient:
         oid = ObjectID.from_random()
         meta, buffers = serialization.dumps_with_buffers(value)
         size = serialization.total_size(meta, buffers)
+        metrics.objects_put.inc()
+        metrics.object_bytes_put.inc(size)
         entry = _MemEntry()
         if size <= self.cfg.max_inline_object_size:
             entry.packed = _pack_bytes(meta, buffers, size)
@@ -386,6 +431,9 @@ class CoreClient:
             "bundle_index": bundle_index,
             "scheduling_node": scheduling_node,
         }
+        metrics.tasks_submitted.inc()
+        self.task_events.emit(task_id=task_id.hex(), name=spec["name"],
+                              state="PENDING_ARGS_AVAIL")
         if num_returns == "streaming":
             self._gen_states[task_id] = _GenState()
             self._call_on_loop(self._submit_async(spec))
@@ -515,6 +563,8 @@ class CoreClient:
             await self._pump(key, state)
 
     async def _run_on_worker(self, key, state, w: _LeasedWorker, spec: dict):
+        self.task_events.emit(task_id=spec["task_id"].hex(), name=spec["name"],
+                              state="SUBMITTED_TO_WORKER", worker_id=w.worker_id)
         try:
             if w.tpu_chips:
                 spec["tpu_chips"] = w.tpu_chips
@@ -539,9 +589,15 @@ class CoreClient:
 
     def _apply_task_reply(self, spec, reply):
         task_id = spec["task_id"]
+        name = spec.get("name") or spec.get("method", "task")
         if reply.get("error") is not None:
+            metrics.tasks_finished.inc(tags={"outcome": "failed"})
+            self.task_events.emit(task_id=task_id.hex(), name=name, state="FAILED",
+                                  error=str(reply["error"])[:200])
             self._complete_task_error(spec, reply["error"])
             return
+        metrics.tasks_finished.inc(tags={"outcome": "ok"})
+        self.task_events.emit(task_id=task_id.hex(), name=name, state="FINISHED")
         for i, result in enumerate(reply["results"]):
             oid = ObjectID.for_task_return(task_id, i)
             entry = self.memory_store.get(oid)
@@ -747,6 +803,9 @@ class CoreClient:
         replies)."""
         task_id = TaskID.generate()
         actor_id = handle.actor_id
+        metrics.actor_calls.inc()
+        self.task_events.emit(task_id=task_id.hex(), name=method,
+                              state="PENDING_ARGS_AVAIL", actor_id=actor_id.hex())
         streaming = num_returns == "streaming"
         refs = []
         if streaming:
@@ -922,6 +981,7 @@ class CoreClient:
         return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
 
     async def close(self):
+        await self.task_events.flush()
         self._closed = True
         await self._bg.cancel_all()
         # return all leases

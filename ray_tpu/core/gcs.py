@@ -329,6 +329,18 @@ class GcsServer:
     async def rpc_list_actors(self, conn, p):
         return [a.view() for a in self.actors.values()]
 
+    async def rpc_list_placement_groups(self, conn, p):
+        return [
+            {
+                "pg_id": pg.pg_id.hex(),
+                "bundles": pg.bundles,
+                "strategy": pg.strategy,
+                "state": pg.state,
+                "bundle_nodes": [n.hex() for n in pg.bundle_nodes],
+            }
+            for pg in self.pgs.values()
+        ]
+
     async def rpc_report_actor_death(self, conn, p):
         info = self.actors.get(p["actor_id"])
         if info is not None and info.state != DEAD:

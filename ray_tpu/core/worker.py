@@ -24,6 +24,7 @@ import concurrent.futures
 import inspect
 import os
 import sys
+import time
 import traceback
 
 try:
@@ -34,7 +35,7 @@ except ImportError:  # pragma: no cover
 from ray_tpu.config import get_config
 from ray_tpu.core.core_client import CoreClient, _pack_bytes
 from ray_tpu.core.ref import ObjectRef, TaskError
-from ray_tpu.utils import rpc, serialization
+from ray_tpu.utils import metrics, rpc, serialization
 from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
 
 
@@ -155,19 +156,7 @@ class Worker:
             if size <= self.cfg.max_inline_object_size:
                 results.append({"inline": _pack_bytes(meta, buffers, size)})
             else:
-                buf = self.core.store.create(oid, size)
-                serialization.pack_into(meta, buffers, buf)
-                self.core.store.seal(oid)
-                import pickle
-
-                holders_blob = await self.core.gcs.call(
-                    "kv_get", {"ns": "obj_loc", "key": oid.hex()}
-                )
-                holders = pickle.loads(holders_blob) if holders_blob else set()
-                holders.add(self.node_id.binary())
-                await self.core.gcs.call(
-                    "kv_put", {"ns": "obj_loc", "key": oid.hex(), "value": pickle.dumps(holders)}
-                )
+                await self._store_shm_object(oid, meta, buffers)
                 results.append({"shm": True})
         return results
 
@@ -178,6 +167,12 @@ class Worker:
             fn = await self._load_function(spec["func_id"])
             args = await self._fetch_args(spec["args"])
             kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
+            self.core.task_events.emit(
+                task_id=spec["task_id"].hex(), name=spec.get("name", "task"),
+                state="RUNNING", worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(),
+            )
+            t0 = time.monotonic()
             if spec["num_returns"] == "streaming":
                 return await self._execute_streaming(spec, fn, args, kwargs)
             loop = asyncio.get_running_loop()
@@ -194,8 +189,20 @@ class Worker:
                     else:
                         value = tuple(value)
             results = await self._store_results(spec["task_id"], spec["num_returns"], value)
+            dur = time.monotonic() - t0
+            metrics.task_exec_seconds.observe(dur)
+            self.core.task_events.emit(
+                task_id=spec["task_id"].hex(), name=spec.get("name", "task"),
+                state="FINISHED", worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(), duration_s=dur,
+            )
             return {"results": results}
         except Exception as e:
+            self.core.task_events.emit(
+                task_id=spec["task_id"].hex(), name=spec.get("name", "task"),
+                state="FAILED", worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(),
+            )
             return {"error": _as_task_error(e)}
 
     async def _execute_streaming(self, spec, fn, args, kwargs):
@@ -211,9 +218,11 @@ class Worker:
         thread blocks on a small semaphore window that the sender releases
         per owner ack (the generator_waiter.h role)."""
         task_id = spec["task_id"]
+        task_name = spec.get("name") or spec.get("method", "stream")
         owner = await rpc.connect(*spec["owner_address"], timeout=10)
         loop = asyncio.get_running_loop()
         index = 0
+        t0 = time.monotonic()
         try:
             gen = fn(*args, **kwargs)
             if inspect.isasyncgen(gen):
@@ -283,9 +292,21 @@ class Worker:
                     await cancel()  # consumer dropped the generator
                     break
             await owner.call("generator_item", {"task_id": task_id, "done": True})
+            dur = time.monotonic() - t0
+            metrics.task_exec_seconds.observe(dur)
+            self.core.task_events.emit(
+                task_id=task_id.hex(), name=task_name, state="FINISHED",
+                worker_id=self.worker_id.hex(), node_id=self.node_id.hex(),
+                pid=os.getpid(), duration_s=dur, items=index,
+            )
             return {"results": [], "streaming": True, "count": index}
         except Exception as e:
             err = _as_task_error(e)
+            self.core.task_events.emit(
+                task_id=task_id.hex(), name=task_name, state="FAILED",
+                worker_id=self.worker_id.hex(), node_id=self.node_id.hex(),
+                pid=os.getpid(),
+            )
             try:
                 await owner.call(
                     "generator_item", {"task_id": task_id, "done": True, "error": err}
@@ -384,13 +405,32 @@ class Worker:
                 ev = gate["events"].pop(seq + 1, None)
                 if ev is not None:
                     ev.set()
+        self.core.task_events.emit(
+            task_id=spec["task_id"].hex(), name=spec.get("method", "actor_task"),
+            state="RUNNING", worker_id=self.worker_id.hex(),
+            node_id=self.node_id.hex(), pid=os.getpid(),
+            actor_id=self.actor_id.hex() if self.actor_id else None,
+        )
+        t0 = time.monotonic()
         try:
             value = await work
             if streaming:
                 return value  # _execute_streaming builds the full reply
             results = await self._store_results(spec["task_id"], spec["num_returns"], value)
+            dur = time.monotonic() - t0
+            metrics.task_exec_seconds.observe(dur)
+            self.core.task_events.emit(
+                task_id=spec["task_id"].hex(), name=spec.get("method", "actor_task"),
+                state="FINISHED", worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(), duration_s=dur,
+            )
             return {"results": results}
         except Exception as e:
+            self.core.task_events.emit(
+                task_id=spec["task_id"].hex(), name=spec.get("method", "actor_task"),
+                state="FAILED", worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(),
+            )
             return {"error": _as_task_error(e)}
 
     async def rpc_start_dag_loop(self, conn, p):

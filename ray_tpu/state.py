@@ -1,0 +1,191 @@
+"""Cluster state API + chrome-trace timeline export.
+
+TPU-native equivalent of the reference state observability surface (ref:
+python/ray/util/state/api.py list_tasks/list_actors/list_nodes/...,
+python/ray/_private/state.py:440 timeline export). All queries hit the
+GCS tables the runtime already maintains; task events come from the
+_TaskEventBuffer producers in every core client and worker.
+
+    import ray_tpu
+    from ray_tpu import state
+
+    state.list_tasks(filters=[("state", "=", "FINISHED")])
+    state.list_actors()
+    state.timeline("/tmp/trace.json")  # open in chrome://tracing / perfetto
+"""
+from __future__ import annotations
+
+import json
+import pickle
+import time
+from typing import Any
+
+
+def _core():
+    from ray_tpu.core.api import get_core
+
+    return get_core()
+
+
+def _call(method: str, payload: dict | None = None):
+    core = _core()
+    return core._run_sync(core.gcs.call(method, payload or {}))
+
+
+def _match(row: dict, filters) -> bool:
+    for key, op, value in filters or ():
+        have = row.get(key)
+        if op in ("=", "=="):
+            if str(have) != str(value):
+                return False
+        elif op == "!=":
+            if str(have) == str(value):
+                return False
+        else:
+            raise ValueError(f"unsupported filter op {op!r} (use '=' or '!=')")
+    return True
+
+
+# ------------------------------------------------------------------- listing
+def list_tasks(filters=None, limit: int = 1000, detail: bool = False) -> list[dict]:
+    """Latest lifecycle state per task, newest first (ref: state/api.py
+    list_tasks). Filter keys: name, state, task_id, worker_id, node_id."""
+    events = _call("get_task_events")
+    _TERMINAL = ("FINISHED", "FAILED")
+    latest: dict[str, dict] = {}
+    # merge in timestamp order; a terminal state is never overwritten by a
+    # non-terminal one (client FINISHED and worker RUNNING batches can
+    # arrive in either order within a flush interval)
+    for ev in sorted(events, key=lambda e: e.get("ts", 0)):
+        tid = ev.get("task_id")
+        if tid is None:
+            continue
+        merged = dict(latest.get(tid, {}))
+        if merged.get("state") in _TERMINAL and ev.get("state") not in _TERMINAL:
+            ev = {k: v for k, v in ev.items() if k not in ("state", "ts")}
+        merged.update(ev)
+        latest[tid] = merged
+    rows = [r for r in latest.values() if _match(r, filters)]
+    rows.sort(key=lambda r: r.get("ts", 0), reverse=True)
+    rows = rows[:limit]
+    if not detail:
+        keep = ("task_id", "name", "state", "ts", "worker_id", "node_id",
+                "actor_id", "duration_s", "error")
+        rows = [{k: r[k] for k in keep if k in r} for r in rows]
+    return rows
+
+
+def list_task_events(limit: int = 10000) -> list[dict]:
+    """Raw lifecycle event stream (every transition, not just the latest)."""
+    return _call("get_task_events")[-limit:]
+
+
+def list_actors(filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("list_actors")
+    rows = [dict(r, actor_id=r["actor_id"].hex() if hasattr(r["actor_id"], "hex")
+                 else r["actor_id"]) for r in rows]
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_nodes(filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("get_cluster")
+    rows = [dict(r, node_id=r["node_id"].hex() if hasattr(r["node_id"], "hex")
+                 else r["node_id"]) for r in rows]
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_placement_groups(filters=None, limit: int = 1000) -> list[dict]:
+    rows = _call("list_placement_groups")
+    return [r for r in rows if _match(r, filters)][:limit]
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    """Objects with registered shm locations (ref: list_objects — here the
+    GCS object directory; owner-inlined objects aren't listed)."""
+    keys = _call("kv_keys", {"ns": "obj_loc", "prefix": ""})[:limit]
+    blobs = _call("kv_multi_get", {"ns": "obj_loc", "keys": keys})
+    out = []
+    for k in keys:
+        blob = blobs.get(k)
+        holders = pickle.loads(blob) if blob else set()
+        out.append({"object_id": k, "locations": [h.hex() if isinstance(h, bytes)
+                                                  else h for h in holders]})
+    return out
+
+
+def summary_tasks() -> dict:
+    """Task counts grouped by (name, state) (ref: summarize_tasks)."""
+    out: dict[str, dict[str, int]] = {}
+    for row in list_tasks(limit=100_000):
+        by_state = out.setdefault(row.get("name", "?"), {})
+        st = row.get("state", "?")
+        by_state[st] = by_state.get(st, 0) + 1
+    return out
+
+
+# ------------------------------------------------------------------- metrics
+def cluster_metrics() -> dict[str, Any]:
+    """Aggregate the per-process metric snapshots pushed to the GCS KV."""
+    keys = _call("kv_keys", {"ns": "metrics", "prefix": ""})
+    blobs = _call("kv_multi_get", {"ns": "metrics", "keys": keys})
+    agg: dict[str, Any] = {}
+    for k in keys:
+        blob = blobs.get(k)
+        if not blob:
+            continue
+        snap = pickle.loads(blob)
+        for name, m in snap.get("metrics", {}).items():
+            slot = agg.setdefault(name, {"type": m["type"], "values": {}})
+            for tag_key, v in m.get("values", {}).items():
+                if m["type"] == "counter":
+                    slot["values"][tag_key] = slot["values"].get(tag_key, 0.0) + v
+                elif m["type"] == "gauge":
+                    slot["values"][tag_key] = v  # last writer wins
+                else:  # histogram: merge counts and sums
+                    cur = slot["values"].setdefault(
+                        tag_key, {"counts": [0] * len(v["counts"]), "sum": 0.0}
+                    )
+                    cur["counts"] = [a + b for a, b in zip(cur["counts"], v["counts"])]
+                    cur["sum"] += v["sum"]
+    return agg
+
+
+# ------------------------------------------------------------------ timeline
+def timeline(filename: str | None = None) -> list[dict]:
+    """Chrome trace events built from worker-side RUNNING->FINISHED/FAILED
+    pairs (ref: _private/state.py:440 chrome_tracing_dump): one row per
+    worker pid, one 'X' slice per task execution. Open the file in
+    chrome://tracing or ui.perfetto.dev."""
+    events = _call("get_task_events")
+    starts: dict[str, dict] = {}
+    trace: list[dict] = []
+    for ev in events:
+        state = ev.get("state")
+        tid = ev.get("task_id")
+        if state == "RUNNING":
+            starts[tid] = ev
+        elif state in ("FINISHED", "FAILED") and tid in starts and ev.get("pid"):
+            s = starts.pop(tid)
+            trace.append({
+                "name": ev.get("name", "task"),
+                "cat": "task",
+                "ph": "X",
+                "ts": s["ts"] * 1e6,  # chrome tracing wants microseconds
+                "dur": max(ev["ts"] - s["ts"], ev.get("duration_s", 0)) * 1e6,
+                "pid": (ev.get("node_id") or "node")[:8],
+                "tid": ev.get("pid"),
+                "args": {"task_id": tid, "state": state},
+            })
+    # still-running tasks appear as instant events
+    now = time.time()
+    for tid, s in starts.items():
+        trace.append({
+            "name": s.get("name", "task"), "cat": "task", "ph": "X",
+            "ts": s["ts"] * 1e6, "dur": (now - s["ts"]) * 1e6,
+            "pid": (s.get("node_id") or "node")[:8], "tid": s.get("pid"),
+            "args": {"task_id": tid, "state": "RUNNING"},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace

@@ -1,0 +1,121 @@
+"""In-process metrics registry.
+
+TPU-native equivalent of the reference stats layer (ref:
+src/ray/stats/metric_defs.cc metric definitions, python/ray/util/metrics.py
+user-facing Counter/Gauge/Histogram). Each process keeps one registry;
+component code records locally (lock-free dict bumps on the hot path) and
+the core client piggybacks periodic snapshots to the GCS KV
+(ns="metrics", key=worker hex) on the task-event flush timer, where the
+state API aggregates them cluster-wide.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Sequence
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        _registry.register(self)
+
+    def _key(self, tags: dict | None) -> tuple:
+        if not tags:
+            return ()
+        return tuple(sorted(tags.items()))
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        k = self._key(tags)
+        self._values[k] = self._values.get(k, 0.0) + value
+
+    def snapshot(self):
+        return {"type": "counter", "values": {str(k): v for k, v in self._values.items()}}
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        self._values: dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float, tags: dict | None = None):
+        self._values[self._key(tags)] = value
+
+    def snapshot(self):
+        return {"type": "gauge", "values": {str(k): v for k, v in self._values.items()}}
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram (ref: metrics.py Histogram)."""
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
+        self.boundaries = tuple(boundaries) or (
+            0.001, 0.01, 0.1, 1.0, 10.0, 100.0, 1000.0
+        )
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: dict | None = None):
+        k = self._key(tags)
+        counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+        i = 0
+        while i < len(self.boundaries) and value > self.boundaries[i]:
+            i += 1
+        counts[i] += 1
+        self._sums[k] = self._sums.get(k, 0.0) + value
+
+    def snapshot(self):
+        return {
+            "type": "histogram",
+            "boundaries": list(self.boundaries),
+            "values": {
+                str(k): {"counts": c, "sum": self._sums.get(k, 0.0)}
+                for k, c in self._counts.items()
+            },
+        }
+
+
+class _Registry:
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric):
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Metric | None:
+        return self._metrics.get(name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ts": time.time(),
+                "metrics": {name: m.snapshot() for name, m in self._metrics.items()},
+            }
+
+
+_registry = _Registry()
+
+
+def registry() -> _Registry:
+    return _registry
+
+
+# --- core runtime metrics (ref: metric_defs.cc tasks/objects families) ------
+tasks_submitted = Counter("rt_tasks_submitted", "tasks submitted by this process")
+tasks_finished = Counter("rt_tasks_finished", "task replies applied, by outcome",
+                         tag_keys=("outcome",))
+actor_calls = Counter("rt_actor_calls", "actor method calls submitted")
+objects_put = Counter("rt_objects_put", "objects created via put")
+object_bytes_put = Counter("rt_object_bytes_put", "bytes written via put")
+task_exec_seconds = Histogram("rt_task_exec_seconds", "worker-side task execution time")

@@ -1,0 +1,159 @@
+"""State API / task events / timeline / metrics tests (ref test strategy:
+python/ray/tests/test_state_api.py, test_task_events.py)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=16)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=20, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise AssertionError(f"timed out: {msg}")
+
+
+def test_task_events_and_list_tasks(rt):
+    @ray_tpu.remote
+    def traced_task(x):
+        return x + 1
+
+    refs = [traced_task.remote(i) for i in range(5)]
+    assert ray_tpu.get(refs, timeout=60) == [1, 2, 3, 4, 5]
+
+    # events flush on a ~1s interval; wait until the worker-side detail
+    # (duration) has also arrived, not just the client-side FINISHED
+    rows = _wait_for(
+        lambda: (
+            lambda rs: rs
+            if len(rs) == 5 and any(r.get("duration_s") is not None for r in rs)
+            else None
+        )(
+            [
+                r for r in state.list_tasks(filters=[("name", "=", "traced_task")])
+                if r.get("state") == "FINISHED"
+            ]
+        ),
+        msg="no complete traced_task events",
+    )
+    assert all(r.get("worker_id") for r in rows)
+
+
+def test_failed_task_event(rt):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kaput")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote(), timeout=60)
+    rows = _wait_for(
+        lambda: state.list_tasks(filters=[("name", "=", "boom"), ("state", "=", "FAILED")]),
+        msg="no FAILED boom event",
+    )
+    assert rows
+
+
+def test_list_actors_and_nodes(rt):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return True
+
+    a = Marker.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60)
+    actors = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(x["state"] == "ALIVE" for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["alive"]
+
+
+def test_placement_group_listing(rt):
+    pg = ray_tpu.placement_group([{"CPU": 1.0}], strategy="PACK")
+    assert pg.ready(timeout=30)
+    pgs = state.list_placement_groups(filters=[("state", "=", "CREATED")])
+    assert any(p["pg_id"] == pg.id.hex() for p in pgs)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_timeline_export(rt, tmp_path):
+    @ray_tpu.remote
+    def slice_task():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([slice_task.remote() for _ in range(3)], timeout=60)
+    path = str(tmp_path / "trace.json")
+    trace = _wait_for(
+        lambda: [e for e in state.timeline(path)
+                 if e["name"] == "slice_task" and e["args"]["state"] == "FINISHED"],
+        msg="no timeline slices",
+    )
+    assert len(trace) >= 3
+    saved = json.load(open(path))
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in saved)
+
+
+def test_cluster_metrics_aggregation(rt):
+    @ray_tpu.remote
+    def metered():
+        return 1
+
+    ray_tpu.get([metered.remote() for _ in range(3)], timeout=60)
+    ray_tpu.put(list(range(1000)))
+
+    def have_metrics():
+        m = state.cluster_metrics()
+        sub = m.get("rt_tasks_submitted", {}).get("values", {}).get("()", 0)
+        puts = m.get("rt_objects_put", {}).get("values", {}).get("()", 0)
+        execs = m.get("rt_task_exec_seconds", {}).get("values", {})
+        return sub >= 3 and puts >= 1 and execs and m
+
+    m = _wait_for(have_metrics, msg="metrics never aggregated")
+    assert m["rt_task_exec_seconds"]["type"] == "histogram"
+
+
+def test_summary_tasks(rt):
+    @ray_tpu.remote
+    def summarized():
+        return 1
+
+    ray_tpu.get([summarized.remote() for _ in range(4)], timeout=60)
+    summary = _wait_for(
+        lambda: (
+            lambda s: s if s and s.get("FINISHED", 0) >= 4 else None
+        )(state.summary_tasks().get("summarized")),
+        msg="no FINISHED summary for summarized",
+    )
+    assert summary["FINISHED"] >= 4
+
+
+def test_streaming_task_gets_terminal_event(rt):
+    """Streaming tasks must close their timeline slice (worker FINISHED
+    with duration + item count)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def streamer():
+        yield from range(3)
+
+    assert len(list(streamer.remote())) == 3
+    rows = _wait_for(
+        lambda: [
+            r for r in state.list_tasks(filters=[("name", "=", "streamer")])
+            if r.get("state") == "FINISHED" and r.get("duration_s") is not None
+        ],
+        msg="no terminal streaming event",
+    )
+    assert rows
