@@ -166,6 +166,24 @@ class CoreClient:
         self._subscribed_actors: set[ActorID] = set()
         self._task_counter = 0
         self._gen_states: dict[TaskID, _GenState] = {}
+        # distributed refcounting state (ref: reference_count.h:72)
+        self._local_refs: dict[ObjectID, int] = {}      # owner-side handles
+        self._borrowers: dict[ObjectID, set] = {}       # owner-side registry
+        self._borrowed_counts: dict[ObjectID, int] = {} # borrower-side handles
+        self._shipped_at: dict[ObjectID, float] = {}
+        self._owner_conns: dict[tuple, rpc.Connection] = {}
+        self._owner_conn_locks: dict[tuple, asyncio.Lock] = {}
+        # lineage for reconstruction (ref: task_manager.h:182 lineage pinning)
+        self._lineage: dict[TaskID, dict] = {}
+        self._lineage_live: dict[TaskID, set] = {}  # return oids still live
+        self._reconstructions: dict[ObjectID, int] = {}
+        # refs pinned while their task is in flight (args must outlive
+        # dispatch; ref: dependency resolver holding arg refs)
+        self._inflight_pins: dict[TaskID, list] = {}
+        self._ship_collect: list | None = None  # set during arg serialization
+        import threading as _threading
+
+        self._rc_lock = _threading.Lock()  # counts are bumped off-loop too
         self._closed = False
         self._bg = aio.TaskGroup()
         self.task_events = _TaskEventBuffer(self)
@@ -194,19 +212,164 @@ class CoreClient:
             self._actor_info[actor_id] = message
 
     # ----------------------------------------------------------- ownership
+    # Distributed reference counting (ref: reference_count.h:72): the owner
+    # frees an object's memory entry AND its shm copies (local + remote
+    # holders) only when its own handles are gone, no borrower is
+    # registered, and no shipment of the ref is recently in flight.
+
+    BORROW_GRACE_S = 3.0  # covers serialize->deserialize windows
+
+    def note_ref_shipped(self, oid: ObjectID, ref=None):
+        self._shipped_at[oid] = time.monotonic()
+        col = self._ship_collect
+        if col is not None and ref is not None:
+            col.append(ref)  # pin the live handle for the flight
+
+    def on_owned_ref_created(self, oid: ObjectID):
+        with self._rc_lock:
+            self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+
     def on_owned_ref_deleted(self, oid: ObjectID):
-        """Called from ObjectRef.__del__ on the owner: drop the local value.
-        (Round-1 GC: owner-local release; distributed borrow counting is a
-        later-round refinement — shm copies remain until LRU eviction.)"""
         if self._closed:
             return
         try:
-            self.loop.call_soon_threadsafe(self._free_object, oid)
+            self.loop.call_soon_threadsafe(self._on_owned_ref_deleted_on_loop, oid)
         except RuntimeError:
             pass
 
-    def _free_object(self, oid: ObjectID):
-        self.memory_store.pop(oid, None)
+    def _on_owned_ref_deleted_on_loop(self, oid: ObjectID):
+        with self._rc_lock:
+            n = self._local_refs.get(oid, 1) - 1
+            if n > 0:
+                self._local_refs[oid] = n
+                return
+            self._local_refs.pop(oid, None)
+        self._bg.spawn(self._maybe_free_object(oid), self.loop)
+
+    async def _maybe_free_object(self, oid: ObjectID):
+        while not self._closed:
+            if self._local_refs.get(oid, 0) > 0:
+                return  # resurrected (e.g. deserialized again on the owner)
+            if self._borrowers.get(oid):
+                return  # an unborrow will re-trigger the free check
+            shipped = self._shipped_at.get(oid)
+            if shipped is not None:
+                wait = self.BORROW_GRACE_S - (time.monotonic() - shipped)
+                if wait > 0:  # a borrow registration may still be in flight
+                    await asyncio.sleep(wait)
+                    continue
+            break
+        if self._closed:
+            return
+        self._shipped_at.pop(oid, None)
+        self._borrowers.pop(oid, None)
+        entry = self.memory_store.pop(oid, None)
+        # lineage pins its task's arg refs only while some return is live
+        tid = oid.task_id()
+        live = self._lineage_live.get(tid)
+        if live is not None:
+            live.discard(oid)
+            if not live:
+                self._lineage.pop(tid, None)
+                self._lineage_live.pop(tid, None)
+        if entry is not None and entry.in_shm:
+            await self._free_shm_everywhere(oid)
+
+    async def _free_shm_everywhere(self, oid: ObjectID):
+        """Delete the sealed copies on every holder node and drop the
+        directory entry (the owner-driven release the reference does via
+        LocalObjectManager free batches)."""
+        try:
+            blob = await self.gcs.call("kv_get", {"ns": "obj_loc", "key": oid.hex()})
+            holders = pickle.loads(blob) if blob else set()
+            await self.gcs.call("kv_del", {"ns": "obj_loc", "key": oid.hex()})
+            nodes = {tuple(n["address"]): n["node_id"].binary() if hasattr(n["node_id"], "binary") else n["node_id"]
+                     for n in await self.gcs.call("get_cluster", {})}
+            for addr, node_bin in nodes.items():
+                if node_bin in holders:
+                    try:
+                        conn = (self.raylet if addr == tuple(self.raylet_address)
+                                else await rpc.connect(*addr, timeout=2))
+                        try:
+                            await conn.call("delete_object", {"object_id": oid.binary()})
+                        finally:
+                            if conn is not self.raylet:
+                                await conn.close()
+                    except Exception:
+                        pass
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- borrower side
+    def on_borrowed_ref_created(self, oid: ObjectID, owner_address):
+        with self._rc_lock:
+            n = self._borrowed_counts.get(oid, 0)
+            self._borrowed_counts[oid] = n + 1
+        if n == 0:
+            self._call_on_loop(self._send_borrow(oid, tuple(owner_address), True))
+
+    def on_borrowed_ref_deleted(self, oid: ObjectID, owner_address):
+        if self._closed:
+            return
+        try:
+            self.loop.call_soon_threadsafe(
+                self._on_borrowed_deleted_on_loop, oid, owner_address
+            )
+        except RuntimeError:
+            pass
+
+    def _on_borrowed_deleted_on_loop(self, oid: ObjectID, owner_address):
+        with self._rc_lock:
+            n = self._borrowed_counts.get(oid, 1) - 1
+            if n > 0:
+                self._borrowed_counts[oid] = n
+                return
+            self._borrowed_counts.pop(oid, None)
+        self._bg.spawn(self._send_borrow(oid, tuple(owner_address), False), self.loop)
+
+    async def _send_borrow(self, oid: ObjectID, owner_address, borrow: bool):
+        """Borrow/unborrow travel on one cached connection per owner, with
+        connect+send under a per-owner lock so they arrive in order."""
+        if not borrow:
+            # if we recently re-shipped this borrowed ref to a third
+            # process, hold our registration until its borrow can land
+            shipped = self._shipped_at.pop(oid, None)
+            if shipped is not None:
+                wait = self.BORROW_GRACE_S - (time.monotonic() - shipped)
+                if wait > 0:
+                    await asyncio.sleep(wait)
+        lock = self._owner_conn_locks.setdefault(owner_address, asyncio.Lock())
+        try:
+            async with lock:
+                conn = self._owner_conns.get(owner_address)
+                if conn is None or conn._closed:
+                    conn = await rpc.connect(*owner_address, timeout=5)
+                    self._owner_conns[owner_address] = conn
+                await conn.notify(
+                    "borrow_object" if borrow else "unborrow_object",
+                    {"object_id": oid.binary(), "borrower": self.worker_id.hex()},
+                )
+        except Exception:
+            pass
+
+    # --------------------------------------------------------- owner RPCs
+    async def rpc_borrow_object(self, conn, p):
+        oid = ObjectID(p["object_id"])
+        self._borrowers.setdefault(oid, set()).add(p["borrower"])
+        return True
+
+    async def rpc_unborrow_object(self, conn, p):
+        oid = ObjectID(p["object_id"])
+        holders = self._borrowers.get(oid)
+        if holders is not None:
+            holders.discard(p["borrower"])
+            if not holders and self._local_refs.get(oid, 0) == 0:
+                self._bg.spawn(self._maybe_free_object(oid), self.loop)
+        return True
+
+    def _new_owned_ref(self, oid: ObjectID) -> ObjectRef:
+        self.on_owned_ref_created(oid)
+        return ObjectRef(oid, self.address, _core=self)
 
     # ----------------------------------------------------------------- put
     def put_value(self, value: Any) -> ObjectRef:
@@ -228,7 +391,7 @@ class CoreClient:
             self.memory_store[oid] = entry
             entry.ready.set()
             self._call_on_loop(self._register_location(oid))
-        return ObjectRef(oid, self.address, _core=self)
+        return self._new_owned_ref(oid)
 
     async def _register_location(self, oid: ObjectID):
         holders = {self.node_id.binary()}
@@ -246,6 +409,7 @@ class CoreClient:
 
     async def _get_one(self, ref: ObjectRef, deadline: float | None):
         oid = ref.id
+        pull_fails = 0
         while True:
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
@@ -266,9 +430,12 @@ class CoreClient:
                 except object_store.ObjectEvictedError:
                     # Local copy was LRU-evicted under memory pressure between
                     # contains() and get(): re-pull from another holder (the
-                    # raylet consults the GCS directory); no holder → lost.
+                    # raylet consults the GCS directory); no holder → lost,
+                    # unless lineage can re-execute the producing task.
                     ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
                     if not ok:
+                        if await self._try_reconstruct(oid):
+                            continue
                         raise ObjectLostError(
                             f"{ref} was evicted and no other copy exists"
                         ) from None
@@ -277,10 +444,14 @@ class CoreClient:
                 if entry.ready.is_set():  # owned, in_shm, not local: pull it
                     ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
                     if not ok:
+                        pull_fails = pull_fails + 1
                         # distinguish "not there yet" from "gone": a local
-                        # eviction tombstone + no pullable holder means the
-                        # object is lost, not late
-                        if self.store.is_evicted(oid):
+                        # eviction tombstone or repeated no-holder pulls
+                        # mean the object is lost -> lineage re-execution
+                        if self.store.is_evicted(oid) or pull_fails >= 5:
+                            if await self._try_reconstruct(oid):
+                                pull_fails = 0
+                                continue
                             raise ObjectLostError(
                                 f"{ref} was evicted and no other copy exists"
                             )
@@ -306,6 +477,14 @@ class CoreClient:
             # large object: pull into local shm through our raylet
             ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
             if not ok:
+                pull_fails += 1
+                if pull_fails in (5, 15, 30):  # escalate: owner re-executes
+                    try:
+                        await self._owner_call(
+                            ref, "recover_object", {"object_id": oid.binary()}, 10
+                        )
+                    except Exception:
+                        pass
                 await asyncio.sleep(0.05)
                 continue
 
@@ -377,6 +556,32 @@ class CoreClient:
         meta, buffers = serialization.dumps_with_buffers(entry.value)
         return {"inline": _pack_bytes(meta, buffers, serialization.total_size(meta, buffers))}
 
+    async def _try_reconstruct(self, oid: ObjectID) -> bool:
+        """Re-execute the producing task to regenerate a lost object
+        (ref: object_recovery_manager.h:43 — lineage-based recovery;
+        deterministic task assumption, bounded attempts)."""
+        task_id = oid.task_id()
+        stash = self._lineage.get(task_id)
+        if stash is None:
+            return False
+        n = self._reconstructions.get(oid, 0)
+        if n >= 3:
+            return False
+        self._reconstructions[oid] = n + 1
+        num_returns = stash["num_returns"]
+        for i in range(num_returns):
+            roid = ObjectID.for_task_return(task_id, i)
+            self.memory_store[roid] = _MemEntry()  # fresh pending entries
+        self.task_events.emit(task_id=task_id.hex(), name=stash.get("name", "task"),
+                              state="PENDING_ARGS_AVAIL", reconstruction=n + 1)
+        fresh = {**stash, "max_retries": self.cfg.default_max_task_retries}
+        await self._submit_async(fresh)
+        return True
+
+    async def rpc_recover_object(self, conn, p):
+        """Borrower-requested recovery of a lost owned object."""
+        return await self._try_reconstruct(ObjectID(p["object_id"]))
+
     async def rpc_probe_object(self, conn, p):
         oid = ObjectID(p["object_id"])
         entry = self.memory_store.get(oid)
@@ -438,11 +643,24 @@ class CoreClient:
             self._gen_states[task_id] = _GenState()
             self._call_on_loop(self._submit_async(spec))
             return ObjectRefGenerator(task_id, self)
+        # lineage stash BEFORE _submit_async mutates args in place: the
+        # original arg refs are pinned so lost returns can re-execute
+        # (ref: task_manager.h:182, object_recovery_manager.h:43)
+        self._lineage[task_id] = {
+            **spec, "args": tuple(args), "kwargs": dict(kwargs),
+        }
+        self._lineage_live[task_id] = {
+            ObjectID.for_task_return(task_id, i) for i in range(num_returns)
+        }
+        if len(self._lineage) > 10_000:
+            old = next(iter(self._lineage))
+            self._lineage.pop(old)
+            self._lineage_live.pop(old, None)
         refs = []
         for i in range(num_returns):
             roid = ObjectID.for_task_return(task_id, i)
             self.memory_store[roid] = _MemEntry()
-            refs.append(ObjectRef(roid, self.address, _core=self))
+            refs.append(self._new_owned_ref(roid))
         self._call_on_loop(self._submit_async(spec))
         return refs[0] if num_returns == 1 else refs
 
@@ -454,10 +672,14 @@ class CoreClient:
 
     async def _submit_async(self, spec: dict):
         try:
-            spec["args"] = await self._resolve_args(spec["args"])
+            pins: list = []
+            spec["args"] = await self._resolve_args(spec["args"], pins)
             spec["kwargs"] = dict(
-                zip(spec["kwargs"].keys(), await self._resolve_args(list(spec["kwargs"].values())))
+                zip(spec["kwargs"].keys(),
+                    await self._resolve_args(list(spec["kwargs"].values()), pins))
             )
+            if pins:
+                self._inflight_pins[spec["task_id"]] = pins
         except Exception as e:
             self._complete_task_error(spec, e)
             return
@@ -473,13 +695,19 @@ class CoreClient:
         await state.pending.put(spec)
         await self._pump(key, state)
 
-    async def _resolve_args(self, args):
+    async def _resolve_args(self, args, pins: list | None = None):
         """Dependency resolution (ref: dependency_resolver.cc): owned inline
         args become values; everything else ships as a ref descriptor the
-        executor fetches."""
+        executor fetches. ``pins`` collects every ObjectRef the args carry
+        (top-level AND nested inside packed values) so the caller can keep
+        them alive until the task completes — without this the owner could
+        free an object while its ref is in flight to a slow-starting
+        worker."""
         out = []
         for a in args:
             if isinstance(a, ObjectRef):
+                if pins is not None:
+                    pins.append(a)
                 entry = self.memory_store.get(a.id)
                 if entry is not None:
                     await entry.ready.wait()
@@ -492,12 +720,19 @@ class CoreClient:
                             packed = _pack_bytes(meta, bufs, serialization.total_size(meta, bufs))
                         out.append(("v", packed))
                         continue
+                self.note_ref_shipped(a.id)
                 out.append(("r", a.id.binary(), a.owner_address))
             else:
                 # pack through our serializer (cloudpickle fallback, jax/numpy
                 # out-of-band) — the raw rpc frame uses plain pickle which
-                # would choke on closures/jax values
-                out.append(("v", serialization.pack(a)))
+                # would choke on closures/jax values. No awaits between
+                # setting and clearing _ship_collect: single loop thread.
+                self._ship_collect = pins
+                try:
+                    packed = serialization.pack(a)
+                finally:
+                    self._ship_collect = None
+                out.append(("v", packed))
         return out
 
     async def _pump(self, key, state: _SchedulingKeyState):
@@ -589,6 +824,7 @@ class CoreClient:
 
     def _apply_task_reply(self, spec, reply):
         task_id = spec["task_id"]
+        self._inflight_pins.pop(task_id, None)
         name = spec.get("name") or spec.get("method", "task")
         if reply.get("error") is not None:
             metrics.tasks_finished.inc(tags={"outcome": "failed"})
@@ -610,6 +846,7 @@ class CoreClient:
             entry.ready.set()
 
     def _complete_task_error(self, spec, error):
+        self._inflight_pins.pop(spec["task_id"], None)
         if not isinstance(error, Exception):
             error = TaskError(str(error))
         if spec["num_returns"] == "streaming":
@@ -645,7 +882,7 @@ class CoreClient:
                 entry.in_shm = True
             entry.ready.set()
             self.memory_store[oid] = entry
-            state.items.append(ObjectRef(oid, self.address, _core=self))
+            state.items.append(self._new_owned_ref(oid))
         if p.get("done"):
             state.done = True
             if p.get("error") is not None:
@@ -814,7 +1051,7 @@ class CoreClient:
             for i in range(num_returns):
                 roid = ObjectID.for_task_return(task_id, i)
                 self.memory_store[roid] = _MemEntry()
-                refs.append(ObjectRef(roid, self.address, _core=self))
+                refs.append(self._new_owned_ref(roid))
         spec = {
             "task_id": task_id,
             "actor_id": actor_id,
@@ -846,10 +1083,14 @@ class CoreClient:
 
     async def _dispatch_actor_task(self, spec):
         try:
-            spec["args"] = await self._resolve_args(spec["args"])
+            pins: list = []
+            spec["args"] = await self._resolve_args(spec["args"], pins)
             spec["kwargs"] = dict(
-                zip(spec["kwargs"].keys(), await self._resolve_args(list(spec["kwargs"].values())))
+                zip(spec["kwargs"].keys(),
+                    await self._resolve_args(list(spec["kwargs"].values()), pins))
             )
+            if pins:
+                self._inflight_pins[spec["task_id"]] = pins
             conn = await self._actor_connection(spec["actor_id"])
             seq = self._conn_seq.get(conn, 0)
             self._conn_seq[conn] = seq + 1
@@ -997,6 +1238,11 @@ class CoreClient:
                     pass
         for conn in self._actor_conns.values():
             await conn.close()
+        for conn in self._owner_conns.values():
+            try:
+                await conn.close()
+            except Exception:
+                pass
         await self.server.stop()
         if self.gcs:
             await self.gcs.close()

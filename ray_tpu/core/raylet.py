@@ -516,6 +516,30 @@ class Raylet:
             del buf
             self.store.release(oid)
 
+    async def rpc_delete_object(self, conn, p):
+        """Owner-driven release of this node's sealed copy (the reference's
+        free-objects batch, local_object_manager.h). A copy with live
+        reader refs only gets LRU-demoted by the native delete, so retry
+        until the readers drop and the bytes actually free."""
+        oid = ObjectID(p["object_id"])
+
+        async def drain():
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                try:
+                    self.store.delete(oid)
+                except Exception:
+                    return
+                if not self.store.contains(oid):
+                    return
+                await asyncio.sleep(0.25)
+
+        if p.get("wait"):
+            await drain()  # tests / synchronous callers
+        else:
+            self._bg.spawn(drain())
+        return True
+
     async def rpc_pull_object(self, conn, p):
         """Pull an object into the local store from whichever node holds it
         (location from the GCS object directory)."""

@@ -57,13 +57,14 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 class ObjectRef:
     """Future-like handle to a (possibly pending) remote object."""
 
-    __slots__ = ("id", "owner_address", "_core", "__weakref__")
+    __slots__ = ("id", "owner_address", "_core", "_borrowed", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_address: tuple[str, int] | None = None,
-                 _core=None):
+                 _core=None, _borrowed: bool = False):
         self.id = object_id
         self.owner_address = owner_address
-        self._core = _core  # set only on the owner: enables local GC
+        self._core = _core  # owner: enables GC; borrower: enables unborrow
+        self._borrowed = _borrowed
 
     def binary(self) -> bytes:
         return self.id.binary()
@@ -84,14 +85,29 @@ class ObjectRef:
         return isinstance(other, ObjectRef) and other.id == self.id
 
     def __reduce__(self):
-        # Serialized refs (borrowed by other processes) do not carry _core.
-        return (ObjectRef, (self.id, self.owner_address))
+        # Borrower protocol (ref: reference_count.h:72): the sender notes
+        # the shipment (owner defers freeing while refs are in flight) and
+        # the receiver registers itself as a borrower at unpickle time.
+        core = self._core
+        if core is None:
+            from ray_tpu.core import api
+
+            core = api._core
+        if core is not None:
+            try:
+                core.note_ref_shipped(self.id, self)
+            except Exception:
+                pass
+        return (_rebuild_borrowed_ref, (self.id, self.owner_address))
 
     def __del__(self):
         core = self._core
         if core is not None:
             try:
-                core.on_owned_ref_deleted(self.id)
+                if self._borrowed:
+                    core.on_borrowed_ref_deleted(self.id, self.owner_address)
+                else:
+                    core.on_owned_ref_deleted(self.id)
             except Exception:
                 pass
 
@@ -103,6 +119,22 @@ class ObjectRef:
             return await api._async_get(self)
 
         return _get().__await__()
+
+
+def _rebuild_borrowed_ref(object_id: ObjectID, owner_address):
+    """Unpickle hook: register this process as a borrower with the owner
+    (ref: borrower registration in reference_count.cc). On the owner's own
+    process the ref resolves back to an owned handle."""
+    from ray_tpu.core import api
+
+    core = api._core
+    if core is None:
+        return ObjectRef(object_id, owner_address)
+    if owner_address is not None and tuple(owner_address) == core.address:
+        core.on_owned_ref_created(object_id)
+        return ObjectRef(object_id, owner_address, _core=core)
+    core.on_borrowed_ref_created(object_id, owner_address)
+    return ObjectRef(object_id, owner_address, _core=core, _borrowed=True)
 
 
 class ObjectRefGenerator:
