@@ -498,45 +498,79 @@ class CoreClient:
 
     # ---------------------------------------------------------------- wait
     async def wait_async(self, refs, num_returns, timeout, fetch_local=True):
-        pending = list(refs)
-        ready: list = []
+        """Event-driven wait: owned refs await their memory-store event,
+        borrowed refs park one long 'wait_object' call at the owner
+        (owner-push readiness) — no per-tick probe RPCs (ref: ray.wait
+        via WaitManager, memory-store wakeups)."""
+        refs = list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
 
-        async def is_ready(ref) -> bool:
+        async def one_ready(ref) -> bool:
             entry = self.memory_store.get(ref.id)
             if entry is not None:
-                return entry.ready.is_set()
+                await entry.ready.wait()
+                return True
             if self.store.contains(ref.id):
                 return True
-            if ref.owner_address and tuple(ref.owner_address) != self.address:
+            if not ref.owner_address or tuple(ref.owner_address) == self.address:
+                # unknown local object: appears when its entry is created
+                while not self.store.contains(ref.id):
+                    entry = self.memory_store.get(ref.id)
+                    if entry is not None:
+                        await entry.ready.wait()
+                        return True
+                    await asyncio.sleep(0.05)
+                return True
+            while True:  # borrowed: park at the owner
                 try:
                     r = await self._owner_call(
-                        ref, "probe_object", {"object_id": ref.id.binary()}, 5.0
+                        ref, "wait_object",
+                        {"object_id": ref.id.binary(), "timeout": 30.0}, 40.0,
                     )
-                    if r and fetch_local:
-                        # start moving the payload to this node in the
-                        # background (ref: ray.wait fetch_local semantics)
-                        self.loop.create_task(
-                            self.raylet.call("pull_object", {"object_id": ref.id.binary()})
-                        )
-                    return bool(r)
                 except Exception:
-                    return False
-            return False
+                    await asyncio.sleep(0.5)
+                    continue
+                if r.get("ready"):
+                    if fetch_local and r.get("error") is None:
+                        # start moving the payload to this node (ref:
+                        # ray.wait fetch_local semantics)
+                        self._bg.spawn(
+                            self.raylet.call(
+                                "pull_object", {"object_id": ref.id.binary()}
+                            ),
+                            self.loop,
+                        )
+                    return True
+                if not r.get("known"):
+                    await asyncio.sleep(0.2)  # not created yet (or freed)
 
-        while True:
-            still = []
-            for ref in pending:
-                if len(ready) < num_returns and await is_ready(ref):
-                    ready.append(ref)
-                else:
-                    still.append(ref)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                return ready, pending
-            if deadline is not None and time.monotonic() >= deadline:
-                return ready, pending
-            await asyncio.sleep(0.005)
+        tasks = {
+            asyncio.ensure_future(one_ready(ref)): i for i, ref in enumerate(refs)
+        }
+        ready_idx: set[int] = set()
+        try:
+            while len(ready_idx) < num_returns and tasks:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                done, _ = await asyncio.wait(
+                    tasks, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break  # timed out
+                for t in done:
+                    idx = tasks.pop(t)
+                    if (len(ready_idx) < num_returns and not t.cancelled()
+                            and t.exception() is None and t.result()):
+                        ready_idx.add(idx)  # extras stay pending (wait contract)
+        finally:
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+        ready = [r for i, r in enumerate(refs) if i in ready_idx]
+        pending = [r for i, r in enumerate(refs) if i not in ready_idx]
+        return ready, pending
 
     # -------------------------------------------- owner-side object service
     async def rpc_get_object(self, conn, p):
@@ -588,6 +622,32 @@ class CoreClient:
         if entry is not None:
             return entry.ready.is_set()
         return self.store is not None and self.store.contains(oid)
+
+    async def rpc_wait_object(self, conn, p):
+        """Owner-push readiness: the call parks here until the object is
+        ready (or a timeout passes), replacing borrower-side probe polling
+        (ref: WaitManager + owner memory-store wakeups)."""
+        oid = ObjectID(p["object_id"])
+        timeout = p.get("timeout", 60.0)
+        entry = self.memory_store.get(oid)
+        if entry is None:
+            if self.store is not None and self.store.contains(oid):
+                return {"ready": True}
+            return {"ready": False, "known": False}
+        deadline = time.monotonic() + timeout
+        while not entry.ready.is_set():
+            if conn._closed:  # requester gone: don't park for the full timeout
+                return {"ready": False, "known": True}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"ready": False, "known": True}
+            try:
+                await asyncio.wait_for(entry.ready.wait(), min(1.0, remaining))
+            except asyncio.TimeoutError:
+                continue
+        if entry.error is not None:
+            return {"ready": True, "error": entry.error}
+        return {"ready": True}
 
     # ------------------------------------------------------ task submission
     def _register_function(self, fn) -> bytes:

@@ -169,6 +169,11 @@ class Raylet:
         self._pending_lease_q: asyncio.Queue = asyncio.Queue()
         self._lease_waiters: list[tuple[dict, asyncio.Future, tuple | None]] = []
         self.cluster_view: list[dict] = []
+        # object transfer: coalesce duplicate pulls + bound inbound streams
+        # (ref: pull_manager.h:49 admission control)
+        self._active_pulls: dict[ObjectID, asyncio.Future] = {}
+        self._pull_admission = asyncio.Semaphore(4)
+        self._transfer_pins: dict[tuple, bool] = {}  # (conn, oid) -> pinned
         self._stopping = False
         self._bg = aio.TaskGroup()
 
@@ -428,6 +433,8 @@ class Raylet:
         self._lease_waiters = still
 
     def _on_client_disconnect(self, conn):
+        for key in [k for k in self._transfer_pins if k[0] is conn]:
+            self._release_transfer_pin(conn, key[1])
         for resources, fut, pg_key, waiter_conn in self._lease_waiters:
             if waiter_conn is conn and not fut.done():
                 fut.cancel()
@@ -505,17 +512,6 @@ class Raylet:
             "resources_total": self.ledger.total,
         }
 
-    async def rpc_fetch_object(self, conn, p):
-        """Serve the raw packed bytes of a local object to a peer raylet."""
-        oid = ObjectID(p["object_id"])
-        loop = asyncio.get_running_loop()
-        buf = await loop.run_in_executor(None, self.store.get_buffer, oid, 5000)
-        try:
-            return bytes(buf)
-        finally:
-            del buf
-            self.store.release(oid)
-
     async def rpc_delete_object(self, conn, p):
         """Owner-driven release of this node's sealed copy (the reference's
         free-objects batch, local_object_manager.h). A copy with live
@@ -542,10 +538,28 @@ class Raylet:
 
     async def rpc_pull_object(self, conn, p):
         """Pull an object into the local store from whichever node holds it
-        (location from the GCS object directory)."""
+        (location from the GCS object directory). Concurrent pulls of the
+        same object coalesce onto one transfer (ref: pull_manager.h:49
+        request dedup + admission control)."""
         oid = ObjectID(p["object_id"])
         if self.store.contains(oid):
             return True
+        fut = self._active_pulls.get(oid)
+        if fut is not None:
+            return await asyncio.shield(fut)
+        fut = asyncio.get_running_loop().create_future()
+        self._active_pulls[oid] = fut
+        try:
+            ok = await self._pull_object(oid)
+            fut.set_result(ok)
+            return ok
+        except Exception as e:
+            fut.set_result(False)
+            raise e
+        finally:
+            self._active_pulls.pop(oid, None)
+
+    async def _pull_object(self, oid: ObjectID) -> bool:
         locs = await self.gcs.call("kv_get", {"ns": "obj_loc", "key": oid.hex()})
         if not locs:
             return False
@@ -554,24 +568,133 @@ class Raylet:
         holders = _p.loads(locs)
         for node in self.cluster_view:
             if node["node_id"].binary() in holders and node["node_id"] != self.node_id:
-                try:
-                    c = await rpc.connect(*node["address"])
-                    raw = await c.call(
-                        "fetch_object", {"object_id": oid.binary()},
-                        timeout=self.cfg.rpc_connect_timeout_s,
-                    )
-                    await c.close()
-                    if raw is not None and not self.store.contains(oid):
-                        self.store.put_raw(oid, raw)
-                        holders.add(self.node_id.binary())
-                        await self.gcs.call(
-                            "kv_put",
-                            {"ns": "obj_loc", "key": oid.hex(), "value": _p.dumps(holders)},
-                        )
-                    return True
-                except Exception:
-                    continue
+                async with self._pull_admission:  # bound concurrent inbound
+                    try:
+                        if await self._chunked_fetch(oid, tuple(node["address"])):
+                            holders.add(self.node_id.binary())
+                            await self.gcs.call(
+                                "kv_put",
+                                {"ns": "obj_loc", "key": oid.hex(),
+                                 "value": _p.dumps(holders)},
+                            )
+                            return True
+                    except Exception:
+                        continue
         return False
+
+    async def _chunked_fetch(self, oid: ObjectID, address: tuple) -> bool:
+        """Stream an object in bounded chunks straight into local shm —
+        peak transient memory is chunk_size x window, independent of object
+        size (ref: push_manager.h:28 chunked pushes,
+        chunk_object_reader.cc)."""
+        chunk = self.cfg.object_transfer_chunk_size
+        window = 4  # in-flight chunk requests (pipelined)
+        c = await rpc.connect(*address, timeout=self.cfg.rpc_connect_timeout_s)
+        pinned = False
+        try:
+            meta = await c.call("fetch_object_meta", {"object_id": oid.binary()},
+                                timeout=self.cfg.rpc_connect_timeout_s)
+            if not meta:
+                return False
+            pinned = True  # holder keeps a store ref until fetch_object_done
+            size = meta["size"]
+            if self.store.contains(oid):
+                return True
+            if size <= chunk:
+                raw = await c.call("fetch_object", {"object_id": oid.binary()},
+                                   timeout=self.cfg.rpc_connect_timeout_s)
+                if raw is None:
+                    return False
+                self.store.put_raw(oid, raw)
+                return True
+            buf = self.store.create(oid, size)
+            try:
+                offsets = list(range(0, size, chunk))
+                for i in range(0, len(offsets), window):
+                    batch = offsets[i : i + window]
+                    parts = await asyncio.gather(*(
+                        c.call(
+                            "fetch_object_chunk",
+                            {"object_id": oid.binary(), "offset": off,
+                             "length": min(chunk, size - off)},
+                            timeout=self.cfg.rpc_connect_timeout_s,
+                        )
+                        for off in batch
+                    ))
+                    for off, part in zip(batch, parts):
+                        if part is None:
+                            raise rpc.RpcError(f"holder lost {oid} mid-transfer")
+                        buf[off : off + len(part)] = part
+                self.store.seal(oid)
+                return True
+            except Exception:
+                try:  # abort the half-written create so the slot isn't stuck
+                    self.store.delete(oid)
+                except Exception:
+                    pass
+                raise
+        finally:
+            if pinned:
+                try:
+                    await c.notify("fetch_object_done", {"object_id": oid.binary()})
+                except Exception:
+                    pass
+            await c.close()
+
+    async def rpc_fetch_object_meta(self, conn, p):
+        """Start of a transfer: pin the object (one store ref held for the
+        whole transfer so eviction/owner-delete can't yank it mid-stream);
+        the peer releases via fetch_object_done or by disconnecting."""
+        oid = ObjectID(p["object_id"])
+        try:
+            buf = self.store.get_buffer(oid, timeout_ms=0)
+        except Exception:
+            return None
+        size = len(buf)
+        del buf
+        key = (conn, oid)
+        if key in self._transfer_pins:
+            self.store.release(oid)  # already pinned by this peer
+        else:
+            self._transfer_pins[key] = True
+        return {"size": size}
+
+    def _release_transfer_pin(self, conn, oid: ObjectID):
+        if self._transfer_pins.pop((conn, oid), None):
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
+
+    async def rpc_fetch_object_done(self, conn, p):
+        self._release_transfer_pin(conn, ObjectID(p["object_id"]))
+        return True
+
+    async def rpc_fetch_object_chunk(self, conn, p):
+        oid = ObjectID(p["object_id"])
+        try:
+            buf = self.store.get_buffer(oid, timeout_ms=0)
+        except Exception:
+            return None
+        try:
+            off, length = p["offset"], p["length"]
+            return bytes(buf[off : off + length])
+        finally:
+            del buf
+            self.store.release(oid)
+
+    async def rpc_fetch_object(self, conn, p):
+        """Single-frame fetch for objects at or below one chunk."""
+        oid = ObjectID(p["object_id"])
+        try:
+            buf = self.store.get_buffer(oid, timeout_ms=0)
+        except Exception:
+            return None
+        try:
+            return bytes(buf)
+        finally:
+            del buf
+            self.store.release(oid)
 
     async def stop(self):
         self._stopping = True

@@ -1,0 +1,142 @@
+"""Inter-node object transfer + wait hardening tests
+(ref test strategy: python/ray/tests/test_object_manager.py)."""
+
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+MB = 1024 * 1024
+
+
+@pytest.fixture()
+def two_node_core():
+    """Driver attached to node A; node B has the 'bee' resource."""
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.core_client import CoreClient
+    from ray_tpu.utils import rpc as _rpc
+
+    io = _rpc.EventLoopThread()
+    cluster = Cluster(io=io)
+    node_a = cluster.add_node(num_cpus=2.0)
+    cluster.add_node(num_cpus=2.0, resources={"bee": 2.0})
+    core = CoreClient(loop=io.loop)
+    io.run(core.connect(cluster.gcs_address, node_a.server.address))
+    old = _api._core
+    _api._core = None
+    yield core, cluster, io
+    _api._core = old
+    try:
+        io.run(core.close(), timeout=10)
+    except Exception:
+        pass
+    cluster.shutdown()
+    io.stop()
+
+
+def _produce_remote(core, nbytes, fill=1):
+    def produce(n, f):
+        import numpy as np
+
+        return np.full(n, f, dtype=np.uint8)
+
+    ref = core.submit_task(produce, (nbytes, fill), {},
+                           resources={"CPU": 1.0, "bee": 1.0})
+    ready, _ = core._run_sync(core.wait_async([ref], 1, 120, False))
+    assert ready
+    return ref
+
+
+def test_chunked_transfer_correctness(two_node_core):
+    """A 64MB object (16 chunks at the 4MB default) crosses nodes intact."""
+    core, cluster, io = two_node_core
+    ref = _produce_remote(core, 64 * MB, fill=7)
+    val = core._run_sync(core.get_async([ref], 120), timeout=130)[0]
+    assert val.nbytes == 64 * MB
+    assert int(val[0]) == 7 and int(val[-1]) == 7
+    assert int(val.sum()) == 7 * 64 * MB
+
+
+def test_chunked_transfer_bounded_memory(two_node_core):
+    """Transfer transients stay at chunk x window, not object size: pulling
+    64MB must allocate far less than the object in Python-heap transients
+    (the payload lands directly in shm)."""
+    core, cluster, io = two_node_core
+    ref = _produce_remote(core, 64 * MB, fill=3)
+
+    tracemalloc.start()
+    val = core._run_sync(core.get_async([ref], 120), timeout=130)[0]
+    current, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert int(val[0]) == 3
+    del val
+    # window(4) x chunk(4MB) x sender+receiver framing ~= 32MB upper bound;
+    # the old whole-blob path peaked at >= 2x object size (128MB+)
+    assert peak < 48 * MB, f"transfer transients too large: peak={peak / MB:.0f}MB"
+
+
+def test_concurrent_pulls_coalesce(two_node_core):
+    """N concurrent gets of one remote object trigger one transfer."""
+    core, cluster, io = two_node_core
+    ref = _produce_remote(core, 32 * MB, fill=9)
+
+    async def many():
+        import asyncio
+
+        return await asyncio.gather(*(core.get_async([ref], 120) for _ in range(8)))
+
+    results = core._run_sync(many(), timeout=130)
+    assert all(int(v[0][0]) == 9 for v in results)
+
+
+def test_wait_event_driven_latency(two_node_core):
+    """wait() wakes promptly when a borrowed ref completes — the readiness
+    push arrives from the owner, not a probe poll."""
+    core, cluster, io = two_node_core
+
+    def slow():
+        import time as _t
+
+        _t.sleep(1.0)
+        return 42
+
+    ref = core.submit_task(slow, (), {}, resources={"CPU": 1.0, "bee": 1.0})
+    t0 = time.monotonic()
+    ready, pending = core._run_sync(core.wait_async([ref], 1, 30, False), timeout=40)
+    elapsed = time.monotonic() - t0
+    assert ready and not pending
+    assert 0.5 < elapsed < 5.0
+
+
+def test_wait_many_refs():
+    """wait over many refs completes without per-ref poll storms."""
+    ray_tpu.init(num_cpus=16)
+    try:
+
+        @ray_tpu.remote
+        def quick(i):
+            return i
+
+        refs = [quick.remote(i) for i in range(200)]
+        ready, pending = ray_tpu.wait(refs, num_returns=200, timeout=120)
+        assert len(ready) == 200 and not pending
+
+        # partial wait: ask for 1 of a mixed set, get it fast
+        @ray_tpu.remote
+        def never():
+            import time as _t
+
+            _t.sleep(30)
+
+        slow_ref = never.remote()
+        fast_ref = quick.remote(1)
+        t0 = time.monotonic()
+        ready, pending = ray_tpu.wait([slow_ref, fast_ref], num_returns=1, timeout=30)
+        assert ready == [fast_ref] and pending == [slow_ref]
+        assert time.monotonic() - t0 < 10
+    finally:
+        ray_tpu.shutdown()
