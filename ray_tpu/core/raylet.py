@@ -609,22 +609,26 @@ class Raylet:
                 return True
             buf = self.store.create(oid, size)
             try:
-                offsets = list(range(0, size, chunk))
-                for i in range(0, len(offsets), window):
-                    batch = offsets[i : i + window]
-                    parts = await asyncio.gather(*(
-                        c.call(
+                # true sliding window: `window` chunk requests always in
+                # flight (a barriered gather per batch would idle the link
+                # for a full RTT between batches)
+                sem = asyncio.Semaphore(window)
+
+                async def fetch_one(off: int):
+                    async with sem:
+                        part = await c.call(
                             "fetch_object_chunk",
                             {"object_id": oid.binary(), "offset": off,
                              "length": min(chunk, size - off)},
                             timeout=self.cfg.rpc_connect_timeout_s,
                         )
-                        for off in batch
-                    ))
-                    for off, part in zip(batch, parts):
-                        if part is None:
-                            raise rpc.RpcError(f"holder lost {oid} mid-transfer")
-                        buf[off : off + len(part)] = part
+                    if part is None:
+                        raise rpc.RpcError(f"holder lost {oid} mid-transfer")
+                    buf[off : off + len(part)] = part
+
+                await asyncio.gather(
+                    *(fetch_one(off) for off in range(0, size, chunk))
+                )
                 self.store.seal(oid)
                 return True
             except Exception:

@@ -1,0 +1,34 @@
+"""ray_tpu.rllib — reinforcement learning on the ray_tpu runtime.
+
+TPU-native counterpart of RLlib's new API stack (ref: rllib/):
+- core: functional jax policy modules (rl_module.py role)
+- env_runner: gymnasium sampling actors (single_agent_env_runner.py:68)
+- learner: jitted PPO updates + learner group (learner_group.py:100)
+- ppo: PPOConfig builder + Algorithm driver (algorithms/ppo/ppo.py:362)
+
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2)
+            .build())
+    for _ in range(10):
+        print(algo.train()["episode_return_mean"])
+"""
+from ray_tpu.rllib.core import policy_init, policy_logits, sample_action, value_fn
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.learner import Learner, compute_gae, make_ppo_update
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = [
+    "EnvRunner",
+    "Learner",
+    "PPO",
+    "PPOConfig",
+    "compute_gae",
+    "make_ppo_update",
+    "policy_init",
+    "policy_logits",
+    "sample_action",
+    "value_fn",
+]

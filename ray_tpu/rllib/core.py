@@ -1,0 +1,61 @@
+"""RLModule-equivalent: pure-jax policy/value networks.
+
+TPU-native counterpart of the reference RLModule layer (ref:
+rllib/core/rl_module/rl_module.py, torch default impls
+core/rl_module/torch/) — here a functional jax pytree + jitted forward
+fns instead of torch nn.Modules: params are plain dicts that ship through
+the object store and allreduce cleanly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mlp_init(key, sizes: list[int]) -> list[dict]:
+    params = []
+    for i, (d_in, d_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(sub, (d_in, d_out)) * np.sqrt(2.0 / d_in),
+            "b": jnp.zeros(d_out),
+        })
+    return params
+
+
+def mlp_apply(params: list[dict], x, activate_last: bool = False):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+def policy_init(key, obs_dim: int, n_actions: int, hidden: int = 64) -> dict:
+    """Separate policy and value heads (the reference's default PPO module
+    shape)."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "pi": mlp_init(k1, [obs_dim, hidden, hidden, n_actions]),
+        "vf": mlp_init(k2, [obs_dim, hidden, hidden, 1]),
+    }
+
+
+def policy_logits(params: dict, obs):
+    return mlp_apply(params["pi"], obs)
+
+
+def value_fn(params: dict, obs):
+    return mlp_apply(params["vf"], obs)[..., 0]
+
+
+@jax.jit
+def sample_action(params: dict, obs, key):
+    """Categorical sample + logp + value in one jitted call (the env-runner
+    hot path)."""
+    logits = policy_logits(params, obs)
+    action = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), action]
+    value = value_fn(params, obs)
+    return action, logp, value

@@ -1,0 +1,91 @@
+"""EnvRunner: actor that samples episodes with the current policy.
+
+TPU-native counterpart of the reference env-runner layer (ref:
+rllib/env/single_agent_env_runner.py:68 sample :149, env_runner_group.py:71
+sync_weights :570): gymnasium vector envs stepped with a jitted
+sample_action; weights arrive by broadcast from the learner group.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int = 1, seed: int = 0,
+                 env_config: dict | None = None):
+        import gymnasium as gym
+
+        from ray_tpu.utils.device import configure_jax
+
+        configure_jax()
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: gym.make(env_name, **(env_config or {}))
+             for i in range(num_envs)]
+        )
+        self.num_envs = num_envs
+        self.seed = seed
+        self._rng_counter = 0
+        self.params = None
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._ep_returns = np.zeros(num_envs)
+        self.completed_returns: list[float] = []
+
+    def set_weights(self, params) -> bool:
+        self.params = params
+        return True
+
+    def sample(self, num_steps: int) -> dict:
+        """Collect num_steps per env; returns flat rollout arrays with
+        bootstrap values for GAE (computed learner-side)."""
+        import jax
+
+        from ray_tpu.rllib.core import sample_action, value_fn
+
+        assert self.params is not None, "set_weights before sample"
+        obs_l, act_l, logp_l, val_l, rew_l, done_l = [], [], [], [], [], []
+        for _ in range(num_steps):
+            self._rng_counter += 1
+            key = jax.random.PRNGKey(self.seed * 1_000_003 + self._rng_counter)
+            action, logp, value = sample_action(self.params, self.obs, key)
+            action = np.asarray(action)
+            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            obs_l.append(self.obs)
+            act_l.append(action)
+            logp_l.append(np.asarray(logp))
+            val_l.append(np.asarray(value))
+            rew_l.append(np.asarray(reward, dtype=np.float32))
+            done_l.append(done)
+            self._ep_returns += reward
+            for i, d in enumerate(done):
+                if d:
+                    self.completed_returns.append(float(self._ep_returns[i]))
+                    self._ep_returns[i] = 0.0
+            self.obs = next_obs
+        last_value = np.asarray(value_fn(self.params, self.obs))
+        return {
+            "obs": np.stack(obs_l),          # [T, N, obs_dim]
+            "actions": np.stack(act_l),      # [T, N]
+            "logp": np.stack(logp_l),
+            "values": np.stack(val_l),
+            "rewards": np.stack(rew_l),
+            "dones": np.stack(done_l),
+            "last_value": last_value,        # [N]
+        }
+
+    def episode_metrics(self) -> dict:
+        rets = self.completed_returns
+        self.completed_returns = []
+        if not rets:
+            return {"episodes": 0}
+        return {
+            "episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+        }
+
+    def obs_and_action_space(self) -> tuple[int, int]:
+        return (
+            int(np.prod(self.envs.single_observation_space.shape)),
+            int(self.envs.single_action_space.n),
+        )

@@ -1,0 +1,180 @@
+"""PPO learner + learner group.
+
+TPU-native counterpart of the reference learner stack (ref:
+rllib/core/learner/learner.py:107 grads :170, learner_group.py:100
+update :234 — remote learner actors with DDP). The update is one jitted
+function (GAE + clipped-surrogate PPO over minibatch epochs via lax.scan);
+multi-learner data parallelism allreduces gradients through
+ray_tpu.collective (XLA collectives on TPU meshes, the cpu fake in tests).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_gae(rollout: dict, gamma: float, lam: float) -> dict:
+    """Flatten [T, N] rollouts into GAE advantages + returns (numpy; runs
+    once per batch on host — the heavy math stays in the jitted update)."""
+    rewards, values, dones = rollout["rewards"], rollout["values"], rollout["dones"]
+    T, N = rewards.shape
+    adv = np.zeros((T, N), dtype=np.float32)
+    last_adv = np.zeros(N, dtype=np.float32)
+    next_value = rollout["last_value"]
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_adv = delta + gamma * lam * nonterminal * last_adv
+        adv[t] = last_adv
+        next_value = values[t]
+    returns = adv + values
+    flat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+    return {
+        "obs": flat(rollout["obs"]).astype(np.float32),
+        "actions": flat(rollout["actions"]).astype(np.int32),
+        "logp_old": flat(rollout["logp"]).astype(np.float32),
+        "advantages": flat(adv).astype(np.float32),
+        "returns": flat(returns).astype(np.float32),
+    }
+
+
+def make_ppo_update(clip: float, vf_coeff: float, entropy_coeff: float,
+                    lr: float, epochs: int, minibatches: int):
+    """Build the jitted multi-epoch PPO update (ref: ppo.py training_step
+    :388 + torch_learner grads, fused here into one compiled fn)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.core import policy_logits, value_fn
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(params, mb):
+        logits = policy_logits(params, mb["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = logp_all[jnp.arange(mb["actions"].shape[0]), mb["actions"]]
+        ratio = jnp.exp(logp - mb["logp_old"])
+        adv = mb["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg = -jnp.minimum(ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+        v = value_fn(params, mb["obs"])
+        vf = ((v - mb["returns"]) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        return pg + vf_coeff * vf - entropy_coeff * entropy, (pg, vf, entropy)
+
+    def update(params, opt_state, batch, perm_key):
+        n = batch["obs"].shape[0]
+        mb_size = n // minibatches
+
+        def epoch_step(carry, key):
+            params, opt_state = carry
+            perm = jax.random.permutation(key, n)
+
+            def mb_step(carry, i):
+                params, opt_state = carry
+                idx = jax.lax.dynamic_slice_in_dim(perm, i * mb_size, mb_size)
+                mb = {k: v[idx] for k, v in batch.items()}
+                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            carry, losses = jax.lax.scan(
+                mb_step, (params, opt_state), jnp.arange(minibatches)
+            )
+            return carry, losses.mean()
+
+        keys = jax.random.split(perm_key, epochs)
+        (params, opt_state), losses = jax.lax.scan(
+            epoch_step, (params, opt_state), keys
+        )
+        return params, opt_state, losses.mean()
+
+    return jax.jit(update), optimizer
+
+
+class Learner:
+    """Actor hosting one PPO learner replica (ref: learner.py:107).
+    With world_size > 1, replicas sync after each local update by
+    averaging BOTH params and float optimizer state (Adam moments) via
+    the collective backend — integer state (step counts) stays local
+    since schedules are identical across ranks."""
+
+    def __init__(self, rank: int, world_size: int, config: dict,
+                 group_name: str | None = None):
+        import jax
+
+        from ray_tpu.utils.device import configure_jax
+
+        configure_jax()
+        self.rank = rank
+        self.world_size = world_size
+        self.config = config
+        self.group_name = group_name
+        if world_size > 1:
+            from ray_tpu import collective
+
+            collective.init_collective_group(
+                world_size, rank, backend=config.get("collective_backend", "cpu"),
+                group_name=group_name or "rl_learners",
+            )
+        key = jax.random.PRNGKey(config.get("seed", 0))
+        from ray_tpu.rllib.core import policy_init
+
+        self.params = policy_init(
+            key, config["obs_dim"], config["n_actions"], config.get("hidden", 64)
+        )
+        self._update, optimizer = make_ppo_update(
+            clip=config.get("clip", 0.2),
+            vf_coeff=config.get("vf_coeff", 0.5),
+            entropy_coeff=config.get("entropy_coeff", 0.01),
+            lr=config.get("lr", 3e-4),
+            epochs=config.get("epochs", 4),
+            minibatches=config.get("minibatches", 4),
+        )
+        self.opt_state = optimizer.init(self.params)
+        self._step = 0
+
+    def get_weights(self):
+        return self.params
+
+    def update(self, rollouts: list[dict]) -> dict:
+        """One training step over this learner's share of rollouts. A rank
+        with an empty shard still participates in the sync (every rank must
+        enter the collective or the group deadlocks)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        loss = 0.0
+        samples = 0
+        if rollouts:
+            batches = [
+                compute_gae(r, self.config.get("gamma", 0.99),
+                            self.config.get("lam", 0.95))
+                for r in rollouts
+            ]
+            batch = {k: np.concatenate([b[k] for b in batches]) for k in batches[0]}
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            self._step += 1
+            key = jax.random.PRNGKey(self.config.get("seed", 0) * 7919 + self._step)
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch, key
+            )
+            loss = float(loss)
+            samples = int(batch["obs"].shape[0])
+        if self.world_size > 1:
+            from ray_tpu import collective
+
+            group = self.group_name or "rl_learners"
+
+            def sync(leaf):
+                # float state (params + Adam moments) averages across
+                # ranks; integer state (step counts) is rank-identical
+                if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                    return collective.allreduce(leaf, group_name=group) / self.world_size
+                return leaf
+
+            self.params = jax.tree_util.tree_map(sync, self.params)
+            self.opt_state = jax.tree_util.tree_map(sync, self.opt_state)
+        return {"loss": loss, "samples": samples}

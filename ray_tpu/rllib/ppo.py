@@ -1,0 +1,190 @@
+"""PPO algorithm: config builder + training driver.
+
+TPU-native counterpart of the reference algorithm layer (ref:
+rllib/algorithms/algorithm.py:207 step :986 training_step :2004,
+algorithm_config.py builder, ppo/ppo.py:362). One train() iteration:
+parallel env-runner sampling -> learner-group update -> weight sync,
+with episode metrics aggregated across runners.
+"""
+from __future__ import annotations
+
+import time
+
+import ray_tpu
+
+
+class PPOConfig:
+    """Builder-style config (ref: algorithm_config.py)."""
+
+    def __init__(self):
+        self.env_name: str | None = None
+        self.env_config: dict = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 128
+        self.num_learners = 1
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lam = 0.95
+        self.clip = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.epochs = 4
+        self.minibatches = 4
+        self.hidden = 64
+        self.seed = 0
+        self.collective_backend = "cpu"
+
+    def environment(self, env: str, env_config: dict | None = None) -> "PPOConfig":
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners: int | None = None,
+                    num_envs_per_env_runner: int | None = None,
+                    rollout_fragment_length: int | None = None) -> "PPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, num_learners: int | None = None) -> "PPOConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def training(self, *, lr=None, gamma=None, lam=None, clip=None,
+                 vf_coeff=None, entropy_coeff=None, epochs=None,
+                 minibatches=None, hidden=None) -> "PPOConfig":
+        for name, val in (("lr", lr), ("gamma", gamma), ("lam", lam),
+                          ("clip", clip), ("vf_coeff", vf_coeff),
+                          ("entropy_coeff", entropy_coeff), ("epochs", epochs),
+                          ("minibatches", minibatches), ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "PPO":
+        if self.env_name is None:
+            raise ValueError("PPOConfig.environment(...) is required")
+        return PPO(self)
+
+
+class PPO:
+    """(ref: algorithms/algorithm.py Algorithm; also usable as a Tune
+    trainable via PPO.as_trainable)."""
+
+    def __init__(self, config: PPOConfig):
+        from ray_tpu.rllib.env_runner import EnvRunner
+        from ray_tpu.rllib.learner import Learner
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        RunnerCls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            RunnerCls.options(num_cpus=0.5).remote(
+                config.env_name, config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, env_config=config.env_config,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim, n_actions = ray_tpu.get(
+            self.runners[0].obs_and_action_space.remote(), timeout=120
+        )
+        learner_cfg = {
+            "obs_dim": obs_dim,
+            "n_actions": n_actions,
+            "hidden": config.hidden,
+            "lr": config.lr,
+            "gamma": config.gamma,
+            "lam": config.lam,
+            "clip": config.clip,
+            "vf_coeff": config.vf_coeff,
+            "entropy_coeff": config.entropy_coeff,
+            "epochs": config.epochs,
+            "minibatches": config.minibatches,
+            "seed": config.seed,
+            "collective_backend": config.collective_backend,
+        }
+        LearnerCls = ray_tpu.remote(Learner)
+        group = f"rl_learners_{id(self)}"
+        self.learners = [
+            LearnerCls.options(num_cpus=1.0, max_concurrency=2).remote(
+                rank, config.num_learners, learner_cfg, group
+            )
+            for rank in range(config.num_learners)
+        ]
+        self._iteration = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        weights_ref = self.learners[0].get_weights.remote()
+        weights = ray_tpu.get(weights_ref, timeout=300)
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners], timeout=120)
+
+    def train(self) -> dict:
+        """One iteration (ref: Algorithm.step :986): sample in parallel,
+        shard rollouts across learners, update, sync."""
+        t0 = time.monotonic()
+        frag = self.config.rollout_fragment_length
+        rollout_refs = [r.sample.remote(frag) for r in self.runners]
+        rollouts = ray_tpu.get(rollout_refs, timeout=600)
+        n_learn = len(self.learners)
+        shards = [rollouts[i::n_learn] for i in range(n_learn)]
+        # every learner participates (empty shards still join the sync)
+        results = ray_tpu.get(
+            [ln.update.remote(shard) for ln, shard in zip(self.learners, shards)],
+            timeout=600,
+        )
+        results = [r for r in results if r["samples"] > 0]
+        self._sync_weights()
+        metrics_list = ray_tpu.get(
+            [r.episode_metrics.remote() for r in self.runners], timeout=120
+        )
+        episodes = sum(m.get("episodes", 0) for m in metrics_list)
+        means = [m["episode_return_mean"] for m in metrics_list
+                 if "episode_return_mean" in m]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": sum(means) / len(means) if means else float("nan"),
+            "episodes_this_iter": episodes,
+            "loss": sum(r["loss"] for r in results) / len(results),
+            "num_env_steps_sampled": frag
+            * self.config.num_envs_per_runner
+            * self.config.num_env_runners,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    def get_weights(self):
+        return ray_tpu.get(self.learners[0].get_weights.remote(), timeout=120)
+
+    def stop(self):
+        for a in self.runners + self.learners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+    @classmethod
+    def as_trainable(cls, config: PPOConfig, stop_iters: int = 10):
+        """Adapter for Tune (ref: Algorithm is-a Trainable)."""
+
+        def trainable(tune_config: dict):
+            from ray_tpu import tune
+
+            cfg = config
+            if "lr" in tune_config:
+                cfg = cfg.training(lr=tune_config["lr"])
+            algo = cfg.build()
+            try:
+                for _ in range(stop_iters):
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

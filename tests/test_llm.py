@@ -1,0 +1,117 @@
+"""LLM tests: KV-cache decode parity vs full recompute, ragged batching,
+serve deployment, dataset batch inference (ref test strategy:
+python/ray/llm tests — engine correctness + serving integration)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.models.llama import LlamaConfig, llama_forward, llama_init
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _greedy_full_recompute(params, cfg, prompt, max_new):
+    """Reference decoder: re-run the full forward per step (no cache)."""
+    import jax.numpy as jnp
+
+    toks = list(prompt)
+    for _ in range(max_new):
+        logits, _ = llama_forward(params, jnp.asarray([toks], dtype=jnp.int32), cfg)
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def test_kv_cache_decode_matches_full_recompute(tiny):
+    """The defining correctness property: cached incremental decode must
+    produce exactly the greedy tokens of full recomputation."""
+    from ray_tpu.llm import generate
+
+    cfg, params = tiny
+    prompt = [5, 17, 42, 7]
+    expected = _greedy_full_recompute(params, cfg, prompt, 8)
+    got = generate(params, cfg, [prompt], max_new_tokens=8, temperature=0.0)[0]
+    assert got == expected, (got, expected)
+
+
+def test_ragged_batch_matches_single(tiny):
+    """Left-padded ragged batching must not change any sequence's output."""
+    from ray_tpu.llm import generate
+
+    cfg, params = tiny
+    prompts = [[5, 17, 42, 7], [3, 9], [11, 2, 8]]
+    singles = [
+        generate(params, cfg, [p], max_new_tokens=6, temperature=0.0)[0]
+        for p in prompts
+    ]
+    batched = generate(params, cfg, prompts, max_new_tokens=6, temperature=0.0)
+    assert batched == singles
+
+
+def test_sampled_generation_seeds(tiny):
+    from ray_tpu.llm import generate
+
+    cfg, params = tiny
+    a = generate(params, cfg, [[1, 2, 3]], max_new_tokens=8, temperature=1.0, seed=1)
+    b = generate(params, cfg, [[1, 2, 3]], max_new_tokens=8, temperature=1.0, seed=1)
+    c = generate(params, cfg, [[1, 2, 3]], max_new_tokens=8, temperature=1.0, seed=2)
+    assert a == b  # deterministic under a seed
+    assert all(0 <= t < cfg.vocab_size for t in a[0])
+    assert a != c or True  # different seeds usually differ; never invalid
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=16)
+    yield ray_tpu
+    from ray_tpu import serve
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_llm_serve_deployment_batches(rt, tiny):
+    """Concurrent requests coalesce into one batched decode
+    (ref: serve/llm LLMServer batching)."""
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_deployment
+
+    cfg, params = tiny
+    app = build_llm_deployment(cfg, params=params, max_batch_size=4)
+    handle = serve.run(app, name="llm", timeout_s=240)
+    refs = [
+        handle.remote({"prompt_tokens": [1, 2, 3, i], "max_tokens": 4})
+        for i in range(8)
+    ]
+    results = ray_tpu.get(refs, timeout=300)
+    assert all(len(r["completion_tokens"]) == 4 for r in results)
+    assert all(0 <= t < cfg.vocab_size for r in results for t in r["completion_tokens"])
+    # at least one request observed a coalesced batch
+    assert max(r["usage"]["batch_size"] for r in results) > 1
+    serve.delete("llm")
+
+
+def test_batch_inference_over_dataset(rt, tiny):
+    """Data-LLM processor: dataset of prompts -> dataset of completions
+    (ref: llm/_internal/batch processors on Ray Data)."""
+    from ray_tpu import data
+    from ray_tpu.llm import build_llm_processor
+
+    cfg, params = tiny
+    ds = data.from_items([
+        {"prompt_tokens": [1, 2, 3], "id": i} for i in range(12)
+    ])
+    processor = build_llm_processor(cfg, params=params, batch_size=4,
+                                    max_new_tokens=3)
+    out = processor(ds).take_all()
+    assert len(out) == 12
+    assert all(len(row["completion_tokens"]) == 3 for row in out)
+    # same prompt -> same greedy completion everywhere
+    assert len({tuple(row["completion_tokens"]) for row in out}) == 1

@@ -1,0 +1,132 @@
+"""RL stack tests: GAE math, jitted PPO update, distributed PPO e2e
+(ref test strategy: rllib/algorithms/ppo/tests/test_ppo.py — learning on
+CartPole at test scale)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=16)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_compute_gae_shapes_and_values():
+    from ray_tpu.rllib import compute_gae
+
+    T, N = 4, 2
+    rollout = {
+        "obs": np.zeros((T, N, 3), dtype=np.float32),
+        "actions": np.zeros((T, N), dtype=np.int64),
+        "logp": np.zeros((T, N), dtype=np.float32),
+        "values": np.zeros((T, N), dtype=np.float32),
+        "rewards": np.ones((T, N), dtype=np.float32),
+        "dones": np.zeros((T, N), dtype=bool),
+        "last_value": np.zeros(N, dtype=np.float32),
+    }
+    batch = compute_gae(rollout, gamma=1.0, lam=1.0)
+    assert batch["obs"].shape == (T * N, 3)
+    # undiscounted, zero values: advantage at t = sum of future rewards
+    assert np.allclose(batch["advantages"].reshape(T, N)[0], 4.0)
+    assert np.allclose(batch["advantages"].reshape(T, N)[-1], 1.0)
+
+    # episode boundary cuts the bootstrap
+    rollout["dones"][1] = True
+    batch = compute_gae(rollout, gamma=1.0, lam=1.0)
+    assert np.allclose(batch["advantages"].reshape(T, N)[0], 2.0)
+
+
+def test_ppo_update_improves_objective():
+    """The jitted update moves the policy toward advantaged actions."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import make_ppo_update, policy_init, policy_logits
+
+    key = jax.random.PRNGKey(0)
+    params = policy_init(key, obs_dim=4, n_actions=2, hidden=16)
+    update, optimizer = make_ppo_update(
+        clip=0.2, vf_coeff=0.5, entropy_coeff=0.0, lr=1e-2, epochs=4, minibatches=2
+    )
+    opt_state = optimizer.init(params)
+    n = 64
+    obs = jnp.asarray(np.random.RandomState(0).randn(n, 4), dtype=jnp.float32)
+    # action 0 taken with positive advantage, action 1 with negative —
+    # (constant advantages would normalize to zero inside the loss)
+    actions = jnp.asarray(np.arange(n) % 2, dtype=jnp.int32)
+    advantages = jnp.where(actions == 0, 1.0, -1.0)
+    batch = {
+        "obs": obs,
+        "actions": actions,
+        "logp_old": jnp.log(jnp.full(n, 0.5)),
+        "advantages": advantages,
+        "returns": jnp.ones(n),
+    }
+    p0 = jax.nn.softmax(policy_logits(params, obs))[:, 0].mean()
+    for i in range(5):
+        params, opt_state, loss = update(params, opt_state, batch, jax.random.PRNGKey(i))
+    p1 = jax.nn.softmax(policy_logits(params, obs))[:, 0].mean()
+    assert float(p1) > float(p0) + 0.1, (float(p0), float(p1))
+
+
+def test_ppo_learns_cartpole(rt):
+    """Distributed e2e: 2 env-runner actors + 1 learner actor; mean return
+    must clearly improve over ~8 iterations."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=128)
+        .training(lr=1e-3, minibatches=4, epochs=4, hidden=64)
+        .build()
+    )
+    try:
+        first = None
+        best = 0.0
+        for i in range(8):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if first is None and not np.isnan(ret):
+                first = ret
+            if not np.isnan(ret):
+                best = max(best, ret)
+        assert first is not None
+        assert best > max(60.0, first * 1.5), (first, best)
+    finally:
+        algo.stop()
+
+
+def test_multi_learner_group_syncs(rt):
+    """2 learner actors with collective sync (params + Adam moments);
+    empty-shard ranks still join the sync without deadlock."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=3, num_envs_per_env_runner=2,
+                     rollout_fragment_length=32)
+        .learners(num_learners=2)
+        .training(minibatches=2, epochs=2, hidden=32)
+        .build()
+    )
+    try:
+        r1 = algo.train()
+        r2 = algo.train()
+        assert np.isfinite(r1["loss"]) and np.isfinite(r2["loss"])
+        # both learners end in an identical synced state
+        import jax
+
+        w = [ray_tpu.get(ln.get_weights.remote(), timeout=120)
+             for ln in algo.learners]
+        for a, b in zip(jax.tree_util.tree_leaves(w[0]),
+                        jax.tree_util.tree_leaves(w[1])):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    finally:
+        algo.stop()
