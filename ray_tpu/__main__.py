@@ -1,0 +1,4 @@
+"""`python -m ray_tpu <command>` — the CLI entry point."""
+from ray_tpu.scripts import main
+
+main()

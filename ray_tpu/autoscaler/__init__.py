@@ -1,0 +1,20 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+TPU-native counterpart of the reference autoscaler v2 (ref:
+python/ray/autoscaler/v2/ — instance-manager reconciler over a
+NodeProvider). The scaling signal is per-node queued lease demand
+reported through raylet heartbeats; the reconciler adds nodes while
+demand persists and drains idle ones after a timeout. Providers are
+pluggable: LocalSubprocessProvider launches real raylet subprocesses
+(the test/e2e provider), a cloud/TPU-pod provider slots behind the same
+three methods.
+"""
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.node_provider import LocalSubprocessProvider, NodeProvider
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "LocalSubprocessProvider",
+    "NodeProvider",
+]

@@ -1,0 +1,141 @@
+"""Autoscaler reconciler: demand up, idle down.
+
+TPU-native counterpart of the reference v2 reconciler (ref:
+python/ray/autoscaler/v2/instance_manager/reconciler.py): a loop that
+reads the GCS cluster view (resources + queued lease demand from raylet
+heartbeats), launches nodes while demand persists past upscale_delay_s,
+and drains nodes idle past idle_timeout_s down to min_nodes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_nodes: int = 1
+    max_nodes: int = 4
+    upscale_delay_s: float = 1.0
+    idle_timeout_s: float = 10.0
+    poll_interval_s: float = 0.5
+    #: resources for each new node (the provider default if None)
+    node_resources: dict | None = None
+
+
+class Autoscaler:
+    """Runs against a live GCS; drives a NodeProvider."""
+
+    def __init__(self, gcs_address: tuple[str, int], provider,
+                 config: AutoscalerConfig | None = None):
+        self.gcs_address = gcs_address
+        self.provider = provider
+        self.config = config or AutoscalerConfig()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._demand_since: float | None = None
+        # GCS node_id hex -> first time seen idle
+        self._idle_since: dict[str, float] = {}
+        # provider node ids this autoscaler launched (never scales below
+        # nodes it doesn't own)
+        self._launched: list[str] = []
+        self.events: list[dict] = []  # scaling decisions (observability)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="rt-autoscaler")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -------------------------------------------------------------- the loop
+    def _run(self):
+        import logging
+
+        from ray_tpu.utils import rpc
+
+        logger = logging.getLogger("ray_tpu.autoscaler")
+        io = rpc.EventLoopThread(name="rt-autoscale-io")
+        conn = None
+        try:
+            while not self._stop.is_set():
+                try:
+                    if conn is None or conn._closed:
+                        conn = io.run(rpc.connect(*self.gcs_address, timeout=10))
+                    nodes = io.run(conn.call("get_cluster", {}))
+                    self._reconcile(nodes)
+                except Exception as e:
+                    logger.warning("autoscaler reconcile failed: %r", e)
+                    conn = None  # reconnect on the next pass
+                self._stop.wait(self.config.poll_interval_s)
+            if conn is not None:
+                io.run(conn.close())
+        finally:
+            io.stop()
+
+    def _reconcile(self, nodes: list[dict]):
+        cfg = self.config
+        now = time.monotonic()
+        alive = [n for n in nodes if n.get("alive")]
+        alive_pids = {int(n.get("pid", 0)) for n in alive}
+        total_queued = sum(int(n.get("queued_leases", 0)) for n in alive)
+
+        # prune launched nodes whose processes died
+        live_provider = set(self.provider.non_terminated_nodes())
+        self._launched = [l for l in self._launched if l in live_provider]
+        # pending = launched but not yet registered with the GCS: while any
+        # exist, don't launch more (ref: v2 instance-manager pending states)
+        pending = [
+            l for l in self._launched
+            if (self.provider.pid_of(l) or -1) not in alive_pids
+        ]
+
+        # ---- scale up: queued demand nothing alive can absorb
+        if total_queued > 0 and not pending:
+            if self._demand_since is None:
+                self._demand_since = now
+            elif (now - self._demand_since >= cfg.upscale_delay_s
+                  and len(alive) < cfg.max_nodes):
+                node_id = self.provider.create_node(cfg.node_resources)
+                self._launched.append(node_id)
+                self._demand_since = None
+                self.events.append({"ts": time.time(), "action": "up",
+                                    "node": node_id, "queued": total_queued})
+        else:
+            self._demand_since = None
+
+        # ---- scale down: an autoscaler-launched node idle past the timeout
+        if len(alive) <= cfg.min_nodes or not self._launched:
+            self._idle_since = {}
+            return
+        pid_to_provider = {
+            self.provider.pid_of(l): l for l in self._launched
+        }
+        for n in alive:
+            node_pid = int(n.get("pid", 0))
+            provider_id = pid_to_provider.get(node_pid)
+            if provider_id is None:
+                continue  # never touch nodes this autoscaler didn't launch
+            nid = n["node_id"].hex() if hasattr(n["node_id"], "hex") else str(n["node_id"])
+            # idle = full resources available and no queued demand
+            res_t, res_a = n["resources_total"], n["resources_available"]
+            busy = any(res_a.get(k, 0.0) < v - 1e-9 for k, v in res_t.items()
+                       if k != "node") or n.get("queued_leases", 0) > 0
+            if busy:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            if now - first >= cfg.idle_timeout_s:
+                # terminate exactly the node observed idle; one per pass
+                self.provider.terminate_node(provider_id)
+                self._launched.remove(provider_id)
+                self._idle_since.pop(nid, None)
+                self.events.append({"ts": time.time(), "action": "down",
+                                    "node": provider_id})
+                break

@@ -44,6 +44,8 @@ class NodeInfo:
     labels: dict[str, str] = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    queued_leases: int = 0  # demand signal (autoscaler)
+    pid: int = 0
 
     def view(self) -> dict:
         return {
@@ -54,6 +56,8 @@ class NodeInfo:
             "resources_available": dict(self.resources_available),
             "labels": dict(self.labels),
             "alive": self.alive,
+            "queued_leases": self.queued_leases,
+            "pid": self.pid,
         }
 
 
@@ -169,6 +173,7 @@ class GcsServer:
             resources_total=dict(p["resources"]),
             resources_available=dict(p["resources"]),
             labels=p.get("labels", {}),
+            pid=int(p.get("pid", 0)),
         )
         self.nodes[info.node_id] = info
         self.raylet_conns[conn] = info.node_id
@@ -180,6 +185,7 @@ class GcsServer:
         if info is None:
             return {"ok": False}
         info.last_heartbeat = time.monotonic()
+        info.queued_leases = int(p.get("queued_leases", 0))
         if p.get("resources_available") is not None:
             changed = info.resources_available != p["resources_available"]
             info.resources_available = dict(p["resources_available"])

@@ -190,6 +190,7 @@ class Raylet:
                 "store_name": self.store_name,
                 "resources": self.ledger.total,
                 "labels": self.labels,
+                "pid": os.getpid(),
             },
         )
         self.cluster_view = reply["cluster"]
@@ -216,7 +217,11 @@ class Raylet:
             try:
                 await self.gcs.call(
                     "heartbeat",
-                    {"node_id": self.node_id, "resources_available": self.ledger.available},
+                    {"node_id": self.node_id,
+                     "resources_available": self.ledger.available,
+                     # demand signal for the autoscaler (ref: autoscaler v2
+                     # resource-demand reporting)
+                     "queued_leases": len(self._lease_waiters)},
                 )
             except Exception:
                 pass

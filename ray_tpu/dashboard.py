@@ -1,0 +1,122 @@
+"""Dashboard: JSON API + single-page cluster overview.
+
+TPU-native counterpart of the reference dashboard role (ref:
+python/ray/dashboard/ — here a small aiohttp app over the state API
+instead of a React bundle + agent tree):
+
+    GET /               one-page HTML overview (auto-refreshing)
+    GET /api/cluster    nodes + resources
+    GET /api/tasks      latest task states
+    GET /api/actors     actor table
+    GET /api/metrics    aggregated cluster metrics
+    GET /api/timeline   chrome-trace events (load into perfetto)
+"""
+from __future__ import annotations
+
+_PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>
+body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+h1{color:#7fd} h2{color:#adf;margin-top:1.2em} table{border-collapse:collapse}
+td,th{border:1px solid #444;padding:4px 10px;text-align:left}
+.ok{color:#7f7}.bad{color:#f77}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="out">loading…</div>
+<script>
+function esc(v){return String(v ?? '').replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));}
+async function refresh(){
+  const [cluster, tasks, actors, metrics] = await Promise.all([
+    fetch('/api/cluster').then(r=>r.json()),
+    fetch('/api/tasks').then(r=>r.json()),
+    fetch('/api/actors').then(r=>r.json()),
+    fetch('/api/metrics').then(r=>r.json()),
+  ]);
+  let h = '<h2>nodes</h2><table><tr><th>node</th><th>alive</th><th>resources (avail/total)</th><th>queued</th></tr>';
+  for (const n of cluster){
+    const res = Object.keys(n.resources_total).map(k=>
+      `${k}: ${n.resources_available[k] ?? 0}/${n.resources_total[k]}`).join('  ');
+    h += `<tr><td>${esc(n.node_id).slice(0,12)}</td><td class="${n.alive?'ok':'bad'}">${n.alive}</td><td>${esc(res)}</td><td>${n.queued_leases||0}</td></tr>`;
+  }
+  h += '</table><h2>tasks (latest)</h2><table><tr><th>name</th><th>state</th><th>duration</th></tr>';
+  for (const t of tasks.slice(0,30)){
+    h += `<tr><td>${esc(t.name)}</td><td class="${t.state==='FAILED'?'bad':'ok'}">${t.state}</td><td>${t.duration_s?t.duration_s.toFixed(3)+'s':''}</td></tr>`;
+  }
+  h += '</table><h2>actors</h2><table><tr><th>actor</th><th>name</th><th>state</th><th>restarts</th></tr>';
+  for (const a of actors){
+    h += `<tr><td>${esc(a.actor_id).slice(0,12)}</td><td>${esc(a.name||'')}</td><td class="${a.state==='ALIVE'?'ok':'bad'}">${a.state}</td><td>${a.num_restarts}</td></tr>`;
+  }
+  h += '</table><h2>metrics</h2><table><tr><th>metric</th><th>value</th></tr>';
+  for (const [k,m] of Object.entries(metrics)){
+    if (m.type !== 'histogram')
+      for (const [tag,v] of Object.entries(m.values))
+        h += `<tr><td>${esc(k)}${tag==='()'?'':' '+esc(tag)}</td><td>${esc(v)}</td></tr>`;
+  }
+  h += '</table>';
+  document.getElementById('out').innerHTML = h;
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+def build_app():
+    from aiohttp import web
+
+    from ray_tpu import state
+
+    async def index(request):
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    def _json(fn):
+        async def handler(request):
+            import asyncio
+
+            return web.json_response(await asyncio.to_thread(fn))
+
+        return handler
+
+    app = web.Application()
+    app.router.add_get("/", index)
+    app.router.add_get("/api/cluster", _json(lambda: _plain(state.list_nodes())))
+    app.router.add_get("/api/tasks", _json(lambda: _plain(state.list_tasks())))
+    app.router.add_get("/api/actors", _json(lambda: _plain(state.list_actors())))
+    app.router.add_get("/api/metrics", _json(lambda: _plain(state.cluster_metrics())))
+    app.router.add_get("/api/timeline", _json(lambda: state.timeline()))
+    return app
+
+
+def _plain(obj):
+    """IDs and tuples -> JSON-safe."""
+    if isinstance(obj, dict):
+        return {str(k): _plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_plain(v) for v in obj]
+    if hasattr(obj, "hex") and not isinstance(obj, (str, bytes)):
+        return obj.hex()
+    if isinstance(obj, bytes):
+        return obj.hex()
+    return obj
+
+
+def run_dashboard(host: str = "127.0.0.1", port: int = 8265):
+    """Blocking server (the CLI entry; ref: dashboard default port 8265)."""
+    from aiohttp import web
+
+    web.run_app(build_app(), host=host, port=port, print=None)
+
+
+def start_dashboard_async(host: str = "127.0.0.1", port: int = 0):
+    """Start on the caller-provided loop; returns (runner, (host, port))."""
+    import asyncio
+
+    from aiohttp import web
+
+    async def go():
+        runner = web.AppRunner(build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        actual = runner.addresses[0][1] if port == 0 else port
+        return runner, (host, actual)
+
+    return go()

@@ -1,0 +1,301 @@
+"""ray_tpu CLI: cluster lifecycle + state inspection.
+
+TPU-native counterpart of the reference CLI (ref:
+python/ray/scripts/scripts.py:2734 — `ray start/stop/status` plus the
+`ray list/summary/timeline` state commands from util/state/state_cli.py).
+
+    python -m ray_tpu start --head [--num-cpus N] [--autoscale MIN:MAX]
+    python -m ray_tpu start --address HOST:PORT      # join as a new node
+    python -m ray_tpu status  [--address HOST:PORT]
+    python -m ray_tpu list tasks|actors|nodes|objects|pgs
+    python -m ray_tpu summary
+    python -m ray_tpu timeline --output trace.json
+    python -m ray_tpu dashboard [--port 8265]
+    python -m ray_tpu stop
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+SESSION_FILE = os.path.join(tempfile.gettempdir(), "ray_tpu", "session.json")
+
+
+def _save_session(data: dict):
+    os.makedirs(os.path.dirname(SESSION_FILE), exist_ok=True)
+    with open(SESSION_FILE, "w") as f:
+        json.dump(data, f)
+
+
+def _load_session() -> dict | None:
+    try:
+        with open(SESSION_FILE) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None)
+    if addr:
+        return addr
+    sess = _load_session()
+    if sess and sess.get("gcs_address"):
+        return sess["gcs_address"]
+    sys.exit("no running session found; pass --address HOST:PORT or `start --head`")
+
+
+def _connect(address: str):
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+# ------------------------------------------------------------------ commands
+def cmd_start(args):
+    env = dict(os.environ)
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+    # daemon children get log files, NOT the CLI's stdio: inherited pipes
+    # would keep callers capturing our output blocked forever
+    log_dir = os.path.join(tempfile.gettempdir(), "ray_tpu", "logs")
+    os.makedirs(log_dir, exist_ok=True)
+
+    def logf(name):
+        return open(os.path.join(log_dir, f"{name}-{os.getpid()}.log"), "ab")
+
+    pids = []
+    if args.head:
+        tmp = tempfile.mkdtemp(prefix="rt_cli_")
+        addr_file = os.path.join(tmp, "gcs_addr")
+        gcs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.gcs", "--address-file", addr_file,
+             *(("--port", str(args.port)) if args.port else ())],
+            env=env, stdout=logf("gcs"), stderr=subprocess.STDOUT,
+        )
+        pids.append(gcs.pid)
+        deadline = time.monotonic() + 30
+        while not os.path.exists(addr_file):
+            if time.monotonic() > deadline:
+                sys.exit("GCS did not start")
+            time.sleep(0.05)
+        gcs_address = open(addr_file).read().strip()
+    else:
+        if not args.address:
+            sys.exit("start needs --head or --address HOST:PORT")
+        gcs_address = args.address
+
+    raylet_cmd = [
+        sys.executable, "-m", "ray_tpu.core.raylet", "--gcs", gcs_address,
+        "--num-cpus", str(args.num_cpus if args.num_cpus is not None
+                          else (os.cpu_count() or 1)),
+    ]
+    if args.num_tpus:
+        raylet_cmd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        raylet_cmd += ["--resources", args.resources]
+    raylet = subprocess.Popen(raylet_cmd, env=env,
+                              stdout=logf("raylet"), stderr=subprocess.STDOUT)
+    pids.append(raylet.pid)
+
+    autoscaler_note = ""
+    if args.head and args.autoscale:
+        lo, hi = (int(x) for x in args.autoscale.split(":"))
+        mon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts", "_autoscaler_monitor",
+             "--address", gcs_address, "--min-nodes", str(lo), "--max-nodes", str(hi)],
+            env=env, stdout=logf("autoscaler"), stderr=subprocess.STDOUT,
+        )
+        pids.append(mon.pid)
+        autoscaler_note = f", autoscaler {lo}:{hi}"
+
+    if args.head:
+        _save_session({"gcs_address": gcs_address, "pids": pids})
+        print(f"ray_tpu head started at {gcs_address}{autoscaler_note}")
+        print(f"  connect:  ray_tpu.init(address={gcs_address!r})")
+        print(f"  stop:     python -m ray_tpu stop")
+    else:
+        sess = _load_session() or {"gcs_address": gcs_address, "pids": []}
+        sess["pids"] = sess.get("pids", []) + pids
+        _save_session(sess)
+        print(f"ray_tpu node joined {gcs_address}")
+
+
+def cmd_stop(args):
+    sess = _load_session()
+    if not sess:
+        print("no session file; nothing to stop")
+        return
+    for pid in sess.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if not any(_pid_alive(p) for p in sess.get("pids", [])):
+            break
+        time.sleep(0.1)
+    for pid in sess.get("pids", []):
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+    try:
+        os.unlink(SESSION_FILE)
+    except FileNotFoundError:
+        pass
+    print("stopped")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def cmd_status(args):
+    rt = _connect(_resolve_address(args))
+    nodes = rt.nodes()
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"nodes: {len(nodes)}")
+    for n in nodes:
+        nid = n["node_id"].hex() if hasattr(n["node_id"], "hex") else n["node_id"]
+        print(f"  {nid[:12]}  alive={n['alive']}  queued={n.get('queued_leases', 0)}")
+    print("resources (available/total):")
+    for k in sorted(total):
+        print(f"  {k}: {avail.get(k, 0.0):g}/{total[k]:g}")
+    rt.shutdown()
+
+
+def cmd_list(args):
+    from ray_tpu import state
+
+    _connect(_resolve_address(args))
+    fn = {
+        "tasks": state.list_tasks,
+        "actors": state.list_actors,
+        "nodes": state.list_nodes,
+        "objects": state.list_objects,
+        "pgs": state.list_placement_groups,
+    }[args.what]
+    rows = fn()
+    print(json.dumps(rows, indent=2, default=lambda o: o.hex()
+                     if hasattr(o, "hex") else str(o)))
+
+
+def cmd_summary(args):
+    from ray_tpu import state
+
+    _connect(_resolve_address(args))
+    print(json.dumps(state.summary_tasks(), indent=2))
+
+
+def cmd_timeline(args):
+    from ray_tpu import state
+
+    _connect(_resolve_address(args))
+    events = state.timeline(args.output)
+    print(f"wrote {len(events)} trace events to {args.output}")
+
+
+def cmd_dashboard(args):
+    from ray_tpu.dashboard import run_dashboard
+
+    _connect(_resolve_address(args))
+    print(f"dashboard on http://{args.host}:{args.port}")
+    run_dashboard(args.host, args.port)
+
+
+def cmd_autoscaler_monitor(args):
+    """Internal: run the autoscaler reconciler (launched by start --head)."""
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, LocalSubprocessProvider
+
+    host, port = args.address.rsplit(":", 1)
+    provider = LocalSubprocessProvider(args.address)
+    scaler = Autoscaler(
+        (host, int(port)), provider,
+        AutoscalerConfig(min_nodes=args.min_nodes, max_nodes=args.max_nodes),
+    )
+    stop_evt = {"stop": False}
+
+    def _term(signum, frame):
+        stop_evt["stop"] = True
+
+    # `ray_tpu stop` sends SIGTERM: the provider's raylet children must
+    # die with the monitor or they'd orphan against a dead GCS
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    scaler.start()
+    try:
+        while not stop_evt["stop"]:
+            time.sleep(0.2)
+    finally:
+        scaler.stop()
+        provider.shutdown()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head node or join a cluster")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=0.0)
+    p.add_argument("--resources", default="")
+    p.add_argument("--autoscale", default=None, metavar="MIN:MAX")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop the local session")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="cluster nodes + resources")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("what", choices=["tasks", "actors", "nodes", "objects", "pgs"])
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="task summary by name/state")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("timeline", help="export chrome trace")
+    p.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard", help="serve the web dashboard")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("_autoscaler_monitor")
+    p.add_argument("--address", required=True)
+    p.add_argument("--min-nodes", type=int, default=1)
+    p.add_argument("--max-nodes", type=int, default=4)
+    p.set_defaults(fn=cmd_autoscaler_monitor)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""CLI + autoscaler + dashboard tests (ref test strategy:
+python/ray/tests/test_cli.py, autoscaler/v2/tests/)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*args, timeout=120, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+@pytest.fixture()
+def cli_session(tmp_path):
+    """A head started through the real CLI, torn down through the CLI."""
+    env = {"TMPDIR": str(tmp_path)}  # isolate the session file
+    r = _cli("start", "--head", "--num-cpus", "4", env_extra=env)
+    assert r.returncode == 0, r.stderr
+    address = [ln for ln in r.stdout.splitlines() if "started at" in ln][0].split()[-1]
+    yield address, env
+    _cli("stop", env_extra=env)
+
+
+def test_cli_start_status_stop(cli_session):
+    address, env = cli_session
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        r = _cli("status", "--address", address, env_extra=env)
+        if r.returncode == 0 and "nodes: 1" in r.stdout:
+            break
+        time.sleep(0.5)
+    else:
+        pytest.fail(f"status never saw the node: {r.stdout} {r.stderr}")
+    assert "CPU" in r.stdout
+
+    r = _cli("list", "nodes", "--address", address, env_extra=env)
+    assert r.returncode == 0
+    assert len(json.loads(r.stdout)) == 1
+
+
+def test_cli_stop_kills_processes(tmp_path):
+    env = {"TMPDIR": str(tmp_path)}
+    r = _cli("start", "--head", "--num-cpus", "2", env_extra=env)
+    assert r.returncode == 0, r.stderr
+    sess = json.load(open(os.path.join(str(tmp_path), "ray_tpu", "session.json")))
+    pids = sess["pids"]
+    assert all(_alive(p) for p in pids)
+    r = _cli("stop", env_extra=env)
+    assert r.returncode == 0
+    time.sleep(1)
+    assert not any(_alive(p) for p in pids)
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def test_autoscaler_scales_up_and_down():
+    """Demand-driven scale-up past one node's capacity, idle scale-down
+    after (ref: autoscaler v2 reconciler semantics)."""
+    import ray_tpu
+    from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, LocalSubprocessProvider
+
+    ray_tpu.init(num_cpus=2, _in_process=False)
+    try:
+        core = ray_tpu.get_core()
+        # recover the GCS address from the live connection
+        addr = core.gcs.peername
+        gcs_addr = f"{addr[0]}:{addr[1]}"
+        provider = LocalSubprocessProvider(gcs_addr, {"CPU": 2.0})
+        scaler = Autoscaler(
+            (addr[0], addr[1]), provider,
+            AutoscalerConfig(min_nodes=1, max_nodes=3, upscale_delay_s=0.5,
+                             idle_timeout_s=3.0, poll_interval_s=0.25),
+        ).start()
+        try:
+
+            @ray_tpu.remote
+            def slow(i):
+                import time as _t
+
+                _t.sleep(3.0)
+                return i
+
+            # 10 x 1-CPU tasks on a 2-CPU node: demand queues, scaler adds
+            refs = [slow.remote(i) for i in range(10)]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if len(ray_tpu.nodes()) > 1:
+                    break
+                time.sleep(0.3)
+            else:
+                pytest.fail(f"no scale-up: events={scaler.events}")
+            assert ray_tpu.get(refs, timeout=180) == list(range(10))
+            assert any(e["action"] == "up" for e in scaler.events)
+
+            # idle: scales back down toward min_nodes
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if any(e["action"] == "down" for e in scaler.events):
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail(f"no scale-down: events={scaler.events}")
+        finally:
+            scaler.stop()
+            provider.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_dashboard_endpoints():
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard_async
+
+    ray_tpu.init(num_cpus=4)
+    try:
+
+        @ray_tpu.remote
+        def touch():
+            return 1
+
+        assert ray_tpu.get([touch.remote() for _ in range(3)], timeout=60) == [1, 1, 1]
+        time.sleep(1.5)  # task-event flush
+
+        core = ray_tpu.get_core()
+        import asyncio
+
+        runner, (host, port) = asyncio.run_coroutine_threadsafe(
+            start_dashboard_async(), core.loop
+        ).result(30)
+        try:
+            def get(path):
+                with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+                    return r.read()
+
+            assert b"ray_tpu dashboard" in get("/")
+            cluster = json.loads(get("/api/cluster"))
+            assert len(cluster) == 1 and cluster[0]["alive"]
+            tasks = json.loads(get("/api/tasks"))
+            assert any(t["name"] == "touch" for t in tasks)
+            metrics = json.loads(get("/api/metrics"))
+            assert "rt_tasks_submitted" in metrics
+        finally:
+            asyncio.run_coroutine_threadsafe(runner.cleanup(), core.loop).result(10)
+    finally:
+        ray_tpu.shutdown()
