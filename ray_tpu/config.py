@@ -51,6 +51,9 @@ class Config:
     #: hybrid scheduling: prefer local node until this utilization fraction
     #: (ref: hybrid_scheduling_policy.h:50)
     hybrid_threshold: float = 0.5
+    #: concurrent lease requests per scheduling key (pipelined worker
+    #: acquisition under bursts; ref: normal_task_submitter lease pipelining)
+    max_lease_parallelism: int = 8
 
     # --- timeouts / health (ref: gcs_health_check_manager.h:59) ---
     health_check_period_s: float = 1.0

@@ -1,0 +1,300 @@
+"""Workflow authoring + durable execution engine.
+
+TPU-native counterpart of the reference workflow engine (ref:
+python/ray/workflow/workflow_executor.py, step checkpointing
+task_executor.py + workflow_storage.py). Design:
+
+- @workflow.step wraps a function; .bind() builds a static DAG node
+  (same authoring shape as compiled graphs / the reference's DAG API).
+- run(dag, workflow_id) executes steps as ray_tpu tasks in dependency
+  order; every completed step's result is pickled to the storage dir
+  (filesystem — durable across driver and cluster restarts, the
+  reference's default local storage role).
+- resume(workflow_id) reloads the DAG definition itself from storage
+  (cloudpickle) and replays: completed steps short-circuit to their
+  checkpointed results; pending steps execute. Nothing about the
+  original driver process is needed.
+- Step failures retry per-step (max_retries); a failed workflow keeps
+  its partial checkpoints and can resume after the bug/outage is fixed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Callable
+
+try:
+    import cloudpickle
+except ImportError:  # pragma: no cover
+    import pickle as cloudpickle
+
+DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu/workflows")
+
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+
+
+def _storage_root() -> str:
+    return os.environ.get("RAY_TPU_WORKFLOW_STORAGE", DEFAULT_STORAGE)
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage_root(), workflow_id)
+
+
+# ------------------------------------------------------------------ authoring
+class WorkflowStep:
+    """A step definition (ref: workflow step decorator)."""
+
+    def __init__(self, fn: Callable, *, name: str | None = None,
+                 max_retries: int = 0, num_cpus: float = 1.0,
+                 resources: dict | None = None):
+        self.fn = fn
+        self.name = name or fn.__name__
+        self.max_retries = max_retries
+        self.num_cpus = num_cpus
+        self.resources = resources or {}
+
+    def options(self, **kw) -> "WorkflowStep":
+        merged = dict(name=self.name, max_retries=self.max_retries,
+                      num_cpus=self.num_cpus, resources=self.resources)
+        merged.update(kw)
+        return WorkflowStep(self.fn, **merged)
+
+    def bind(self, *args, **kwargs) -> "StepNode":
+        return StepNode(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        return self.fn(*a, **k)  # direct call runs locally (debugging)
+
+
+class StepNode:
+    """DAG node: a step bound to (possibly node-valued) arguments.
+    step_id is assigned by _assign_ids at run time from the DAG's own
+    structure (DFS order), so identical DAGs get identical ids no matter
+    what else the process built before them."""
+
+    def __init__(self, step: WorkflowStep, args: tuple, kwargs: dict):
+        self.step = step
+        self.args = args
+        self.kwargs = kwargs
+        self.step_id: str | None = None
+
+
+def _assign_ids(dag: StepNode) -> None:
+    """Deterministic ids: <name>_<k> by first-visit DFS order over args
+    then kwargs (sorted). Persisted ids in a stored DAG are kept."""
+    counters: dict[str, int] = {}
+    seen: set[int] = set()
+
+    def visit(node: StepNode):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for a in node.args:
+            if isinstance(a, StepNode):
+                visit(a)
+        for k in sorted(node.kwargs):
+            v = node.kwargs[k]
+            if isinstance(v, StepNode):
+                visit(v)
+        if node.step_id is None:
+            counters[node.step.name] = counters.get(node.step.name, 0) + 1
+            node.step_id = f"{node.step.name}_{counters[node.step.name]}"
+
+    visit(dag)
+
+
+def step(fn=None, *, name: str | None = None, max_retries: int = 0,
+         num_cpus: float = 1.0, resources: dict | None = None):
+    """@workflow.step decorator."""
+
+    def wrap(f):
+        return WorkflowStep(f, name=name, max_retries=max_retries,
+                            num_cpus=num_cpus, resources=resources)
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
+
+
+# ------------------------------------------------------------------ storage
+class _Storage:
+    """Filesystem checkpoint layout (ref: workflow_storage.py):
+    <root>/<workflow_id>/{dag.pkl, status.json, steps/<step_id>.pkl}"""
+
+    def __init__(self, workflow_id: str):
+        self.dir = _wf_dir(workflow_id)
+
+    def _ensure_dirs(self):
+        # write paths only: reads of unknown ids must not create phantom
+        # workflow directories that pollute list_all()/resume_all()
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def save_dag(self, dag: StepNode):
+        self._ensure_dirs()
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            cloudpickle.dump(dag, f)
+
+    def load_dag(self) -> StepNode:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def set_status(self, status: str, error: str | None = None):
+        self._ensure_dirs()
+        with open(os.path.join(self.dir, "status.json"), "w") as f:
+            json.dump({"status": status, "error": error, "ts": time.time()}, f)
+
+    def get_status(self) -> dict:
+        try:
+            with open(os.path.join(self.dir, "status.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {"status": "NOT_FOUND"}
+
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self.step_path(step_id))
+
+    def save_step(self, step_id: str, value: Any):
+        self._ensure_dirs()
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self.step_path(step_id))  # atomic: no torn results
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self.step_path(step_id), "rb") as f:
+            return cloudpickle.load(f)
+
+
+# ------------------------------------------------------------------ executor
+def _execute(dag: StepNode, storage: _Storage) -> Any:
+    """DAG execution with checkpoint short-circuiting. Independent
+    branches run in parallel: steps receive upstream ObjectRefs and the
+    runtime's dependency resolution does the waiting; the driver then
+    drains results in submission (topological) order to checkpoint them."""
+    import ray_tpu
+    from ray_tpu.core.ref import ObjectRef
+
+    memo: dict[str, Any] = {}  # step_id -> ObjectRef | checkpointed value
+    order: list[tuple[StepNode, Any]] = []  # submitted, pending checkpoint
+
+    def submit(node: StepNode) -> Any:
+        if node.step_id in memo:
+            return memo[node.step_id]
+        if storage.has_step(node.step_id):
+            value = storage.load_step(node.step_id)  # replay from checkpoint
+            memo[node.step_id] = value
+            return value
+        args = [submit(a) if isinstance(a, StepNode) else a for a in node.args]
+        kwargs = {k: submit(v) if isinstance(v, StepNode) else v
+                  for k, v in node.kwargs.items()}
+        remote_fn = ray_tpu.remote(node.step.fn)
+        ref = remote_fn.options(
+            num_cpus=node.step.num_cpus,
+            resources=node.step.resources or None,
+            max_retries=node.step.max_retries,
+            name=f"wf:{node.step_id}",
+        ).remote(*args, **kwargs)
+        memo[node.step_id] = ref
+        order.append((node, ref))
+        return ref
+
+    out = submit(dag)
+    for node, ref in order:  # topological: deps checkpoint before dependents
+        storage.save_step(node.step_id, ray_tpu.get(ref))
+    if isinstance(out, ObjectRef):
+        return ray_tpu.get(out)
+    return out
+
+
+def _run_to_completion(storage: _Storage, dag: StepNode) -> Any:
+    storage.set_status(RUNNING)
+    try:
+        result = _execute(dag, storage)
+    except Exception as e:
+        storage.set_status(FAILED, error=repr(e))
+        raise
+    # the output checkpoint lands BEFORE the status flip: a crash between
+    # the two leaves a resumable RUNNING workflow, never a SUCCESSFUL one
+    # with no output
+    storage.save_step("__output__", result)
+    storage.set_status(SUCCESSFUL)
+    return result
+
+
+def run(dag: StepNode, *, workflow_id: str | None = None) -> Any:
+    """Execute a workflow DAG durably (ref: api.py run:123)."""
+    import ray_tpu
+
+    if not isinstance(dag, StepNode):
+        raise TypeError("workflow.run takes a bound step: my_step.bind(...)")
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:12]}"
+    storage = _Storage(workflow_id)
+    _assign_ids(dag)
+    storage.save_dag(dag)
+    return _run_to_completion(storage, dag)
+
+
+def resume(workflow_id: str) -> Any:
+    """Resume from checkpoints; the DAG definition comes from storage, so
+    any process can resume any workflow (ref: api.py resume:243)."""
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    storage = _Storage(workflow_id)
+    status = storage.get_status()
+    if status.get("status") == "NOT_FOUND":
+        raise ValueError(f"no workflow {workflow_id!r} in storage")
+    if status.get("status") == SUCCESSFUL:
+        return storage.load_step("__output__")
+    dag = storage.load_dag()
+    return _run_to_completion(storage, dag)
+
+
+def resume_all(include_failed: bool = True) -> list[tuple[str, Any]]:
+    """Resume every non-successful stored workflow (ref: api.py
+    resume_all:502)."""
+    out = []
+    for wf_id in list_all():
+        status = get_status(wf_id)
+        if status == SUCCESSFUL:
+            continue
+        if status == FAILED and not include_failed:
+            continue
+        try:
+            out.append((wf_id, resume(wf_id)))
+        except Exception as e:  # keep going: one bad workflow isn't fatal
+            out.append((wf_id, e))
+    return out
+
+
+def get_status(workflow_id: str) -> str:
+    return _Storage(workflow_id).get_status().get("status", "NOT_FOUND")
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = _Storage(workflow_id)
+    if storage.get_status().get("status") != SUCCESSFUL:
+        raise ValueError(f"workflow {workflow_id!r} has not succeeded")
+    return storage.load_step("__output__")
+
+
+def list_all() -> list[str]:
+    root = _storage_root()
+    try:
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+    except FileNotFoundError:
+        return []
