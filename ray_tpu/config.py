@@ -55,6 +55,12 @@ class Config:
     #: acquisition under bursts; ref: normal_task_submitter lease pipelining)
     max_lease_parallelism: int = 8
 
+    # --- memory protection (ref: memory_monitor.h:52) ---
+    #: fraction of system memory in use that triggers OOM killing;
+    #: <= 0 disables the monitor
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_s: float = 1.0
+
     # --- timeouts / health (ref: gcs_health_check_manager.h:59) ---
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5

@@ -47,6 +47,7 @@ def init(
     num_tpus: float | None = None,
     resources: dict[str, float] | None = None,
     object_store_memory: int | None = None,
+    runtime_env: dict | None = None,
     _in_process: bool = True,
 ) -> None:
     """Bring up (or connect to) a cluster and attach this driver.
@@ -101,7 +102,24 @@ def init(
     core = CoreClient(loop=_io.loop)
     _io.run(core.connect(gcs_addr, raylet_addr), timeout=cfg.rpc_connect_timeout_s + 5)
     _core = core
+    if runtime_env:
+        core.default_runtime_env = _package_runtime_env(core, runtime_env)
     atexit.register(shutdown)
+
+
+def _package_runtime_env(core: CoreClient, env: dict) -> dict:
+    """Zip + upload runtime_env packages once (ref: working_dir.py
+    upload_package_if_needed)."""
+    from ray_tpu.runtime_env import package_runtime_env
+
+    def kv_put(key: str, blob: bytes):
+        core._run_sync(core.gcs.call(
+            "kv_put",
+            {"ns": "runtime_env_packages", "key": key, "value": blob,
+             "overwrite": False},
+        ))
+
+    return package_runtime_env(env, kv_put)
 
 
 
@@ -266,6 +284,7 @@ class RemoteFunction:
             bundle_index=o.get("placement_group_bundle_index", -1),
             scheduling_node=o.get("_scheduling_node"),
             name=o.get("name"),
+            runtime_env=o.get("runtime_env"),
         )
 
     def __call__(self, *a, **k):
@@ -301,6 +320,7 @@ class ActorClass:
             bundle_index=o.get("placement_group_bundle_index", -1),
             get_if_exists=bool(o.get("get_if_exists", False)),
             lifetime=o.get("lifetime"),
+            runtime_env=o.get("runtime_env"),
         )
 
 

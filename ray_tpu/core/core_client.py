@@ -185,6 +185,7 @@ class CoreClient:
 
         self._rc_lock = _threading.Lock()  # counts are bumped off-loop too
         self._closed = False
+        self.default_runtime_env: dict | None = None  # packaged descriptor
         self._bg = aio.TaskGroup()
         self.task_events = _TaskEventBuffer(self)
 
@@ -704,7 +705,8 @@ class CoreClient:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=None, placement_group=None, bundle_index=-1,
-                    scheduling_node=None, name=None) -> list[ObjectRef] | ObjectRef:
+                    scheduling_node=None, name=None,
+                    runtime_env=None) -> list[ObjectRef] | ObjectRef:
         """Synchronous entry (driver thread) or loop-thread entry (nested)."""
         func_id = self._register_function(fn)
         self._task_counter += 1
@@ -723,6 +725,7 @@ class CoreClient:
             "placement_group": placement_group,
             "bundle_index": bundle_index,
             "scheduling_node": scheduling_node,
+            "runtime_env": self._resolve_runtime_env(runtime_env),
         }
         metrics.tasks_submitted.inc()
         self.task_events.emit(task_id=task_id.hex(), name=spec["name"],
@@ -1069,13 +1072,58 @@ class CoreClient:
             pass
 
     # ------------------------------------------------------------- actors
+    def _resolve_runtime_env(self, env):
+        """Per-call envs with raw paths get packaged (and uploaded,
+        synchronously — the task must not race its own package to the
+        worker); already-packaged descriptors and the init() default pass
+        through."""
+        if env is None:
+            return self.default_runtime_env
+        import re as _re
+
+        def is_digest(v):
+            return isinstance(v, str) and _re.fullmatch(r"[0-9a-f]{40}", v)
+
+        wd = env.get("working_dir")
+        mods = env.get("py_modules", ())
+        # a non-digest entry must be a real directory: catch typos at
+        # submission, not as a cryptic package-missing error on the worker
+        for entry in ([wd] if wd else []) + list(mods):
+            if not is_digest(entry) and not os.path.isdir(entry):
+                raise ValueError(
+                    f"runtime_env path {entry!r} is not a directory"
+                )
+        needs_packaging = (wd and os.path.isdir(wd)) or any(
+            os.path.isdir(p) for p in mods
+        )
+        if not needs_packaging:
+            return env
+        if _in_loop(self.loop):
+            raise RuntimeError(
+                "per-call runtime_env with directory paths cannot be "
+                "packaged from the event-loop thread; package it at "
+                "init(runtime_env=...) instead"
+            )
+        from ray_tpu.runtime_env import package_runtime_env
+
+        def kv_put(key, blob):
+            self._run_sync(self.gcs.call(
+                "kv_put",
+                {"ns": "runtime_env_packages", "key": key, "value": blob,
+                 "overwrite": False},
+            ))
+
+        return package_runtime_env(env, kv_put)
+
     def _build_actor_spec(self, cls, args, kwargs, *, num_cpus=1.0, resources=None,
                           name=None, max_restarts=0, max_concurrency=1,
                           placement_group=None, bundle_index=-1,
-                          get_if_exists=False, lifetime=None) -> dict:
+                          get_if_exists=False, lifetime=None,
+                          runtime_env=None) -> dict:
         res = dict(resources or {})
         res.setdefault("CPU", num_cpus)
         return {
+            "runtime_env": self._resolve_runtime_env(runtime_env),
             "actor_id": ActorID.generate(),
             "name": name,
             "class_blob": serialization.ship_dumps(cls),

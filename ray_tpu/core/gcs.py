@@ -95,8 +95,11 @@ class PlacementGroupInfo:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: str | None = None):
         self.cfg = get_config()
+        self.persist_path = persist_path
+        self._dirty = False
         self.server = rpc.RpcServer(host, port)
         self.server.add_routes(self)
         self.server.on_disconnect = self._on_disconnect
@@ -119,6 +122,8 @@ class GcsServer:
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, message: Any):
+        if channel in ("actors", "pgs") or channel.startswith("actor:"):
+            self.mark_dirty()  # actor/pg table changed alongside this event
         dead = []
         for conn in self.subs.get(channel, ()):  # push-based: no long-poll
             try:
@@ -139,6 +144,7 @@ class GcsServer:
         if exists and not p.get("overwrite", True):
             return False
         ns[p["key"]] = p["value"]
+        self.mark_dirty()
         return True
 
     async def rpc_kv_get(self, conn, p):
@@ -149,6 +155,7 @@ class GcsServer:
         return {k: ns.get(k) for k in p["keys"]}
 
     async def rpc_kv_del(self, conn, p):
+        self.mark_dirty()
         return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
 
     async def rpc_kv_exists(self, conn, p):
@@ -162,6 +169,7 @@ class GcsServer:
     # -------------------------------------------------------------------- jobs
     async def rpc_register_job(self, conn, p):
         self.job_counter += 1
+        self.mark_dirty()
         return JobID(self.job_counter.to_bytes(4, "little"))
 
     # ------------------------------------------------------------------- nodes
@@ -176,6 +184,11 @@ class GcsServer:
             pid=int(p.get("pid", 0)),
         )
         self.nodes[info.node_id] = info
+        # a re-registering raylet (GCS-FT reconnect) replaces its old
+        # connection mapping, so the old socket's close is a no-op
+        for old_conn, nid in list(self.raylet_conns.items()):
+            if nid == info.node_id and old_conn is not conn:
+                self.raylet_conns.pop(old_conn, None)
         self.raylet_conns[conn] = info.node_id
         await self.publish("nodes", {"event": "added", "node": info.view()})
         return {"node_id": info.node_id, "cluster": self.cluster_view()}
@@ -531,15 +544,88 @@ class GcsServer:
             for info in list(self.nodes.values()):
                 if info.alive and now - info.last_heartbeat > deadline:
                     await self._mark_node_dead(info.node_id, "health check timeout")
+            # restored ALIVE actors whose node never re-registered after a
+            # GCS restart are dead, not merely unobserved
+            restored_at = getattr(self, "_restored_at", None)
+            if restored_at is not None and now - restored_at > deadline:
+                self._restored_at = None
+                alive_nodes = {nid for nid, n in self.nodes.items() if n.alive}
+                for info in list(self.actors.values()):
+                    if info.state == ALIVE and info.node_id not in alive_nodes:
+                        await self._on_actor_failure(
+                            info, "node lost across GCS restart"
+                        )
+
+    def _restore(self):
+        """Recover durable tables from the snapshot (ref: GCS FT via Redis
+        store_client — here an atomic pickle snapshot). Volatile state
+        (node registry, metrics) is rebuilt by re-registration."""
+        import pickle as _p
+
+        if not self.persist_path or not os.path.exists(self.persist_path):
+            return
+        with open(self.persist_path, "rb") as f:
+            snap = _p.load(f)
+        self.kv = snap.get("kv", {})
+        self.kv.pop("metrics", None)
+        self.job_counter = snap.get("job_counter", 0)
+        self.actors = snap.get("actors", {})
+        self.named_actors = snap.get("named_actors", {})
+        self.pgs = snap.get("pgs", {})
+        self._restored_at = time.monotonic()
+
+    def mark_dirty(self):
+        self._dirty = True
+
+    async def _persist_loop(self):
+        import pickle as _p
+
+        while not self._stopping:
+            await asyncio.sleep(1.0)
+            if not self._dirty:
+                continue
+            self._dirty = False
+            if not self._write_snapshot():
+                self._dirty = True  # keep trying: the write failed
+
+    def _write_snapshot(self) -> bool:
+        import pickle as _p
+
+        try:
+            snap = _p.dumps({
+                "kv": {ns: dict(d) for ns, d in self.kv.items() if ns != "metrics"},
+                "job_counter": self.job_counter,
+                "actors": dict(self.actors),
+                "named_actors": dict(self.named_actors),
+                "pgs": dict(self.pgs),
+            })
+            tmp = self.persist_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(snap)
+            os.replace(tmp, self.persist_path)  # atomic snapshot
+            return True
+        except Exception:
+            return False
 
     async def start(self) -> tuple[str, int]:
+        self._restore()
         addr = await self.server.start()
+        # reconcile restored actor state (ref: GCS FT actor reconstruction):
+        # PENDING actors lost their scheduling coroutine with the old
+        # process — reschedule them now
+        for info in self.actors.values():
+            if info.state == PENDING:
+                self._bg.spawn(self._schedule_actor(info))
         self._bg.spawn(self._health_loop())
+        if self.persist_path:
+            self._bg.spawn(self._persist_loop())
         return addr
 
     async def stop(self):
         self._stopping = True
         await self._bg.cancel_all()
+        if self.persist_path and self._dirty:
+            self._write_snapshot()  # final flush: acknowledged writes survive
         await self.server.stop()
 
 
@@ -562,10 +648,12 @@ def main():
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--address-file", default=None)
+    parser.add_argument("--persist", default=None,
+                        help="snapshot file for durable tables (GCS FT)")
     args = parser.parse_args()
 
     async def run():
-        gcs = GcsServer(args.host, args.port)
+        gcs = GcsServer(args.host, args.port, persist_path=args.persist)
         host, port = await gcs.start()
         line = f"{host}:{port}"
         if args.address_file:

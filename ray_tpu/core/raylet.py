@@ -176,6 +176,14 @@ class Raylet:
         self._transfer_pins: dict[tuple, bool] = {}  # (conn, oid) -> pinned
         self._stopping = False
         self._bg = aio.TaskGroup()
+        self.memory_monitor = None
+        if self.cfg.memory_usage_threshold > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                self, self.cfg.memory_usage_threshold,
+                self.cfg.memory_monitor_refresh_s,
+            )
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> tuple[str, int]:
@@ -199,6 +207,36 @@ class Raylet:
         self._bg.spawn(self._reaper_loop())
         return addr
 
+    async def _reconnect_gcs(self):
+        """Dial a (possibly restarted) GCS and re-establish registration.
+        The old connection closes only AFTER re-registration replaced its
+        mapping server-side — closing first would read as a node death."""
+        conn = await rpc.connect(*self.gcs_address, timeout=5)
+        old = self.gcs
+        self.gcs = conn
+        self.gcs.on_message = self._on_gcs_push
+        await self._reregister()
+        if old is not None:
+            try:
+                await old.close()
+            except Exception:
+                pass
+
+    async def _reregister(self):
+        reply = await self.gcs.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "address": self.server.address,
+                "store_name": self.store_name,
+                "resources": self.ledger.total,
+                "labels": self.labels,
+                "pid": os.getpid(),
+            },
+        )
+        self.cluster_view = reply["cluster"]
+        await self.gcs.call("subscribe", {"channel": "nodes"})
+
     def _on_gcs_push(self, msg):
         if msg.get("m") == "pubsub" and msg["p"]["channel"] == "nodes":
             event = msg["p"]["message"]
@@ -213,9 +251,10 @@ class Raylet:
                 ]
 
     async def _heartbeat_loop(self):
+        failures = 0
         while not self._stopping:
             try:
-                await self.gcs.call(
+                reply = await self.gcs.call(
                     "heartbeat",
                     {"node_id": self.node_id,
                      "resources_available": self.ledger.available,
@@ -223,15 +262,36 @@ class Raylet:
                      # resource-demand reporting)
                      "queued_leases": len(self._lease_waiters)},
                 )
+                failures = 0
+                if isinstance(reply, dict) and not reply.get("ok", True):
+                    # a restarted GCS doesn't know this node: re-register
+                    # (the GCS-FT reconnection path, ref: gcs_client
+                    # reconnection in accessor.h)
+                    await self._reregister()
             except Exception:
-                pass
+                failures += 1
+                if failures >= 3:
+                    try:
+                        await self._reconnect_gcs()
+                        failures = 0
+                    except Exception:
+                        pass
             await asyncio.sleep(self.cfg.health_check_period_s)
 
     async def _reaper_loop(self):
-        """Reap dead worker processes; free leases; trim the idle pool."""
+        """Reap dead worker processes; free leases; trim the idle pool;
+        poll the memory monitor (OOM protection)."""
+        last_mem_check = 0.0
         while not self._stopping:
             await asyncio.sleep(0.2)
             now = time.monotonic()
+            if (self.memory_monitor is not None
+                    and now - last_mem_check >= self.cfg.memory_monitor_refresh_s):
+                last_mem_check = now
+                try:
+                    self.memory_monitor.maybe_kill()
+                except Exception:
+                    pass
             for w in list(self.all_workers.values()):
                 if w.proc.poll() is not None:
                     await self._on_worker_death(w)

@@ -102,6 +102,41 @@ class Worker:
 
         TPUAcceleratorManager.set_current_process_visible_accelerator_ids(chips)
 
+    async def _apply_runtime_env(self, desc):
+        """Materialize the task's runtime env before user code runs (ref:
+        runtime_env agent role; packages come from the GCS KV). Workers are
+        single-env: the first successfully applied env wins for the process
+        lifetime (the reference starts dedicated workers per env); a
+        failed application is retried by the next task."""
+        if not desc:
+            return
+        if not hasattr(self, "_runtime_env_lock"):
+            self._runtime_env_lock = asyncio.Lock()
+        async with self._runtime_env_lock:  # concurrent tasks gate here
+            if getattr(self, "_runtime_env_applied", False):
+                return
+            import os as _os
+            import tempfile as _tempfile
+
+            from ray_tpu.runtime_env import apply_runtime_env
+
+            cache = _os.path.join(_tempfile.gettempdir(), "ray_tpu", "runtime_envs")
+            blobs = {}
+            digests = ([] if not desc.get("working_dir") else [desc["working_dir"]])
+            digests += list(desc.get("py_modules", []))
+            for d in digests:
+                # node-local content-addressed cache first: warm workers on
+                # this node skip the package transfer entirely
+                if _os.path.exists(_os.path.join(cache, d + ".done")):
+                    continue
+                blobs[d] = await self.core.gcs.call(
+                    "kv_get", {"ns": "runtime_env_packages", "key": d}
+                )
+            apply_runtime_env(desc, lambda k: blobs.get(k))
+            self._runtime_env_applied = True  # only after success
+            # nested submissions from this worker inherit the env
+            self.core.default_runtime_env = desc
+
     async def _load_function(self, func_id: bytes):
         fn = self._func_cache.get(func_id)
         if fn is not None:
@@ -164,6 +199,7 @@ class Worker:
         spec = p["spec"]
         try:
             self._apply_accel_env(spec.get("tpu_chips"))
+            await self._apply_runtime_env(spec.get("runtime_env"))
             fn = await self._load_function(spec["func_id"])
             args = await self._fetch_args(spec["args"])
             kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
@@ -349,6 +385,7 @@ class Worker:
     async def rpc_create_actor(self, conn, p):
         spec = p["spec"]
         self._apply_accel_env(p.get("tpu_chips"))
+        await self._apply_runtime_env(spec.get("runtime_env"))
         cls = cloudpickle.loads(spec["class_blob"])
         args = await self._fetch_args(spec["args"])
         kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))

@@ -1,0 +1,127 @@
+"""Runtime environments: ship the driver's code directory to workers.
+
+TPU-native counterpart of the reference runtime-env subsystem (ref:
+python/ray/_private/runtime_env/working_dir.py — zip+hash upload,
+worker-side download/extract/sys.path; env_vars plugin). The GCS KV is
+the package store (the reference's GCS-backed package URI role):
+
+    ray_tpu.init(runtime_env={
+        "working_dir": "./my_project",        # zipped -> GCS -> workers
+        "env_vars": {"TOKENIZERS_PARALLELISM": "false"},
+        "py_modules": ["./libs/extra_pkg"],   # each added to sys.path
+    })
+
+Workers apply the env before the first user code runs: extract packages
+to a content-addressed cache, prepend to sys.path, chdir into
+working_dir, export env_vars.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import tempfile
+import zipfile
+
+_EXCLUDE_DIRS = {".git", "__pycache__", ".venv", "node_modules", ".eggs"}
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024  # reference default working_dir cap
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for fname in files:
+                full = os.path.join(root, fname)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > MAX_PACKAGE_BYTES:
+                    raise ValueError(
+                        f"working_dir {path!r} exceeds "
+                        f"{MAX_PACKAGE_BYTES >> 20}MB (pare it down or use "
+                        "py_modules for just the code)"
+                    )
+                zf.write(full, rel)
+    return buf.getvalue()
+
+
+def package_runtime_env(env: dict, kv_put) -> dict:
+    """Driver side: zip+upload dirs once (content-addressed), return the
+    descriptor that travels in task/actor specs.
+
+    kv_put(key, blob) stores a package (GCS KV ns=runtime_env_packages)."""
+    desc: dict = {}
+    if env.get("env_vars"):
+        desc["env_vars"] = {str(k): str(v) for k, v in env["env_vars"].items()}
+    for field, many in (("working_dir", False), ("py_modules", True)):
+        src = env.get(field)
+        if not src:
+            continue
+        paths = src if many else [src]
+        hashes = []
+        for p in paths:
+            p = os.path.abspath(os.path.expanduser(p))
+            if not os.path.isdir(p):
+                raise ValueError(f"runtime_env {field}: {p!r} is not a directory")
+            blob = _zip_dir(p)
+            digest = hashlib.sha1(blob).hexdigest()
+            kv_put(digest, blob)
+            hashes.append(digest)
+        desc[field] = hashes if many else hashes[0]
+    unknown = set(env) - {"working_dir", "py_modules", "env_vars"}
+    if unknown:
+        raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
+    return desc
+
+
+def _cache_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "ray_tpu", "runtime_envs")
+
+
+def _extract_package(digest: str, blob: bytes) -> str:
+    """Content-addressed extraction (idempotent across workers)."""
+    dest = os.path.join(_cache_dir(), digest)
+    done = dest + ".done"
+    if os.path.exists(done):
+        return dest
+    tmp = dest + f".tmp{os.getpid()}"
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.replace(tmp, dest)  # atomic claim; losers fall through
+    except OSError:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    open(done, "w").close()
+    return dest
+
+
+def apply_runtime_env(desc: dict, kv_get) -> None:
+    """Worker side: materialize the descriptor before user code runs.
+    kv_get(key) fetches a package blob."""
+    for k, v in desc.get("env_vars", {}).items():
+        os.environ[k] = v
+    for digest in desc.get("py_modules", []):
+        path = _materialize(digest, kv_get)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    wd = desc.get("working_dir")
+    if wd:
+        path = _materialize(wd, kv_get)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+        os.chdir(path)
+
+
+def _materialize(digest: str, kv_get) -> str:
+    dest = os.path.join(_cache_dir(), digest)
+    if os.path.exists(dest + ".done"):
+        return dest
+    blob = kv_get(digest)
+    if blob is None:
+        raise RuntimeError(f"runtime_env package {digest} missing from the GCS")
+    return _extract_package(digest, blob)

@@ -1,0 +1,125 @@
+"""Runtime env tests: working_dir shipping, py_modules, env_vars
+(ref test strategy: python/ray/tests/test_runtime_env_working_dir.py)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_packaging_roundtrip(tmp_path):
+    from ray_tpu.runtime_env import apply_runtime_env, package_runtime_env
+
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "mylib.py").write_text("VALUE = 41\n")
+    (proj / ".git").mkdir()
+    (proj / ".git" / "junk").write_text("x" * 1000)
+
+    store: dict[str, bytes] = {}
+    desc = package_runtime_env(
+        {"working_dir": str(proj), "env_vars": {"RT_TEST_VAR": "yes"}},
+        store.__setitem__,
+    )
+    assert len(store) == 1  # one package, .git excluded
+    digest = desc["working_dir"]
+    assert len(digest) == 40
+
+    # content-addressed: repackaging uploads nothing new
+    desc2 = package_runtime_env({"working_dir": str(proj)}, store.__setitem__)
+    assert desc2["working_dir"] == digest
+
+    cwd = os.getcwd()
+    try:
+        apply_runtime_env(desc, store.get)
+        assert os.environ["RT_TEST_VAR"] == "yes"
+        assert os.path.exists("mylib.py")  # chdir'd into the extraction
+        sys.path_snapshot = list(sys.path)
+        import mylib  # noqa: F401
+
+        assert mylib.VALUE == 41
+    finally:
+        os.chdir(cwd)
+        os.environ.pop("RT_TEST_VAR", None)
+        sys.modules.pop("mylib", None)
+
+
+def test_working_dir_ships_to_workers(tmp_path):
+    """The full e2e: a task imports a module that exists ONLY in the
+    driver's working_dir (ref: working_dir.py semantics). Run in a clean
+    subprocess so the driver itself can't leak the module."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "shipped_mod.py").write_text("def answer():\n    return 1234\n")
+
+    driver = f'''
+import sys
+sys.path.insert(0, {REPO!r})
+import ray_tpu
+
+ray_tpu.init(num_cpus=4, runtime_env={{
+    "working_dir": {str(proj)!r},
+    "env_vars": {{"SHIPPED_FLAG": "on"}},
+}})
+
+@ray_tpu.remote
+def uses_shipped():
+    import os
+
+    import shipped_mod  # only exists in the shipped working_dir
+
+    return shipped_mod.answer(), os.environ.get("SHIPPED_FLAG")
+
+@ray_tpu.remote
+class UsesShipped:
+    def go(self):
+        import shipped_mod
+
+        return shipped_mod.answer() + 1
+
+assert ray_tpu.get(uses_shipped.remote(), timeout=120) == (1234, "on")
+a = UsesShipped.remote()
+assert ray_tpu.get(a.go.remote(), timeout=120) == 1235
+print("RUNTIME-ENV-OK", flush=True)
+ray_tpu.shutdown()
+'''
+    r = subprocess.run([sys.executable, "-c", driver], capture_output=True,
+                       text=True, timeout=300)
+    assert "RUNTIME-ENV-OK" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_py_modules(tmp_path):
+    proj = tmp_path / "libdir"
+    proj.mkdir()
+    (proj / "extra_pkg.py").write_text("NAME = 'extra'\n")
+
+    driver = f'''
+import sys
+sys.path.insert(0, {REPO!r})
+import ray_tpu
+
+ray_tpu.init(num_cpus=4, runtime_env={{"py_modules": [{str(proj)!r}]}})
+
+@ray_tpu.remote
+def uses():
+    import extra_pkg
+
+    return extra_pkg.NAME
+
+assert ray_tpu.get(uses.remote(), timeout=120) == "extra"
+print("PY-MODULES-OK", flush=True)
+ray_tpu.shutdown()
+'''
+    r = subprocess.run([sys.executable, "-c", driver], capture_output=True,
+                       text=True, timeout=300)
+    assert "PY-MODULES-OK" in r.stdout, (r.stdout, r.stderr)
+
+
+def test_unknown_field_rejected():
+    from ray_tpu.runtime_env import package_runtime_env
+
+    with pytest.raises(ValueError, match="unsupported"):
+        package_runtime_env({"conda": "env.yml"}, lambda k, v: None)
