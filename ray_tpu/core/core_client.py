@@ -53,6 +53,11 @@ ALIVE = "ALIVE"
 DEAD = "DEAD"
 
 
+class _RecoveryNeeded(Exception):
+    """Internal pump signal: a connection died while this dispatch was
+    suspended; the spec must wait for the replay to be requeued first."""
+
+
 @dataclass
 class _MemEntry:
     value: Any = None
@@ -162,6 +167,11 @@ class CoreClient:
         self._actor_conn_locks: dict[ActorID, asyncio.Lock] = {}
         self._actor_queues: dict[ActorID, list] = {}
         self._actor_pump_running: set[ActorID] = set()
+        # per-actor in-flight specs in send (seq) order, for FIFO replay on
+        # reconnect (ref: actor_task_submitter sequence replay)
+        self._actor_inflight: dict[ActorID, dict] = {}
+        # dead connections awaiting pump-owned recovery, per actor
+        self._actor_recover_pending: dict[ActorID, set] = {}
         self._conn_seq: dict[rpc.Connection, int] = {}
         self._subscribed_actors: set[ActorID] = set()
         self._task_counter = 0
@@ -1221,71 +1231,116 @@ class CoreClient:
         return refs[0] if num_returns == 1 else refs
 
     async def _ensure_actor_pump(self, actor_id: ActorID):
+        """Single pump per actor owns BOTH dispatch and reconnect recovery,
+        so replayed in-flight specs always precede anything newer — no
+        separate recovery task can race the send order."""
         if actor_id in self._actor_pump_running:
             return
         self._actor_pump_running.add(actor_id)
         try:
-            q = self._actor_queues.get(actor_id, [])
-            while q:
+            q = self._actor_queues.setdefault(actor_id, [])
+            while True:
+                dead = self._actor_recover_pending.get(actor_id)
+                if dead:
+                    conn = next(iter(dead))
+                    dead.discard(conn)
+                    await self._recover_actor_conn(actor_id, conn)
+                    continue  # replay was prepended; loop re-checks
+                if not q:
+                    return
                 spec = q.pop(0)
-                await self._dispatch_actor_task(spec)
+                try:
+                    await self._dispatch_actor_task(spec)
+                except _RecoveryNeeded:
+                    q.insert(0, spec)  # retried AFTER the replay goes out
         finally:
             self._actor_pump_running.discard(actor_id)
 
     async def _dispatch_actor_task(self, spec):
         try:
-            pins: list = []
-            spec["args"] = await self._resolve_args(spec["args"], pins)
-            spec["kwargs"] = dict(
-                zip(spec["kwargs"].keys(),
-                    await self._resolve_args(list(spec["kwargs"].values()), pins))
-            )
-            if pins:
-                self._inflight_pins[spec["task_id"]] = pins
+            if not spec.get("_resolved"):  # replayed specs are already done
+                pins: list = []
+                spec["args"] = await self._resolve_args(spec["args"], pins)
+                spec["kwargs"] = dict(
+                    zip(spec["kwargs"].keys(),
+                        await self._resolve_args(list(spec["kwargs"].values()), pins))
+                )
+                spec["_resolved"] = True
+                if pins:
+                    self._inflight_pins[spec["task_id"]] = pins
             conn = await self._actor_connection(spec["actor_id"])
+            if self._actor_recover_pending.get(spec["actor_id"]):
+                # a connection died while this dispatch was suspended: the
+                # replay must go out first — hand the spec back to the pump
+                raise _RecoveryNeeded()
             seq = self._conn_seq.get(conn, 0)
             self._conn_seq[conn] = seq + 1
             spec["seq"] = seq
+            self._actor_inflight.setdefault(spec["actor_id"], {})[spec["task_id"]] = spec
             # pipelined: don't await the reply here, keep the pump moving
             self._bg.spawn(self._await_actor_reply(conn, spec), self.loop)
+        except _RecoveryNeeded:
+            raise
         except Exception as e:
             self._complete_task_error(spec, e)
 
     async def _await_actor_reply(self, conn, spec):
         try:
             reply = await conn.call("push_actor_task", {"spec": spec})
+            self._actor_inflight.get(spec["actor_id"], {}).pop(spec["task_id"], None)
             self._apply_task_reply(spec, reply)
         except rpc.ConnectionLost:
-            if self._actor_conns.get(spec["actor_id"]) is conn:
-                self._actor_conns.pop(spec["actor_id"], None)
-            self._conn_seq.pop(conn, None)
+            # mark the conn for pump-owned recovery and wake the pump; the
+            # spec stays in _actor_inflight for the replay
+            aid = spec["actor_id"]
+            self._actor_recover_pending.setdefault(aid, set()).add(conn)
+            self._bg.spawn(self._ensure_actor_pump(aid), self.loop)
+        except Exception as e:
+            self._actor_inflight.get(spec["actor_id"], {}).pop(spec["task_id"], None)
+            self._complete_task_error(spec, e)
+
+    async def _recover_actor_conn(self, actor_id: ActorID, conn):
+        """Runs INSIDE the actor's pump: requeue the dead connection's
+        in-flight specs at the queue head in original send order, so FIFO
+        holds across the reconnect (ref: actor_task_submitter sequence
+        replay). Execution is at-least-once across reconnects, same as
+        worker-crash retries. Any failure here fails the replayed specs —
+        they are never silently dropped."""
+        if self._actor_conns.get(actor_id) is conn:
+            self._actor_conns.pop(actor_id, None)
+        self._conn_seq.pop(conn, None)
+        inflight = self._actor_inflight.get(actor_id, {})
+        replay = list(inflight.values())  # dict preserves send order
+        inflight.clear()
+        if not replay:
+            return
+        info = None
+        for _ in range(3):  # ride out a transient GCS blip
+            try:
+                info = await self._refresh_actor(actor_id)
+                break
+            except Exception:
+                await asyncio.sleep(0.2)
+        alive = info and info.get("state") in (
+            ALIVE, "RESTARTING", "PENDING_CREATION"
+        )
+        requeue = []
+        for spec in replay:
             if spec["num_returns"] == "streaming":
                 # never replay a generator: already-consumed items would
-                # duplicate into the live stream (same policy as
-                # _on_worker_lost for streaming tasks)
+                # duplicate into the live stream
                 self._complete_task_error(
                     spec, ActorError("actor connection lost mid-stream")
                 )
-                return
-            info = await self._refresh_actor(spec["actor_id"])
-            if info and info.get("state") in (ALIVE, "RESTARTING", "PENDING_CREATION"):
-                spec["seq"] = None  # ordering lost across reconnect: send unordered
-                await self._await_actor_reply_retry(spec)
+            elif alive:
+                spec["seq"] = None  # fresh seq on the new connection
+                requeue.append(spec)
             else:
                 cause = (info or {}).get("death_cause") or "actor connection lost"
                 self._complete_task_error(spec, ActorError(cause))
-        except Exception as e:
-            self._complete_task_error(spec, e)
-
-    async def _await_actor_reply_retry(self, spec):
-        try:
-            conn = await self._actor_connection(spec["actor_id"])
-            reply = await conn.call("push_actor_task", {"spec": spec})
-            self._apply_task_reply(spec, reply)
-        except Exception as e:
-            if isinstance(e, rpc.ConnectionLost):
-                e = ActorError("actor connection lost during retry")
-            self._complete_task_error(spec, e)
+        if requeue:
+            q = self._actor_queues.setdefault(actor_id, [])
+            q[:0] = requeue  # ahead of anything not yet sent
 
     async def _actor_connection(self, actor_id: ActorID) -> rpc.Connection:
         lock = self._actor_conn_locks.setdefault(actor_id, asyncio.Lock())

@@ -63,6 +63,14 @@ class Worker:
         self._exit_requested = False
 
     async def start(self):
+        # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
+        # anything can touch jax: unpacking a jax-array argument triggers
+        # device_put, and an unconfigured worker would try to initialize
+        # the axon TPU backend — hanging on the single tunneled chip the
+        # driver already holds.
+        from ray_tpu.utils.device import configure_jax
+
+        configure_jax()
         self.core = CoreClient(loop=asyncio.get_running_loop())
         # the worker's own server doubles as the task receiver
         self.core.server.add_routes(self)
