@@ -344,6 +344,56 @@ def remote(*args, **options):
     return wrap
 
 
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel a task (ref: ray.cancel): queued tasks complete with
+    TaskCancelledError; with force=True an executing task's worker is
+    killed. Actor tasks cannot be cancelled (matches the reference's
+    default actor-task semantics)."""
+    get_core().cancel_task(ref, force=force)
+
+
+class RuntimeContext:
+    """(ref: ray.runtime_context.RuntimeContext)"""
+
+    def __init__(self, core):
+        self._core = core
+
+    @property
+    def job_id(self):
+        return self._core.job_id
+
+    @property
+    def node_id(self):
+        return self._core.node_id
+
+    @property
+    def worker_id(self):
+        return self._core.worker_id
+
+    @property
+    def gcs_address(self):
+        return self._core.gcs.peername if self._core.gcs else None
+
+    def get_actor_id(self):
+        from ray_tpu.core import worker as _worker_mod  # circular-safe
+
+        w = getattr(_worker_mod, "_current_worker", None)
+        return w.actor_id if w is not None else None
+
+    def get(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "node_id": self.node_id,
+            "worker_id": self.worker_id,
+            "actor_id": self.get_actor_id(),
+            "gcs_address": self.gcs_address,
+        }
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(get_core())
+
+
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     get_core().kill_actor(actor.actor_id, no_restart=no_restart)
 

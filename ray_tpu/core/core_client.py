@@ -43,6 +43,7 @@ from ray_tpu.core.ref import (
     ObjectLostError,
     ObjectRef,
     ObjectRefGenerator,
+    TaskCancelledError,
     TaskError,
     WorkerCrashedError,
 )
@@ -175,6 +176,8 @@ class CoreClient:
         self._conn_seq: dict[rpc.Connection, int] = {}
         self._subscribed_actors: set[ActorID] = set()
         self._task_counter = 0
+        self._cancelled_tasks: set[TaskID] = set()
+        self._task_worker: dict[TaskID, tuple] = {}  # task -> (conn, worker)
         self._gen_states: dict[TaskID, _GenState] = {}
         # distributed refcounting state (ref: reference_count.h:72)
         self._local_refs: dict[ObjectID, int] = {}      # owner-side handles
@@ -634,6 +637,8 @@ class CoreClient:
         (ref: object_recovery_manager.h:43 — lineage-based recovery;
         deterministic task assumption, bounded attempts)."""
         task_id = oid.task_id()
+        if task_id in self._cancelled_tasks:
+            return False
         stash = self._lineage.get(task_id)
         if stash is None:
             return False
@@ -914,8 +919,17 @@ class CoreClient:
             await self._pump(key, state)
 
     async def _run_on_worker(self, key, state, w: _LeasedWorker, spec: dict):
+        if spec["task_id"] in self._cancelled_tasks:
+            self._complete_task_error(spec, TaskCancelledError(str(spec["task_id"])))
+            state.inflight_tasks -= 1
+            w.busy = False
+            w.idle_since = time.monotonic()
+            await self._pump(key, state)
+            self._bg.spawn(self._maybe_return_lease(key, state, w), self.loop)
+            return
         self.task_events.emit(task_id=spec["task_id"].hex(), name=spec["name"],
                               state="SUBMITTED_TO_WORKER", worker_id=w.worker_id)
+        self._task_worker[spec["task_id"]] = (w.raylet_address, w.worker_id)
         try:
             if w.tpu_chips:
                 spec["tpu_chips"] = w.tpu_chips
@@ -925,12 +939,15 @@ class CoreClient:
             return
         except Exception as e:
             # e.g. an unpicklable task spec: fail the task, free the worker
+            self._task_worker.pop(spec["task_id"], None)
             self._complete_task_error(spec, e)
             state.inflight_tasks -= 1
             w.busy = False
             w.idle_since = time.monotonic()
             await self._pump(key, state)
+            self._bg.spawn(self._maybe_return_lease(key, state, w), self.loop)
             return
+        self._task_worker.pop(spec["task_id"], None)
         self._apply_task_reply(spec, reply)
         state.inflight_tasks -= 1
         w.busy = False
@@ -941,6 +958,7 @@ class CoreClient:
     def _apply_task_reply(self, spec, reply):
         task_id = spec["task_id"]
         self._inflight_pins.pop(task_id, None)
+        self._cancelled_tasks.discard(task_id)
         name = spec.get("name") or spec.get("method", "task")
         if reply.get("error") is not None:
             metrics.tasks_finished.inc(tags={"outcome": "failed"})
@@ -963,6 +981,8 @@ class CoreClient:
 
     def _complete_task_error(self, spec, error):
         self._inflight_pins.pop(spec["task_id"], None)
+        if not isinstance(error, TaskCancelledError):
+            self._cancelled_tasks.discard(spec["task_id"])
         if not isinstance(error, Exception):
             error = TaskError(str(error))
         if spec["num_returns"] == "streaming":
@@ -1045,6 +1065,14 @@ class CoreClient:
         so the stream fails fast instead."""
         if w in state.workers:
             state.workers.remove(w)
+        self._task_worker.pop(spec["task_id"], None)
+        if spec["task_id"] in self._cancelled_tasks:
+            self._complete_task_error(
+                spec, TaskCancelledError(str(spec["task_id"]))
+            )
+            state.inflight_tasks -= 1
+            await self._pump(key, state)
+            return
         if spec["num_returns"] == "streaming":
             self._complete_task_error(spec, WorkerCrashedError())
             state.inflight_tasks -= 1
@@ -1390,6 +1418,65 @@ class CoreClient:
         if info is not None:
             self._actor_info[actor_id] = info
         return info
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False):
+        """Cancel a task (ref: ray.cancel, core_worker CancelTask):
+        best-effort — the caller's pending refs fail with
+        TaskCancelledError immediately (even if the task is dependency-
+        blocked), a queued task never dispatches, and with force=True an
+        executing task's worker is killed."""
+        task_id = ref.id.task_id()
+        self._cancelled_tasks.add(task_id)
+        self._run_sync(self._cancel_async(task_id, force))
+
+    def _fail_task_returns_cancelled(self, task_id: TaskID):
+        i = 0
+        while True:  # returns are dense indices; stop at the first miss
+            oid = ObjectID.for_task_return(task_id, i)
+            entry = self.memory_store.get(oid)
+            if entry is None:
+                break
+            if entry.error is None and not entry.ready.is_set():
+                entry.error = TaskCancelledError(str(task_id))
+                entry.ready.set()
+            i += 1
+
+    async def _cancel_async(self, task_id: TaskID, force: bool):
+        # the caller must not hang on a dep-blocked or in-flight task:
+        # fail its return entries now (best-effort semantics — a task that
+        # still completes keeps its stored result, but gets raise the
+        # cancellation)
+        self._fail_task_returns_cancelled(task_id)
+        # drain it from any pending queue
+        for state in self.sched_keys.values():
+            kept = []
+            while not state.pending.empty():
+                spec = state.pending.get_nowait()
+                if spec["task_id"] == task_id:
+                    self._complete_task_error(
+                        spec, TaskCancelledError(str(task_id))
+                    )
+                    state.inflight_tasks -= 1
+                else:
+                    kept.append(spec)
+            for spec in kept:
+                await state.pending.put(spec)
+        if force:
+            loc = self._task_worker.get(task_id)
+            if loc is not None:
+                raylet_addr, worker_id = loc
+                # pre-mark so the crash completes as cancellation, not retry
+                try:
+                    conn = (self.raylet
+                            if tuple(raylet_addr) == tuple(self.raylet_address)
+                            else await rpc.connect(*raylet_addr, timeout=5))
+                    try:
+                        await conn.call("kill_worker", {"worker_id": worker_id})
+                    finally:
+                        if conn is not self.raylet:
+                            await conn.close()
+                except Exception:
+                    pass
 
     def kill_actor(self, actor_id: ActorID, no_restart=True):
         self._run_sync(self.gcs.call("kill_actor", {"actor_id": actor_id,

@@ -362,6 +362,20 @@ class Raylet:
         chips = self._worker_chips.get(_WID.from_hex(p["worker_id"]))
         return {"tpu_chips": chips}
 
+    async def rpc_kill_worker(self, conn, p):
+        """Force-kill a worker (task cancellation with force=True; ref:
+        CancelTask force_kill path)."""
+        from ray_tpu.utils.ids import WorkerID as _WID
+
+        w = self.all_workers.get(_WID.from_hex(p["worker_id"]))
+        if w is None:
+            return False
+        try:
+            w.proc.kill()
+        except Exception:
+            return False
+        return True
+
     async def rpc_worker_ready(self, conn, p):
         w = self.all_workers.get(WorkerID.from_hex(p["worker_id"]))
         if w is None:

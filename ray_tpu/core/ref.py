@@ -41,6 +41,10 @@ class ActorUnavailableError(ActorError):
     pass
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray.cancel (ref: TaskCancelledError)."""
+
+
 class WorkerCrashedError(TaskError):
     def __init__(self, message="worker process died while executing the task"):
         super().__init__(message)

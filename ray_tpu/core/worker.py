@@ -39,6 +39,9 @@ from ray_tpu.utils import metrics, rpc, serialization
 from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
 
 
+_current_worker = None  # set by Worker.start(): runtime_context introspection
+
+
 class Worker:
     def __init__(self):
         self.cfg = get_config()
@@ -71,6 +74,12 @@ class Worker:
         from ray_tpu.utils.device import configure_jax
 
         configure_jax()
+        # register on the CANONICAL module: under `python -m` this file
+        # also exists as `__main__`, and runtime_context imports
+        # ray_tpu.core.worker — the two must agree
+        import ray_tpu.core.worker as _canonical
+
+        _canonical._current_worker = self
         self.core = CoreClient(loop=asyncio.get_running_loop())
         # the worker's own server doubles as the task receiver
         self.core.server.add_routes(self)

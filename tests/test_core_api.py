@@ -277,3 +277,73 @@ def test_actor_fifo_preserved_across_crash(rt, tmp_path):
         if x not in firsts:
             firsts.append(x)
     assert firsts == sorted(firsts), f"order inverted: {firsts}"
+
+
+def test_cancel_pending_task(rt):
+    """Queued tasks cancel cleanly with TaskCancelledError (ref: ray.cancel)."""
+    from ray_tpu.core.ref import TaskCancelledError
+
+    @ray_tpu.remote
+    def blocker():
+        import time
+
+        time.sleep(2)
+        return "done"
+
+    @ray_tpu.remote
+    def queued(dep):
+        return "ran"
+
+    # the victim is dependency-blocked behind the running blocker, so the
+    # cancel deterministically lands before it can dispatch
+    dep = blocker.remote()
+    victim = queued.remote(dep)
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=60)
+    # the rest of the cluster is unharmed
+    assert ray_tpu.get(dep, timeout=120) == "done"
+
+
+def test_cancel_force_kills_running_task(rt):
+    from ray_tpu.core.ref import TaskCancelledError
+
+    @ray_tpu.remote(max_retries=2)
+    def forever(path):
+        import time
+
+        open(path, "w").close()
+        time.sleep(120)
+
+    import tempfile
+    import time as _t
+
+    marker = tempfile.mktemp()
+    ref = forever.remote(marker)
+    deadline = _t.monotonic() + 60
+    import os
+
+    while not os.path.exists(marker) and _t.monotonic() < deadline:
+        _t.sleep(0.1)
+    assert os.path.exists(marker), "task never started"
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)  # killed, not retried
+
+
+def test_runtime_context(rt):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.job_id is not None
+    assert ctx.node_id is not None
+    assert ctx.gcs_address is not None
+    assert ctx.get_actor_id() is None  # driver side
+
+    @ray_tpu.remote
+    class Inspector:
+        def who(self):
+            c = ray_tpu.get_runtime_context()
+            return c.get_actor_id() is not None, c.node_id is not None
+
+    a = Inspector.remote()
+    has_actor_id, has_node = ray_tpu.get(a.who.remote(), timeout=60)
+    assert has_actor_id and has_node
