@@ -372,7 +372,7 @@ class RuntimeContext:
 
     @property
     def gcs_address(self):
-        return self._core.gcs.peername if self._core.gcs else None
+        return getattr(self._core, "gcs_address", None)
 
     def get_actor_id(self):
         from ray_tpu.core import worker as _worker_mod  # circular-safe

@@ -50,6 +50,8 @@ from ray_tpu.core.ref import (
 from ray_tpu.utils import aio, metrics, rpc, serialization
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 
+_NCPU = max(1, os.cpu_count() or 1)
+
 ALIVE = "ALIVE"
 DEAD = "DEAD"
 
@@ -197,6 +199,10 @@ class CoreClient:
         import threading as _threading
 
         self._rc_lock = _threading.Lock()  # counts are bumped off-loop too
+        self._xq: list = []  # thread->loop submission queue (see _call_on_loop)
+        self._xq_armed = False
+        self._xq_linger = False
+        self._xq_lock = _threading.Lock()
         self._closed = False
         self.default_runtime_env: dict | None = None  # packaged descriptor
         self._bg = aio.TaskGroup()
@@ -205,6 +211,7 @@ class CoreClient:
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
         self.address = await self.server.start()
+        self.gcs_address = tuple(gcs_address)  # dialable, unlike loopback peername
         self.gcs = await rpc.connect(*gcs_address, timeout=self.cfg.rpc_connect_timeout_s)
         self.gcs.on_message = self._on_push
         self.raylet = await rpc.connect(*raylet_address, timeout=self.cfg.rpc_connect_timeout_s)
@@ -276,6 +283,9 @@ class CoreClient:
             if not live:
                 self._lineage.pop(tid, None)
                 self._lineage_live.pop(tid, None)
+                # nothing can reconstruct this task anymore — safe to forget
+                # its cancellation mark (bounds _cancelled_tasks growth)
+                self._cancelled_tasks.discard(tid)
 
     async def _maybe_free_object(self, oid: ObjectID):
         while not self._closed:
@@ -773,8 +783,37 @@ class CoreClient:
     def _call_on_loop(self, coro):
         if _in_loop(self.loop):
             self._bg.spawn(coro, self.loop)
-        else:
-            self.loop.call_soon_threadsafe(self._bg.spawn, coro, self.loop)
+            return
+        # Coalesced thread->loop handoff: call_soon_threadsafe writes the
+        # loop's self-pipe (a syscall) per call, so a burst of .remote()
+        # submissions from the user thread pays one wakeup per task. Queue
+        # instead and arm a single drain callback per burst.
+        with self._xq_lock:
+            self._xq.append(coro)
+            arm = not self._xq_armed
+            if arm:
+                self._xq_armed = True
+        if arm:
+            self.loop.call_soon_threadsafe(self._drain_xq)
+
+    def _drain_xq(self):
+        with self._xq_lock:
+            if not self._xq:
+                # Linger one extra loop tick before disarming: during a
+                # submission burst the producer refills between ticks, and
+                # staying armed means it never pays the self-pipe wakeup.
+                if self._xq_linger:
+                    self._xq_linger = False
+                    self.loop.call_soon(self._drain_xq)
+                else:
+                    self._xq_armed = False
+                return
+            batch = self._xq
+            self._xq = []
+            self._xq_linger = True
+        for coro in batch:
+            self._bg.spawn(coro, self.loop)
+        self.loop.call_soon(self._drain_xq)
 
     async def _submit_async(self, spec: dict):
         try:
@@ -855,7 +894,7 @@ class CoreClient:
         # must not pay one sequential worker-spawn per task. Bounded by
         # host cores — concurrent python worker spawns are CPU-hungry and
         # over-forking on small machines slows everything down.
-        spawn_cap = max(1, os.cpu_count() or 1)
+        spawn_cap = _NCPU
         want = min(
             state.pending.qsize() - state.lease_requests_inflight,
             self.cfg.max_lease_parallelism - state.lease_requests_inflight,
@@ -929,7 +968,7 @@ class CoreClient:
             return
         self.task_events.emit(task_id=spec["task_id"].hex(), name=spec["name"],
                               state="SUBMITTED_TO_WORKER", worker_id=w.worker_id)
-        self._task_worker[spec["task_id"]] = (w.raylet_address, w.worker_id)
+        self._task_worker[spec["task_id"]] = (w.raylet_address, w.worker_id, w.conn)
         try:
             if w.tpu_chips:
                 spec["tpu_chips"] = w.tpu_chips
@@ -1227,7 +1266,7 @@ class CoreClient:
         numbers and pipelines pushes — the reference's ActorTaskSubmitter
         shape (ref: actor_task_submitter.h:75, ordered sends + out-of-order
         replies)."""
-        task_id = TaskID.generate()
+        task_id = TaskID.generate_actor()
         actor_id = handle.actor_id
         metrics.actor_calls.inc()
         self.task_events.emit(task_id=task_id.hex(), name=method,
@@ -1426,6 +1465,11 @@ class CoreClient:
         blocked), a queued task never dispatches, and with force=True an
         executing task's worker is killed."""
         task_id = ref.id.task_id()
+        if task_id.is_actor_task():
+            # matches the documented contract (api.cancel): actor tasks run
+            # to completion; half-cancelling the caller's ref would discard
+            # a result whose side effects still happen.
+            raise ValueError("actor tasks cannot be cancelled")
         self._cancelled_tasks.add(task_id)
         self._run_sync(self._cancel_async(task_id, force))
 
@@ -1464,14 +1508,28 @@ class CoreClient:
         if force:
             loc = self._task_worker.get(task_id)
             if loc is not None:
-                raylet_addr, worker_id = loc
-                # pre-mark so the crash completes as cancellation, not retry
+                raylet_addr, worker_id, wconn = loc
+                # Ask the worker itself to die only if it is STILL running
+                # this task — the identity check happens inside the worker
+                # process, so a task that completed and a reused worker can
+                # never be killed by a stale cancel.
+                try:
+                    await wconn.call("cancel_if_current", {"task_id": task_id},
+                                     timeout=5)
+                    return
+                except Exception:
+                    pass  # worker loop unresponsive/conn dead: raylet fallback
+                # Fallback (worker wedged): kill via raylet, but only if the
+                # task is still mapped to that same worker.
+                if self._task_worker.get(task_id) != loc:
+                    return
                 try:
                     conn = (self.raylet
                             if tuple(raylet_addr) == tuple(self.raylet_address)
                             else await rpc.connect(*raylet_addr, timeout=5))
                     try:
-                        await conn.call("kill_worker", {"worker_id": worker_id})
+                        if self._task_worker.get(task_id) == loc:
+                            await conn.call("kill_worker", {"worker_id": worker_id})
                     finally:
                         if conn is not self.raylet:
                             await conn.close()

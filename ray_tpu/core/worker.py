@@ -64,6 +64,9 @@ class Worker:
         # id()-keyed map could collide after CPython address reuse
         self._seq_gates: dict[object, dict] = {}
         self._exit_requested = False
+        # normal-task ids currently executing, for exact-identity force
+        # cancellation (cancel_if_current) — never holds actor task ids
+        self._current_tasks: set = set()
 
     async def start(self):
         # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
@@ -212,8 +215,19 @@ class Worker:
                 results.append({"shm": True})
         return results
 
+    async def rpc_cancel_if_current(self, conn, p):
+        """Die iff the named task is still executing here. The check runs in
+        this process, so a stale force-cancel can never kill a worker that
+        finished the task and was reused (ref: CancelTask force_kill)."""
+        if p["task_id"] in self._current_tasks:
+            loop = asyncio.get_running_loop()
+            loop.call_soon(os._exit, 1)  # reply first, then die
+            return True
+        return False
+
     async def rpc_push_task(self, conn, p):
         spec = p["spec"]
+        self._current_tasks.add(spec["task_id"])
         try:
             self._apply_accel_env(spec.get("tpu_chips"))
             await self._apply_runtime_env(spec.get("runtime_env"))
@@ -257,6 +271,8 @@ class Worker:
                 node_id=self.node_id.hex(), pid=os.getpid(),
             )
             return {"error": _as_task_error(e)}
+        finally:
+            self._current_tasks.discard(spec["task_id"])
 
     async def _execute_streaming(self, spec, fn, args, kwargs):
         """Run a (sync or async) generator, reporting each item to the

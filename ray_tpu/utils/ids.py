@@ -86,10 +86,29 @@ class PlacementGroupID(BaseID):
 
 class TaskID(BaseID):
     SIZE = _TASK_ID_SIZE
+    # Last id byte tags the task kind so owners can tell actor tasks apart
+    # from normal tasks without per-task state (cancel semantics differ).
+    _ACTOR_MARK = 0xA5
 
     @classmethod
     def for_driver(cls, job_id: JobID) -> "TaskID":
         return cls(job_id.binary() + b"\x00" * (cls.SIZE - JobID.SIZE))
+
+    @classmethod
+    def generate(cls):
+        raw = bytearray(os.urandom(cls.SIZE))
+        if raw[-1] == cls._ACTOR_MARK:
+            raw[-1] ^= 0xFF
+        return cls(bytes(raw))
+
+    @classmethod
+    def generate_actor(cls) -> "TaskID":
+        raw = bytearray(os.urandom(cls.SIZE))
+        raw[-1] = cls._ACTOR_MARK
+        return cls(bytes(raw))
+
+    def is_actor_task(self) -> bool:
+        return self._bytes[-1] == self._ACTOR_MARK
 
 
 class ObjectID(BaseID):

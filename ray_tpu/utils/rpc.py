@@ -51,7 +51,6 @@ class Connection:
         self.writer = writer
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
-        self._send_lock = asyncio.Lock()
         self._closed = False
         self.on_message: Callable[[dict], Awaitable[Any] | None] | None = None
         self._reader_task: asyncio.Task | None = None
@@ -93,13 +92,22 @@ class Connection:
                 fut.set_exception(exc)
         self._pending.clear()
 
+    def send_nowait(self, msg: dict):
+        """Write a frame without awaiting backpressure (transport buffers)."""
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        self.writer.write(frame_bytes(msg))
+
     async def send(self, msg: dict):
-        data = frame_bytes(msg)
-        async with self._send_lock:
-            if self._closed:
-                raise ConnectionLost("connection closed")
-            self.writer.write(data)
-            await self.writer.drain()
+        self.send_nowait(msg)
+        # Backpressure: only await when the transport is actually over its
+        # high-water mark (drain() is a no-op await otherwise, and skipping
+        # it saves a lock + await per frame on the hot path).
+        try:
+            if self.writer.transport.get_write_buffer_size() > (1 << 21):
+                await self.writer.drain()
+        except (ConnectionResetError, BrokenPipeError) as e:
+            raise ConnectionLost(str(e)) from None
 
     async def call(self, method: str, payload: Any = None, timeout: float | None = None):
         i = next(self._ids)
@@ -127,6 +135,111 @@ class Connection:
             await asyncio.wait_for(self.writer.wait_closed(), timeout=1.0)
         except (Exception, asyncio.TimeoutError):
             pass
+
+
+class LoopbackConnection:
+    """In-memory Connection pair end for same-process, same-loop peers.
+
+    When the driver runs the GCS/raylet on its own event loop (head mode),
+    TCP round-trips per control message are pure syscall overhead. A
+    loopback pair delivers frames as loop callbacks instead; messages still
+    take a pickle round-trip so payload isolation matches the wire path.
+    Duck-types the subset of Connection the control plane uses.
+    """
+
+    def __init__(self):
+        self.peer: "LoopbackConnection | None" = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self.on_message: Callable[[dict], Awaitable[Any] | None] | None = None
+        # set on the server-side end only:
+        self._server: "RpcServer | None" = None
+
+    @property
+    def peername(self):
+        return ("loopback", 0)
+
+    def _deliver(self, msg: dict):
+        """Hand a frame to this end, as if read off the socket."""
+        if self._closed:
+            return
+        msg = pickle.loads(pickle.dumps(msg, protocol=5))
+        kind = msg.get("k")
+        if self._server is not None:
+            if kind in ("c", "n"):
+                self._server._spawn_dispatch(self, msg)
+            elif kind == "r":  # reply to a server-initiated call on this conn
+                fut = self._pending.pop(msg["i"], None)
+                if fut is not None and not fut.done():
+                    if msg.get("e") is not None:
+                        fut.set_exception(msg["e"])
+                    else:
+                        fut.set_result(msg.get("v"))
+            return
+        if kind == "r":
+            fut = self._pending.pop(msg["i"], None)
+            if fut is not None and not fut.done():
+                if msg.get("e") is not None:
+                    fut.set_exception(msg["e"])
+                else:
+                    fut.set_result(msg.get("v"))
+        elif self.on_message is not None:
+            res = self.on_message(msg)
+            if asyncio.iscoroutine(res):
+                asyncio.get_running_loop().create_task(res)
+
+    def _fail_pending(self, exc: Exception):
+        self._closed = True
+        exc = exc if isinstance(exc, ConnectionLost) else ConnectionLost(repr(exc))
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    def send_nowait(self, msg: dict):
+        if self._closed or self.peer is None:
+            raise ConnectionLost("connection closed")
+        self.peer._deliver(msg)
+
+    async def send(self, msg: dict):
+        self.send_nowait(msg)
+
+    async def call(self, method: str, payload: Any = None, timeout: float | None = None):
+        i = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[i] = fut
+        await self.send({"k": "c", "i": i, "m": method, "p": payload})
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def notify(self, method: str, payload: Any = None):
+        await self.send({"k": "n", "m": method, "p": payload})
+
+    async def respond(self, msg_id: int, value: Any = None, error: Exception | None = None):
+        await self.send({"k": "r", "i": msg_id, "v": value, "e": error})
+
+    async def close(self):
+        if self._closed:
+            return
+        self._fail_pending(ConnectionLost("connection closed"))
+        peer = self.peer
+        if peer is not None and not peer._closed:
+            peer._fail_pending(ConnectionLost("peer disconnected"))
+            srv = peer._server
+            if srv is not None:
+                srv._conns.discard(peer)
+                if srv.on_disconnect is not None:
+                    try:
+                        srv.on_disconnect(peer)
+                    except Exception:
+                        pass
+
+
+# (host, port) -> (RpcServer, loop) for servers in this process; lets
+# rpc.connect() short-circuit same-loop connections through a loopback pair.
+_LOCAL_SERVERS: dict[tuple, tuple] = {}
 
 
 class RpcServer:
@@ -158,7 +271,23 @@ class RpcServer:
         self._server = await asyncio.start_server(self._on_client, self._host, self._port)
         sock = self._server.sockets[0]
         self._host, self._port = sock.getsockname()[:2]
+        _LOCAL_SERVERS[(self._host, self._port)] = (self, asyncio.get_running_loop())
         return self._host, self._port
+
+    def attach_loopback(self) -> LoopbackConnection:
+        """Create an in-memory client connection to this server (same loop)."""
+        client = LoopbackConnection()
+        server_end = LoopbackConnection()
+        server_end._server = self
+        client.peer = server_end
+        server_end.peer = client
+        self._conns.add(server_end)
+        return client
+
+    def _spawn_dispatch(self, conn, msg: dict):
+        t = asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
+        self._dispatch_tasks.add(t)
+        t.add_done_callback(self._dispatch_tasks.discard)
 
     @property
     def address(self) -> tuple[str, int]:
@@ -172,9 +301,7 @@ class RpcServer:
                 msg = await read_frame(reader)
                 kind = msg.get("k")
                 if kind in ("c", "n"):
-                    t = asyncio.get_running_loop().create_task(self._dispatch(conn, msg))
-                    self._dispatch_tasks.add(t)
-                    t.add_done_callback(self._dispatch_tasks.discard)
+                    self._spawn_dispatch(conn, msg)
                 elif kind == "r":
                     fut = conn._pending.pop(msg["i"], None)
                     if fut is not None and not fut.done():
@@ -222,10 +349,17 @@ class RpcServer:
                 pass
 
     async def stop(self):
+        _LOCAL_SERVERS.pop((self._host, self._port), None)
         # close live connections first: their handler coroutines sit in
         # read_frame(), and 3.12's wait_closed() waits for handlers to finish
         for conn in list(self._conns):
-            await conn.close()
+            if isinstance(conn, LoopbackConnection):
+                conn._closed = True
+                if conn.peer is not None:
+                    conn.peer._fail_pending(ConnectionLost("server stopped"))
+                self._conns.discard(conn)
+            else:
+                await conn.close()
         for t in list(self._dispatch_tasks):
             t.cancel()
         if self._dispatch_tasks:
@@ -239,6 +373,9 @@ class RpcServer:
 
 
 async def connect(host: str, port: int, timeout: float = 30.0) -> Connection:
+    local = _LOCAL_SERVERS.get((host, port))
+    if local is not None and local[1] is asyncio.get_running_loop():
+        return local[0].attach_loopback()
     deadline = asyncio.get_running_loop().time() + timeout
     last_err: Exception | None = None
     while asyncio.get_running_loop().time() < deadline:

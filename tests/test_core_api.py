@@ -305,6 +305,25 @@ def test_cancel_pending_task(rt):
     assert ray_tpu.get(dep, timeout=120) == "done"
 
 
+def test_cancel_actor_task_refused(rt):
+    """Actor tasks cannot be cancelled: cancel must refuse loudly instead of
+    half-cancelling the caller's ref while the method still runs."""
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 7
+
+    a = A.remote()
+    try:
+        ref = a.m.remote()
+        with pytest.raises(ValueError):
+            ray_tpu.cancel(ref)
+        assert ray_tpu.get(ref, timeout=30) == 7  # result intact
+    finally:
+        ray_tpu.kill(a)  # free the worker slot for later tests
+
+
 def test_cancel_force_kills_running_task(rt):
     from ray_tpu.core.ref import TaskCancelledError
 

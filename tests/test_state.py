@@ -97,11 +97,12 @@ def test_timeline_export(rt, tmp_path):
 
     ray_tpu.get([slice_task.remote() for _ in range(3)], timeout=60)
     path = str(tmp_path / "trace.json")
-    trace = _wait_for(
-        lambda: [e for e in state.timeline(path)
-                 if e["name"] == "slice_task" and e["args"]["state"] == "FINISHED"],
-        msg="no timeline slices",
-    )
+    def all_slices():
+        rows = [e for e in state.timeline(path)
+                if e["name"] == "slice_task" and e["args"]["state"] == "FINISHED"]
+        return rows if len(rows) >= 3 else None
+
+    trace = _wait_for(all_slices, msg="fewer than 3 timeline slices")
     assert len(trace) >= 3
     saved = json.load(open(path))
     assert all(e["ph"] == "X" and e["dur"] > 0 for e in saved)
