@@ -23,8 +23,12 @@ _lib_lock = threading.Lock()
 
 
 def _source_files() -> list[str]:
+    # rt_cpp_* is the standalone C++ worker runtime (see build_cpp_worker),
+    # not part of the in-process store library
     return sorted(
-        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR) if f.endswith(".cc")
+        os.path.join(_SRC_DIR, f)
+        for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc") and not f.startswith("rt_cpp")
     )
 
 
@@ -50,6 +54,49 @@ def _build() -> str:
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so_path)  # atomic: concurrent builders race safely
     return so_path
+
+
+def _build_cpp_binary(sources: list[str], runtime_cc: str, prefix: str,
+                      out_path: str | None) -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    runtime = os.path.join(_SRC_DIR, runtime_cc)
+    headers = [os.path.join(_SRC_DIR, h)
+               for h in ("picklite.h", "rt_cpp_api.h", "rt_wire.h",
+                         "rt_cpp_client.h")]
+    h = hashlib.sha256()
+    for p in [*sources, runtime, *headers]:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
+    out = out_path or os.path.join(_BUILD_DIR, f"{prefix}_{tag}")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    proc = subprocess.run(
+        ["g++", "-std=c++17", "-O2", "-I", _SRC_DIR, "-o", tmp,
+         *sources, runtime, "-pthread"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        # this compiles user-authored code: surface the diagnostics
+        raise RuntimeError(
+            f"C++ build failed (g++ exit {proc.returncode}):\n{proc.stderr}"
+        )
+    os.replace(tmp, out)
+    return out
+
+
+def build_cpp_worker(sources: list[str], out_path: str | None = None) -> str:
+    """Compile a C++ worker binary: user RT_REMOTE sources + the rt runtime
+    (rt_cpp_worker.cc / rt_cpp_api.h / picklite.h). Hash-keyed like the
+    store build; returns the binary path for RT_CPP_WORKER."""
+    return _build_cpp_binary(sources, "rt_cpp_worker.cc", "rt_cpp_worker", out_path)
+
+
+def build_cpp_client(sources: list[str], out_path: str | None = None) -> str:
+    """Compile a C++ driver binary against the rt client runtime
+    (rt_cpp_client.cc): connect to a cluster and submit C++ tasks."""
+    return _build_cpp_binary(sources, "rt_cpp_client.cc", "rt_cpp_client", out_path)
 
 
 def get_lib() -> ctypes.CDLL:

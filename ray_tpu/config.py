@@ -48,6 +48,9 @@ class Config:
     min_idle_workers: int = 1
     #: seconds before an idle leased worker is returned to the pool
     worker_lease_timeout_s: float = 10.0
+    #: path to a C++ worker binary (rt_cpp_api.h + RT_REMOTE functions) for
+    #: language="cpp" tasks; RT_CPP_WORKER env overrides (ref: cpp/ worker)
+    cpp_worker_binary: str = ""
     #: hybrid scheduling: prefer local node until this utilization fraction
     #: (ref: hybrid_scheduling_policy.h:50)
     hybrid_threshold: float = 0.5

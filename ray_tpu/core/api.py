@@ -344,6 +344,40 @@ def remote(*args, **options):
     return wrap
 
 
+class CppFunction:
+    """Cross-language handle for a task implemented in a C++ worker binary
+    (ref: cpp/ worker API + cross_language call surface). The function is
+    resolved worker-side from the binary's RT_REMOTE registry by name."""
+
+    def __init__(self, name: str, *, num_returns: int = 1,
+                 resources: dict | None = None):
+        self._name = name
+        self._num_returns = num_returns
+        self._resources = resources
+
+    def options(self, *, num_returns: int | None = None,
+                resources: dict | None = None) -> "CppFunction":
+        return CppFunction(
+            self._name,
+            num_returns=self._num_returns if num_returns is None else num_returns,
+            resources=self._resources if resources is None else resources,
+        )
+
+    def remote(self, *args):
+        return get_core().submit_task(
+            ("cpp", self._name), args, {},
+            num_returns=self._num_returns,
+            resources=self._resources,
+            max_retries=0,  # native tasks: no automatic re-execution yet
+        )
+
+
+def cpp_function(name: str, **options) -> CppFunction:
+    """Handle to a C++ task registered as ``name`` via RT_REMOTE in the
+    cluster's C++ worker binary (configured with RT_CPP_WORKER)."""
+    return CppFunction(name, **options)
+
+
 def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     """Cancel a task (ref: ray.cancel): queued tasks complete with
     TaskCancelledError; with force=True an executing task's worker is

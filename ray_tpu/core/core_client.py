@@ -102,6 +102,7 @@ class _SchedulingKeyState:
     workers: list[_LeasedWorker] = field(default_factory=list)
     lease_requests_inflight: int = 0
     inflight_tasks: int = 0
+    lease_failures: int = 0  # consecutive; N in a row fails the pending queue
 
 
 class _TaskEventBuffer:
@@ -732,15 +733,29 @@ class CoreClient:
                     max_retries=None, placement_group=None, bundle_index=-1,
                     scheduling_node=None, name=None,
                     runtime_env=None) -> list[ObjectRef] | ObjectRef:
-        """Synchronous entry (driver thread) or loop-thread entry (nested)."""
-        func_id = self._register_function(fn)
+        """Synchronous entry (driver thread) or loop-thread entry (nested).
+
+        ``fn`` is a Python callable, or ("cpp", func_name) for cross-language
+        submission to a C++ worker (ref: cpp/ worker API; function resolved
+        from the binary's RT_REMOTE registry by name)."""
+        language = "python"
+        func_name = None
+        if isinstance(fn, tuple) and len(fn) == 2 and fn[0] == "cpp":
+            language, func_name = "cpp", fn[1]
+            if kwargs:
+                raise TypeError("C++ tasks take positional arguments only")
+            func_id = b"cpp:" + func_name.encode()
+        else:
+            func_id = self._register_function(fn)
         self._task_counter += 1
         task_id = TaskID.generate()
         resources = dict(resources or {"CPU": 1.0})
         spec = {
             "task_id": task_id,
-            "name": name or getattr(fn, "__name__", "task"),
+            "name": name or func_name or getattr(fn, "__name__", "task"),
             "func_id": func_id,
+            "language": language,
+            "func_name": func_name,
             "args": args,
             "kwargs": kwargs,
             "num_returns": num_returns,
@@ -912,6 +927,9 @@ class CoreClient:
                 "resources": resources,
                 "pg_id": None,
                 "bundle_index": key[3],
+                # cpp func_ids are b"cpp:<name>"; the raylet pools and
+                # spawns workers per language (ref: worker_pool.h:231)
+                "language": "cpp" if key[0].startswith(b"cpp:") else "python",
             }
             if pg_hex:
                 from ray_tpu.utils.ids import PlacementGroupID
@@ -945,14 +963,28 @@ class CoreClient:
                     )
                     w.conn = await rpc.connect(*w.address)
                     state.workers.append(w)
+                    state.lease_failures = 0
                     # arm the idle-return timer NOW: a lease granted after
                     # the backlog drained may never run a task, and the
                     # post-task timer alone would leak it (and its CPUs)
                     self._bg.spawn(self._maybe_return_lease(key, state, w), self.loop)
                     break
                 raylet_addr = tuple(reply["spill_to"])
-        except Exception:
-            traceback.print_exc()
+        except Exception as e:
+            # A lease that fails repeatedly with the same error is a
+            # configuration problem (e.g. cpp task but no RT_CPP_WORKER
+            # binary), not transient pressure: fail the pending tasks
+            # instead of spinning spawn->raise->pump forever.
+            state.lease_failures += 1
+            if state.lease_failures >= 3:
+                err = e if isinstance(e, Exception) else TaskError(str(e))
+                while not state.pending.empty():
+                    spec = state.pending.get_nowait()
+                    self._complete_task_error(spec, err)
+                    state.inflight_tasks -= 1
+                state.lease_failures = 0
+            else:
+                traceback.print_exc()
         finally:
             state.lease_requests_inflight -= 1
             await self._pump(key, state)

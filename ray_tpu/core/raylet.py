@@ -40,6 +40,7 @@ class WorkerHandle:
     lease_id: int | None = None
     actor_id: bytes | None = None
     idle_since: float = 0.0
+    language: str = "python"
 
 
 @dataclass
@@ -295,17 +296,21 @@ class Raylet:
             for w in list(self.all_workers.values()):
                 if w.proc.poll() is not None:
                     await self._on_worker_death(w)
-            # trim idle workers beyond the warm minimum
+            # trim idle workers beyond the warm minimum, counted per
+            # language: an idle cpp worker must not occupy the python warm
+            # slot (or vice versa) — pools are language-segregated
             keep: list[WorkerHandle] = []
+            kept_by_lang: dict[str, int] = {}
             for w in self.idle_workers:
                 if (
-                    len(keep) >= self.cfg.min_idle_workers
+                    kept_by_lang.get(w.language, 0) >= self.cfg.min_idle_workers
                     and now - w.idle_since > self.cfg.worker_lease_timeout_s
                 ):
                     w.proc.terminate()
                     self.all_workers.pop(w.worker_id, None)
                 else:
                     keep.append(w)
+                    kept_by_lang[w.language] = kept_by_lang.get(w.language, 0) + 1
             self.idle_workers = keep
 
     async def _on_worker_death(self, w: WorkerHandle):
@@ -326,7 +331,7 @@ class Raylet:
                 pass
 
     # ---------------------------------------------------------- worker pool
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, language: str = "python") -> WorkerHandle:
         worker_id = WorkerID.generate()
         env = dict(os.environ)
         env.update(self.cfg.to_env())
@@ -344,13 +349,21 @@ class Raylet:
                 "RT_SESSION": self.session,
             }
         )
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker"],
-            env=env,
-            stdout=None,
-            stderr=None,
-        )
-        w = WorkerHandle(worker_id=worker_id, proc=proc)
+        if language == "cpp":
+            # C++ worker binary (rt_cpp_worker.cc runtime + user RT_REMOTE
+            # functions), pointed at via RT_CPP_WORKER (ref: cpp/ worker API)
+            binary = os.environ.get("RT_CPP_WORKER") or self.cfg.cpp_worker_binary
+            if not binary:
+                raise RuntimeError(
+                    "cpp task submitted but no C++ worker binary configured "
+                    "(set RT_CPP_WORKER=<path to binary built against "
+                    "rt_cpp_api.h>)"
+                )
+            argv = [binary]
+        else:
+            argv = [sys.executable, "-m", "ray_tpu.core.worker"]
+        proc = subprocess.Popen(argv, env=env, stdout=None, stderr=None)
+        w = WorkerHandle(worker_id=worker_id, proc=proc, language=language)
         self.all_workers[worker_id] = w
         return w
 
@@ -384,13 +397,16 @@ class Raylet:
         w.ready.set()
         return {"ok": True}
 
-    async def _pop_worker(self) -> WorkerHandle:
-        while self.idle_workers:
-            w = self.idle_workers.pop()
+    async def _pop_worker(self, language: str = "python") -> WorkerHandle:
+        # language-segregated pop (ref: worker_pool.h:231 per-language pools)
+        for i in range(len(self.idle_workers) - 1, -1, -1):
+            if self.idle_workers[i].language != language:
+                continue
+            w = self.idle_workers.pop(i)
             if w.proc.poll() is None:
                 return w
             await self._on_worker_death(w)
-        w = self._spawn_worker()
+        w = self._spawn_worker(language)
         try:
             await asyncio.wait_for(w.ready.wait(), timeout=self.cfg.worker_start_timeout_s)
         except asyncio.TimeoutError:
@@ -432,7 +448,7 @@ class Raylet:
             self._grant_waiters()
             raise rpc.RpcError("lease requester disconnected")
         try:
-            w = await self._pop_worker()
+            w = await self._pop_worker(p.get("language") or "python")
         except Exception:
             self._free_resources(resources, pg_key)
             raise

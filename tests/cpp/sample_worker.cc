@@ -1,0 +1,66 @@
+// Sample C++ worker used by tests/test_cpp_api.py (the cpp/ worker-API
+// parity fixture). Demonstrates scalars, containers, multi-return, and
+// error propagation through the cross-language path.
+#include <numeric>
+#include <stdexcept>
+
+#include "rt_cpp_api.h"
+
+using rt::Value;
+using rt::ValuePtr;
+
+ValuePtr Add(std::vector<ValuePtr>& args) {
+  return Value::integer(args.at(0)->i + args.at(1)->i);
+}
+RT_REMOTE(Add);
+
+ValuePtr Concat(std::vector<ValuePtr>& args) {
+  return Value::str(args.at(0)->s + args.at(1)->s);
+}
+RT_REMOTE(Concat);
+
+// sums a list of numbers (int or float), returns float
+ValuePtr SumList(std::vector<ValuePtr>& args) {
+  double total = 0;
+  for (auto& v : args.at(0)->items)
+    total += (v->kind == Value::kInt) ? (double)v->i : v->d;
+  return Value::real(total);
+}
+RT_REMOTE(SumList);
+
+// dict in, dict out: adds a "count" key
+ValuePtr Annotate(std::vector<ValuePtr>& args) {
+  auto d = args.at(0);
+  d->set("count", Value::integer((int64_t)d->dict.size()));
+  return d;
+}
+RT_REMOTE(Annotate);
+
+ValuePtr DivMod(std::vector<ValuePtr>& args) {
+  auto out = Value::tuple();
+  out->items.push_back(Value::integer(args.at(0)->i / args.at(1)->i));
+  out->items.push_back(Value::integer(args.at(0)->i % args.at(1)->i));
+  return out;
+}
+RT_REMOTE(DivMod);
+
+ValuePtr Fail(std::vector<ValuePtr>& args) {
+  throw std::runtime_error("deliberate C++ failure: " + args.at(0)->s);
+}
+RT_REMOTE(Fail);
+
+// echo bytes (exercises binary payloads both ways)
+ValuePtr EchoBytes(std::vector<ValuePtr>& args) {
+  return Value::bytes(args.at(0)->s);
+}
+RT_REMOTE(EchoBytes);
+
+// returns a str holding invalid UTF-8 — must fail with a clear TaskError,
+// never a driver-side UnicodeDecodeError
+ValuePtr BadString(std::vector<ValuePtr>& args) {
+  (void)args;
+  return Value::str("\xff\xfe broken");
+}
+RT_REMOTE(BadString);
+
+int main() { return rt::worker_main(); }

@@ -1,0 +1,131 @@
+"""C++ worker API tests (ref test strategy: cpp/ worker API + cross-language
+call tests). Builds tests/cpp/sample_worker.cc against the rt runtime and
+drives it from a Python driver via ray_tpu.cpp_function()."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._native import build_cpp_worker
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture(scope="module")
+def rt_cpp():
+    binary = build_cpp_worker([os.path.join(HERE, "cpp", "sample_worker.cc")])
+    os.environ["RT_CPP_WORKER"] = binary
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    os.environ.pop("RT_CPP_WORKER", None)
+
+
+def test_cpp_scalar_roundtrip(rt_cpp):
+    add = ray_tpu.cpp_function("Add")
+    assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+    assert ray_tpu.get(add.remote(-(2**40), 1), timeout=60) == -(2**40) + 1
+
+
+def test_cpp_strings_bytes_containers(rt_cpp):
+    assert ray_tpu.get(ray_tpu.cpp_function("Concat").remote("héllo ", "wörld"),
+                       timeout=60) == "héllo wörld"
+    blob = bytes(range(256))
+    assert ray_tpu.get(ray_tpu.cpp_function("EchoBytes").remote(blob),
+                       timeout=60) == blob
+    assert ray_tpu.get(
+        ray_tpu.cpp_function("SumList").remote([1, 2, 3.5]), timeout=60
+    ) == pytest.approx(6.5)
+    out = ray_tpu.get(
+        ray_tpu.cpp_function("Annotate").remote({"a": 1, "b": "x"}), timeout=60
+    )
+    assert out == {"a": 1, "b": "x", "count": 2}
+
+
+def test_cpp_multi_return(rt_cpp):
+    q, r = ray_tpu.cpp_function("DivMod", num_returns=2).remote(17, 5)
+    assert ray_tpu.get([q, r], timeout=60) == [3, 2]
+
+
+def test_cpp_error_propagates(rt_cpp):
+    from ray_tpu.core.ref import TaskError
+
+    with pytest.raises(TaskError, match="deliberate C\\+\\+ failure: boom"):
+        ray_tpu.get(ray_tpu.cpp_function("Fail").remote("boom"), timeout=60)
+
+
+def test_cpp_non_utf8_str_is_clear_error(rt_cpp):
+    from ray_tpu.core.ref import TaskError
+
+    with pytest.raises(TaskError, match="non-UTF-8"):
+        ray_tpu.get(ray_tpu.cpp_function("BadString").remote(), timeout=60)
+
+
+def test_cpp_no_binary_fails_fast():
+    """A cpp task without RT_CPP_WORKER configured must error, not hang in a
+    lease retry loop (repeated identical lease failures fail the queue)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2)\n"
+        "try:\n"
+        "    ray_tpu.get(ray_tpu.cpp_function('Add').remote(1, 2), timeout=60)\n"
+        "    print('NO-ERROR')\n"
+        "except Exception as e:\n"
+        "    print('FAILED-FAST:' + type(e).__name__)\n"
+        "ray_tpu.shutdown()\n"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "RT_CPP_WORKER"}
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=180, env=env)
+    assert "FAILED-FAST:RuntimeError" in out.stdout, (out.stdout, out.stderr)
+
+
+def test_cpp_unknown_function(rt_cpp):
+    from ray_tpu.core.ref import TaskError
+
+    with pytest.raises(TaskError, match="no C\\+\\+ task registered"):
+        ray_tpu.get(ray_tpu.cpp_function("Nope").remote(), timeout=60)
+
+
+def test_cpp_and_python_tasks_interleave(rt_cpp):
+    """Language pools are segregated: the same driver mixes both."""
+
+    @ray_tpu.remote
+    def py_add(a, b):
+        return a + b
+
+    add = ray_tpu.cpp_function("Add")
+    refs = []
+    for i in range(10):
+        refs.append(add.remote(i, i) if i % 2 == 0 else py_add.remote(i, i))
+    assert ray_tpu.get(refs, timeout=120) == [2 * i for i in range(10)]
+
+
+def test_cpp_driver_end_to_end(rt_cpp):
+    """A C++ *driver* (rt::Client) submits C++ tasks to the same cluster:
+    GCS discovery -> raylet lease -> worker push_task -> inline result."""
+    import subprocess
+
+    from ray_tpu._native import build_cpp_client
+
+    binary = build_cpp_client([os.path.join(HERE, "cpp", "sample_client.cc")])
+    host, port = ray_tpu.get_runtime_context().gcs_address
+    out = subprocess.run([binary, host, str(port)], capture_output=True,
+                         text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "Add=42" in out.stdout
+    assert "Concat=c++ driver" in out.stdout
+    assert "TaskError: deliberate C++ failure: from-cpp-driver" in out.stdout
+    assert f"Burst={sum(i + 1 for i in range(20))}" in out.stdout
+    assert out.stdout.strip().endswith("OK")
+
+
+def test_cpp_burst_reuses_worker(rt_cpp):
+    """Lease caching must reuse the same C++ worker across a burst."""
+    add = ray_tpu.cpp_function("Add")
+    vals = ray_tpu.get([add.remote(i, 1) for i in range(50)], timeout=120)
+    assert vals == [i + 1 for i in range(50)]
