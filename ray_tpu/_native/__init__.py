@@ -70,7 +70,15 @@ def _build_cpp_binary(sources: list[str], runtime_cc: str, prefix: str,
     tag = h.hexdigest()[:16]
     out = out_path or os.path.join(_BUILD_DIR, f"{prefix}_{tag}")
     if os.path.exists(out):
-        return out
+        if out_path is None:
+            return out  # hash is in the name: existing == current
+        # explicit out_path: the name carries no hash, so check the sidecar
+        try:
+            with open(out + ".hash") as f:
+                if f.read().strip() == tag:
+                    return out
+        except OSError:
+            pass  # no/unreadable sidecar: rebuild
     tmp = out + f".tmp{os.getpid()}"
     proc = subprocess.run(
         ["g++", "-std=c++17", "-O2", "-I", _SRC_DIR, "-o", tmp,
@@ -83,6 +91,9 @@ def _build_cpp_binary(sources: list[str], runtime_cc: str, prefix: str,
             f"C++ build failed (g++ exit {proc.returncode}):\n{proc.stderr}"
         )
     os.replace(tmp, out)
+    if out_path is not None:
+        with open(out + ".hash", "w") as f:
+            f.write(tag)
     return out
 
 

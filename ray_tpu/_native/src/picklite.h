@@ -389,19 +389,30 @@ class Encoder {
     uint64_t u; std::memcpy(&u, &d, 8);
     for (int k = 7; k >= 0; --k) out += (char)((u >> (8 * k)) & 0xff);
   }
+  // Strict UTF-8 (matches CPython's decoder): rejects overlong encodings,
+  // UTF-16 surrogates (U+D800-DFFF), code points above U+10FFFF, and
+  // invalid lead bytes — anything CPython's BINUNICODE decode would reject.
   static bool valid_utf8(const std::string& s) {
     size_t i = 0, n = s.size();
     while (i < n) {
       uint8_t c = (uint8_t)s[i];
+      if (c < 0x80) { ++i; continue; }
       size_t extra;
-      if (c < 0x80) extra = 0;
-      else if ((c >> 5) == 0x6) extra = 1;
-      else if ((c >> 4) == 0xe) extra = 2;
-      else if ((c >> 3) == 0x1e) extra = 3;
-      else return false;
-      if (extra > 0 && i + extra >= n) return false;
-      for (size_t k = 1; k <= extra; ++k)
-        if (((uint8_t)s[i + k] >> 6) != 0x2) return false;
+      uint32_t cp;
+      if ((c & 0xe0) == 0xc0) { extra = 1; cp = c & 0x1f; }
+      else if ((c & 0xf0) == 0xe0) { extra = 2; cp = c & 0x0f; }
+      else if ((c & 0xf8) == 0xf0) { extra = 3; cp = c & 0x07; }
+      else return false;  // continuation or F8+ lead byte
+      if (i + extra >= n) return false;
+      for (size_t k = 1; k <= extra; ++k) {
+        uint8_t cc = (uint8_t)s[i + k];
+        if ((cc & 0xc0) != 0x80) return false;
+        cp = (cp << 6) | (cc & 0x3f);
+      }
+      static const uint32_t kMin[4] = {0, 0x80, 0x800, 0x10000};
+      if (cp < kMin[extra]) return false;                 // overlong
+      if (cp >= 0xd800 && cp <= 0xdfff) return false;    // surrogate
+      if (cp > 0x10ffff) return false;                   // out of range
       i += extra + 1;
     }
     return true;

@@ -50,6 +50,7 @@ struct Worker {
   int server_port = 0;
   std::atomic<long> current_task_lo{0};  // first 8 bytes of running task id
   std::mutex exec_mu;                    // one task at a time (worker invariant)
+  std::mutex write_mu;                   // interleaved responses per process
 
   ValuePtr envelope(const char* kind, int64_t corr_id) {
     auto msg = Value::dict_();
@@ -62,10 +63,12 @@ struct Worker {
     auto msg = envelope("r", corr_id);
     msg->set("v", value ? value : Value::none());
     msg->set("e", error ? error : Value::none());
-    return write_frame(fd, picklite::dumps(*msg));
+    std::string frame = picklite::dumps(*msg);
+    std::lock_guard<std::mutex> g(write_mu);  // replies may interleave
+    return write_frame(fd, frame);
   }
 
-  ValuePtr run_task(const ValuePtr& spec) {
+  ValuePtr run_task(const ValuePtr& spec, long tlo) {
     auto fname = spec->get("func_name");
     if (!fname || fname->kind != Value::kStr)
       throw std::runtime_error("spec has no func_name (cpp task expected)");
@@ -92,7 +95,17 @@ struct Worker {
       }
     }
     std::lock_guard<std::mutex> g(exec_mu);
-    return it->second(args);
+    // mark under the execution lock: with pipelined pushes, the marker must
+    // always name the task that is actually running
+    current_task_lo.store(tlo);
+    try {
+      auto out = it->second(args);
+      current_task_lo.store(0);
+      return out;
+    } catch (...) {
+      current_task_lo.store(0);
+      throw;
+    }
   }
 
   void handle_push_task(int fd, int64_t corr_id, const ValuePtr& payload) {
@@ -100,15 +113,13 @@ struct Worker {
     ValuePtr reply = Value::dict_();
     try {
       if (!spec) throw std::runtime_error("no spec");
-      // mark current task (cancel_if_current identity check)
+      // current-task marker for the cancel_if_current identity check
       auto tid = spec->get("task_id");
       long tlo = 0;
       if (tid && !tid->items.empty() && tid->items[0]->kind == Value::kBytes &&
           tid->items[0]->s.size() >= 8)
         std::memcpy(&tlo, tid->items[0]->s.data(), 8);
-      current_task_lo.store(tlo);
-      ValuePtr value = run_task(spec);
-      current_task_lo.store(0);
+      ValuePtr value = run_task(spec, tlo);
       int64_t num_returns = 1;
       auto nr = spec->get("num_returns");
       if (nr && nr->kind == Value::kInt) num_returns = nr->i;
@@ -129,7 +140,6 @@ struct Worker {
       }
       reply->set("results", results);
     } catch (const std::exception& e) {
-      current_task_lo.store(0);
       auto err = Value::opaque("ray_tpu.core.ref", "TaskError");
       err->items.push_back(Value::str(e.what()));
       reply->set("error", err);
@@ -155,7 +165,12 @@ struct Worker {
       auto payload = msg->get("p");
       if (!method) continue;
       if (method->s == "push_task") {
-        handle_push_task(fd, corr_id, payload);
+        // execute off-thread so this connection keeps reading — a
+        // cancel_if_current sent on the SAME connection mid-task must be
+        // seen while the task runs (exec_mu still serializes execution)
+        std::thread([this, fd, corr_id, payload] {
+          handle_push_task(fd, corr_id, payload);
+        }).detach();
       } else if (method->s == "cancel_if_current") {
         long tlo = 0;
         auto tid = payload ? payload->get("task_id") : nullptr;
@@ -168,6 +183,13 @@ struct Worker {
         respond(fd, corr_id, Value::boolean(false));
       } else if (method->s == "ping") {
         respond(fd, corr_id, Value::boolean(true));
+      } else if (method->s == "__hello__") {
+        auto v = Value::dict_();
+        auto proto = Value::tuple();
+        proto->items.push_back(Value::integer(wire::kProtocolMajor));
+        proto->items.push_back(Value::integer(wire::kProtocolMinor));
+        v->set("proto", proto);
+        respond(fd, corr_id, v);
       } else {
         auto err = Value::opaque("ray_tpu.utils.rpc", "RpcError");
         err->items.push_back(

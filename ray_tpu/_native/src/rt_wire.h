@@ -19,6 +19,11 @@
 namespace rt {
 namespace wire {
 
+// Wire-schema version; must match ray_tpu/utils/schema.py PROTOCOL_VERSION
+// (tests/test_wire_schema.py cross-checks the two).
+constexpr int kProtocolMajor = 1;
+constexpr int kProtocolMinor = 1;
+
 inline bool read_exact(int fd, void* buf, size_t n) {
   auto* p = (char*)buf;
   while (n > 0) {

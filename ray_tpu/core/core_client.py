@@ -102,7 +102,11 @@ class _SchedulingKeyState:
     workers: list[_LeasedWorker] = field(default_factory=list)
     lease_requests_inflight: int = 0
     inflight_tasks: int = 0
-    lease_failures: int = 0  # consecutive; N in a row fails the pending queue
+    # persistent-lease-failure breaker: repeated identical errors over real
+    # time with zero live workers fail the pending queue (see _request_lease)
+    lease_failures: int = 0
+    lease_failure_sig: str | None = None
+    lease_failure_since: float = 0.0
 
 
 class _TaskEventBuffer:
@@ -964,6 +968,7 @@ class CoreClient:
                     w.conn = await rpc.connect(*w.address)
                     state.workers.append(w)
                     state.lease_failures = 0
+                    state.lease_failure_sig = None
                     # arm the idle-return timer NOW: a lease granted after
                     # the backlog drained may never run a task, and the
                     # post-task timer alone would leak it (and its CPUs)
@@ -971,18 +976,34 @@ class CoreClient:
                     break
                 raylet_addr = tuple(reply["spill_to"])
         except Exception as e:
-            # A lease that fails repeatedly with the same error is a
-            # configuration problem (e.g. cpp task but no RT_CPP_WORKER
-            # binary), not transient pressure: fail the pending tasks
-            # instead of spinning spawn->raise->pump forever.
-            state.lease_failures += 1
-            if state.lease_failures >= 3:
+            # A lease that keeps failing the SAME way with no workers to
+            # show for it is a configuration problem (e.g. cpp task but no
+            # RT_CPP_WORKER binary): fail the pending tasks instead of
+            # spinning spawn->raise->pump forever. Guarded against one
+            # transient hiccup failing several PARALLEL requests at once:
+            # the error text must repeat, the failures must span real time
+            # (> 2s, i.e. distinct attempts), and no lease may be live.
+            now = time.monotonic()
+            sig = f"{type(e).__name__}: {e}"
+            if sig != state.lease_failure_sig:
+                state.lease_failure_sig = sig
+                state.lease_failures = 1
+                state.lease_failure_since = now
+            else:
+                state.lease_failures += 1
+            persistent = (
+                state.lease_failures >= 3
+                and now - state.lease_failure_since > 2.0
+                and not state.workers
+            )
+            if persistent:
                 err = e if isinstance(e, Exception) else TaskError(str(e))
                 while not state.pending.empty():
                     spec = state.pending.get_nowait()
                     self._complete_task_error(spec, err)
                     state.inflight_tasks -= 1
                 state.lease_failures = 0
+                state.lease_failure_sig = None
             else:
                 traceback.print_exc()
         finally:

@@ -242,6 +242,15 @@ class LoopbackConnection:
 _LOCAL_SERVERS: dict[tuple, tuple] = {}
 
 
+async def _hello_handler(conn, payload):
+    """Version handshake (ref: protobuf schema versioning role — see
+    utils/schema.py). Replies with our version; the CLIENT enforces
+    compatibility so old peers get a clear error, not a hang."""
+    from ray_tpu.utils import schema
+
+    return {"proto": schema.PROTOCOL_VERSION}
+
+
 class RpcServer:
     """Method-dispatch server. Handlers: async def h(conn, payload) -> value."""
 
@@ -249,7 +258,7 @@ class RpcServer:
         self._host = host
         self._port = port
         self._server: asyncio.base_events.Server | None = None
-        self._handlers: dict[str, Callable] = {}
+        self._handlers: dict[str, Callable] = {"__hello__": _hello_handler}
         self._conns: set[Connection] = set()
         self._dispatch_tasks: set[asyncio.Task] = set()
         self.on_disconnect: Callable[[Connection], None] | None = None
@@ -372,10 +381,11 @@ class RpcServer:
                 pass
 
 
-async def connect(host: str, port: int, timeout: float = 30.0) -> Connection:
+async def connect(host: str, port: int, timeout: float = 30.0,
+                  handshake: bool = True) -> Connection:
     local = _LOCAL_SERVERS.get((host, port))
     if local is not None and local[1] is asyncio.get_running_loop():
-        return local[0].attach_loopback()
+        return local[0].attach_loopback()  # same process: same version
     deadline = asyncio.get_running_loop().time() + timeout
     last_err: Exception | None = None
     while asyncio.get_running_loop().time() < deadline:
@@ -383,11 +393,41 @@ async def connect(host: str, port: int, timeout: float = 30.0) -> Connection:
             reader, writer = await asyncio.open_connection(host, port)
             conn = Connection(reader, writer)
             conn.start()
+            if handshake:
+                remaining = deadline - asyncio.get_running_loop().time()
+                await _check_version(conn, max(1.0, remaining))
             return conn
         except (ConnectionRefusedError, OSError) as e:
             last_err = e
             await asyncio.sleep(0.05)
     raise ConnectionLost(f"could not connect to {host}:{port}: {last_err}")
+
+
+async def _check_version(conn: Connection, timeout: float):
+    """Enforce wire-schema compatibility (utils/schema.py) at connect time."""
+    from ray_tpu.utils import schema
+
+    try:
+        reply = await conn.call("__hello__", {"proto": schema.PROTOCOL_VERSION},
+                                timeout=timeout)
+    except asyncio.TimeoutError:
+        await conn.close()
+        raise RpcError("peer did not answer the version handshake") from None
+    except RpcError as e:
+        if "no handler" in str(e):
+            # pre-handshake peer: the handshake itself is a 1.x minor
+            # addition, so an unknown-method reply means "same major,
+            # older minor" — compatible by policy
+            return
+        await conn.close()
+        raise
+    peer = tuple(reply.get("proto", (0, 0))) if isinstance(reply, dict) else (0, 0)
+    if not schema.compatible(peer):
+        await conn.close()
+        raise RpcError(
+            f"incompatible wire protocol: peer speaks {peer}, "
+            f"we speak {schema.PROTOCOL_VERSION}"
+        )
 
 
 class EventLoopThread:

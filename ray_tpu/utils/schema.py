@@ -1,0 +1,132 @@
+"""Versioned wire schema for the control plane.
+
+The role of the reference's protobuf schema tree (ref: src/ray/protobuf/
+gcs_service.proto, node_manager.proto, core_worker.proto): one place that
+names every RPC service, method, and payload field, with the version each
+was introduced in. Peers exchange PROTOCOL_VERSION at connect time
+(rpc.connect ``__hello__`` handshake) and refuse major mismatches; minor
+additions are backwards-compatible (unknown payload keys are ignored by
+every handler, the dict-payload equivalent of proto field skipping).
+
+tests/test_wire_schema.py machine-checks this catalog against the live
+``rpc_*`` handlers, so adding a method without cataloging it fails CI —
+the same forcing function a .proto file provides.
+"""
+
+from __future__ import annotations
+
+# (major, minor): bump MAJOR for incompatible changes (renamed/removed
+# methods, changed field meaning), MINOR for additions.
+PROTOCOL_VERSION = (1, 1)
+
+# service -> method -> {"since": (major, minor), "fields": {...}}
+# field values document type + meaning; "->" entries are the reply shape.
+CATALOG: dict[str, dict[str, dict]] = {
+    # ---------------------------------------------------------------- GCS
+    # (ref: gcs_service.proto services)
+    "gcs": {
+        "register_node": {"since": (1, 0), "fields": {
+            "node_id": "hex", "address": "(host, port)", "resources": "dict",
+            "labels": "dict", "store_name": "str", "->": "cluster view"}},
+        "register_job": {"since": (1, 0), "fields": {"job_id": "hex"}},
+        "register_actor": {"since": (1, 0), "fields": {
+            "actor_id": "ActorID", "cls_blob": "bytes", "opts": "dict"}},
+        "get_actor": {"since": (1, 0), "fields": {
+            "actor_id": "ActorID | None", "name": "str | None",
+            "->": "actor info dict"}},
+        "kill_actor": {"since": (1, 0), "fields": {
+            "actor_id": "ActorID", "no_restart": "bool"}},
+        "report_actor_death": {"since": (1, 0), "fields": {
+            "actor_id": "ActorID", "reason": "str"}},
+        "list_actors": {"since": (1, 0), "fields": {"->": "[actor info]"}},
+        "heartbeat": {"since": (1, 0), "fields": {
+            "node_id": "hex", "resources_available": "dict", "load": "dict"}},
+        "get_cluster": {"since": (1, 0), "fields": {"->": "[node info]"}},
+        "drain_node": {"since": (1, 0), "fields": {"node_id": "hex"}},
+        "subscribe": {"since": (1, 0), "fields": {"channels": "[str]"}},
+        "kv_put": {"since": (1, 0), "fields": {
+            "ns": "str", "key": "str", "value": "bytes", "overwrite": "bool"}},
+        "kv_get": {"since": (1, 0), "fields": {"ns": "str", "key": "str"}},
+        "kv_multi_get": {"since": (1, 0), "fields": {"ns": "str", "keys": "[str]"}},
+        "kv_del": {"since": (1, 0), "fields": {"ns": "str", "key": "str"}},
+        "kv_keys": {"since": (1, 0), "fields": {"ns": "str", "prefix": "str"}},
+        "kv_exists": {"since": (1, 0), "fields": {"ns": "str", "key": "str"}},
+        "create_placement_group": {"since": (1, 0), "fields": {
+            "bundles": "[dict]", "strategy": "PACK|SPREAD|STRICT_*"}},
+        "remove_placement_group": {"since": (1, 0), "fields": {"pg_id": "PGID"}},
+        "get_placement_group": {"since": (1, 0), "fields": {"pg_id": "PGID"}},
+        "list_placement_groups": {"since": (1, 0), "fields": {}},
+        "report_task_events": {"since": (1, 0), "fields": {"events": "[dict]"}},
+        "get_task_events": {"since": (1, 0), "fields": {
+            "job_id": "hex | None", "limit": "int"}},
+    },
+    # -------------------------------------------------------------- raylet
+    # (ref: node_manager.proto NodeManagerService)
+    "raylet": {
+        "register_client": {"since": (1, 0), "fields": {
+            "worker_id": "hex", "address": "(host, port)"}},
+        "lease_worker": {"since": (1, 0), "fields": {
+            "resources": "dict", "pg_id": "PGID | None", "bundle_index": "int",
+            "owner_bound": "bool", "no_spill": "bool", "for_actor": "ActorID",
+            "language": "python|cpp (since 1.1)"}},
+        "return_lease": {"since": (1, 0), "fields": {
+            "lease_id": "int", "kill": "bool"}},
+        "worker_ready": {"since": (1, 0), "fields": {
+            "worker_id": "hex", "address": "(host, port)", "pid": "int",
+            "language": "str (since 1.1)"}},
+        "get_lease_env": {"since": (1, 0), "fields": {"worker_id": "hex"}},
+        "kill_worker": {"since": (1, 0), "fields": {"worker_id": "hex"}},
+        "prepare_bundle": {"since": (1, 0), "fields": {
+            "pg_id": "PGID", "bundle_index": "int", "resources": "dict"}},
+        "commit_bundle": {"since": (1, 0), "fields": {
+            "pg_id": "PGID", "bundle_index": "int"}},
+        "return_bundle": {"since": (1, 0), "fields": {
+            "pg_id": "PGID", "bundle_index": "int"}},
+        "pull_object": {"since": (1, 0), "fields": {
+            "object_id": "bytes", "owner_address": "(host, port)"}},
+        "fetch_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "fetch_object_meta": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "fetch_object_chunk": {"since": (1, 0), "fields": {
+            "object_id": "bytes", "offset": "int", "size": "int"}},
+        "fetch_object_done": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "delete_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+    },
+    # ------------------------------------------------- owner (CoreClient)
+    # (ref: core_worker.proto owner-side RPCs)
+    "owner": {
+        "get_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "probe_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "wait_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "borrow_object": {"since": (1, 0), "fields": {
+            "object_id": "bytes", "borrower": "hex"}},
+        "unborrow_object": {"since": (1, 0), "fields": {
+            "object_id": "bytes", "borrower": "hex"}},
+        "recover_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "generator_item": {"since": (1, 0), "fields": {
+            "task_id": "TaskID", "index": "int", "item": "packed | None",
+            "done": "bool"}},
+    },
+    # ------------------------------------------------------------- worker
+    # (ref: core_worker.proto PushTask + worker-side control)
+    "worker": {
+        "push_task": {"since": (1, 0), "fields": {"spec": "task spec dict"}},
+        "push_actor_task": {"since": (1, 0), "fields": {
+            "spec": "actor task spec", "seq": "int"}},
+        "create_actor": {"since": (1, 0), "fields": {
+            "actor_id": "ActorID", "cls_blob": "bytes", "args": "[arg]",
+            "opts": "dict"}},
+        "cancel_if_current": {"since": (1, 1), "fields": {"task_id": "TaskID"}},
+        "exit_worker": {"since": (1, 0), "fields": {}},
+        "ping": {"since": (1, 0), "fields": {}},
+        "start_dag_loop": {"since": (1, 0), "fields": {"schedule": "dict"}},
+    },
+}
+
+
+def compatible(peer: tuple[int, int]) -> bool:
+    """Same major = compatible; minor additions are tolerated both ways."""
+    return peer[0] == PROTOCOL_VERSION[0]
+
+
+def methods(service: str) -> set[str]:
+    return set(CATALOG[service])

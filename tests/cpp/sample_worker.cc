@@ -1,6 +1,7 @@
 // Sample C++ worker used by tests/test_cpp_api.py (the cpp/ worker-API
 // parity fixture). Demonstrates scalars, containers, multi-return, and
 // error propagation through the cross-language path.
+#include <ctime>
 #include <numeric>
 #include <stdexcept>
 
@@ -48,6 +49,17 @@ ValuePtr Fail(std::vector<ValuePtr>& args) {
   throw std::runtime_error("deliberate C++ failure: " + args.at(0)->s);
 }
 RT_REMOTE(Fail);
+
+ValuePtr SleepSeconds(std::vector<ValuePtr>& args) {
+  double s = args.at(0)->kind == Value::kInt ? (double)args.at(0)->i
+                                             : args.at(0)->d;
+  struct timespec ts;
+  ts.tv_sec = (time_t)s;
+  ts.tv_nsec = (long)((s - (double)ts.tv_sec) * 1e9);
+  nanosleep(&ts, nullptr);
+  return Value::boolean(true);
+}
+RT_REMOTE(SleepSeconds);
 
 // echo bytes (exercises binary payloads both ways)
 ValuePtr EchoBytes(std::vector<ValuePtr>& args) {

@@ -1,0 +1,173 @@
+// store_stress.cc — concurrency stress driver for the shm object store,
+// built both plain and with -fsanitize=thread by tests/test_store_tsan.py
+// (the race-detection role of the reference's .bazelrc build:tsan configs,
+// ref: .bazelrc:113-125; sanitizers run over the C++ store because it is
+// the one component with real cross-thread/cross-process shared state).
+//
+// Spawns writer/reader/deleter/channel threads hammering one arena for a
+// fixed wall-clock budget; exits 0 iff no API invariant broke. TSAN findings
+// surface on stderr and fail the wrapping pytest.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_store_create(const char* name, uint64_t capacity);
+void* rt_store_connect(const char* name);
+void rt_store_close(void* h);
+int rt_store_destroy(const char* name);
+int rt_create(void* h, const uint8_t* id, uint64_t size, uint64_t* offset_out);
+int rt_seal(void* h, const uint8_t* id);
+int rt_get(void* h, const uint8_t* id, int64_t timeout_ms, uint64_t* offset_out,
+           uint64_t* size_out);
+int rt_contains(void* h, const uint8_t* id);
+int rt_release(void* h, const uint8_t* id);
+int rt_delete(void* h, const uint8_t* id);
+int rt_chan_create(void* h, const uint8_t* id, uint64_t size,
+                   uint32_t num_readers, uint64_t* offset_out);
+int rt_chan_write_acquire(void* h, const uint8_t* id, int64_t timeout_ms);
+int rt_chan_write_release(void* h, const uint8_t* id, uint64_t payload_size);
+int rt_chan_read_acquire(void* h, const uint8_t* id, uint64_t last_version,
+                         int64_t timeout_ms, uint64_t* version_out,
+                         uint64_t* payload_size_out);
+int rt_chan_read_release(void* h, const uint8_t* id);
+int rt_chan_data(void* h, const uint8_t* id, uint64_t* offset_out,
+                 uint64_t* size_out);
+}
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kIdSize = 20;
+std::atomic<bool> stop{false};
+std::atomic<long> ops{0};
+std::atomic<int> failures{0};
+uint8_t* g_base = nullptr;  // our own mapping of the arena (offset -> ptr)
+
+void make_id(uint8_t* id, int lane, int slot) {
+  std::memset(id, 0, kIdSize);
+  std::memcpy(id, &lane, sizeof(lane));
+  std::memcpy(id + 4, &slot, sizeof(slot));
+}
+
+// create -> fill -> seal -> delete churn within a private id lane
+void writer(void* h, int lane) {
+  std::mt19937 rng(lane);
+  int slot = 0;
+  while (!stop.load()) {
+    uint8_t id[kIdSize];
+    make_id(id, lane, slot++ % 64);
+    uint64_t size = 256 + (rng() % 8192);
+    uint64_t off;
+    int rc = rt_create(h, id, size, &off);
+    if (rc == 0) {
+      rt_seal(h, id);
+      if (rng() % 2) rt_delete(h, id);
+    } else if (rc == -2 /*kExists*/) {
+      rt_delete(h, id);
+    }
+    ops.fetch_add(1);
+  }
+}
+
+// get/release against the writers' lanes (cross-thread object handoff)
+void reader(void* h, int lanes) {
+  std::mt19937 rng(9999);
+  while (!stop.load()) {
+    uint8_t id[kIdSize];
+    make_id(id, (int)(rng() % lanes), (int)(rng() % 64));
+    uint64_t off, size;
+    if (rt_get(h, id, 1, &off, &size) == 0) {
+      if (size == 0) failures.fetch_add(1);  // sealed objects are non-empty
+      rt_release(h, id);
+    }
+    rt_contains(h, id);
+    ops.fetch_add(1);
+  }
+}
+
+// 1-writer/1-reader versioned channel ping-pong
+void channel_pair(void* h, int lane) {
+  uint8_t id[kIdSize];
+  make_id(id, 1000 + lane, 0);
+  uint64_t off;
+  if (rt_chan_create(h, id, 4096, 1, &off) != 0) return;
+  std::thread rd([h, &id] {
+    uint64_t version = 0, payload = 0;
+    while (!stop.load()) {
+      if (rt_chan_read_acquire(h, id, version, 5, &version, &payload) == 0) {
+        uint64_t doff, dsize;
+        if (payload >= 8 && rt_chan_data(h, id, &doff, &dsize) == 0) {
+          uint64_t v;
+          std::memcpy(&v, g_base + doff, 8);
+          if (v != version) failures.fetch_add(1);  // torn write visible
+        }
+        rt_chan_read_release(h, id);
+      }
+    }
+  });
+  uint64_t version = 0;
+  while (!stop.load()) {
+    if (rt_chan_write_acquire(h, id, 5) == 0) {
+      uint64_t doff, dsize;
+      if (rt_chan_data(h, id, &doff, &dsize) == 0) {
+        ++version;
+        std::memcpy(g_base + doff, &version, 8);
+        rt_chan_write_release(h, id, 8);
+        ops.fetch_add(1);
+      }
+    }
+  }
+  rd.join();
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* name = argc > 1 ? argv[1] : "rt_stress";
+  double seconds = argc > 2 ? std::atof(argv[2]) : 3.0;
+  void* h = rt_store_create(name, 64ull << 20);
+  if (!h) {
+    std::fprintf(stderr, "store create failed\n");
+    return 2;
+  }
+  {
+    // map the arena like an external client would (offsets -> pointers)
+    int fd = ::shm_open(name, O_RDWR, 0600);
+    struct stat st;
+    if (fd < 0 || ::fstat(fd, &st) != 0) {
+      std::fprintf(stderr, "arena map failed\n");
+      return 2;
+    }
+    g_base = (uint8_t*)::mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE,
+                              MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (g_base == MAP_FAILED) {
+      std::fprintf(stderr, "arena mmap failed\n");
+      return 2;
+    }
+  }
+  const int kWriters = 4;
+  std::vector<std::thread> ts;
+  for (int i = 0; i < kWriters; ++i) ts.emplace_back(writer, h, i);
+  for (int i = 0; i < 2; ++i) ts.emplace_back(reader, h, kWriters);
+  for (int i = 0; i < 2; ++i) ts.emplace_back(channel_pair, h, i);
+  std::this_thread::sleep_for(std::chrono::duration<double>(seconds));
+  stop.store(true);
+  for (auto& t : ts) t.join();
+  rt_store_close(h);
+  rt_store_destroy(name);
+  std::printf("ops=%ld failures=%d\n", ops.load(), failures.load());
+  return failures.load() == 0 ? 0 : 1;
+}

@@ -124,6 +124,24 @@ def test_cpp_driver_end_to_end(rt_cpp):
     assert out.stdout.strip().endswith("OK")
 
 
+def test_cpp_force_cancel_running_task(rt_cpp):
+    """cancel(force=True) must reach a C++ worker mid-task: pushes run
+    off-thread so the connection keeps reading, and cancel_if_current
+    kills by exact task identity."""
+    import time
+
+    from ray_tpu.core.ref import TaskCancelledError
+
+    ref = ray_tpu.cpp_function("SleepSeconds").remote(120)
+    time.sleep(2.0)  # let it dispatch and start sleeping
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    # identity path, not the 5s raylet-fallback timeout
+    assert time.monotonic() - t0 < 5.0
+
+
 def test_cpp_burst_reuses_worker(rt_cpp):
     """Lease caching must reuse the same C++ worker across a burst."""
     add = ray_tpu.cpp_function("Add")

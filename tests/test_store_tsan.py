@@ -1,0 +1,50 @@
+"""Race detection for the C++ shm store (ref: .bazelrc build:tsan
+configs, .bazelrc:113-125 — the reference runs its C++ core under
+ThreadSanitizer; here the store is the concurrency-bearing native code).
+
+Builds tests/cpp/store_stress.cc twice (plain, -fsanitize=thread) and runs
+both: the plain build checks API invariants under contention, the TSAN
+build fails the test on any data-race report."""
+
+import os
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(os.path.dirname(HERE), "ray_tpu", "_native", "src")
+BUILD = os.path.join(os.path.dirname(HERE), "ray_tpu", "_native", "build")
+
+
+def _build(flags, out_name):
+    os.makedirs(BUILD, exist_ok=True)
+    out = os.path.join(BUILD, out_name)
+    cmd = ["g++", "-std=c++17", "-O1", "-g", *flags,
+           "-o", out,
+           os.path.join(HERE, "cpp", "store_stress.cc"),
+           os.path.join(SRC, "store.cc"),
+           "-lpthread", "-lrt"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        return None, proc.stderr
+    return out, None
+
+
+def test_store_stress_plain():
+    binary, err = _build([], "store_stress_plain")
+    assert binary, err
+    out = subprocess.run([binary, f"rt_stress_{os.getpid()}", "2.0"],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "failures=0" in out.stdout
+
+
+def test_store_stress_tsan():
+    binary, err = _build(["-fsanitize=thread"], "store_stress_tsan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=thread: {err[-200:]}")
+    out = subprocess.run([binary, f"rt_tsan_{os.getpid()}", "2.0"],
+                         capture_output=True, text=True, timeout=300)
+    assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
