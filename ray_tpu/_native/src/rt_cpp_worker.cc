@@ -13,12 +13,14 @@
 // serialization.pack (u32 meta-len + pickled (sizes, header) + buffers);
 // errors unpickle as real ray_tpu.core.ref.TaskError on the driver.
 
+#include <csignal>
 #include <unistd.h>
 
 #include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <thread>
 
@@ -41,6 +43,28 @@ using wire::unpack_value;
 using wire::write_frame;
 
 // ----------------------------------------------------------------- worker
+
+// Shared per-connection state: the read loop and detached task threads
+// both hold a reference; the fd closes only when the last user is done,
+// so a late task reply can never hit a recycled descriptor.
+struct ConnState {
+  int fd;
+  std::atomic<int> inflight{0};
+  std::atomic<bool> eof{false};
+  std::mutex close_mu;
+  bool closed = false;
+
+  explicit ConnState(int f) : fd(f) {}
+
+  void maybe_close() {
+    if (!eof.load() || inflight.load() != 0) return;
+    std::lock_guard<std::mutex> g(close_mu);
+    if (!closed) {
+      closed = true;
+      ::close(fd);
+    }
+  }
+};
 
 struct Worker {
   std::string worker_id_hex;
@@ -147,7 +171,8 @@ struct Worker {
     respond(fd, corr_id, reply);
   }
 
-  void serve_conn(int fd) {
+  void serve_conn(std::shared_ptr<ConnState> cs) {
+    const int fd = cs->fd;
     std::string frame;
     while (read_frame(fd, &frame)) {
       ValuePtr msg;
@@ -167,9 +192,13 @@ struct Worker {
       if (method->s == "push_task") {
         // execute off-thread so this connection keeps reading — a
         // cancel_if_current sent on the SAME connection mid-task must be
-        // seen while the task runs (exec_mu still serializes execution)
-        std::thread([this, fd, corr_id, payload] {
-          handle_push_task(fd, corr_id, payload);
+        // seen while the task runs (exec_mu still serializes execution).
+        // The ConnState ref keeps the fd alive until the reply is written.
+        cs->inflight.fetch_add(1);
+        std::thread([this, cs, corr_id, payload] {
+          handle_push_task(cs->fd, corr_id, payload);
+          cs->inflight.fetch_sub(1);
+          cs->maybe_close();
         }).detach();
       } else if (method->s == "cancel_if_current") {
         long tlo = 0;
@@ -197,10 +226,12 @@ struct Worker {
         respond(fd, corr_id, nullptr, err);
       }
     }
-    ::close(fd);
+    cs->eof.store(true);
+    cs->maybe_close();
   }
 
   int run() {
+    ::signal(SIGPIPE, SIG_IGN);  // peer-closed writes return EPIPE, not kill
     const char* wid = ::getenv("RT_WORKER_ID");
     const char* rh = ::getenv("RT_RAYLET_HOST");
     const char* rp = ::getenv("RT_RAYLET_PORT");
@@ -265,7 +296,8 @@ struct Worker {
       if (cfd < 0) continue;
       int one = 1;
       ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      std::thread([this, cfd] { serve_conn(cfd); }).detach();
+      auto cs = std::make_shared<ConnState>(cfd);
+      std::thread([this, cs] { serve_conn(cs); }).detach();
     }
   }
 };

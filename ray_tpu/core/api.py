@@ -49,6 +49,7 @@ def init(
     object_store_memory: int | None = None,
     runtime_env: dict | None = None,
     _in_process: bool = True,
+    _client_mode: bool = False,
 ) -> None:
     """Bring up (or connect to) a cluster and attach this driver.
 
@@ -99,7 +100,7 @@ def init(
         gcs_addr = (host, int(port))
         raylet_addr = _find_local_raylet(_io, gcs_addr)
 
-    core = CoreClient(loop=_io.loop)
+    core = CoreClient(loop=_io.loop, client_mode=_client_mode)
     _io.run(core.connect(gcs_addr, raylet_addr), timeout=cfg.rpc_connect_timeout_s + 5)
     _core = core
     if runtime_env:

@@ -151,8 +151,10 @@ class _TaskEventBuffer:
 
 
 class CoreClient:
-    def __init__(self, loop: asyncio.AbstractEventLoop | None = None):
+    def __init__(self, loop: asyncio.AbstractEventLoop | None = None,
+                 client_mode: bool = False):
         self.cfg = get_config()
+        self.client_mode = client_mode  # remote driver: no local shm arena
         self.loop = loop or asyncio.get_event_loop()
         self.worker_id = WorkerID.generate()
         self.job_id: JobID | None = None
@@ -223,7 +225,18 @@ class CoreClient:
         self.raylet_address = raylet_address
         info = await self.raylet.call("register_client", {})
         self.node_id = info["node_id"]
-        self.store = SharedObjectStore(info["store_name"])
+        if self.client_mode:
+            self.store = None
+        else:
+            try:
+                self.store = SharedObjectStore(info["store_name"])
+            except Exception:
+                # Remote driver (Ray-Client role, ref: util/client/): the
+                # raylet's shm arena is on another machine. Objects this
+                # driver owns live in its memory store and are owner-served
+                # over RPC; shm-resident results are fetched through the
+                # raylet's chunked transfer RPCs instead of mapped.
+                self.store = None
         self.job_id = await self.gcs.call("register_job", {})
         self._bg.spawn(self.task_events._flush_loop(), self.loop)
 
@@ -419,7 +432,9 @@ class CoreClient:
         metrics.objects_put.inc()
         metrics.object_bytes_put.inc(size)
         entry = _MemEntry()
-        if size <= self.cfg.max_inline_object_size:
+        if size <= self.cfg.max_inline_object_size or self.store is None:
+            # client mode has no local shm: every owned object is memory-
+            # store resident and owner-served (borrowers fetch over RPC)
             entry.packed = _pack_bytes(meta, buffers, size)
             self.memory_store[oid] = entry
             entry.ready.set()
@@ -464,6 +479,46 @@ class CoreClient:
                     return entry.value
                 # owned shm result — may live on the executing node's store
                 # (spillback): fall through to the shm/pull path below
+            if self.store is None:
+                # remote driver: no local arena. Owned memory-store entries
+                # returned above; anything shm-resident (task results,
+                # borrowed large objects) is materialized over the raylet
+                # connection via the chunked transfer RPCs.
+                if entry is not None and not entry.ready.is_set():
+                    await _wait_event(entry.ready, remaining)
+                    continue
+                if entry is not None or ref.owner_address is None or \
+                        tuple(ref.owner_address) == self.address:
+                    data = await self._fetch_via_raylet(oid)
+                    if data is not None:
+                        return serialization.unpack(data)
+                    pull_fails += 1
+                    if pull_fails >= 5:
+                        if await self._try_reconstruct(oid):
+                            pull_fails = 0
+                            continue
+                        raise ObjectLostError(f"{ref}: no reachable copy")
+                    await asyncio.sleep(0.05)
+                    continue
+                # borrowed: ask the owner (inline reply or shm indirection)
+                try:
+                    reply = await self._owner_call(
+                        ref, "get_object", {"object_id": oid.binary()}, remaining
+                    )
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(f"get timed out on {ref}") from None
+                if reply.get("error") is not None:
+                    raise reply["error"]
+                if reply.get("inline") is not None:
+                    return serialization.unpack(reply["inline"])
+                data = await self._fetch_via_raylet(oid)
+                if data is not None:
+                    return serialization.unpack(data)
+                pull_fails += 1
+                if pull_fails >= 15:
+                    raise ObjectLostError(f"{ref}: no reachable copy")
+                await asyncio.sleep(0.05)
+                continue
             if self.store.contains(oid):
                 try:
                     return await self.loop.run_in_executor(None, self.store.get, oid, 10_000)
@@ -528,6 +583,42 @@ class CoreClient:
                 await asyncio.sleep(0.05)
                 continue
 
+    async def _fetch_via_raylet(self, oid: ObjectID) -> bytes | None:
+        """Client mode: materialize a shm-resident object through the raylet
+        connection (pull to the raylet's arena if needed, then stream it
+        with the chunked transfer RPCs — the remote-driver read path)."""
+        obj = {"object_id": oid.binary()}
+        try:
+            ok = await self.raylet.call("pull_object", obj)
+            if not ok:
+                return None
+            meta = await self.raylet.call("fetch_object_meta", obj)
+            if meta is None:
+                return None
+            size = meta["size"]
+            chunk = self.cfg.object_transfer_chunk_size
+            parts = []
+            try:
+                off = 0
+                while off < size:
+                    n = min(chunk, size - off)
+                    data = await self.raylet.call(
+                        "fetch_object_chunk",
+                        {"object_id": oid.binary(), "offset": off, "length": n},
+                    )
+                    if data is None:
+                        return None
+                    parts.append(data)
+                    off += n
+            finally:
+                try:
+                    await self.raylet.call("fetch_object_done", obj)
+                except Exception:
+                    pass
+            return b"".join(parts)
+        except rpc.ConnectionLost:
+            return None
+
     async def _owner_call(self, ref: ObjectRef, method: str, payload: dict,
                           timeout: float | None):
         conn = await rpc.connect(*ref.owner_address, timeout=self.cfg.rpc_connect_timeout_s)
@@ -555,7 +646,8 @@ class CoreClient:
             entry = self.memory_store.get(ref.id)
             if entry is not None and entry.ready.is_set():
                 ready_idx_fast.add(i)
-            elif entry is None and self.store.contains(ref.id):
+            elif entry is None and self.store is not None \
+                    and self.store.contains(ref.id):
                 ready_idx_fast.add(i)
         if len(ready_idx_fast) >= num_returns:
             ready = [r for i, r in enumerate(refs) if i in ready_idx_fast]
@@ -567,11 +659,11 @@ class CoreClient:
             if entry is not None:
                 await entry.ready.wait()
                 return True
-            if self.store.contains(ref.id):
+            if self.store is not None and self.store.contains(ref.id):
                 return True
             if not ref.owner_address or tuple(ref.owner_address) == self.address:
                 # unknown local object: appears when its entry is created
-                while not self.store.contains(ref.id):
+                while self.store is None or not self.store.contains(ref.id):
                     entry = self.memory_store.get(ref.id)
                     if entry is not None:
                         await entry.ready.wait()
@@ -984,7 +1076,9 @@ class CoreClient:
             # the error text must repeat, the failures must span real time
             # (> 2s, i.e. distinct attempts), and no lease may be live.
             now = time.monotonic()
-            sig = f"{type(e).__name__}: {e}"
+            # type-only signature: messages embed per-attempt detail
+            # (ports, pids, paths) that must not defeat the breaker
+            sig = type(e).__name__
             if sig != state.lease_failure_sig:
                 state.lease_failure_sig = sig
                 state.lease_failures = 1

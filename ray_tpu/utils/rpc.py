@@ -241,6 +241,11 @@ class LoopbackConnection:
 # rpc.connect() short-circuit same-loop connections through a loopback pair.
 _LOCAL_SERVERS: dict[tuple, tuple] = {}
 
+# peers whose version handshake already succeeded this process: a live
+# peer's version cannot change, so repeat connects (e.g. per-call owner
+# dials) skip the extra round-trip.
+_VERIFIED_PEERS: set = set()
+
 
 async def _hello_handler(conn, payload):
     """Version handshake (ref: protobuf schema versioning role — see
@@ -393,9 +398,12 @@ async def connect(host: str, port: int, timeout: float = 30.0,
             reader, writer = await asyncio.open_connection(host, port)
             conn = Connection(reader, writer)
             conn.start()
-            if handshake:
+            if handshake and (host, port) not in _VERIFIED_PEERS:
                 remaining = deadline - asyncio.get_running_loop().time()
                 await _check_version(conn, max(1.0, remaining))
+                _VERIFIED_PEERS.add((host, port))
+                if len(_VERIFIED_PEERS) > 4096:  # port-reuse churn bound
+                    _VERIFIED_PEERS.clear()
             return conn
         except (ConnectionRefusedError, OSError) as e:
             last_err = e

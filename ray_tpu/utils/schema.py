@@ -87,7 +87,7 @@ CATALOG: dict[str, dict[str, dict]] = {
         "fetch_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
         "fetch_object_meta": {"since": (1, 0), "fields": {"object_id": "bytes"}},
         "fetch_object_chunk": {"since": (1, 0), "fields": {
-            "object_id": "bytes", "offset": "int", "size": "int"}},
+            "object_id": "bytes", "offset": "int", "length": "int"}},
         "fetch_object_done": {"since": (1, 0), "fields": {"object_id": "bytes"}},
         "delete_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
     },

@@ -62,6 +62,7 @@ def test_handshake_accepts_current_and_rejects_major_mismatch():
             return {"proto": (99, 0)}
 
         server._handlers["__hello__"] = old_hello
+        rpc._VERIFIED_PEERS.discard((host, port))  # force a fresh handshake
         with pytest.raises(rpc.RpcError, match="incompatible wire protocol"):
             await rpc.connect(host, port)
         await server.stop()
