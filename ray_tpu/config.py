@@ -51,6 +51,10 @@ class Config:
     #: path to a C++ worker binary (rt_cpp_api.h + RT_REMOTE functions) for
     #: language="cpp" tasks; RT_CPP_WORKER env overrides (ref: cpp/ worker)
     cpp_worker_binary: str = ""
+    #: place each worker in a kernel cgroup; a lease's "memory" resource
+    #: becomes the worker's memory cap (ref: cgroup_manager.h "physical
+    #: execution mode"). Needs a writable cgroup hierarchy.
+    enable_worker_cgroups: bool = False
     #: hybrid scheduling: prefer local node until this utilization fraction
     #: (ref: hybrid_scheduling_policy.h:50)
     hybrid_threshold: float = 0.5

@@ -597,24 +597,28 @@ class CoreClient:
                 return None
             size = meta["size"]
             chunk = self.cfg.object_transfer_chunk_size
-            parts = []
-            try:
-                off = 0
-                while off < size:
-                    n = min(chunk, size - off)
-                    data = await self.raylet.call(
+            offsets = list(range(0, size, chunk))
+            parts: list = [None] * len(offsets)
+            window = asyncio.Semaphore(4)  # pipeline: hide per-chunk RTT
+
+            async def fetch(i: int, off: int):
+                async with window:
+                    parts[i] = await self.raylet.call(
                         "fetch_object_chunk",
-                        {"object_id": oid.binary(), "offset": off, "length": n},
+                        {"object_id": oid.binary(), "offset": off,
+                         "length": min(chunk, size - off)},
                     )
-                    if data is None:
-                        return None
-                    parts.append(data)
-                    off += n
+
+            try:
+                await asyncio.gather(*(fetch(i, off)
+                                       for i, off in enumerate(offsets)))
             finally:
                 try:
                     await self.raylet.call("fetch_object_done", obj)
                 except Exception:
                     pass
+            if any(p is None for p in parts):
+                return None
             return b"".join(parts)
         except rpc.ConnectionLost:
             return None

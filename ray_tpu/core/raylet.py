@@ -149,6 +149,15 @@ class Raylet:
         if resources is None:
             resources = {"CPU": float(os.cpu_count() or 1)}
         resources.setdefault("node", 1.0)
+        if "memory" not in resources:
+            # advertise system memory (bytes) so memory-capped leases are
+            # schedulable (ref: memory as a default node resource)
+            try:
+                from ray_tpu.core.memory_monitor import read_system_memory
+
+                resources["memory"] = float(read_system_memory()[1])
+            except Exception:
+                pass
         self.ledger = ResourceLedger(resources)
 
         self.store_name = f"/rt_{self.session}_{self.node_id.hex()[:8]}"
@@ -185,6 +194,13 @@ class Raylet:
                 self, self.cfg.memory_usage_threshold,
                 self.cfg.memory_monitor_refresh_s,
             )
+        # kernel-enforced per-worker memory caps ("physical execution
+        # mode", ref: cgroup_manager.h); advisory monitor still runs when
+        # the hierarchy isn't writable
+        from ray_tpu.core.cgroup import CgroupManager, detect_driver
+
+        driver = detect_driver() if self.cfg.enable_worker_cgroups else None
+        self.cgroups = CgroupManager(self.node_id.hex(), driver)
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> tuple[str, int]:
@@ -308,6 +324,7 @@ class Raylet:
                 ):
                     w.proc.terminate()
                     self.all_workers.pop(w.worker_id, None)
+                    self.cgroups.release_worker(w.worker_id.hex())
                 else:
                     keep.append(w)
                     kept_by_lang[w.language] = kept_by_lang.get(w.language, 0) + 1
@@ -315,6 +332,7 @@ class Raylet:
 
     async def _on_worker_death(self, w: WorkerHandle):
         self.all_workers.pop(w.worker_id, None)
+        self.cgroups.release_worker(w.worker_id.hex())
         if w in self.idle_workers:
             self.idle_workers.remove(w)
         if w.lease_id is not None and w.lease_id in self.leases:
@@ -365,6 +383,7 @@ class Raylet:
         proc = subprocess.Popen(argv, env=env, stdout=None, stderr=None)
         w = WorkerHandle(worker_id=worker_id, proc=proc, language=language)
         self.all_workers[worker_id] = w
+        self.cgroups.isolate_worker(worker_id.hex(), proc.pid, None)
         return w
 
     async def rpc_get_lease_env(self, conn, p):
@@ -412,6 +431,7 @@ class Raylet:
         except asyncio.TimeoutError:
             w.proc.kill()
             self.all_workers.pop(w.worker_id, None)
+            self.cgroups.release_worker(w.worker_id.hex())
             raise RuntimeError("worker failed to start in time")
         return w
 
@@ -454,6 +474,10 @@ class Raylet:
             raise
         lease_id = next(self._lease_ids)
         w.lease_id = lease_id
+        # the lease's memory resource becomes a kernel cap; None RESETS the
+        # cap so a recycled worker can't inherit the previous lease's limit
+        mem = resources.get("memory")
+        self.cgroups.set_limit(w.worker_id.hex(), int(mem) if mem else None)
         tpu_chips = None
         n_tpu = int(resources.get("TPU", 0))
         if n_tpu > 0 and self._tpu_chips_free:
@@ -550,6 +574,7 @@ class Raylet:
             except Exception:
                 pass
             self.all_workers.pop(w.worker_id, None)
+            self.cgroups.release_worker(w.worker_id.hex())
         if dead:
             self._grant_waiters()
 
@@ -579,6 +604,7 @@ class Raylet:
             # chip set at first init, so recycling would leak the old chips
             w.proc.terminate()
             self.all_workers.pop(w.worker_id, None)
+            self.cgroups.release_worker(w.worker_id.hex())
         elif w.proc.poll() is None:
             w.idle_since = time.monotonic()
             self.idle_workers.append(w)
@@ -806,6 +832,10 @@ class Raylet:
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()
+        try:
+            self.cgroups.teardown()  # no rt_node_* leftovers on the host
+        except Exception:
+            pass
         try:
             self.store.destroy()
         except Exception:

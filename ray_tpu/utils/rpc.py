@@ -86,6 +86,11 @@ class Connection:
 
     def _fail_pending(self, exc: Exception):
         self._closed = True
+        # peer DIED (not a deliberate close): its replacement on this
+        # address must re-handshake — a restart can change the wire
+        # version, and ephemeral ports get reused
+        if not getattr(self, "_closing", False):
+            _VERIFIED_PEERS.discard(getattr(self, "_peer_key", None))
         exc = exc if isinstance(exc, ConnectionLost) else ConnectionLost(repr(exc))
         for fut in self._pending.values():
             if not fut.done():
@@ -126,6 +131,7 @@ class Connection:
 
     async def close(self):
         self._closed = True
+        self._closing = True  # deliberate: keep the peer's handshake cached
         if self._reader_task is not None:
             self._reader_task.cancel()
         try:
@@ -397,6 +403,7 @@ async def connect(host: str, port: int, timeout: float = 30.0,
         try:
             reader, writer = await asyncio.open_connection(host, port)
             conn = Connection(reader, writer)
+            conn._peer_key = (host, port)
             conn.start()
             if handshake and (host, port) not in _VERIFIED_PEERS:
                 remaining = deadline - asyncio.get_running_loop().time()
