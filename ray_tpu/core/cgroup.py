@@ -175,8 +175,14 @@ class CgroupManager:
         self.app = f"{self.root}/application"
         self._workers: dict[str, str] = {}  # worker_id -> leaf path
         if self.driver is not None:
-            self.driver.create(self.root, None)
-            self.driver.create(self.app, None)
+            try:
+                self.driver.create(self.root, None)
+                self.driver.create(self.app, None)
+            except (OSError, CgroupError):
+                # detect_driver's W_OK probe can pass in containers where
+                # mkdir is still refused: degrade to advisory-only instead
+                # of failing raylet startup
+                self.driver = None
 
     @property
     def enabled(self) -> bool:
@@ -192,6 +198,7 @@ class CgroupManager:
             self.driver.create(leaf, mem_limit)
             self.driver.add_pid(leaf, pid)
         except (OSError, CgroupError):
+            self.driver.remove(leaf)  # partial create must not leak the dir
             return False
         self._workers[worker_id_hex] = leaf
         return True

@@ -603,22 +603,25 @@ class CoreClient:
 
             async def fetch(i: int, off: int):
                 async with window:
-                    parts[i] = await self.raylet.call(
+                    data = await self.raylet.call(
                         "fetch_object_chunk",
                         {"object_id": oid.binary(), "offset": off,
                          "length": min(chunk, size - off)},
                     )
+                    if data is None:  # holder lost mid-stream: abort the rest
+                        raise LookupError("chunk gone")
+                    parts[i] = data
 
             try:
                 await asyncio.gather(*(fetch(i, off)
                                        for i, off in enumerate(offsets)))
+            except LookupError:
+                return None
             finally:
                 try:
                     await self.raylet.call("fetch_object_done", obj)
                 except Exception:
                     pass
-            if any(p is None for p in parts):
-                return None
             return b"".join(parts)
         except rpc.ConnectionLost:
             return None

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import random
 import sys
 import time
 from dataclasses import dataclass, field
@@ -326,15 +327,24 @@ class GcsServer:
                 if node and node.alive and _fits(resources, node.resources_available):
                     return node
             return None
-        best, best_score = None, -1.0
+        # hybrid top-k (ref: hybrid_scheduling_policy.h:50 + policy/scorer.h):
+        # score feasible nodes by their worst post-placement utilization on
+        # the requested dimensions, then pick randomly among the k best —
+        # deterministic argmin herds every concurrent request onto one node.
+        scored = []
         for node in self.nodes.values():
             if not node.alive or not _fits(resources, node.resources_available):
                 continue
-            # least-loaded: prefer the node with most free capacity left
-            free = sum(node.resources_available.values())
-            if free > best_score:
-                best, best_score = node, free
-        return best
+            score = 0.0
+            for k, v in resources.items():
+                total = node.resources_total.get(k, 0.0) or 1.0
+                used = total - node.resources_available.get(k, 0.0) + v
+                score = max(score, used / total)
+            scored.append((score, node))
+        if not scored:
+            return None
+        scored.sort(key=lambda sn: sn[0])
+        return random.choice([n for _, n in scored[:3]])
 
     async def rpc_get_actor(self, conn, p):
         actor_id = p.get("actor_id")

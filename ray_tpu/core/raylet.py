@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import os
+import random
 import subprocess
 import sys
 import time
@@ -53,31 +54,60 @@ class Lease:
     tpu_chips: list | None = None  # chip ids granted to this lease
 
 
+# Fixed-point resource quantum (ref: src/ray/common/scheduling/
+# fixed_point.h — 1/10000 granules). All ledger arithmetic is integral so
+# allocate/free cycles of fractional demands (0.1 CPU x 10) can never
+# drift a slot away through float error.
+FP_ONE = 10_000
+
+
+def _fp(v: float) -> int:
+    return round(v * FP_ONE)
+
+
+def _fp_dict(d: dict[str, float]) -> dict[str, int]:
+    return {k: _fp(v) for k, v in d.items()}
+
+
+def _unfp_dict(d: dict[str, int]) -> dict[str, float]:
+    return {k: v / FP_ONE for k, v in d.items()}
+
+
 class ResourceLedger:
     """Fractional resource accounting for one node, incl. PG bundles
     (ref: src/ray/common/scheduling/resource_instance_set.h semantics,
     simplified to totals — per-slot TPU instance tracking lives in the
-    accelerator layer)."""
+    accelerator layer). Internally fixed-point; the dict[str, float] API
+    converts at the boundary."""
 
     def __init__(self, total: dict[str, float]):
-        self.total = dict(total)
-        self.available = dict(total)
+        self._total = _fp_dict(total)
+        self._available = dict(self._total)
         # (pg_id, bundle_index) -> {"resources": ..., "available": ..., "committed": bool}
         self.bundles: dict[tuple, dict] = {}
 
+    @property
+    def total(self) -> dict[str, float]:
+        return _unfp_dict(self._total)
+
+    @property
+    def available(self) -> dict[str, float]:
+        return _unfp_dict(self._available)
+
     def fits(self, req: dict[str, float]) -> bool:
-        return all(self.available.get(k, 0.0) >= v - 1e-9 for k, v in req.items())
+        return all(self._available.get(k, 0) >= _fp(v) for k, v in req.items())
 
     def allocate(self, req: dict[str, float]) -> bool:
         if not self.fits(req):
             return False
         for k, v in req.items():
-            self.available[k] = self.available.get(k, 0.0) - v
+            self._available[k] = self._available.get(k, 0) - _fp(v)
         return True
 
     def free(self, req: dict[str, float]) -> None:
         for k, v in req.items():
-            self.available[k] = min(self.available.get(k, 0.0) + v, self.total.get(k, v))
+            cap = self._total.get(k, _fp(v))
+            self._available[k] = min(self._available.get(k, 0) + _fp(v), cap)
 
     # -- placement group bundles ------------------------------------------
     def prepare_bundle(self, key: tuple, resources: dict[str, float]) -> bool:
@@ -86,8 +116,8 @@ class ResourceLedger:
         if not self.allocate(resources):
             return False
         self.bundles[key] = {
-            "resources": dict(resources),
-            "available": dict(resources),
+            "resources": _fp_dict(resources),
+            "available": _fp_dict(resources),
             "committed": False,
         }
         return True
@@ -102,16 +132,16 @@ class ResourceLedger:
     def return_bundle(self, key: tuple) -> None:
         b = self.bundles.pop(key, None)
         if b is not None:
-            self.free(b["resources"])
+            self.free(_unfp_dict(b["resources"]))
 
     def bundle_allocate(self, key: tuple, req: dict[str, float]) -> bool:
         b = self.bundles.get(key)
         if b is None or not b["committed"]:
             return False
-        if not all(b["available"].get(k, 0.0) >= v - 1e-9 for k, v in req.items()):
+        if not all(b["available"].get(k, 0) >= _fp(v) for k, v in req.items()):
             return False
         for k, v in req.items():
-            b["available"][k] -= v
+            b["available"][k] -= _fp(v)
         return True
 
     def bundle_free(self, key: tuple, req: dict[str, float]) -> None:
@@ -119,7 +149,8 @@ class ResourceLedger:
         if b is None:
             return
         for k, v in req.items():
-            b["available"][k] = min(b["available"].get(k, 0.0) + v, b["resources"].get(k, v))
+            cap = b["resources"].get(k, _fp(v))
+            b["available"][k] = min(b["available"].get(k, 0) + _fp(v), cap)
 
 
 class Raylet:
@@ -324,15 +355,29 @@ class Raylet:
                 ):
                     w.proc.terminate()
                     self.all_workers.pop(w.worker_id, None)
-                    self.cgroups.release_worker(w.worker_id.hex())
+                    self._release_cgroup_after_exit(w)
                 else:
                     keep.append(w)
                     kept_by_lang[w.language] = kept_by_lang.get(w.language, 0) + 1
             self.idle_workers = keep
 
+    def _release_cgroup_after_exit(self, w: WorkerHandle):
+        """rmdir of a leaf fails EBUSY while the (just-terminated) process
+        is still listed in cgroup.procs — release only after it exits."""
+        if not self.cgroups.enabled:
+            return
+
+        async def waiter():
+            deadline = time.monotonic() + 10.0
+            while w.proc.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            self.cgroups.release_worker(w.worker_id.hex())
+
+        self._bg.spawn(waiter(), asyncio.get_running_loop())
+
     async def _on_worker_death(self, w: WorkerHandle):
         self.all_workers.pop(w.worker_id, None)
-        self.cgroups.release_worker(w.worker_id.hex())
+        self.cgroups.release_worker(w.worker_id.hex())  # already exited
         if w in self.idle_workers:
             self.idle_workers.remove(w)
         if w.lease_id is not None and w.lease_id in self.leases:
@@ -431,7 +476,7 @@ class Raylet:
         except asyncio.TimeoutError:
             w.proc.kill()
             self.all_workers.pop(w.worker_id, None)
-            self.cgroups.release_worker(w.worker_id.hex())
+            self._release_cgroup_after_exit(w)
             raise RuntimeError("worker failed to start in time")
         return w
 
@@ -574,7 +619,7 @@ class Raylet:
             except Exception:
                 pass
             self.all_workers.pop(w.worker_id, None)
-            self.cgroups.release_worker(w.worker_id.hex())
+            self._release_cgroup_after_exit(w)
         if dead:
             self._grant_waiters()
 
@@ -584,13 +629,26 @@ class Raylet:
         (ref: hybrid_scheduling_policy.h:50, normal_task_submitter.cc:461)."""
         if p.get("no_spill") or p.get("pg_id") is not None:
             return None
+        # hybrid top-k among feasible peers (ref: hybrid_scheduling_policy
+        # top-k random): first-fit would herd every spilled lease from every
+        # concurrent client onto the same peer
+        scored = []
         for n in self.cluster_view:
             if n["node_id"] == self.node_id or not n.get("alive", True):
                 continue
             av = n.get("resources_available", {})
-            if all(av.get(k, 0.0) >= v - 1e-9 for k, v in resources.items()):
-                return tuple(n["address"])
-        return None
+            if not all(av.get(k, 0.0) >= v - 1e-9 for k, v in resources.items()):
+                continue
+            tot = n.get("resources_total", {})
+            score = 0.0
+            for k, v in resources.items():
+                total = tot.get(k, 0.0) or 1.0
+                score = max(score, (total - av.get(k, 0.0) + v) / total)
+            scored.append((score, tuple(n["address"])))
+        if not scored:
+            return None
+        scored.sort(key=lambda sa: sa[0])
+        return random.choice([a for _, a in scored[:3]])
 
     async def rpc_return_lease(self, conn, p):
         lease = self.leases.pop(p["lease_id"], None)
@@ -604,7 +662,7 @@ class Raylet:
             # chip set at first init, so recycling would leak the old chips
             w.proc.terminate()
             self.all_workers.pop(w.worker_id, None)
-            self.cgroups.release_worker(w.worker_id.hex())
+            self._release_cgroup_after_exit(w)
         elif w.proc.poll() is None:
             w.idle_since = time.monotonic()
             self.idle_workers.append(w)
@@ -832,6 +890,12 @@ class Raylet:
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()
+        if self.cgroups.enabled:
+            # leaves rmdir EBUSY until their procs exit: wait briefly
+            deadline = time.monotonic() + 3.0
+            while (any(w.proc.poll() is None for w in self.all_workers.values())
+                   and time.monotonic() < deadline):
+                await asyncio.sleep(0.05)
         try:
             self.cgroups.teardown()  # no rt_node_* leftovers on the host
         except Exception:

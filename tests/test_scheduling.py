@@ -1,0 +1,69 @@
+"""Scheduler internals: fixed-point resource accounting + hybrid top-k
+placement (ref: fixed_point.h granules; hybrid_scheduling_policy.h:50)."""
+
+import collections
+
+from ray_tpu.core.raylet import ResourceLedger
+
+
+def test_fixed_point_no_drift():
+    """10k allocate/free cycles of 0.1 CPU must return to exactly full —
+    float accounting drifts (0.1 has no binary representation)."""
+    ledger = ResourceLedger({"CPU": 4.0})
+    for _ in range(10_000):
+        assert ledger.allocate({"CPU": 0.1})
+        ledger.free({"CPU": 0.1})
+    assert ledger.available["CPU"] == 4.0
+    # 40 concurrent 0.1-slots fit exactly, the 41st does not
+    for _ in range(40):
+        assert ledger.allocate({"CPU": 0.1})
+    assert not ledger.allocate({"CPU": 0.1})
+    assert ledger.available["CPU"] == 0.0
+
+
+def test_fixed_point_bundles():
+    ledger = ResourceLedger({"CPU": 2.0})
+    key = (b"pg", 0)
+    assert ledger.prepare_bundle(key, {"CPU": 1.0})
+    assert ledger.commit_bundle(key)
+    for _ in range(10):
+        assert ledger.bundle_allocate(key, {"CPU": 0.1})
+    assert not ledger.bundle_allocate(key, {"CPU": 0.1})
+    for _ in range(10):
+        ledger.bundle_free(key, {"CPU": 0.1})
+    assert ledger.bundle_allocate(key, {"CPU": 1.0})
+    ledger.bundle_free(key, {"CPU": 1.0})
+    ledger.return_bundle(key)
+    assert ledger.available["CPU"] == 2.0
+
+
+def test_hybrid_topk_spreads_across_best_nodes():
+    """GCS placement picks randomly among the k least-utilized feasible
+    nodes — repeated picks must not all land on one node."""
+    from ray_tpu.core.gcs import GcsServer, NodeInfo
+    from ray_tpu.utils.ids import NodeID
+
+    gcs = GcsServer.__new__(GcsServer)  # policy unit: only .nodes touched
+    gcs.nodes = {}
+    gcs.pgs = {}
+    for i in range(4):
+        nid = NodeID.generate().binary()
+        gcs.nodes[nid] = NodeInfo(
+            node_id=nid,
+            address=("127.0.0.1", 7000 + i),
+            resources_total={"CPU": 8.0},
+            resources_available={"CPU": 8.0},
+            store_name=f"/rt_test_{i}",
+        )
+    picks = collections.Counter(
+        gcs._pick_node({"CPU": 1.0}).address for _ in range(60)
+    )
+    assert len(picks) >= 2, f"top-k random degenerated to one node: {picks}"
+
+    # an overloaded node must lose to idle ones
+    busy = next(iter(gcs.nodes.values()))
+    busy.resources_available = {"CPU": 0.5}
+    picks = collections.Counter(
+        gcs._pick_node({"CPU": 0.25}).address for _ in range(60)
+    )
+    assert picks.get(busy.address, 0) == 0, picks
