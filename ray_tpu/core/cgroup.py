@@ -62,11 +62,15 @@ class CgroupV2Driver:
         with open(os.path.join(self.base, path, "cgroup.procs"), "w") as f:
             f.write(str(pid))
 
-    def remove(self, path: str) -> None:
+    def remove(self, path: str) -> bool:
+        full = os.path.join(self.base, path)
         try:
-            os.rmdir(os.path.join(self.base, path))
+            os.rmdir(full)
+            return True
+        except FileNotFoundError:
+            return True
         except OSError:
-            pass  # still has procs (dying) or already gone
+            return not os.path.isdir(full)  # EBUSY: procs still exiting
 
     def current_usage(self, path: str) -> int | None:
         try:
@@ -103,11 +107,15 @@ class CgroupV1Driver:
         with open(os.path.join(self.base, path, "cgroup.procs"), "w") as f:
             f.write(str(pid))
 
-    def remove(self, path: str) -> None:
+    def remove(self, path: str) -> bool:
+        full = os.path.join(self.base, path)
         try:
-            os.rmdir(os.path.join(self.base, path))
+            os.rmdir(full)
+            return True
+        except FileNotFoundError:
+            return True
         except OSError:
-            pass
+            return not os.path.isdir(full)
 
     def current_usage(self, path: str) -> int | None:
         try:
@@ -145,9 +153,10 @@ class FakeCgroupDriver:
             raise CgroupError(f"no cgroup {path}")
         self.cgroups[path]["pids"].add(pid)
 
-    def remove(self, path: str) -> None:
+    def remove(self, path: str) -> bool:
         self.cgroups.pop(path, None)
         self.removed.append(path)
+        return True
 
     def current_usage(self, path: str) -> int | None:
         return 0 if path in self.cgroups else None
@@ -216,9 +225,12 @@ class CgroupManager:
         return True
 
     def release_worker(self, worker_id_hex: str) -> None:
-        leaf = self._workers.pop(worker_id_hex, None)
-        if leaf is not None and self.enabled:
-            self.driver.remove(leaf)
+        leaf = self._workers.get(worker_id_hex)
+        if leaf is None:
+            return
+        if not self.enabled or self.driver.remove(leaf):
+            self._workers.pop(worker_id_hex, None)
+        # else: leaf still busy (proc exiting); kept for a later retry
 
     def worker_usage(self, worker_id_hex: str) -> int | None:
         leaf = self._workers.get(worker_id_hex)
@@ -226,10 +238,13 @@ class CgroupManager:
             return None
         return self.driver.current_usage(leaf)
 
-    def teardown(self) -> None:
+    def teardown(self) -> bool:
+        """Remove all leaves + the node tree; False if anything is still
+        busy (caller may retry after the owning processes exit)."""
         if not self.enabled:
-            return
+            return True
         for wid in list(self._workers):
             self.release_worker(wid)
-        self.driver.remove(self.app)
-        self.driver.remove(self.root)
+        ok = not self._workers
+        ok = self.driver.remove(self.app) and ok
+        return self.driver.remove(self.root) and ok

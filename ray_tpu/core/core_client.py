@@ -612,10 +612,16 @@ class CoreClient:
                         raise LookupError("chunk gone")
                     parts[i] = data
 
+            tasks = [asyncio.ensure_future(fetch(i, off))
+                     for i, off in enumerate(offsets)]
             try:
-                await asyncio.gather(*(fetch(i, off)
-                                       for i, off in enumerate(offsets)))
+                await asyncio.gather(*tasks)
             except LookupError:
+                # gather doesn't cancel siblings: stop the queued fetches so
+                # a multi-GB failure doesn't keep streaming dead chunks
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
                 return None
             finally:
                 try:

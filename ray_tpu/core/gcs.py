@@ -18,13 +18,13 @@ from __future__ import annotations
 
 import asyncio
 import os
-import random
 import sys
 import time
 from dataclasses import dataclass, field
 from typing import Any
 
 from ray_tpu.config import get_config
+from ray_tpu.core import policy
 from ray_tpu.utils import aio, rpc
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
 
@@ -47,6 +47,10 @@ class NodeInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     queued_leases: int = 0  # demand signal (autoscaler)
     pid: int = 0
+    # sender-assigned monotonic version of this node's resource view
+    # (ref: ray_syncer.h:83 versioned messages — stale deliveries are
+    # dropped by version comparison, both at the GCS and at receivers)
+    view_version: int = 0
 
     def view(self) -> dict:
         return {
@@ -59,6 +63,7 @@ class NodeInfo:
             "alive": self.alive,
             "queued_leases": self.queued_leases,
             "pid": self.pid,
+            "view_version": self.view_version,
         }
 
 
@@ -199,13 +204,20 @@ class GcsServer:
         if info is None:
             return {"ok": False}
         info.last_heartbeat = time.monotonic()
+        version = int(p.get("version", 0))
+        if version and version <= info.view_version:
+            # stale or reordered report (e.g. a delayed frame after a GCS
+            # reconnect): liveness refreshed above, view NOT applied
+            return {"ok": True, "stale": True}
         info.queued_leases = int(p.get("queued_leases", 0))
         if p.get("resources_available") is not None:
             changed = info.resources_available != p["resources_available"]
             info.resources_available = dict(p["resources_available"])
+            if version:
+                info.view_version = version
             if changed:
-                # resource-view gossip to all raylets (the RaySyncer role,
-                # ref: ray_syncer.h:83) so spillback decisions stay fresh
+                # versioned resource-view gossip to all raylets (the
+                # RaySyncer role, ref: ray_syncer.h:83)
                 await self.publish("nodes", {"event": "updated", "node": info.view()})
         return {"ok": True}
 
@@ -327,24 +339,16 @@ class GcsServer:
                 if node and node.alive and _fits(resources, node.resources_available):
                     return node
             return None
-        # hybrid top-k (ref: hybrid_scheduling_policy.h:50 + policy/scorer.h):
-        # score feasible nodes by their worst post-placement utilization on
-        # the requested dimensions, then pick randomly among the k best —
-        # deterministic argmin herds every concurrent request onto one node.
-        scored = []
-        for node in self.nodes.values():
-            if not node.alive or not _fits(resources, node.resources_available):
-                continue
-            score = 0.0
-            for k, v in resources.items():
-                total = node.resources_total.get(k, 0.0) or 1.0
-                used = total - node.resources_available.get(k, 0.0) + v
-                score = max(score, used / total)
-            scored.append((score, node))
-        if not scored:
-            return None
-        scored.sort(key=lambda sn: sn[0])
-        return random.choice([n for _, n in scored[:3]])
+        # hybrid top-k (ref: hybrid_scheduling_policy.h:50 + policy/scorer.h,
+        # shared impl in core/policy.py): randomize among comfortable nodes,
+        # deterministic best when everything is tight.
+        scored = [
+            (policy.score(resources, node.resources_total,
+                          node.resources_available), node)
+            for node in self.nodes.values()
+            if node.alive and _fits(resources, node.resources_available)
+        ]
+        return policy.pick(scored)
 
     async def rpc_get_actor(self, conn, p):
         actor_id = p.get("actor_id")

@@ -20,13 +20,13 @@ from __future__ import annotations
 import asyncio
 import itertools
 import os
-import random
 import subprocess
 import sys
 import time
 from dataclasses import dataclass, field
 
 from ray_tpu.config import get_config
+from ray_tpu.core import policy
 from ray_tpu.core.object_store import SharedObjectStore
 from ray_tpu.utils import aio, rpc
 from ray_tpu.utils.ids import NodeID, ObjectID, WorkerID
@@ -204,6 +204,7 @@ class Raylet:
         self.gcs: rpc.Connection | None = None
 
         self._lease_ids = itertools.count(1)
+        self._view_versions = itertools.count(1)  # resource-view sync versions
         self.leases: dict[int, Lease] = {}
         self.idle_workers: list[WorkerHandle] = []
         self.all_workers: dict[WorkerID, WorkerHandle] = {}
@@ -289,10 +290,19 @@ class Raylet:
         if msg.get("m") == "pubsub" and msg["p"]["channel"] == "nodes":
             event = msg["p"]["message"]
             if event.get("event") in ("added", "updated"):
+                node = event["node"]
+                for n in self.cluster_view:
+                    if n["node_id"] != node["node_id"]:
+                        continue
+                    # versioned apply (ray_syncer.h:83): a reordered push
+                    # must not roll the peer's view back to an older state
+                    if node.get("view_version", 0) < n.get("view_version", 0):
+                        return
+                    break
                 self.cluster_view = [
-                    n for n in self.cluster_view if n["node_id"] != event["node"]["node_id"]
+                    n for n in self.cluster_view if n["node_id"] != node["node_id"]
                 ]
-                self.cluster_view.append(event["node"])
+                self.cluster_view.append(node)
             elif event.get("event") == "removed":
                 self.cluster_view = [
                     n for n in self.cluster_view if n["node_id"] != event["node_id"]
@@ -306,6 +316,9 @@ class Raylet:
                     "heartbeat",
                     {"node_id": self.node_id,
                      "resources_available": self.ledger.available,
+                     # monotone view version: the GCS and peers drop
+                     # reordered/stale reports (ray_syncer.h versioning)
+                     "version": next(self._view_versions),
                      # demand signal for the autoscaler (ref: autoscaler v2
                      # resource-demand reporting)
                      "queued_leases": len(self._lease_waiters)},
@@ -629,9 +642,9 @@ class Raylet:
         (ref: hybrid_scheduling_policy.h:50, normal_task_submitter.cc:461)."""
         if p.get("no_spill") or p.get("pg_id") is not None:
             return None
-        # hybrid top-k among feasible peers (ref: hybrid_scheduling_policy
-        # top-k random): first-fit would herd every spilled lease from every
-        # concurrent client onto the same peer
+        # hybrid top-k among feasible peers (ref: hybrid_scheduling_policy,
+        # shared impl in core/policy.py): first-fit would herd every spilled
+        # lease from every concurrent client onto the same peer
         scored = []
         for n in self.cluster_view:
             if n["node_id"] == self.node_id or not n.get("alive", True):
@@ -639,16 +652,11 @@ class Raylet:
             av = n.get("resources_available", {})
             if not all(av.get(k, 0.0) >= v - 1e-9 for k, v in resources.items()):
                 continue
-            tot = n.get("resources_total", {})
-            score = 0.0
-            for k, v in resources.items():
-                total = tot.get(k, 0.0) or 1.0
-                score = max(score, (total - av.get(k, 0.0) + v) / total)
-            scored.append((score, tuple(n["address"])))
-        if not scored:
-            return None
-        scored.sort(key=lambda sa: sa[0])
-        return random.choice([a for _, a in scored[:3]])
+            scored.append((
+                policy.score(resources, n.get("resources_total", {}), av),
+                tuple(n["address"]),
+            ))
+        return policy.pick(scored)
 
     async def rpc_return_lease(self, conn, p):
         lease = self.leases.pop(p["lease_id"], None)
@@ -891,15 +899,17 @@ class Raylet:
         if self.gcs is not None:
             await self.gcs.close()
         if self.cgroups.enabled:
-            # leaves rmdir EBUSY until their procs exit: wait briefly
+            # leaves rmdir EBUSY until their procs exit — including workers
+            # already popped from all_workers whose deferred release waiters
+            # were cancelled above; retry teardown until clean or deadline
             deadline = time.monotonic() + 3.0
-            while (any(w.proc.poll() is None for w in self.all_workers.values())
-                   and time.monotonic() < deadline):
+            while time.monotonic() < deadline:
+                try:
+                    if self.cgroups.teardown():
+                        break
+                except Exception:
+                    break
                 await asyncio.sleep(0.05)
-        try:
-            self.cgroups.teardown()  # no rt_node_* leftovers on the host
-        except Exception:
-            pass
         try:
             self.store.destroy()
         except Exception:

@@ -40,7 +40,9 @@ CATALOG: dict[str, dict[str, dict]] = {
             "actor_id": "ActorID", "reason": "str"}},
         "list_actors": {"since": (1, 0), "fields": {"->": "[actor info]"}},
         "heartbeat": {"since": (1, 0), "fields": {
-            "node_id": "hex", "resources_available": "dict", "load": "dict"}},
+            "node_id": "hex", "resources_available": "dict", "load": "dict",
+            "version": "int — monotone view version (since 1.1)",
+            "queued_leases": "int demand signal"}},
         "get_cluster": {"since": (1, 0), "fields": {"->": "[node info]"}},
         "drain_node": {"since": (1, 0), "fields": {"node_id": "hex"}},
         "subscribe": {"since": (1, 0), "fields": {"channels": "[str]"}},

@@ -37,6 +37,66 @@ def test_fixed_point_bundles():
     assert ledger.available["CPU"] == 2.0
 
 
+def test_versioned_view_sync_drops_stale_updates():
+    """Resource-view gossip is versioned (ref: ray_syncer.h:83): a
+    reordered heartbeat must not roll the GCS's view back."""
+    import asyncio
+
+    from ray_tpu.core.gcs import GcsServer, NodeInfo
+    from ray_tpu.utils.ids import NodeID
+
+    gcs = GcsServer.__new__(GcsServer)
+    gcs.nodes = {}
+    gcs.subs = {}
+    nid = NodeID.generate()
+    gcs.nodes[nid] = NodeInfo(
+        node_id=nid, address=("127.0.0.1", 7001), store_name="/rt_t",
+        resources_total={"CPU": 8.0}, resources_available={"CPU": 8.0},
+    )
+
+    async def run():
+        r = await gcs.rpc_heartbeat(None, {
+            "node_id": nid, "version": 5,
+            "resources_available": {"CPU": 2.0}})
+        assert r["ok"] and not r.get("stale")
+        # delayed older report arrives after: must be dropped
+        r = await gcs.rpc_heartbeat(None, {
+            "node_id": nid, "version": 3,
+            "resources_available": {"CPU": 7.0}})
+        assert r.get("stale")
+        assert gcs.nodes[nid].resources_available == {"CPU": 2.0}
+        assert gcs.nodes[nid].view_version == 5
+        # newer wins
+        r = await gcs.rpc_heartbeat(None, {
+            "node_id": nid, "version": 6,
+            "resources_available": {"CPU": 4.0}})
+        assert not r.get("stale")
+        assert gcs.nodes[nid].resources_available == {"CPU": 4.0}
+
+    asyncio.run(run())
+
+
+def test_raylet_view_apply_is_versioned():
+    """A reordered node-view push must not roll a peer's cluster view back."""
+    from ray_tpu.core.raylet import Raylet
+
+    r = Raylet.__new__(Raylet)
+    r.cluster_view = [{"node_id": b"n1", "view_version": 7,
+                       "resources_available": {"CPU": 1.0}}]
+
+    def push(version, avail):
+        r._on_gcs_push({"m": "pubsub", "p": {"channel": "nodes", "message": {
+            "event": "updated",
+            "node": {"node_id": b"n1", "view_version": version,
+                     "resources_available": {"CPU": avail}}}}})
+
+    push(5, 8.0)  # stale: dropped
+    assert r.cluster_view[0]["view_version"] == 7
+    push(9, 3.0)  # newer: applied
+    assert r.cluster_view[0]["view_version"] == 9
+    assert r.cluster_view[0]["resources_available"] == {"CPU": 3.0}
+
+
 def test_hybrid_topk_spreads_across_best_nodes():
     """GCS placement picks randomly among the k least-utilized feasible
     nodes — repeated picks must not all land on one node."""
