@@ -28,11 +28,16 @@ def score(resources: dict, total: dict, available: dict) -> float:
 
 
 def pick(candidates: list[tuple[float, object]]):
-    """candidates: [(score, item)]. Returns an item or None."""
+    """candidates: [(score, item)]. Returns an item or None.
+
+    Comfortable nodes (under the threshold) shadow tight ones, but the
+    final choice is ALWAYS randomized over a set: concurrent requests
+    act on gossip-stale views, and any deterministic pick herds them
+    all onto one node until the next heartbeat."""
     if not candidates:
         return None
     candidates.sort(key=lambda si: si[0])
     comfortable = [i for s, i in candidates[:TOP_K] if s <= UTIL_THRESHOLD]
     if comfortable:
         return random.choice(comfortable)
-    return candidates[0][1]  # all tight: deterministic best
+    return random.choice([i for _, i in candidates[:TOP_K]])

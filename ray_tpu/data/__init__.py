@@ -11,6 +11,7 @@ stream (ref: data/dataset.py:1731 streaming_split).
 from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     Dataset,
+    GroupedDataset,
     from_arrow,
     from_items,
     from_numpy,
@@ -30,6 +31,7 @@ __all__ = [
     "BlockAccessor",
     "DataIterator",
     "Dataset",
+    "GroupedDataset",
     "from_arrow",
     "from_items",
     "from_numpy",

@@ -116,6 +116,11 @@ class Dataset:
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         return Dataset(self._plan.with_op(SortOp(key, descending)))
 
+    def groupby(self, key: str) -> "GroupedDataset":
+        """Group rows by a key column (ref: dataset.py groupby ->
+        grouped_data.py; hash-aggregated map-side partials + one merge)."""
+        return GroupedDataset(self, key)
+
     def union(self, other: "Dataset") -> "Dataset":
         if self._plan.ops or other._plan.ops:
             # materialize both sides into read tasks
@@ -254,6 +259,163 @@ class Dataset:
     def __repr__(self):
         ops = " -> ".join(op.name for op in self._plan.ops) or "source"
         return f"Dataset({len(self._plan.read_tasks)} read tasks, {ops})"
+
+    # ------------------------------------------------------------- sinks
+    def _write_files(self, path: str, ext: str, write_block: Callable) -> list[str]:
+        """One file per block: path/part-<i>.<ext> (ref: write_parquet &
+        friends — per-block write tasks, no driver materialization)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+
+        @ray_tpu.remote
+        def write(block, out_path):
+            write_block(block, out_path)
+            return out_path
+
+        refs = []
+        for i, ref in enumerate(self.iter_block_refs()):
+            refs.append(write.remote(ref, os.path.join(path, f"part-{i:05d}.{ext}")))
+        return ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> list[str]:
+        def wb(block, out_path):
+            import pandas as pd
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            cols = rows_to_columns(block) if isinstance(block, list) else block
+            pq.write_table(pa.Table.from_pandas(pd.DataFrame(cols)), out_path)
+
+        return self._write_files(path, "parquet", wb)
+
+    def write_csv(self, path: str) -> list[str]:
+        def wb(block, out_path):
+            import pandas as pd
+
+            cols = rows_to_columns(block) if isinstance(block, list) else block
+            pd.DataFrame(cols).to_csv(out_path, index=False)
+
+        return self._write_files(path, "csv", wb)
+
+    def write_json(self, path: str) -> list[str]:
+        def wb(block, out_path):
+            import pandas as pd
+
+            cols = rows_to_columns(block) if isinstance(block, list) else block
+            pd.DataFrame(cols).to_json(out_path, orient="records", lines=True)
+
+        return self._write_files(path, "json", wb)
+
+
+class GroupedDataset:
+    """Result of Dataset.groupby(key) (ref: grouped_data.py GroupedData:
+    count/sum/min/max/mean/aggregate/map_groups). Aggregations run as
+    map-side partials per block + one merge task — the hash-aggregate
+    shape (ref: execution/operators/hash_aggregate.py) at library scale."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate(self, init, accum, merge, finalize, out_name: str) -> Dataset:
+        key = self._key
+
+        @ray_tpu.remote
+        def partial(block):
+            acc = BlockAccessor.for_block(block)
+            states: dict = {}
+            for row in acc.rows():
+                k = row[key]
+                states[k] = accum(states.get(k, init()), row)
+            return states
+
+        @ray_tpu.remote
+        def reduce(*partials):
+            states: dict = {}
+            for p in partials:
+                for k, s in p.items():
+                    states[k] = merge(states[k], s) if k in states else s
+            return [{key: k, out_name: finalize(s)}
+                    for k, s in sorted(states.items(), key=lambda kv: str(kv[0]))]
+
+        parts = [partial.remote(r) for r in self._ds.iter_block_refs()]
+        rows = ray_tpu.get(reduce.remote(*parts)) if parts else []
+        return from_items(rows)
+
+    def count(self) -> Dataset:
+        return self._aggregate(
+            lambda: 0, lambda s, r: s + 1, lambda a, b: a + b, lambda s: s,
+            "count()")
+
+    def sum(self, on: str) -> Dataset:
+        return self._aggregate(
+            lambda: 0, lambda s, r: s + r[on], lambda a, b: a + b, lambda s: s,
+            f"sum({on})")
+
+    def min(self, on: str) -> Dataset:
+        return self._aggregate(
+            lambda: None,
+            lambda s, r: r[on] if s is None else builtins.min(s, r[on]),
+            lambda a, b: builtins.min(a, b), lambda s: s, f"min({on})")
+
+    def max(self, on: str) -> Dataset:
+        return self._aggregate(
+            lambda: None,
+            lambda s, r: r[on] if s is None else builtins.max(s, r[on]),
+            lambda a, b: builtins.max(a, b), lambda s: s, f"max({on})")
+
+    def mean(self, on: str) -> Dataset:
+        return self._aggregate(
+            lambda: (0.0, 0),
+            lambda s, r: (s[0] + r[on], s[1] + 1),
+            lambda a, b: (a[0] + b[0], a[1] + b[1]),
+            lambda s: s[0] / s[1] if s[1] else float("nan"), f"mean({on})")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply fn(list_of_rows) -> list_of_rows per complete group.
+
+        Hash-shuffle shape (ref: execution/operators/hash_shuffle.py): each
+        block is hash-partitioned by key into P shards; one apply task per
+        shard sees only its shard of every block — parallelism P, no task
+        materializes the whole dataset."""
+        key = self._key
+        block_refs = list(self._ds.iter_block_refs())
+        if not block_refs:
+            return from_items([])
+        P = builtins.min(len(block_refs), 16) or 1
+
+        @ray_tpu.remote(num_returns=P)
+        def partition(block):
+            acc = BlockAccessor.for_block(block)
+            shards: list[dict] = [{} for _ in builtins.range(P)]
+            for row in acc.rows():
+                k = row[key]
+                shards[hash(k) % P].setdefault(k, []).append(row)
+            return tuple(shards) if P > 1 else shards[0]
+
+        @ray_tpu.remote
+        def apply_shard(*shard_parts):
+            groups: dict = {}
+            for p in shard_parts:
+                for k, rows in p.items():
+                    groups.setdefault(k, []).extend(rows)
+            out = []
+            for k in sorted(groups, key=str):
+                out.extend(fn(groups[k]))
+            return out
+
+        sharded = [partition.remote(r) for r in block_refs]
+        if P == 1:
+            shard_cols = [[s] for s in sharded]
+        else:
+            shard_cols = [[sharded[b][p] for b in builtins.range(len(sharded))]
+                          for p in builtins.range(P)]
+        out_rows: list = []
+        for rows in ray_tpu.get(
+                [apply_shard.remote(*col) for col in shard_cols]):
+            out_rows.extend(rows)
+        return from_items(out_rows)
 
 
 class _HoldBlock:

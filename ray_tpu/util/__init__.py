@@ -1,0 +1,6 @@
+"""User-facing distributed utilities (ref: python/ray/util/*)."""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Queue", "Empty", "Full"]

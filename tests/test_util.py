@@ -1,0 +1,78 @@
+"""ActorPool + Queue tests (ref: python/ray/tests/test_actor_pool.py,
+test_queue.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=0)
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered(rt):
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [2 * i for i in range(10)]
+
+
+def test_actor_pool_unordered_and_backlog(rt):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    # more submits than actors: backlog drains as actors free up
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v), range(9)))
+    assert out == sorted(2 * i for i in range(9))
+
+
+def test_actor_pool_push_pop(rt):
+    a = Doubler.remote()
+    pool = ActorPool([a])
+    popped = pool.pop_idle()
+    assert popped is a
+    assert pool.pop_idle() is None
+    pool.push(a)
+    pool.submit(lambda ac, v: ac.double.remote(v), 21)
+    assert pool.get_next(timeout=60) == 42
+
+
+def test_queue_fifo_and_nowait(rt):
+    q = Queue(maxsize=2)
+    try:
+        q.put(1)
+        q.put(2)
+        with pytest.raises(Full):
+            q.put(3, block=False)
+        assert q.qsize() == 2 and q.full()
+        assert q.get() == 1
+        assert q.get() == 2
+        assert q.empty()
+        with pytest.raises(Empty):
+            q.get(block=False)
+        with pytest.raises(Empty):
+            q.get(timeout=0.2)
+    finally:
+        q.shutdown()
+
+
+def test_queue_cross_task(rt):
+    q = Queue()
+    try:
+        @ray_tpu.remote
+        def producer(q, n):
+            for i in range(n):
+                q.put(i)
+            return n
+
+        ray_tpu.get(producer.remote(q, 5), timeout=120)
+        assert [q.get(timeout=30) for _ in range(5)] == list(range(5))
+    finally:
+        q.shutdown()
