@@ -322,7 +322,24 @@ class ActorClass:
             get_if_exists=bool(o.get("get_if_exists", False)),
             lifetime=o.get("lifetime"),
             runtime_env=o.get("runtime_env"),
+            concurrency_groups=o.get("concurrency_groups"),
         )
+
+
+def method(*, concurrency_group: str | None = None,
+           num_returns: int | None = None):
+    """Annotate an actor method (ref: ray.method): assign it to a named
+    concurrency group declared in @remote(concurrency_groups={...}) and/or
+    fix its num_returns."""
+
+    def deco(fn):
+        fn.__rt_method_opts__ = {
+            "concurrency_group": concurrency_group,
+            "num_returns": num_returns,
+        }
+        return fn
+
+    return deco
 
 
 def _actor_resources(o: dict) -> dict:

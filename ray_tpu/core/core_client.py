@@ -150,6 +150,12 @@ class _TaskEventBuffer:
             pass
 
 
+def _handle_options(spec: dict) -> dict:
+    """Driver-side method metadata carried on creation handles (num_returns
+    from @method annotations; worker-side group routing uses the spec)."""
+    return {"method_num_returns": spec.get("method_num_returns") or {}}
+
+
 class CoreClient:
     def __init__(self, loop: asyncio.AbstractEventLoop | None = None,
                  client_mode: bool = False):
@@ -1356,9 +1362,31 @@ class CoreClient:
                           name=None, max_restarts=0, max_concurrency=1,
                           placement_group=None, bundle_index=-1,
                           get_if_exists=False, lifetime=None,
-                          runtime_env=None) -> dict:
+                          runtime_env=None, concurrency_groups=None) -> dict:
         res = dict(resources or {})
         res.setdefault("CPU", num_cpus)
+        # per-method concurrency groups (ref: concurrency_group_manager.cc):
+        # methods annotated with @ray_tpu.method(concurrency_group=...) map
+        # onto named executor pools sized by `concurrency_groups`
+        method_groups = {}
+        method_num_returns = {}
+        for mname in dir(cls):  # dir() walks the MRO: inherited methods count
+            m = getattr(cls, mname, None)
+            opts = getattr(m, "__rt_method_opts__", None)
+            if not callable(m) or not opts:
+                continue
+            if opts.get("concurrency_group"):
+                method_groups[mname] = opts["concurrency_group"]
+            if opts.get("num_returns"):
+                method_num_returns[mname] = opts["num_returns"]
+        declared = set(concurrency_groups or {})
+        undeclared = set(method_groups.values()) - declared
+        if undeclared:
+            raise ValueError(
+                f"methods reference undeclared concurrency groups "
+                f"{sorted(undeclared)}; declare them in "
+                f"@remote(concurrency_groups={{...}})"
+            )
         return {
             "runtime_env": self._resolve_runtime_env(runtime_env),
             "actor_id": ActorID.generate(),
@@ -1369,6 +1397,9 @@ class CoreClient:
             "resources": res,
             "max_restarts": max_restarts,
             "max_concurrency": max_concurrency,
+            "concurrency_groups": dict(concurrency_groups or {}),
+            "method_groups": method_groups,
+            "method_num_returns": method_num_returns,
             "placement_group": placement_group,
             "bundle_index": bundle_index,
             "owner_address": self.address,
@@ -1402,15 +1433,18 @@ class CoreClient:
                     "create_actor_async instead"
                 )
             self._bg.spawn(self._register_actor(spec), self.loop)
-            return ActorHandle(spec["actor_id"], core=self)
+            return ActorHandle(spec["actor_id"], core=self,
+                               options=_handle_options(spec))
         view = self._run_sync(self._register_actor(spec))
-        return ActorHandle(view["actor_id"], core=self)
+        return ActorHandle(view["actor_id"], core=self,
+                           options=_handle_options(spec))
 
     async def create_actor_async(self, cls, args, kwargs, **opts) -> ActorHandle:
         """Event-loop-safe actor creation (supports get_if_exists)."""
         spec = self._build_actor_spec(cls, args, kwargs, **opts)
         view = await self._register_actor(spec)
-        return ActorHandle(view["actor_id"], core=self)
+        return ActorHandle(view["actor_id"], core=self,
+                           options=_handle_options(spec))
 
     async def get_actor_by_name_async(self, name: str) -> ActorHandle | None:
         info = await self.gcs.call("get_actor", {"name": name})
@@ -1420,7 +1454,9 @@ class CoreClient:
         return ActorHandle(info["actor_id"], core=self)
 
     def submit_actor_task(self, handle: ActorHandle, method: str, args, kwargs,
-                          num_returns=1) -> ObjectRef | list[ObjectRef]:
+                          num_returns=1,
+                          concurrency_group: str | None = None
+                          ) -> ObjectRef | list[ObjectRef]:
         """Submission order is fixed here (sync, caller thread); a per-actor
         pump coroutine then resolves deps, assigns per-connection sequence
         numbers and pipelines pushes — the reference's ActorTaskSubmitter
@@ -1449,6 +1485,7 @@ class CoreClient:
             "num_returns": num_returns,
             "owner_address": self.address,
             "seq": None,
+            "concurrency_group": concurrency_group,
         }
         q = self._actor_queues.setdefault(actor_id, [])
         q.append(spec)

@@ -201,7 +201,8 @@ class ActorHandle:
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        num_returns = self._options.get("method_num_returns", {}).get(name)
+        return ActorMethod(self, name, num_returns=num_returns)
 
     def __reduce__(self):
         return (_rebuild_actor_handle, (self._actor_id, self._method_names, self._options))
@@ -211,21 +212,26 @@ class ActorHandle:
 
 
 class ActorMethod:
-    def __init__(self, handle: ActorHandle, name: str, num_returns: int | None = None):
+    def __init__(self, handle: ActorHandle, name: str, num_returns: int | None = None,
+                 concurrency_group: str | None = None):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
-    def options(self, num_returns: int | None = None, **kw):
-        m = ActorMethod(self._handle, self._name, num_returns)
-        return m
+    def options(self, num_returns: int | None = None,
+                concurrency_group: str | None = None, **kw):
+        return ActorMethod(self._handle, self._name, num_returns,
+                           concurrency_group or self._concurrency_group)
 
     def remote(self, *args, **kwargs) -> Any:
         from ray_tpu.core import api
 
         core = self._handle._core or api.get_core()
         return core.submit_actor_task(
-            self._handle, self._name, args, kwargs, num_returns=self._num_returns or 1
+            self._handle, self._name, args, kwargs,
+            num_returns=self._num_returns or 1,
+            concurrency_group=self._concurrency_group,
         )
 
     def bind(self, *args) -> Any:

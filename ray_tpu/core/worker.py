@@ -67,6 +67,10 @@ class Worker:
         # normal-task ids currently executing, for exact-identity force
         # cancellation (cancel_if_current) — never holds actor task ids
         self._current_tasks: set = set()
+        # actor concurrency groups (populated by rpc_create_actor)
+        self._method_groups: dict = {}
+        self._group_execs: dict = {}
+        self._group_sems: dict = {}
 
     async def start(self):
         # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
@@ -427,6 +431,18 @@ class Worker:
             self.executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=max_concurrency, thread_name_prefix="rt-actor"
             )
+        # named concurrency groups: each group gets its own executor pool +
+        # async-slot semaphore, isolated from the default executor
+        # (ref: concurrency_group_manager.cc per-group thread pools)
+        self._method_groups = spec.get("method_groups") or {}
+        self._group_execs = {}
+        self._group_sems = {}
+        for gname, slots in (spec.get("concurrency_groups") or {}).items():
+            slots = max(1, int(slots))
+            self._group_execs[gname] = concurrent.futures.ThreadPoolExecutor(
+                max_workers=slots, thread_name_prefix=f"rt-cg-{gname}"
+            )
+            self._group_sems[gname] = asyncio.Semaphore(slots)
         loop = asyncio.get_running_loop()
         try:
             self.actor_instance = await loop.run_in_executor(
@@ -458,15 +474,33 @@ class Worker:
             method = getattr(self.actor_instance, spec["method"])
             args = await self._fetch_args(spec["args"])
             kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
+            group = (spec.get("concurrency_group")
+                     or self._method_groups.get(spec["method"]))
+            if group and group not in self._group_execs:
+                # loud, not a silent fallback: an undeclared group name
+                # (typo) would otherwise lose the isolation it asked for
+                return {"error": TaskError(
+                    f"concurrency group {group!r} not declared on this actor "
+                    f"(declared: {sorted(self._group_execs)})")}
             if streaming:
                 work = asyncio.get_running_loop().create_task(
                     self._execute_streaming(spec, method, args, kwargs)
                 )
             elif inspect.iscoroutinefunction(method):
-                work = asyncio.get_running_loop().create_task(method(*args, **kwargs))
+                if group and group in self._group_sems:
+                    sem = self._group_sems[group]
+
+                    async def run_grouped(method=method, args=args, kwargs=kwargs):
+                        async with sem:  # group-bounded async slots
+                            return await method(*args, **kwargs)
+
+                    work = asyncio.get_running_loop().create_task(run_grouped())
+                else:
+                    work = asyncio.get_running_loop().create_task(method(*args, **kwargs))
             else:
                 loop = asyncio.get_running_loop()
-                work = loop.run_in_executor(self.executor, lambda: method(*args, **kwargs))
+                executor = self._group_execs.get(group, self.executor)
+                work = loop.run_in_executor(executor, lambda: method(*args, **kwargs))
         except Exception as e:
             return {"error": _as_task_error(e)}
         finally:

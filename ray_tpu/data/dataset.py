@@ -387,11 +387,17 @@ class GroupedDataset:
 
         @ray_tpu.remote(num_returns=P)
         def partition(block):
+            import zlib
+
             acc = BlockAccessor.for_block(block)
             shards: list[dict] = [{} for _ in builtins.range(P)]
             for row in acc.rows():
                 k = row[key]
-                shards[hash(k) % P].setdefault(k, []).append(row)
+                # process-stable hash: python str hashing is randomized per
+                # process, and partition tasks run in different workers — a
+                # group must land in ONE shard cluster-wide
+                shard = zlib.crc32(repr(k).encode()) % P
+                shards[shard].setdefault(k, []).append(row)
             return tuple(shards) if P > 1 else shards[0]
 
         @ray_tpu.remote

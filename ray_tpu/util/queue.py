@@ -32,7 +32,9 @@ class _QueueActor:
         except asyncio.TimeoutError:
             return False
 
-    def put_nowait(self, item) -> bool:
+    async def put_nowait(self, item) -> bool:
+        # async so it runs on the actor's event loop: asyncio.Queue is not
+        # thread-safe and a sync method would mutate it from executor threads
         try:
             self._q.put_nowait(item)
             return True
@@ -47,13 +49,13 @@ class _QueueActor:
         except asyncio.TimeoutError:
             return False, None
 
-    def get_nowait(self):
+    async def get_nowait(self):
         try:
             return True, self._q.get_nowait()
         except asyncio.QueueEmpty:
             return False, None
 
-    def qsize(self) -> int:
+    async def qsize(self) -> int:
         return self._q.qsize()
 
 
