@@ -1451,7 +1451,8 @@ class CoreClient:
         if info is None or info.get("state") == DEAD:
             return None
         self._actor_info[info["actor_id"]] = info
-        return ActorHandle(info["actor_id"], core=self)
+        return ActorHandle(info["actor_id"], core=self,
+                           options=_handle_options(info))
 
     def submit_actor_task(self, handle: ActorHandle, method: str, args, kwargs,
                           num_returns=1,
@@ -1742,7 +1743,8 @@ class CoreClient:
         if info is None or info.get("state") == DEAD:
             return None
         self._actor_info[info["actor_id"]] = info
-        return ActorHandle(info["actor_id"], core=self)
+        return ActorHandle(info["actor_id"], core=self,
+                           options=_handle_options(info))
 
     # ------------------------------------------------------ compiled DAGs
     def start_dag_loop(self, handle: ActorHandle, schedule: dict):

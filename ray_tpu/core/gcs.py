@@ -88,6 +88,9 @@ class ActorInfo:
             "node_id": self.node_id,
             "num_restarts": self.num_restarts,
             "death_cause": self.death_cause,
+            # driver-side method metadata: handles from get_actor() must
+            # honor @method(num_returns=...) like creation handles do
+            "method_num_returns": self.spec.get("method_num_returns") or {},
         }
 
 

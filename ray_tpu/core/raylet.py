@@ -191,6 +191,9 @@ class Raylet:
                 pass
         self.ledger = ResourceLedger(resources)
 
+        self.log_dir = os.path.join(
+            "/tmp", "ray_tpu", f"session_{self.session}", "logs"
+        )
         self.store_name = f"/rt_{self.session}_{self.node_id.hex()[:8]}"
         self.store = SharedObjectStore(
             self.store_name,
@@ -438,11 +441,57 @@ class Raylet:
             argv = [binary]
         else:
             argv = [sys.executable, "-m", "ray_tpu.core.worker"]
-        proc = subprocess.Popen(argv, env=env, stdout=None, stderr=None)
+        # per-worker log files (ref: the /tmp/ray/session_*/logs tree +
+        # pipe_logger.h redirection): stdout/err land in the session log dir
+        # and are served back via rpc_get_log / state.get_log
+        out_f = err_f = None
+        try:
+            os.makedirs(self.log_dir, exist_ok=True)
+            stem = os.path.join(self.log_dir, f"worker-{worker_id.hex()[:12]}")
+            out_f = open(stem + ".out", "ab")
+            err_f = open(stem + ".err", "ab")
+        except OSError:
+            if out_f is not None:
+                out_f.close()  # .err open failed: don't leak the .out fd
+            out_f = err_f = None  # unwritable tmp: inherit the raylet's fds
+        proc = subprocess.Popen(argv, env=env, stdout=out_f, stderr=err_f)
+        if out_f is not None:
+            out_f.close()
+            err_f.close()
         w = WorkerHandle(worker_id=worker_id, proc=proc, language=language)
         self.all_workers[worker_id] = w
         self.cgroups.isolate_worker(worker_id.hex(), proc.pid, None)
         return w
+
+    async def rpc_get_log(self, conn, p):
+        """Serve a worker's captured stdout/stderr tail (ref: state API
+        get_log over the dashboard log tree). p: worker_id (hex prefix ok),
+        stream ("out"|"err"), tail bytes."""
+        stream = p.get("stream", "out")
+        if stream not in ("out", "err"):
+            return None
+        prefix = (p.get("worker_id") or "")[:12]
+        if not prefix:
+            return None
+        path = os.path.join(self.log_dir, f"worker-{prefix}.{stream}")
+        if not os.path.exists(path):
+            # short hex prefixes are allowed: resolve by glob, unique match
+            import glob as _glob
+
+            matches = _glob.glob(
+                os.path.join(self.log_dir, f"worker-{prefix}*.{stream}"))
+            if len(matches) != 1:
+                return None
+            path = matches[0]
+        tail = int(p.get("tail", 64 * 1024))
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return None
 
     async def rpc_get_lease_env(self, conn, p):
         """Worker-side query for its accelerator assignment (applied as

@@ -221,8 +221,10 @@ class ActorMethod:
 
     def options(self, num_returns: int | None = None,
                 concurrency_group: str | None = None, **kw):
-        return ActorMethod(self._handle, self._name, num_returns,
-                           concurrency_group or self._concurrency_group)
+        return ActorMethod(
+            self._handle, self._name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group or self._concurrency_group)
 
     def remote(self, *args, **kwargs) -> Any:
         from ray_tpu.core import api

@@ -88,6 +88,10 @@ class Worker:
 
         _canonical._current_worker = self
         self.core = CoreClient(loop=asyncio.get_running_loop())
+        # adopt the raylet-assigned identity: runtime_context.worker_id and
+        # the raylet's spawn bookkeeping (log files, chip grants, kills)
+        # must name the same worker
+        self.core.worker_id = self.worker_id
         # the worker's own server doubles as the task receiver
         self.core.server.add_routes(self)
         self.core.server.on_disconnect = lambda conn: self._seq_gates.pop(conn, None)
@@ -278,7 +282,7 @@ class Worker:
         finally:
             self._current_tasks.discard(spec["task_id"])
 
-    async def _execute_streaming(self, spec, fn, args, kwargs):
+    async def _execute_streaming(self, spec, fn, args, kwargs, executor=None):
         """Run a (sync or async) generator, reporting each item to the
         owner as it is produced (ref: _raylet.pyx:1363
         execute_streaming_generator_sync/async; item report RPC
@@ -324,7 +328,7 @@ class Worker:
                     except BaseException as e:  # noqa: BLE001
                         loop.call_soon_threadsafe(out_q.put_nowait, ("error", e))
 
-                driver = loop.run_in_executor(self.executor, drive)
+                driver = loop.run_in_executor(executor or self.executor, drive)
 
                 async def items():
                     while True:
@@ -483,8 +487,13 @@ class Worker:
                     f"concurrency group {group!r} not declared on this actor "
                     f"(declared: {sorted(self._group_execs)})")}
             if streaming:
+                # a grouped generator drives its iteration on the group's
+                # pool, not the default executor (isolation holds for
+                # streaming methods too)
                 work = asyncio.get_running_loop().create_task(
-                    self._execute_streaming(spec, method, args, kwargs)
+                    self._execute_streaming(
+                        spec, method, args, kwargs,
+                        executor=self._group_execs.get(group))
                 )
             elif inspect.iscoroutinefunction(method):
                 if group and group in self._group_sems:

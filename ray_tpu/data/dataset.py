@@ -389,15 +389,26 @@ class GroupedDataset:
         def partition(block):
             import zlib
 
+            def canon(k):
+                # equal dict keys must route identically: 1 == 1.0 == True
+                # share a float encoding; str/bytes get their own spaces
+                # (process-stable, unlike randomized str hash())
+                if isinstance(k, str):
+                    return b"s:" + k.encode()
+                if isinstance(k, bytes):
+                    return b"b:" + k
+                if isinstance(k, (bool, int, float)):
+                    try:
+                        return b"n:" + repr(float(k)).encode()
+                    except OverflowError:
+                        return b"i:" + repr(int(k)).encode()
+                return b"o:" + repr(k).encode()
+
             acc = BlockAccessor.for_block(block)
             shards: list[dict] = [{} for _ in builtins.range(P)]
             for row in acc.rows():
                 k = row[key]
-                # process-stable hash: python str hashing is randomized per
-                # process, and partition tasks run in different workers — a
-                # group must land in ONE shard cluster-wide
-                shard = zlib.crc32(repr(k).encode()) % P
-                shards[shard].setdefault(k, []).append(row)
+                shards[zlib.crc32(canon(k)) % P].setdefault(k, []).append(row)
             return tuple(shards) if P > 1 else shards[0]
 
         @ray_tpu.remote

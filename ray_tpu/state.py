@@ -32,6 +32,34 @@ def _call(method: str, payload: dict | None = None):
     return core._run_sync(core.gcs.call(method, payload or {}))
 
 
+def get_log(worker_id: str, *, stream: str = "out", tail: int = 64 * 1024,
+            node_address: tuple | None = None) -> str | None:
+    """Tail a worker's captured stdout/stderr (ref: ray.util.state.get_log
+    over the session log tree). ``worker_id`` may be a hex prefix; pass
+    ``node_address`` for a worker on another node (defaults to the local
+    raylet)."""
+    core = _core()
+
+    async def fetch():
+        if node_address is None or tuple(node_address) == tuple(core.raylet_address):
+            conn = core.raylet
+            owns = False
+        else:
+            from ray_tpu.utils import rpc as _rpc
+
+            conn = await _rpc.connect(*node_address, timeout=10)
+            owns = True
+        try:
+            return await conn.call(
+                "get_log", {"worker_id": worker_id, "stream": stream,
+                            "tail": tail})
+        finally:
+            if owns:
+                await conn.close()
+
+    return core._run_sync(fetch())
+
+
 def _match(row: dict, filters) -> bool:
     for key, op, value in filters or ():
         have = row.get(key)

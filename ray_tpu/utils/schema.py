@@ -92,6 +92,9 @@ CATALOG: dict[str, dict[str, dict]] = {
             "object_id": "bytes", "offset": "int", "length": "int"}},
         "fetch_object_done": {"since": (1, 0), "fields": {"object_id": "bytes"}},
         "delete_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "get_log": {"since": (1, 1), "fields": {
+            "worker_id": "hex (prefix ok)", "stream": "out|err",
+            "tail": "int bytes", "->": "str | None"}},
     },
     # ------------------------------------------------- owner (CoreClient)
     # (ref: core_worker.proto owner-side RPCs)

@@ -158,3 +158,25 @@ def test_streaming_task_gets_terminal_event(rt):
         msg="no terminal streaming event",
     )
     assert rows
+
+
+def test_get_log_worker_stdout(rt):
+    """Worker stdout/stderr land in the session log tree and are served
+    back via state.get_log (ref: ray.util.state.get_log)."""
+
+    @ray_tpu.remote
+    def chatty():
+        import sys
+
+        print("needle-on-stdout-12345", flush=True)
+        print("needle-on-stderr-67890", file=sys.stderr, flush=True)
+        return ray_tpu.get_runtime_context().worker_id.hex()
+
+    wid = ray_tpu.get(chatty.remote(), timeout=120)
+    out = _wait_for(lambda: state.get_log(wid, stream="out"),
+                    msg="no stdout log")
+    assert "needle-on-stdout-12345" in out
+    err = _wait_for(lambda: state.get_log(wid, stream="err"),
+                    msg="no stderr log")
+    assert "needle-on-stderr-67890" in err
+    assert state.get_log(wid, stream="bogus") is None
