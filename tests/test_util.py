@@ -76,3 +76,46 @@ def test_queue_cross_task(rt):
         assert [q.get(timeout=30) for _ in range(5)] == list(range(5))
     finally:
         q.shutdown()
+
+
+def test_multiprocessing_pool(rt):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as p:
+        assert p.map(_double, range(12)) == [2 * i for i in range(12)]
+        assert p.starmap(_add2, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(_add2, (5, 6)) == 11
+        r = p.apply_async(_add2, (1, 1))
+        assert r.get(timeout=120) == 2 and r.ready() and r.successful()
+        assert sorted(p.imap_unordered(_double, range(6))) == \
+            [2 * i for i in range(6)]
+        assert list(p.imap(_double, range(5))) == [2 * i for i in range(5)]
+    with pytest.raises(ValueError):
+        p.map(_double, [1])  # closed
+
+
+def test_multiprocessing_pool_initializer(rt):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2, initializer=_set_marker, initargs=(42,)) as p:
+        assert all(v == 42 for v in p.map(_read_marker, range(6)))
+
+
+def _double(x):
+    return 2 * x
+
+
+def _add2(a, b):
+    return a + b
+
+
+def _set_marker(v):
+    import builtins
+
+    builtins._rt_pool_marker = v
+
+
+def _read_marker(_):
+    import builtins
+
+    return getattr(builtins, "_rt_pool_marker", None)
