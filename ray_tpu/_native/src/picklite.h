@@ -364,6 +364,7 @@ class Encoder {
 
  private:
   void u32(uint32_t v) { out.append((const char*)&v, 4); }
+  void u64(uint64_t v) { out.append((const char*)&v, 8); }
   void enc_int(int64_t v) {
     if (v >= INT32_MIN && v <= INT32_MAX) {
       out += 'J';
@@ -422,10 +423,16 @@ class Encoder {
     // up far from the producing task — fail here with a clear error instead
     if (!valid_utf8(s))
       throw Error("Value::str holds non-UTF-8 bytes; use Value::bytes for binary data");
-    out += 'X'; u32((uint32_t)s.size()); out += s;
+    // >=4 GiB payloads need the 8-byte length opcode: a silent uint32
+    // truncation would emit a corrupt frame, not an error
+    if (s.size() > 0xffffffffULL) { out += (char)0x8d; u64(s.size()); }
+    else { out += 'X'; u32((uint32_t)s.size()); }
+    out += s;
   }
   void enc_bytes(const std::string& s) {
-    out += 'B'; u32((uint32_t)s.size()); out += s;
+    if (s.size() > 0xffffffffULL) { out += (char)0x8e; u64(s.size()); }
+    else { out += 'B'; u32((uint32_t)s.size()); }
+    out += s;
   }
   void enc_tuple(const std::vector<ValuePtr>& items) {
     if (items.empty()) { out += ')'; return; }

@@ -89,10 +89,18 @@ bool Client::Connect(const std::string& gcs_host, int gcs_port) {
   ::close(gcs_fd);
   if (!cluster || cluster->kind != Value::kList || cluster->items.empty())
     return false;
-  auto addr = cluster->items[0]->get("address");
-  if (!addr || addr->items.size() != 2) return false;
-  raylet_fd_ = dial(addr->items[0]->s, (int)addr->items[1]->i);
-  return raylet_fd_ >= 0;
+  // the GCS view lists alive nodes, but liveness can lag reality (health
+  // reaping interval): try each raylet in turn instead of failing on a
+  // stale first entry
+  for (auto& node : cluster->items) {
+    auto alive = node->get("alive");
+    if (alive && !alive->truthy()) continue;
+    auto addr = node->get("address");
+    if (!addr || addr->items.size() != 2) continue;
+    raylet_fd_ = dial(addr->items[0]->s, (int)addr->items[1]->i);
+    if (raylet_fd_ >= 0) return true;
+  }
+  return false;
 }
 
 bool Client::EnsureWorker(std::string* error) {

@@ -22,6 +22,7 @@
 #include <cstring>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <thread>
 
 #include "rt_cpp_api.h"
@@ -75,6 +76,11 @@ struct Worker {
   std::atomic<long> current_task_lo{0};  // first 8 bytes of running task id
   std::mutex exec_mu;                    // one task at a time (worker invariant)
   std::mutex write_mu;                   // interleaved responses per process
+  // tasks accepted but not yet finished, marked at push RECEIPT (reader
+  // thread) — a force-cancel racing task startup or a pipelined task queued
+  // behind exec_mu must still match (peer of worker.py _current_tasks)
+  std::mutex pending_mu;
+  std::set<long> pending_tasks;
 
   ValuePtr envelope(const char* kind, int64_t corr_id) {
     auto msg = Value::dict_();
@@ -190,13 +196,29 @@ struct Worker {
       auto payload = msg->get("p");
       if (!method) continue;
       if (method->s == "push_task") {
+        // mark at RECEIPT so a racing force-cancel can't slip between
+        // accept and execution (the arg-decode window under exec_mu)
+        long tlo = 0;
+        auto spec = payload ? payload->get("spec") : nullptr;
+        auto tid = spec ? spec->get("task_id") : nullptr;
+        if (tid && !tid->items.empty() && tid->items[0]->kind == Value::kBytes &&
+            tid->items[0]->s.size() >= 8)
+          std::memcpy(&tlo, tid->items[0]->s.data(), 8);
+        if (tlo != 0) {
+          std::lock_guard<std::mutex> g(pending_mu);
+          pending_tasks.insert(tlo);
+        }
         // execute off-thread so this connection keeps reading — a
         // cancel_if_current sent on the SAME connection mid-task must be
         // seen while the task runs (exec_mu still serializes execution).
         // The ConnState ref keeps the fd alive until the reply is written.
         cs->inflight.fetch_add(1);
-        std::thread([this, cs, corr_id, payload] {
+        std::thread([this, cs, corr_id, payload, tlo] {
           handle_push_task(cs->fd, corr_id, payload);
+          if (tlo != 0) {
+            std::lock_guard<std::mutex> g(pending_mu);
+            pending_tasks.erase(tlo);
+          }
           cs->inflight.fetch_sub(1);
           cs->maybe_close();
         }).detach();
@@ -205,7 +227,12 @@ struct Worker {
         auto tid = payload ? payload->get("task_id") : nullptr;
         if (tid && !tid->items.empty() && tid->items[0]->s.size() >= 8)
           std::memcpy(&tlo, tid->items[0]->s.data(), 8);
-        if (tlo != 0 && current_task_lo.load() == tlo) {
+        bool pending = false;
+        if (tlo != 0) {
+          std::lock_guard<std::mutex> g(pending_mu);
+          pending = pending_tasks.count(tlo) != 0;
+        }
+        if (pending || (tlo != 0 && current_task_lo.load() == tlo)) {
           respond(fd, corr_id, Value::boolean(true));
           ::_exit(1);
         }
@@ -243,11 +270,12 @@ struct Worker {
     raylet_host = rh;
     raylet_port = std::atoi(rp);
 
-    // task-receiver server on an ephemeral port
+    // task-receiver server on an ephemeral port; bind ANY so drivers on
+    // other nodes can dial a leased C++ worker (loopback would wall it off)
     server_fd = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
-    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
     addr.sin_port = 0;
     if (::bind(server_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
         ::listen(server_fd, 64) != 0) {
@@ -265,13 +293,28 @@ struct Worker {
                    raylet_host.c_str(), raylet_port);
       return 2;
     }
+    // advertise the address this host is reachable on: the local IP of the
+    // raylet dial (RT_ADVERTISE_HOST overrides), not a hardcoded loopback —
+    // a driver on another node must be able to dial this worker
+    std::string adv_host = "127.0.0.1";
+    if (const char* ah = std::getenv("RT_ADVERTISE_HOST")) {
+      adv_host = ah;
+    } else {
+      sockaddr_in local{};
+      socklen_t llen = sizeof(local);
+      if (::getsockname(rfd, (sockaddr*)&local, &llen) == 0) {
+        char buf[INET_ADDRSTRLEN];
+        if (inet_ntop(AF_INET, &local.sin_addr, buf, sizeof(buf)))
+          adv_host = buf;
+      }
+    }
     {
       auto msg = envelope("c", 1);
       msg->set("m", Value::str("worker_ready"));
       auto p = Value::dict_();
       p->set("worker_id", Value::str(worker_id_hex));
       auto address = Value::tuple();
-      address->items.push_back(Value::str("127.0.0.1"));
+      address->items.push_back(Value::str(adv_host));
       address->items.push_back(Value::integer(server_port));
       p->set("address", address);
       p->set("pid", Value::integer((int64_t)::getpid()));

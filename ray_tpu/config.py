@@ -61,6 +61,10 @@ class Config:
     #: concurrent lease requests per scheduling key (pipelined worker
     #: acquisition under bursts; ref: normal_task_submitter lease pipelining)
     max_lease_parallelism: int = 8
+    #: max task specs pushed to a leased worker in one rpc frame — a deep
+    #: backlog amortizes frame/pickle/loop-wakeup costs across the batch
+    #: (ref: normal_task_submitter.cc direct PushTask pipelining)
+    push_batch_size: int = 32
 
     # --- memory protection (ref: memory_monitor.h:52) ---
     #: fraction of system memory in use that triggers OOM killing;

@@ -278,7 +278,10 @@ class CoreClient:
         if self._closed:
             return
         try:
-            self.loop.call_soon_threadsafe(self._on_owned_ref_deleted_on_loop, oid)
+            # rides the coalesced thread->loop queue: dropping a batch of
+            # refs (every `get([...])` return) must not pay one self-pipe
+            # write syscall per ref
+            self._call_on_loop(oid)
         except RuntimeError:
             pass
 
@@ -472,9 +475,11 @@ class CoreClient:
         oid = ref.id
         pull_fails = 0
         while True:
+            # timeout=0 is a non-blocking fetch: ready values are returned,
+            # the timeout only fires where we would otherwise block
+            # (ref: ray worker.get timeout semantics, worker.py:2757)
             remaining = None if deadline is None else deadline - time.monotonic()
-            if remaining is not None and remaining <= 0:
-                raise GetTimeoutError(f"get timed out on {ref}")
+            expired = remaining is not None and remaining <= 0
             entry = self.memory_store.get(oid)
             if entry is not None and entry.ready.is_set():
                 if entry.error is not None:
@@ -491,6 +496,8 @@ class CoreClient:
                 # borrowed large objects) is materialized over the raylet
                 # connection via the chunked transfer RPCs.
                 if entry is not None and not entry.ready.is_set():
+                    if expired:
+                        raise GetTimeoutError(f"get timed out on {ref}")
                     await _wait_event(entry.ready, remaining)
                     continue
                 if entry is not None or ref.owner_address is None or \
@@ -498,6 +505,8 @@ class CoreClient:
                     data = await self._fetch_via_raylet(oid)
                     if data is not None:
                         return serialization.unpack(data)
+                    if expired:
+                        raise GetTimeoutError(f"get timed out on {ref}")
                     pull_fails += 1
                     if pull_fails >= 5:
                         if await self._try_reconstruct(oid):
@@ -507,6 +516,8 @@ class CoreClient:
                     await asyncio.sleep(0.05)
                     continue
                 # borrowed: ask the owner (inline reply or shm indirection)
+                if expired:
+                    raise GetTimeoutError(f"get timed out on {ref}")
                 try:
                     reply = await self._owner_call(
                         ref, "get_object", {"object_id": oid.binary()}, remaining
@@ -520,6 +531,8 @@ class CoreClient:
                 data = await self._fetch_via_raylet(oid)
                 if data is not None:
                     return serialization.unpack(data)
+                if expired:
+                    raise GetTimeoutError(f"get timed out on {ref}")
                 pull_fails += 1
                 if pull_fails >= 15:
                     raise ObjectLostError(f"{ref}: no reachable copy")
@@ -534,6 +547,8 @@ class CoreClient:
                     # raylet consults the GCS directory); no holder → lost,
                     # unless lineage can re-execute the producing task.
                     ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+                    if expired:
+                        raise GetTimeoutError(f"get timed out on {ref}") from None
                     if not ok:
                         if await self._try_reconstruct(oid):
                             continue
@@ -544,6 +559,11 @@ class CoreClient:
             if entry is not None:
                 if entry.ready.is_set():  # owned, in_shm, not local: pull it
                     ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+                    if expired:
+                        # pull issued (or refused) but the value is still not
+                        # local and the deadline passed: raise rather than
+                        # spinning pull RPCs forever on a stalled transfer
+                        raise GetTimeoutError(f"get timed out on {ref}")
                     if not ok:
                         pull_fails = pull_fails + 1
                         # distinguish "not there yet" from "gone": a local
@@ -559,9 +579,13 @@ class CoreClient:
                         await asyncio.sleep(0.05)
                     continue
                 # owned, pending task result
+                if expired:
+                    raise GetTimeoutError(f"get timed out on {ref}")
                 await _wait_event(entry.ready, remaining)
                 continue
             # borrowed ref: ask the owner
+            if expired:
+                raise GetTimeoutError(f"get timed out on {ref}")
             if ref.owner_address is None or tuple(ref.owner_address) == self.address:
                 await asyncio.sleep(0.01)
                 continue
@@ -911,8 +935,13 @@ class CoreClient:
         return refs[0] if num_returns == 1 else refs
 
     def _call_on_loop(self, coro):
+        """Run a coroutine (or apply a deleted-ref notice, passed as a bare
+        ObjectID) on the loop thread, coalescing cross-thread wakeups."""
         if _in_loop(self.loop):
-            self._bg.spawn(coro, self.loop)
+            if type(coro) is ObjectID:
+                self._on_owned_ref_deleted_on_loop(coro)
+            else:
+                self._bg.spawn(coro, self.loop)
             return
         # Coalesced thread->loop handoff: call_soon_threadsafe writes the
         # loop's self-pipe (a syscall) per call, so a burst of .remote()
@@ -942,7 +971,10 @@ class CoreClient:
             self._xq = []
             self._xq_linger = True
         for coro in batch:
-            self._bg.spawn(coro, self.loop)
+            if type(coro) is ObjectID:
+                self._on_owned_ref_deleted_on_loop(coro)
+            else:
+                self._bg.spawn(coro, self.loop)
         self.loop.call_soon(self._drain_xq)
 
     async def _submit_async(self, spec: dict):
@@ -1012,19 +1044,44 @@ class CoreClient:
 
     async def _pump(self, key, state: _SchedulingKeyState):
         """Dispatch pending tasks onto free leased workers; grow leases."""
-        # hand tasks to free workers
+        # hand tasks to free workers — a deep backlog rides one rpc frame
+        # per worker turn (push_task_multi) instead of one frame per task.
+        # The backlog is split across ALL free workers first (chunk), so a
+        # small burst doesn't pile onto one worker and serialize.
         free = [w for w in state.workers if not w.busy]
-        while free and not state.pending.empty():
-            w = free.pop()
-            spec = state.pending.get_nowait()
-            w.busy = True
-            self._bg.spawn(self._run_on_worker(key, state, w, spec), self.loop)
+        if free and not state.pending.empty():
+            # chunk the backlog over free workers PLUS the leases we could
+            # still grow into: a batch is committed to its worker, so
+            # handing one worker everything would leave nothing for workers
+            # a lease request is about to deliver (and then churn
+            # spawn/idle/return on them)
+            headroom = max(
+                0,
+                min(self.cfg.max_lease_parallelism, _NCPU)
+                - len(state.workers),
+            )
+            targets = len(free) + headroom
+            chunk = max(1, min(self.cfg.push_batch_size,
+                               -(-state.pending.qsize() // targets)))
+            for w in free:
+                if state.pending.empty():
+                    break
+                specs = [state.pending.get_nowait()]
+                while len(specs) < chunk and not state.pending.empty():
+                    specs.append(state.pending.get_nowait())
+                w.busy = True
+                self._bg.spawn(
+                    self._run_on_worker(key, state, w, specs), self.loop)
         # grow leases in PARALLEL with backlog depth (ref:
         # normal_task_submitter pipelined RequestWorkerLease): a deep burst
         # must not pay one sequential worker-spawn per task. Bounded by
         # host cores — concurrent python worker spawns are CPU-hungry and
         # over-forking on small machines slows everything down.
         spawn_cap = _NCPU
+        # demand = work still in the queue (the chunking above deliberately
+        # leaves backlog in pending when lease headroom exists, so this
+        # signal stays live for deep bursts — and goes quiet for small
+        # bursts fully committed to live workers, avoiding spawn churn)
         want = min(
             state.pending.qsize() - state.lease_requests_inflight,
             self.cfg.max_lease_parallelism - state.lease_requests_inflight,
@@ -1104,10 +1161,17 @@ class CoreClient:
                 state.lease_failure_since = now
             else:
                 state.lease_failures += 1
-            persistent = (
-                state.lease_failures >= 3
-                and now - state.lease_failure_since > 2.0
-                and not state.workers
+            # ConfigurationError is definitively non-transient (no worker
+            # binary etc.): break immediately. Anything else — including
+            # worker-start timeouts on a loaded box — gets a high threshold
+            # and real elapsed time before we fail the pending tasks.
+            is_config = sig == "ConfigurationError"
+            persistent = not state.workers and (
+                is_config
+                or (
+                    state.lease_failures >= 10
+                    and now - state.lease_failure_since > 15.0
+                )
             )
             if persistent:
                 err = e if isinstance(e, Exception) else TaskError(str(e))
@@ -1119,42 +1183,108 @@ class CoreClient:
                 state.lease_failure_sig = None
             else:
                 traceback.print_exc()
+                # backoff so repeated transient failures (slow spawns) don't
+                # hot-spin the pump → lease → raise loop
+                await asyncio.sleep(min(0.2 * state.lease_failures, 2.0))
         finally:
             state.lease_requests_inflight -= 1
             await self._pump(key, state)
 
-    async def _run_on_worker(self, key, state, w: _LeasedWorker, spec: dict):
-        if spec["task_id"] in self._cancelled_tasks:
-            self._complete_task_error(spec, TaskCancelledError(str(spec["task_id"])))
-            state.inflight_tasks -= 1
+    async def _run_on_worker(self, key, state, w: _LeasedWorker, specs: list):
+        todo = []
+        for spec in specs:
+            if spec["task_id"] in self._cancelled_tasks:
+                self._complete_task_error(
+                    spec, TaskCancelledError(str(spec["task_id"])))
+                state.inflight_tasks -= 1
+            else:
+                todo.append(spec)
+        if not todo:
             w.busy = False
             w.idle_since = time.monotonic()
             await self._pump(key, state)
             self._bg.spawn(self._maybe_return_lease(key, state, w), self.loop)
             return
-        self.task_events.emit(task_id=spec["task_id"].hex(), name=spec["name"],
-                              state="SUBMITTED_TO_WORKER", worker_id=w.worker_id)
-        self._task_worker[spec["task_id"]] = (w.raylet_address, w.worker_id, w.conn)
-        try:
+        for spec in todo:
+            self.task_events.emit(task_id=spec["task_id"].hex(),
+                                  name=spec["name"],
+                                  state="SUBMITTED_TO_WORKER",
+                                  worker_id=w.worker_id)
+            self._task_worker[spec["task_id"]] = (
+                w.raylet_address, w.worker_id, w.conn)
             if w.tpu_chips:
                 spec["tpu_chips"] = w.tpu_chips
-            reply = await w.conn.call("push_task", {"spec": spec})
+        done: list = []
+        try:
+            if len(todo) == 1 or key[0].startswith(b"cpp:"):
+                # C++ workers speak the single-push protocol only (their
+                # reader drops notification frames): pipeline sequentially
+                for spec in todo:
+                    done.append(
+                        (spec, await w.conn.call("push_task", {"spec": spec})))
+            else:
+                # one frame out, one reply per task back as each finishes
+                futs = w.conn.call_scatter(
+                    "push_task_multi", [{"spec": s} for s in todo])
+                for idx, (spec, fut) in enumerate(zip(todo, futs)):
+                    try:
+                        done.append((spec, await fut))
+                    except rpc.ConnectionLost:
+                        # later batch-mates may have RESOLVED before the
+                        # connection died (replies arrive out of order):
+                        # harvest those results, and consume the failed
+                        # siblings' exceptions so asyncio doesn't log
+                        # "exception was never retrieved" per task
+                        lost = []
+                        for s2, f2 in zip(todo[idx:], futs[idx:]):
+                            if f2.done() and f2.exception() is None:
+                                done.append((s2, f2.result()))
+                            else:
+                                if not f2.done():
+                                    f2.cancel()
+                                lost.append(s2)
+                        # apply what completed, retry only the rest
+                        for s2, reply in done:
+                            self._task_worker.pop(s2["task_id"], None)
+                            self._apply_task_reply(s2, reply)
+                            state.inflight_tasks -= 1
+                        for s2 in lost:
+                            await self._on_worker_lost(key, state, w, s2)
+                        return
         except rpc.ConnectionLost:
-            await self._on_worker_lost(key, state, w, spec)
+            # apply whatever completed before the drop (sequential path),
+            # retry only the rest
+            for s2, reply in done:
+                self._task_worker.pop(s2["task_id"], None)
+                self._apply_task_reply(s2, reply)
+                state.inflight_tasks -= 1
+            finished = {id(s) for s, _ in done}
+            for spec in todo:
+                if id(spec) not in finished:
+                    await self._on_worker_lost(key, state, w, spec)
             return
         except Exception as e:
-            # e.g. an unpicklable task spec: fail the task, free the worker
-            self._task_worker.pop(spec["task_id"], None)
-            self._complete_task_error(spec, e)
-            state.inflight_tasks -= 1
+            # e.g. an unpicklable task spec: fail the tasks, free the worker
+            for s2, reply in done:
+                self._task_worker.pop(s2["task_id"], None)
+                self._apply_task_reply(s2, reply)
+                state.inflight_tasks -= 1
+            finished = {id(s) for s, _ in done}
+            for spec in todo:
+                if id(spec) in finished:
+                    continue
+                self._task_worker.pop(spec["task_id"], None)
+                self._complete_task_error(spec, e)
+                state.inflight_tasks -= 1
             w.busy = False
             w.idle_since = time.monotonic()
             await self._pump(key, state)
             self._bg.spawn(self._maybe_return_lease(key, state, w), self.loop)
             return
-        self._task_worker.pop(spec["task_id"], None)
-        self._apply_task_reply(spec, reply)
-        state.inflight_tasks -= 1
+        for spec, reply in done:
+            self._task_worker.pop(spec["task_id"], None)
+            self._apply_task_reply(spec, reply)
+            state.inflight_tasks -= 1
         w.busy = False
         w.idle_since = time.monotonic()
         await self._pump(key, state)
@@ -1513,45 +1643,80 @@ class CoreClient:
                     continue  # replay was prepended; loop re-checks
                 if not q:
                     return
-                spec = q.pop(0)
-                try:
-                    await self._dispatch_actor_task(spec)
-                except _RecoveryNeeded:
-                    q.insert(0, spec)  # retried AFTER the replay goes out
+                # collect a same-connection batch: each spec keeps its own
+                # seq + reply future (scatter push), so FIFO and per-call
+                # completion are unchanged — only the frames coalesce
+                batch: list = []
+                bconn = None
+                recover = False
+                while q and len(batch) < self.cfg.push_batch_size:
+                    spec = q[0]
+                    try:
+                        conn = await self._prepare_actor_task(spec)
+                    except _RecoveryNeeded:
+                        recover = True
+                        break  # spec stays queued; replay goes out first
+                    except Exception as e:
+                        q.pop(0)
+                        self._complete_task_error(spec, e)
+                        continue
+                    q.pop(0)
+                    if bconn is not None and conn is not bconn:
+                        # connection changed mid-collect (reconnect): flush
+                        # what we have, start a new batch on the new conn
+                        self._send_actor_batch(bconn, batch)
+                        batch = []
+                    bconn = conn
+                    batch.append(spec)
+                if batch:
+                    self._send_actor_batch(bconn, batch)
+                if recover:
+                    continue
         finally:
             self._actor_pump_running.discard(actor_id)
 
-    async def _dispatch_actor_task(self, spec):
-        try:
-            if not spec.get("_resolved"):  # replayed specs are already done
-                pins: list = []
-                spec["args"] = await self._resolve_args(spec["args"], pins)
-                spec["kwargs"] = dict(
-                    zip(spec["kwargs"].keys(),
-                        await self._resolve_args(list(spec["kwargs"].values()), pins))
-                )
-                spec["_resolved"] = True
-                if pins:
-                    self._inflight_pins[spec["task_id"]] = pins
-            conn = await self._actor_connection(spec["actor_id"])
-            if self._actor_recover_pending.get(spec["actor_id"]):
-                # a connection died while this dispatch was suspended: the
-                # replay must go out first — hand the spec back to the pump
-                raise _RecoveryNeeded()
-            seq = self._conn_seq.get(conn, 0)
-            self._conn_seq[conn] = seq + 1
-            spec["seq"] = seq
-            self._actor_inflight.setdefault(spec["actor_id"], {})[spec["task_id"]] = spec
-            # pipelined: don't await the reply here, keep the pump moving
-            self._bg.spawn(self._await_actor_reply(conn, spec), self.loop)
-        except _RecoveryNeeded:
-            raise
-        except Exception as e:
-            self._complete_task_error(spec, e)
+    async def _prepare_actor_task(self, spec):
+        """Resolve deps, pick the connection, assign the per-connection
+        sequence number and register the spec for reconnect replay. Raises
+        _RecoveryNeeded (before any seq is taken) when a replay must go out
+        first."""
+        if not spec.get("_resolved"):  # replayed specs are already done
+            pins: list = []
+            spec["args"] = await self._resolve_args(spec["args"], pins)
+            spec["kwargs"] = dict(
+                zip(spec["kwargs"].keys(),
+                    await self._resolve_args(list(spec["kwargs"].values()), pins))
+            )
+            spec["_resolved"] = True
+            if pins:
+                self._inflight_pins[spec["task_id"]] = pins
+        conn = await self._actor_connection(spec["actor_id"])
+        if self._actor_recover_pending.get(spec["actor_id"]):
+            # a connection died while this dispatch was suspended: the
+            # replay must go out first — hand the spec back to the pump
+            raise _RecoveryNeeded()
+        seq = self._conn_seq.get(conn, 0)
+        self._conn_seq[conn] = seq + 1
+        spec["seq"] = seq
+        self._actor_inflight.setdefault(spec["actor_id"], {})[spec["task_id"]] = spec
+        return conn
 
-    async def _await_actor_reply(self, conn, spec):
+    def _send_actor_batch(self, conn, specs: list):
+        # pipelined: don't await replies here, keep the pump moving
+        if len(specs) == 1:
+            self._bg.spawn(self._await_actor_reply(conn, specs[0]), self.loop)
+            return
+        futs = conn.call_scatter(
+            "push_actor_task_multi", [{"spec": s} for s in specs])
+        for spec, fut in zip(specs, futs):
+            self._bg.spawn(self._await_actor_reply(conn, spec, fut), self.loop)
+
+    async def _await_actor_reply(self, conn, spec, fut=None):
         try:
-            reply = await conn.call("push_actor_task", {"spec": spec})
+            if fut is None:
+                reply = await conn.call("push_actor_task", {"spec": spec})
+            else:
+                reply = await fut
             self._actor_inflight.get(spec["actor_id"], {}).pop(spec["task_id"], None)
             self._apply_task_reply(spec, reply)
         except rpc.ConnectionLost:
@@ -1712,9 +1877,18 @@ class CoreClient:
                 # process, so a task that completed and a reused worker can
                 # never be killed by a stale cancel.
                 try:
-                    await wconn.call("cancel_if_current", {"task_id": task_id},
-                                     timeout=5)
-                    return
+                    killed = await wconn.call(
+                        "cancel_if_current", {"task_id": task_id}, timeout=5)
+                    if killed or self._task_worker.get(task_id) != loc:
+                        return
+                    # worker said "not mine" but the task is still mapped
+                    # here: the push may be racing startup — retry once
+                    # before escalating to a raylet kill
+                    await asyncio.sleep(0.1)
+                    killed = await wconn.call(
+                        "cancel_if_current", {"task_id": task_id}, timeout=5)
+                    if killed or self._task_worker.get(task_id) != loc:
+                        return
                 except Exception:
                     pass  # worker loop unresponsive/conn dead: raylet fallback
                 # Fallback (worker wedged): kill via raylet, but only if the

@@ -207,12 +207,16 @@ class GcsServer:
         if info is None:
             return {"ok": False}
         info.last_heartbeat = time.monotonic()
+        # queued_leases is a latest-wins scalar independent of the versioned
+        # resource view: apply it even on stale frames so the autoscaler
+        # demand signal tracks the most recent report
+        if "queued_leases" in p:
+            info.queued_leases = int(p.get("queued_leases", 0))
         version = int(p.get("version", 0))
         if version and version <= info.view_version:
             # stale or reordered report (e.g. a delayed frame after a GCS
             # reconnect): liveness refreshed above, view NOT applied
             return {"ok": True, "stale": True}
-        info.queued_leases = int(p.get("queued_leases", 0))
         if p.get("resources_available") is not None:
             changed = info.resources_available != p["resources_available"]
             info.resources_available = dict(p["resources_available"])

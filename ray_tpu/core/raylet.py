@@ -433,7 +433,9 @@ class Raylet:
             # functions), pointed at via RT_CPP_WORKER (ref: cpp/ worker API)
             binary = os.environ.get("RT_CPP_WORKER") or self.cfg.cpp_worker_binary
             if not binary:
-                raise RuntimeError(
+                from ray_tpu.core.ref import ConfigurationError
+
+                raise ConfigurationError(
                     "cpp task submitted but no C++ worker binary configured "
                     "(set RT_CPP_WORKER=<path to binary built against "
                     "rt_cpp_api.h>)"

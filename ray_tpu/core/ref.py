@@ -58,6 +58,12 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     pass
 
 
+class ConfigurationError(RayTpuError):
+    """The cluster cannot run this task as configured (e.g. a cpp task with
+    no RT_CPP_WORKER binary). Never transient: retrying cannot succeed, so
+    the lease-failure breaker fails pending tasks on it immediately."""
+
+
 class ObjectRef:
     """Future-like handle to a (possibly pending) remote object."""
 

@@ -233,6 +233,290 @@ class Worker:
             return True
         return False
 
+    async def rpc_push_task_multi(self, conn, p):
+        """Scatter-push handler: ONE frame carries many (corr_id, payload)
+        items; each task gets its own reply frame when it finishes (ref:
+        normal_task_submitter.cc PushTask pipelining — the driver amortizes
+        frame/pickle/wakeup costs without batching completion).
+
+        Contiguous runs of "simple" tasks — cached sync function, inline
+        args, no runtime env / accelerator grant, plain int num_returns —
+        execute in ONE executor hop: the thread handoff (~100us each way)
+        would otherwise dominate sub-millisecond tasks. Execution stays
+        strictly sequential (one lease = one CPU's worth of work).
+
+        Runs on the notification dispatch path (no auto-reply), so EVERY
+        item must get a reply here even when the batch machinery itself
+        blows up — a stranded correlation id wedges the driver's lease."""
+        items = p["items"]
+        replied: set = set()
+        try:
+            await self._push_task_multi_inner(conn, items, replied)
+        except Exception as e:
+            err = {"error": _as_task_error(e)}
+            for corr, _ in items:
+                if corr in replied:
+                    continue
+                try:
+                    await conn.respond(corr, value=err)
+                except Exception:
+                    break  # connection gone: driver handles ConnectionLost
+
+    async def _push_task_multi_inner(self, conn, items, replied: set):
+        i = 0
+        loop = asyncio.get_running_loop()
+        while i < len(items):
+            run = []
+            while i < len(items):
+                spec = items[i][1]["spec"]
+                simple = (
+                    isinstance(spec["num_returns"], int)
+                    and not spec.get("runtime_env")
+                    and not spec.get("tpu_chips")
+                    and all(a[0] in ("v", "p") for a in spec["args"])
+                    and all(a[0] in ("v", "p") for a in spec["kwargs"].values())
+                )
+                if simple:
+                    fn = self._func_cache.get(spec["func_id"])
+                    if fn is None:
+                        try:
+                            fn = await self._load_function(spec["func_id"])
+                        except Exception:
+                            fn = None
+                    simple = fn is not None and not inspect.iscoroutinefunction(fn)
+                if not simple:
+                    break
+                run.append((items[i][0], spec))
+                i += 1
+            if run:
+                for _, s in run:
+                    self._current_tasks.add(s["task_id"])
+                    self.core.task_events.emit(
+                        task_id=s["task_id"].hex(), name=s.get("name", "task"),
+                        state="RUNNING", worker_id=self.worker_id.hex(),
+                        node_id=self.node_id.hex(), pid=os.getpid(),
+                    )
+                t0 = time.monotonic()
+                outcomes = await loop.run_in_executor(
+                    self.executor, self._exec_simple_run, [s for _, s in run])
+                per_task = (time.monotonic() - t0) / len(run)
+                out = []
+                for (corr, s), (ok, value) in zip(run, outcomes):
+                    if ok:
+                        try:
+                            results = await self._store_results(
+                                s["task_id"], s["num_returns"], value)
+                            reply = {"results": results}
+                            metrics.task_exec_seconds.observe(per_task)
+                            state = "FINISHED"
+                        except Exception as e:
+                            reply = {"error": _as_task_error(e)}
+                            state = "FAILED"
+                    else:
+                        reply = {"error": _as_task_error(value)}
+                        state = "FAILED"
+                    ev = dict(
+                        task_id=s["task_id"].hex(), name=s.get("name", "task"),
+                        state=state, worker_id=self.worker_id.hex(),
+                        node_id=self.node_id.hex(), pid=os.getpid(),
+                    )
+                    if state == "FINISHED":
+                        ev["duration_s"] = per_task
+                    self.core.task_events.emit(**ev)
+                    self._current_tasks.discard(s["task_id"])
+                    out.append((corr, reply, None))
+                    replied.add(corr)
+                await conn.respond_multi(out)
+                continue
+            corr, payload = items[i]
+            i += 1
+            reply = await self.rpc_push_task(conn, payload)
+            replied.add(corr)
+            await conn.respond(corr, value=reply)
+
+    async def rpc_push_actor_task_multi(self, conn, p):
+        """Scatter-push for actor calls: dispatch every item immediately
+        (the per-connection seq gates order execution for sync actors;
+        async actors keep their concurrency), reply per item as each
+        finishes.
+
+        Contiguous consecutive-seq runs of "simple" calls — sync method on
+        a max_concurrency=1 actor, default concurrency group, inline args —
+        execute in ONE executor hop, like the normal-task fast path. Only
+        when the actor is strictly serial anyway: on a wider pool two sync
+        methods may legitimately rendezvous across threads, and batching
+        them onto one thread would deadlock that."""
+        items = p["items"]
+        replied: set = set()
+        try:
+            await self._push_actor_multi_inner(conn, items, replied)
+        except Exception as e:
+            err_reply = {"error": _as_task_error(e)}
+            for corr, _ in items:
+                if corr in replied:
+                    continue
+                try:
+                    await conn.respond(corr, value=err_reply)
+                except Exception:
+                    break
+
+    async def _push_actor_multi_inner(self, conn, items, replied: set):
+        loop = asyncio.get_running_loop()
+        i = 0
+        serial_actor = (
+            self.actor_instance is not None
+            and getattr(self, "_actor_max_concurrency", 1) == 1
+            and not self._group_execs
+        )
+        while i < len(items):
+            run = []
+            while serial_actor and i < len(items):
+                spec = items[i][1]["spec"]
+                ok = (
+                    isinstance(spec.get("num_returns"), int)
+                    and spec.get("seq") is not None
+                    and not spec.get("concurrency_group")
+                    and not self._method_groups.get(spec.get("method"))
+                    and all(a[0] in ("v", "p") for a in spec["args"])
+                    and all(a[0] in ("v", "p") for a in spec["kwargs"].values())
+                )
+                if ok:
+                    m = getattr(self.actor_instance, spec["method"], None)
+                    ok = (callable(m)
+                          and not inspect.iscoroutinefunction(m)
+                          and not inspect.isasyncgenfunction(m)
+                          and not inspect.isgeneratorfunction(m))
+                if ok and run:
+                    ok = spec["seq"] == run[-1][1]["spec"]["seq"] + 1
+                if not ok:
+                    break
+                run.append(items[i])
+                i += 1
+            if len(run) >= 2:
+                await self._exec_actor_simple_run(conn, run, replied)
+                continue
+            if run:
+                corr, payload = run[0]
+                replied.add(corr)  # _actor_push_respond owns the reply
+                loop.create_task(self._actor_push_respond(conn, corr, payload))
+                continue
+            corr, payload = items[i]
+            i += 1
+            replied.add(corr)
+            loop.create_task(self._actor_push_respond(conn, corr, payload))
+
+    async def _exec_actor_simple_run(self, conn, run, replied: set):
+        gate = self._seq_gates.setdefault(conn, {"next": 0, "events": {}})
+        s0 = run[0][1]["spec"]["seq"]
+        while gate["next"] != s0:
+            ev = gate["events"].setdefault(s0, asyncio.Event())
+            await ev.wait()
+        specs = [payload["spec"] for _, payload in run]
+        for s in specs:
+            self.core.task_events.emit(
+                task_id=s["task_id"].hex(), name=s.get("method", "actor_task"),
+                state="RUNNING", worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(),
+                actor_id=self.actor_id.hex() if self.actor_id else None,
+            )
+        # open the gate BEFORE executing, exactly like the single-dispatch
+        # path releases it after dispatch: later calls (notably async
+        # methods, which run on the loop even on a max_concurrency=1 actor)
+        # must be able to start while this run occupies the executor thread
+        # — a sync method blocking on something an async method will set
+        # would otherwise deadlock. Later SYNC calls still serialize behind
+        # this run in the single executor thread.
+        last = specs[-1]["seq"]
+        gate["next"] = last + 1
+        ev = gate["events"].pop(last + 1, None)
+        if ev is not None:
+            ev.set()
+        t0 = time.monotonic()
+        outcomes = await asyncio.get_running_loop().run_in_executor(
+            self.executor, self._exec_actor_run_thread, specs)
+        per_task = (time.monotonic() - t0) / len(specs)
+        out = []
+        for (corr, _), s, (ok, value) in zip(run, specs, outcomes):
+            if ok:
+                try:
+                    results = await self._store_results(
+                        s["task_id"], s["num_returns"], value)
+                    reply = {"results": results}
+                    metrics.task_exec_seconds.observe(per_task)
+                    state = "FINISHED"
+                except Exception as e:
+                    reply = {"error": _as_task_error(e)}
+                    state = "FAILED"
+            else:
+                reply = {"error": _as_task_error(value)}
+                state = "FAILED"
+            ev = dict(
+                task_id=s["task_id"].hex(), name=s.get("method", "actor_task"),
+                state=state, worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid(),
+                actor_id=self.actor_id.hex() if self.actor_id else None,
+            )
+            if state == "FINISHED":
+                ev["duration_s"] = per_task
+            self.core.task_events.emit(**ev)
+            out.append((corr, reply, None))
+            replied.add(corr)
+        await conn.respond_multi(out)
+
+    def _exec_actor_run_thread(self, specs):
+        out = []
+        inst = self.actor_instance
+        for spec in specs:
+            try:
+                m = getattr(inst, spec["method"])
+                args = [
+                    serialization.unpack(a[1]) if a[0] == "v" else a[1]
+                    for a in spec["args"]
+                ]
+                kwargs = {
+                    k: serialization.unpack(a[1]) if a[0] == "v" else a[1]
+                    for k, a in spec["kwargs"].items()
+                }
+                out.append((True, m(*args, **kwargs)))
+            except Exception as e:
+                out.append((False, e))
+        return out
+
+    async def _actor_push_respond(self, conn, corr, payload):
+        try:
+            reply = await self.rpc_push_actor_task(conn, payload)
+            await conn.respond(corr, value=reply)
+        except Exception as e:
+            try:
+                await conn.respond(corr, error=e)
+            except Exception:
+                pass
+
+    def _exec_simple_run(self, run):
+        """Thread-side body of the simple-batch fast path: no awaits, no
+        loop interaction — just call the user functions back to back."""
+        out = []
+        for spec in run:
+            try:
+                fn = self._func_cache[spec["func_id"]]
+                args = [
+                    serialization.unpack(a[1]) if a[0] == "v" else a[1]
+                    for a in spec["args"]
+                ]
+                kwargs = {
+                    k: serialization.unpack(a[1]) if a[0] == "v" else a[1]
+                    for k, a in spec["kwargs"].items()
+                }
+                value = fn(*args, **kwargs)
+                if inspect.isgenerator(value):
+                    value = list(value)
+                    if spec["num_returns"] != 1:
+                        value = tuple(value)
+                out.append((True, value))
+            except Exception as e:
+                out.append((False, e))
+        return out
+
     async def rpc_push_task(self, conn, p):
         spec = p["spec"]
         self._current_tasks.add(spec["task_id"])
@@ -431,6 +715,7 @@ class Worker:
         args = await self._fetch_args(spec["args"])
         kwargs = dict(zip(spec["kwargs"].keys(), await self._fetch_args(list(spec["kwargs"].values()))))
         max_concurrency = spec.get("max_concurrency", 1)
+        self._actor_max_concurrency = max_concurrency
         if max_concurrency > 1:
             self.executor = concurrent.futures.ThreadPoolExecutor(
                 max_workers=max_concurrency, thread_name_prefix="rt-actor"

@@ -20,12 +20,15 @@ _OBJECT_ID_SIZE = _TASK_ID_SIZE + _OBJECT_INDEX_SIZE  # 20 bytes
 
 class BaseID:
     SIZE = _UNIQUE_ID_SIZE
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, b: bytes):
         if len(b) != self.SIZE:
             raise ValueError(f"{type(self).__name__} needs {self.SIZE} bytes, got {len(b)}")
         self._bytes = bytes(b)
+        # ids key nearly every hot-path dict (memory store, refcounts,
+        # lineage): cache the hash instead of re-hashing 20 bytes per lookup
+        self._hash = hash(self._bytes)
 
     @classmethod
     def generate(cls):
@@ -49,7 +52,7 @@ class BaseID:
         return cls(bytes.fromhex(h))
 
     def __hash__(self):
-        return hash(self._bytes)
+        return self._hash
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

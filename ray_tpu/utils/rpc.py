@@ -20,6 +20,18 @@ from typing import Any, Awaitable, Callable
 _LEN = struct.Struct("<Q")
 
 
+def _resolve_multi(pending: dict, items: list):
+    """Resolve futures for a coalesced-response ("R") frame:
+    items = [(corr_id, value, error)]."""
+    for i, v, e in items:
+        fut = pending.pop(i, None)
+        if fut is not None and not fut.done():
+            if e is not None:
+                fut.set_exception(e)
+            else:
+                fut.set_result(v)
+
+
 class RpcError(Exception):
     pass
 
@@ -77,6 +89,8 @@ class Connection:
                             fut.set_exception(msg["e"])
                         else:
                             fut.set_result(msg.get("v"))
+                elif kind == "R":  # coalesced responses (scatter replies)
+                    _resolve_multi(self._pending, msg["f"])
                 elif self.on_message is not None:
                     res = self.on_message(msg)
                     if asyncio.iscoroutine(res):
@@ -128,6 +142,12 @@ class Connection:
 
     async def respond(self, msg_id: int, value: Any = None, error: Exception | None = None):
         await self.send({"k": "r", "i": msg_id, "v": value, "e": error})
+
+    async def respond_multi(self, items: list):
+        """items: [(msg_id, value, error)] — one frame, many responses."""
+        await self.send({"k": "R", "f": items})
+
+    call_scatter = None  # bound below (shared with LoopbackConnection)
 
     async def close(self):
         self._closed = True
@@ -182,6 +202,8 @@ class LoopbackConnection:
                         fut.set_exception(msg["e"])
                     else:
                         fut.set_result(msg.get("v"))
+            elif kind == "R":
+                self._apply_multi(msg["f"])
             return
         if kind == "r":
             fut = self._pending.pop(msg["i"], None)
@@ -190,6 +212,8 @@ class LoopbackConnection:
                     fut.set_exception(msg["e"])
                 else:
                     fut.set_result(msg.get("v"))
+        elif kind == "R":
+            self._apply_multi(msg["f"])
         elif self.on_message is not None:
             res = self.on_message(msg)
             if asyncio.iscoroutine(res):
@@ -226,6 +250,14 @@ class LoopbackConnection:
     async def respond(self, msg_id: int, value: Any = None, error: Exception | None = None):
         await self.send({"k": "r", "i": msg_id, "v": value, "e": error})
 
+    async def respond_multi(self, items: list):
+        await self.send({"k": "R", "f": items})
+
+    def _apply_multi(self, items: list):
+        _resolve_multi(self._pending, items)
+
+    call_scatter = None  # bound below (shared with Connection)
+
     async def close(self):
         if self._closed:
             return
@@ -251,6 +283,36 @@ _LOCAL_SERVERS: dict[tuple, tuple] = {}
 # peer's version cannot change, so repeat connects (e.g. per-call owner
 # dials) skip the extra round-trip.
 _VERIFIED_PEERS: set = set()
+
+
+def _call_scatter(self, method: str, payloads: list) -> list:
+    """Send MANY calls in ONE frame; the handler replies per item (each got
+    its own correlation id), so batching the transport does not batch
+    completion — a slow task can't hold back its batch-mates' replies.
+    Returns one future per payload, resolved like call()'s."""
+    loop = asyncio.get_running_loop()
+    futs, items = [], []
+    for p in payloads:
+        i = next(self._ids)
+        fut = loop.create_future()
+        self._pending[i] = fut
+        futs.append(fut)
+        items.append((i, p))
+    try:
+        self.send_nowait({"k": "n", "m": method, "p": {"items": items}})
+    except Exception as e:  # ConnectionLost, or an unpicklable payload
+        if not isinstance(e, ConnectionLost):
+            e = type(e)(str(e))  # detach from the traceback for the futures
+        for i, _ in items:
+            self._pending.pop(i, None)
+        for f in futs:
+            if not f.done():
+                f.set_exception(e)
+    return futs
+
+
+Connection.call_scatter = _call_scatter
+LoopbackConnection.call_scatter = _call_scatter
 
 
 async def _hello_handler(conn, payload):
@@ -329,6 +391,8 @@ class RpcServer:
                             fut.set_exception(msg["e"])
                         else:
                             fut.set_result(msg.get("v"))
+                elif kind == "R":
+                    _resolve_multi(conn._pending, msg["f"])
         except (ConnectionLost, ConnectionResetError):
             pass
         finally:

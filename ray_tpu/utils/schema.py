@@ -17,7 +17,7 @@ from __future__ import annotations
 
 # (major, minor): bump MAJOR for incompatible changes (renamed/removed
 # methods, changed field meaning), MINOR for additions.
-PROTOCOL_VERSION = (1, 1)
+PROTOCOL_VERSION = (1, 2)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -121,6 +121,11 @@ CATALOG: dict[str, dict[str, dict]] = {
             "actor_id": "ActorID", "cls_blob": "bytes", "args": "[arg]",
             "opts": "dict"}},
         "cancel_if_current": {"since": (1, 1), "fields": {"task_id": "TaskID"}},
+        "push_task_multi": {"since": (1, 2), "fields": {
+            "items": "[(corr_id, {spec})] — scatter push; one reply frame "
+                     "per item as each task finishes"}},
+        "push_actor_task_multi": {"since": (1, 2), "fields": {
+            "items": "[(corr_id, {spec})] — scatter push of actor calls"}},
         "exit_worker": {"since": (1, 0), "fields": {}},
         "ping": {"since": (1, 0), "fields": {}},
         "start_dag_loop": {"since": (1, 0), "fields": {"schedule": "dict"}},

@@ -81,7 +81,7 @@ def test_cpp_no_binary_fails_fast():
     env = {k: v for k, v in os.environ.items() if k != "RT_CPP_WORKER"}
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=180, env=env)
-    assert "FAILED-FAST:RuntimeError" in out.stdout, (out.stdout, out.stderr)
+    assert "FAILED-FAST:ConfigurationError" in out.stdout, (out.stdout, out.stderr)
 
 
 def test_cpp_unknown_function(rt_cpp):
