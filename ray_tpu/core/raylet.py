@@ -23,6 +23,7 @@ import os
 import subprocess
 import sys
 import time
+import traceback
 from dataclasses import dataclass, field
 
 from ray_tpu.config import get_config
@@ -773,6 +774,127 @@ class Raylet:
         else:
             self._bg.spawn(drain())
         return True
+
+    # -------------------------------------------- cross-node DAG channels
+    # (the RegisterMutableObjectReader role, ref: core_worker.proto:577 +
+    # experimental_mutable_object_provider.cc: remote readers of a mutable
+    # object get a local mirror cell fed one push per version)
+
+    async def rpc_channel_create(self, conn, p):
+        """Create a channel cell (origin or mirror) in this node's arena."""
+        cid = ObjectID(p["chan_id"])
+        self.store.channel_create(cid, int(p["size"]), int(p["num_readers"]))
+        return True
+
+    async def rpc_channel_push(self, conn, p):
+        """Write one version's packed payload into a local mirror cell.
+        Blocks (off-loop) until the mirror's readers released the previous
+        version — backpressure propagates across the network."""
+        cid = ObjectID(p["chan_id"])
+        payload = p["payload"]
+
+        def push():
+            buf = self.store.channel_write_acquire(cid, -1)
+            buf[: len(payload)] = payload
+            self.store.channel_write_release(cid, len(payload))
+
+        await asyncio.get_running_loop().run_in_executor(
+            self._chan_io_executor(cid), push)
+        return True
+
+    async def rpc_channel_register_remote(self, conn, p):
+        """Start a forwarder pumping this node's channel cell to mirror
+        cells on remote nodes, one push per version, releasing the origin
+        only after every mirror accepted (keeps the end-to-end depth-1
+        write/read protocol of the shm cells)."""
+        cid = ObjectID(p["chan_id"])
+        targets = [tuple(a) for a in p["readers"]]
+        self._bg.spawn(self._channel_forwarder(cid, targets))
+        return True
+
+    async def rpc_channel_close(self, conn, p):
+        cid = ObjectID(p["chan_id"])
+        try:
+            self.store.channel_close(cid)
+        except Exception:
+            pass
+        # mirror nodes create a push executor per channel: release it here
+        # (the forwarder's finally only runs on the origin node)
+        ex = getattr(self, "_chan_execs", {}).pop(cid, None)
+        if ex is not None:
+            ex.shutdown(wait=False)
+        return True
+
+    def _chan_io_executor(self, cid: ObjectID):
+        """One single-thread executor per channel: blocking cell waits must
+        not starve the shared pool (a parked forwarder would otherwise hold
+        a shared worker thread for the DAG's lifetime)."""
+        if not hasattr(self, "_chan_execs"):
+            self._chan_execs = {}
+        ex = self._chan_execs.get(cid)
+        if ex is None:
+            import concurrent.futures as _cf
+
+            ex = self._chan_execs[cid] = _cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"rt-chan-{cid.hex()[:8]}")
+        return ex
+
+    async def _channel_forwarder(self, cid: ObjectID, targets: list):
+        from ray_tpu.core.object_store import ChannelClosedError
+
+        loop = asyncio.get_running_loop()
+        ex = self._chan_io_executor(cid)
+        conns = []
+        try:
+            for t in targets:
+                conns.append(await rpc.connect(
+                    *t, timeout=self.cfg.rpc_connect_timeout_s))
+            last_version = 0
+
+            def read_next(v=None):
+                return self.store.channel_read_acquire(cid, last_version, -1)
+
+            while True:
+                payload, version = await loop.run_in_executor(ex, read_next)
+                data = bytes(payload)
+                await asyncio.gather(*[
+                    c.call("channel_push",
+                           {"chan_id": cid.binary(), "payload": data},
+                           timeout=None)
+                    for c in conns
+                ])
+                self.store.channel_read_release(cid)
+                last_version = version
+        except ChannelClosedError:
+            pass  # normal teardown: origin closed under us
+        except Exception:
+            # a mirror died or the forwarder itself broke: this is NOT a
+            # clean close — log it, or the DAG just stops delivering
+            # versions with zero diagnostics
+            traceback.print_exc()
+        finally:
+            # propagate the close both ways: mirrors stop their readers,
+            # and the ORIGIN cell closes so the producer's next write
+            # raises ChannelClosed instead of blocking forever on the
+            # never-released read slot
+            for c in conns:
+                try:
+                    await c.call("channel_close", {"chan_id": cid.binary()},
+                                 timeout=5)
+                except Exception:
+                    pass
+            try:
+                self.store.channel_close(cid)
+            except Exception:
+                pass
+            for c in conns:
+                try:
+                    await c.close()
+                except Exception:
+                    pass
+            ex2 = getattr(self, "_chan_execs", {}).pop(cid, None)
+            if ex2 is not None:
+                ex2.shutdown(wait=False)
 
     async def rpc_pull_object(self, conn, p):
         """Pull an object into the local store from whichever node holds it

@@ -1,13 +1,17 @@
 """Compiled graphs (aDAG equivalent): static dataflow over actors on shm
-channels (ref: python/ray/dag/ + python/ray/experimental/channel/)."""
+channels (ref: python/ray/dag/ + python/ray/experimental/channel/), with
+cross-node channels mirrored over the raylet transfer plane and collective
+nodes riding the collective backend."""
 
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
 from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
+    CollectiveNode,
     DAGNode,
     InputNode,
     MultiOutputNode,
+    allreduce_bind,
     bind,
 )
 
@@ -17,8 +21,10 @@ __all__ = [
     "CompiledDAG",
     "CompiledDAGRef",
     "ClassMethodNode",
+    "CollectiveNode",
     "DAGNode",
     "InputNode",
     "MultiOutputNode",
+    "allreduce_bind",
     "bind",
 ]

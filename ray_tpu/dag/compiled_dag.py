@@ -15,10 +15,15 @@ schedules). Compilation:
   4. driver I/O: execute() writes the input channel, result refs read the
      leaf channels.
 
-Single-node by design for now: channels live in the node's shm arena (the
-reference's cross-node channel registration, core_worker.proto:577, is the
-round-3+ extension; multi-host TPU pipelines run *inside* one jitted SPMD
-program over the mesh instead — see parallel/pipeline.py).
+Cross-node DAGs: a channel's origin cell lives in the producer's node
+arena; every remote reader node gets a local mirror cell, fed one push per
+version by a raylet forwarder that releases the origin only after all
+mirrors accepted — the reference's remote-reader registration
+(ref: core_worker.proto:577 RegisterMutableObjectReader,
+experimental_mutable_object_provider.cc), with end-to-end depth-1
+backpressure preserved across the network. Multi-host TPU pipelines can
+still run *inside* one jitted SPMD program over the mesh instead — see
+parallel/pipeline.py.
 """
 
 from __future__ import annotations
@@ -31,6 +36,7 @@ from ray_tpu.core import api
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
+    CollectiveNode,
     DAGNode,
     InputNode,
     MultiOutputNode,
@@ -107,41 +113,108 @@ class CompiledDAG:
                 raise ValueError("DAG outputs must be actor method nodes")
             consumers[id(leaf)].add("driver")
 
-        # verify all actors are on this node (shm channels are node-local)
+        # locate every participant: actors may live on ANY node — channels
+        # get their origin cell on the producer's node and a mirror cell on
+        # every remote reader node, fed by a raylet forwarder per version
+        # (ref: core_worker.proto:577 RegisterMutableObjectReader,
+        # experimental_mutable_object_provider.cc)
         my_node = core.node_id.binary()
+        actor_node: dict[bytes, bytes] = {}  # actor_id -> node_id bytes
         for n in body:
-            info = core._run_sync(
-                core.gcs.call("get_actor", {"actor_id": n.actor_handle.actor_id})
-            )
-            if info is None:
-                raise ValueError(f"actor {n.actor_handle.actor_id!r} not found")
-            node_id = info.get("node_id")
-            if node_id is not None and _as_bytes(node_id) != my_node:
-                raise NotImplementedError(
-                    "compiled DAGs currently require all actors on the "
-                    "driver's node (shm channels; cross-node channels are the "
-                    "DCN extension)"
+            akey = n.actor_handle.actor_id.binary()
+            if akey in actor_node:
+                continue
+            # channels are wired to the actor's NODE, so compile must know
+            # real placements — wait briefly for pending creations instead
+            # of silently guessing (a wrong guess wires cells to the wrong
+            # arena and the loop's first read hangs)
+            deadline = time.monotonic() + 30.0
+            node_id = None
+            while time.monotonic() < deadline:
+                info = core._run_sync(
+                    core.gcs.call("get_actor",
+                                  {"actor_id": n.actor_handle.actor_id})
                 )
+                if info is None:
+                    raise ValueError(
+                        f"actor {n.actor_handle.actor_id!r} not found")
+                node_id = info.get("node_id")
+                if node_id is not None:
+                    break
+                time.sleep(0.1)
+            if node_id is None:
+                raise RuntimeError(
+                    f"actor {n.actor_handle.actor_id!r} is not placed yet "
+                    "(still PENDING_CREATION after 30s); compile after the "
+                    "actor is running")
+            actor_node[akey] = _as_bytes(node_id)
+        # raylet address per node (for mirror creation + forwarder setup)
+        cluster = core._run_sync(core.gcs.call("get_cluster", {}))
+        node_addr = {_as_bytes(v["node_id"]): tuple(v["address"])
+                     for v in cluster}
+
+        def loc(consumer_key) -> bytes:
+            return my_node if consumer_key == "driver" else actor_node[consumer_key]
 
         store = core.store
-        # one channel per node that has at least one *cross-process* consumer
+        if store is None:
+            raise RuntimeError(
+                "compiled DAGs need a local shm arena (not available in "
+                "remote-client mode)")
         self.channels: dict[int, ShmChannel] = {}
+        self._remote_cells: list[tuple[tuple, bytes]] = []  # (addr, chan_id)
         node_actor = {id(n): n.actor_handle.actor_id.binary() for n in body}
 
         def needs_channel(n) -> set:
-            """Remote consumer set for node n (producers never read their own
-            channel: same-actor edges are passed in-process)."""
+            """Cross-process consumer set for node n (producers never read
+            their own channel: same-actor edges are passed in-process)."""
             owner = node_actor.get(id(n), "driver")
             return {c for c in consumers[id(n)] if c != owner}
 
+        _raylet_call = self._raylet_call
+
         for n in [self.input_node] + body:
-            remote = needs_channel(n)
-            if remote:
-                cid = ObjectID.from_random()
-                self.channels[id(n)] = ShmChannel(
-                    store, cid, size=self.buffer_size,
-                    num_readers=len(remote), create=True,
-                )
+            readers = needs_channel(n)
+            if not readers:
+                continue
+            prod_node = loc(node_actor.get(id(n), "driver"))
+            by_node: dict[bytes, int] = {}
+            for c in readers:
+                by_node[loc(c)] = by_node.get(loc(c), 0) + 1
+            remote_nodes = [nid for nid in by_node if nid != prod_node]
+            local_readers = by_node.get(prod_node, 0)
+            cid = ObjectID.from_random()
+            origin_readers = local_readers + (1 if remote_nodes else 0)
+            # origin cell on the producer's node
+            if prod_node == my_node:
+                ch = ShmChannel(store, cid, size=self.buffer_size,
+                                num_readers=origin_readers, create=True)
+            else:
+                core._run_sync(_raylet_call(
+                    node_addr[prod_node], "channel_create",
+                    {"chan_id": cid.binary(), "size": self.buffer_size,
+                     "num_readers": origin_readers}))
+                self._remote_cells.append((node_addr[prod_node], cid.binary()))
+                ch = ShmChannel(store, cid, size=self.buffer_size,
+                                num_readers=by_node.get(my_node, 0) or 1,
+                                create=False)
+            # mirror cells on every remote reader node + the forwarder
+            if remote_nodes:
+                for nid in remote_nodes:
+                    if nid == my_node:
+                        ShmChannel(store, cid, size=self.buffer_size,
+                                   num_readers=by_node[nid], create=True)
+                    else:
+                        core._run_sync(_raylet_call(
+                            node_addr[nid], "channel_create",
+                            {"chan_id": cid.binary(), "size": self.buffer_size,
+                             "num_readers": by_node[nid]}))
+                        self._remote_cells.append((node_addr[nid], cid.binary()))
+                core._run_sync(_raylet_call(
+                    node_addr[prod_node], "channel_register_remote",
+                    {"chan_id": cid.binary(),
+                     "readers": [list(node_addr[nid]) for nid in remote_nodes]}))
+            self.channels[id(n)] = ch
 
         # build per-actor schedules in topo order
         node_index = {id(n): i for i, n in enumerate(nodes)}
@@ -159,12 +232,16 @@ class CompiledDAG:
                 else:
                     args_spec.append(("static", a))
             out = self.channels.get(id(n))
-            schedules.setdefault(akey, []).append({
+            task = {
                 "node_index": node_index[id(n)],
                 "method": n.method_name,
                 "args": args_spec,
                 "out_chan": out.chan_id.binary() if out else None,
-            })
+            }
+            if isinstance(n, CollectiveNode):
+                task["collective"] = n.op
+                task["group"] = n.group_name
+            schedules.setdefault(akey, []).append(task)
         for sched in schedules.values():
             sched.sort(key=lambda t: t["node_index"])
 
@@ -180,6 +257,21 @@ class CompiledDAG:
         # give loops a beat to attach to channels before first execute
         time.sleep(0.05)
         self._compiled = True
+
+    @staticmethod
+    async def _raylet_call(addr, method, payload):
+        """One RPC to a raylet, reusing the persistent connection when it's
+        the driver's own."""
+        core = api.get_core()
+        if tuple(addr) == tuple(core.raylet_address):
+            return await core.raylet.call(method, payload)
+        from ray_tpu.utils import rpc as _rpc
+
+        c = await _rpc.connect(*addr, timeout=10)
+        try:
+            return await c.call(method, payload, timeout=30)
+        finally:
+            await c.close()
 
     # ------------------------------------------------------------- execute
     def execute(self, value: Any) -> CompiledDAGRef:
@@ -213,8 +305,24 @@ class CompiledDAG:
                 ch.close()
             except Exception:
                 pass
-        # loops observe the close and reply; drain their results
         core = api.get_core()
+        # close origin/mirror cells living on other nodes, concurrently
+        # (forwarders see the close and propagate it)
+        cells = getattr(self, "_remote_cells", [])
+        if cells:
+            async def _close_all():
+                import asyncio as _a
+
+                await _a.gather(*[
+                    self._raylet_call(addr, "channel_close", {"chan_id": cid})
+                    for addr, cid in cells
+                ], return_exceptions=True)
+
+            try:
+                core._run_sync(_close_all())
+            except Exception:
+                pass
+        # loops observe the close and reply; drain their results
         for fut in self._loop_futures:
             try:
                 core.wait_dag_loop(fut, timeout=5.0)

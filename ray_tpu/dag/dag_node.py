@@ -82,5 +82,32 @@ class MultiOutputNode(DAGNode):
         self.outputs = list(outputs)
 
 
+class CollectiveNode(ClassMethodNode):
+    """A collective op over one actor's iteration value (ref:
+    dag/collective_node.py CollectiveOutputNode +
+    experimental/collective/operations.py): every actor in ``group_name``
+    binds its own CollectiveNode; at runtime each DAG loop calls the
+    collective backend with its local value, and the backend's rendezvous
+    synchronizes the group (XLA/ICI on TPU, the CPU fake in tests)."""
+
+    def __init__(self, actor_handle, op: str, arg, group_name: str):
+        super().__init__(actor_handle, f"__collective_{op}__", (arg,))
+        self.op = op
+        self.group_name = group_name
+
+
+def allreduce_bind(inputs: list, group_name: str = "default") -> list:
+    """Bind an allreduce over a set of per-actor DAG nodes (one per group
+    member). Returns one CollectiveNode per input, each bound to that
+    input's actor (ref: ray.experimental.collective.allreduce.bind)."""
+    out = []
+    for node in inputs:
+        if not isinstance(node, ClassMethodNode):
+            raise ValueError("allreduce_bind takes actor method nodes")
+        out.append(CollectiveNode(node.actor_handle, "allreduce", node,
+                                  group_name))
+    return out
+
+
 def bind(actor_handle, method_name: str, *args: Any) -> ClassMethodNode:
     return ClassMethodNode(actor_handle, method_name, args)

@@ -42,8 +42,17 @@ def run_dag_loop(worker, schedule: dict) -> dict:
                         args.append(local_vals[v])
                     else:  # static
                         args.append(v)
-                method = getattr(worker.actor_instance, t["method"])
-                out = method(*args)
+                if t.get("collective"):
+                    # collective op node: the group's rendezvous synchronizes
+                    # the members (ref: dag/collective_node.py + aDAG
+                    # allreduce); XLA/ICI group on TPU, CPU fake in tests
+                    from ray_tpu.collective import collective as col
+
+                    fn = getattr(col, t["collective"])
+                    out = fn(args[0], group_name=t["group"])
+                else:
+                    method = getattr(worker.actor_instance, t["method"])
+                    out = method(*args)
                 local_vals[t["node_index"]] = out
                 if t["out_chan"] is not None:
                     chan(t["out_chan"]).write(out)

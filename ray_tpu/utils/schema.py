@@ -95,6 +95,15 @@ CATALOG: dict[str, dict[str, dict]] = {
         "get_log": {"since": (1, 1), "fields": {
             "worker_id": "hex (prefix ok)", "stream": "out|err",
             "tail": "int bytes", "->": "str | None"}},
+        # cross-node DAG channels (the RegisterMutableObjectReader role,
+        # ref: core_worker.proto:577)
+        "channel_create": {"since": (1, 2), "fields": {
+            "chan_id": "bytes", "size": "int", "num_readers": "int"}},
+        "channel_push": {"since": (1, 2), "fields": {
+            "chan_id": "bytes", "payload": "packed bytes (one version)"}},
+        "channel_register_remote": {"since": (1, 2), "fields": {
+            "chan_id": "bytes", "readers": "[(host, port)] mirror raylets"}},
+        "channel_close": {"since": (1, 2), "fields": {"chan_id": "bytes"}},
     },
     # ------------------------------------------------- owner (CoreClient)
     # (ref: core_worker.proto owner-side RPCs)
