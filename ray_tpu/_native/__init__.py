@@ -129,6 +129,9 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_store_capacity.argtypes = [ctypes.c_void_p]
             lib.rt_store_bytes_in_use.restype = u64
             lib.rt_store_bytes_in_use.argtypes = [ctypes.c_void_p]
+            lib.rt_store_list_spillable.restype = ctypes.c_int
+            lib.rt_store_list_spillable.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, p64, ctypes.c_int]
             lib.rt_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64, p64]
             lib.rt_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, p64, p64]

@@ -451,6 +451,46 @@ uint64_t rt_store_bytes_in_use(void* hv) {
   return static_cast<Handle*>(hv)->hdr->bytes_in_use;
 }
 
+// Enumerate spill candidates: sealed, unreferenced objects, LRU-first.
+// Writes up to `max` ids (kIdSize bytes each) + sizes; returns the count.
+// The raylet uses this to pick what to move to disk under arena pressure
+// (the LocalObjectManager role, ref: local_object_manager.h:42).
+int rt_store_list_spillable(void* hv, uint8_t* ids_out, uint64_t* sizes_out,
+                            int max) {
+  auto* h = static_cast<Handle*>(hv);
+  StoreHeader* s = h->hdr;
+  lock(&s->mu);
+  Entry* t = table(h);
+  uint64_t slots = s->table_slots;
+  // collect candidate slot indexes, then insertion-sort by lru_seq (max is
+  // small — the raylet spills in bounded passes)
+  int n = 0;
+  struct Cand { uint64_t lru; uint64_t idx; };
+  Cand* cands = new Cand[max];
+  for (uint64_t i = 0; i < slots; ++i) {
+    Entry* e = &t[i];
+    if (e->state != kSealed || e->refcnt != 0) continue;
+    Cand c{e->lru_seq, i};
+    if (n < max) {
+      int j = n++;
+      while (j > 0 && cands[j - 1].lru > c.lru) { cands[j] = cands[j - 1]; --j; }
+      cands[j] = c;
+    } else if (cands[max - 1].lru > c.lru) {
+      int j = max - 1;
+      while (j > 0 && cands[j - 1].lru > c.lru) { cands[j] = cands[j - 1]; --j; }
+      cands[j] = c;
+    }
+  }
+  for (int k = 0; k < n; ++k) {
+    Entry* e = &t[cands[k].idx];
+    memcpy(ids_out + (uint64_t)k * kIdSize, e->id, kIdSize);
+    sizes_out[k] = e->size;
+  }
+  delete[] cands;
+  pthread_mutex_unlock(&s->mu);
+  return n;
+}
+
 // Create an object; returns kOK and sets *offset_out (arena offset of data).
 int rt_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* offset_out) {
   auto* h = static_cast<Handle*>(hv);

@@ -40,6 +40,13 @@ class Config:
     max_inline_object_size: int = 100 * 1024
     #: chunk size for inter-node object transfer
     object_transfer_chunk_size: int = 4 * 1024 * 1024
+    #: spill sealed objects to disk when the arena passes this fraction
+    #: (ref: local_object_manager.h:42 spill under pressure); <= 0 disables
+    object_spilling_threshold: float = 0.8
+    #: spill down to this fraction once triggered
+    object_spilling_low_water: float = 0.6
+    #: directory for spilled objects ("" = <temp_dir>/<session>/spill)
+    object_spilling_dir: str = ""
 
     # --- scheduler / raylet ---
     #: max workers a single raylet will fork

@@ -448,6 +448,7 @@ class CoreClient:
             self.memory_store[oid] = entry
             entry.ready.set()
         else:
+            self._maybe_request_spill(size)
             buf = self.store.create(oid, size)
             serialization.pack_into(meta, buffers, buf)
             self.store.seal(oid)
@@ -456,6 +457,36 @@ class CoreClient:
             entry.ready.set()
             self._call_on_loop(self._register_location(oid))
         return self._new_owned_ref(oid)
+
+    def spill_pressure(self, size: int) -> bool:
+        """True when creating `size` more bytes would cross the spill
+        threshold (shared by driver puts and worker result stores)."""
+        if self.store is None or self.cfg.object_spilling_threshold <= 0:
+            return False
+        cap = max(1, self.store.capacity)
+        return (self.store.bytes_in_use + size
+                > self.cfg.object_spilling_threshold * cap)
+
+    def _maybe_request_spill(self, size: int):
+        """Pressured put: ask the raylet to spill before creating, so the
+        arena frees by spill (bytes preserved on disk) instead of LRU
+        eviction (bytes destroyed; a later get pays lineage re-execution).
+        Ref: local_object_manager.h:42 spill-under-pressure.
+
+        Best-effort for callers ON the event loop (async actor methods):
+        the RPC is spawned rather than awaited there — the raylet's
+        200ms monitor backstops the window."""
+        if not self.spill_pressure(size):
+            return
+        try:
+            if _in_loop(self.loop):
+                self._bg.spawn(
+                    self.raylet.call("spill_now", {"need": size}), self.loop)
+            else:
+                self._run_sync(
+                    self.raylet.call("spill_now", {"need": size}), timeout=60)
+        except Exception:
+            pass
 
     async def _register_location(self, oid: ObjectID):
         holders = {self.node_id.binary()}

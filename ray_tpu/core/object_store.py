@@ -162,6 +162,19 @@ class SharedObjectStore:
     def bytes_in_use(self) -> int:
         return self._lib.rt_store_bytes_in_use(self._handle)
 
+    def list_spillable(self, max_count: int = 64) -> list[tuple[ObjectID, int]]:
+        """Sealed, unreferenced objects in LRU order (spill candidates for
+        the raylet's spill manager, ref: local_object_manager.h:42)."""
+        ids = ctypes.create_string_buffer(20 * max_count)
+        sizes = (ctypes.c_uint64 * max_count)()
+        n = self._lib.rt_store_list_spillable(
+            self._handle, ids,
+            ctypes.cast(sizes, ctypes.POINTER(ctypes.c_uint64)), max_count)
+        out = []
+        for i in range(n):
+            out.append((ObjectID(ids.raw[i * 20:(i + 1) * 20]), int(sizes[i])))
+        return out
+
     # -- serialized object API ----------------------------------------------
 
     def put(self, object_id: ObjectID, value) -> int:

@@ -28,8 +28,8 @@ from dataclasses import dataclass, field
 
 from ray_tpu.config import get_config
 from ray_tpu.core import policy
-from ray_tpu.core.object_store import SharedObjectStore
-from ray_tpu.utils import aio, rpc
+from ray_tpu.core.object_store import ObjectStoreError, SharedObjectStore
+from ray_tpu.utils import aio, metrics, rpc
 from ray_tpu.utils.ids import NodeID, ObjectID, WorkerID
 
 
@@ -215,6 +215,16 @@ class Raylet:
         self._pending_lease_q: asyncio.Queue = asyncio.Queue()
         self._lease_waiters: list[tuple[dict, asyncio.Future, tuple | None]] = []
         self.cluster_view: list[dict] = []
+        # object spilling (ref: local_object_manager.h:42): sealed objects
+        # move to disk under arena pressure and restore on demand
+        self._spilled: dict[ObjectID, str] = {}  # oid -> file path
+        self._spill_lock = asyncio.Lock()
+        self._spilling_now: set[ObjectID] = set()
+        self._freed_while_spilling: set[ObjectID] = set()
+        self._spill_failed_at: dict[ObjectID, float] = {}
+        base = self.cfg.object_spilling_dir or os.path.join(
+            self.cfg.temp_dir, f"session_{self.session}", "spill")
+        self.spill_dir = os.path.join(base, self.node_id.hex()[:12])
         # object transfer: coalesce duplicate pulls + bound inbound streams
         # (ref: pull_manager.h:49 admission control)
         self._active_pulls: dict[ObjectID, asyncio.Future] = {}
@@ -258,6 +268,8 @@ class Raylet:
         await self.gcs.call("subscribe", {"channel": "nodes"})
         self._bg.spawn(self._heartbeat_loop())
         self._bg.spawn(self._reaper_loop())
+        if self.cfg.object_spilling_threshold > 0:
+            self._bg.spawn(self._spill_monitor_loop())
         return addr
 
     async def _reconnect_gcs(self):
@@ -757,6 +769,7 @@ class Raylet:
         reader refs only gets LRU-demoted by the native delete, so retry
         until the readers drop and the bytes actually free."""
         oid = ObjectID(p["object_id"])
+        self._drop_spill_file(oid)  # freed objects don't keep disk copies
 
         async def drain():
             deadline = time.monotonic() + 15.0
@@ -774,6 +787,128 @@ class Raylet:
         else:
             self._bg.spawn(drain())
         return True
+
+    # ----------------------------------------------------- object spilling
+    # (ref: local_object_manager.h:42 SpillObjects/RestoreSpilledObject:
+    # sealed objects move to disk under arena pressure; pulls and peer
+    # fetches restore them on demand. The node stays listed as a holder in
+    # the GCS directory — it can always materialize the bytes.)
+
+    async def _spill_monitor_loop(self):
+        while not self._stopping:
+            try:
+                usage = self.store.bytes_in_use / max(1, self.store.capacity)
+                if usage >= self.cfg.object_spilling_threshold:
+                    await self._spill_until_low_water()
+            except Exception:
+                if self._stopping:  # executor torn down mid-pass
+                    return
+                traceback.print_exc()
+            await asyncio.sleep(0.2)
+
+    async def rpc_spill_now(self, conn, p):
+        """Synchronous spill pass — pressured putters call this before a
+        large create so the arena frees by SPILL (bytes preserved on disk)
+        rather than by LRU eviction (bytes destroyed, lineage recompute)."""
+        need = int(p.get("need", 0))
+        await self._spill_until_low_water(extra_need=need)
+        return True
+
+    async def _spill_until_low_water(self, extra_need: int = 0):
+        async with self._spill_lock:
+            cap = max(1, self.store.capacity)
+            target = int(self.cfg.object_spilling_low_water * cap) - extra_need
+            loop = asyncio.get_running_loop()
+            now = time.monotonic()
+            while self.store.bytes_in_use > target:
+                cands = [
+                    (oid, sz)
+                    for oid, sz in self.store.list_spillable(64)
+                    # skip candidates whose spill recently failed (full
+                    # disk etc.) so the monitor doesn't hot-loop on them
+                    if self._spill_failed_at.get(oid, -1e9) < now - 30.0
+                ]
+                if not cands:
+                    return
+                for oid, _sz in cands:
+                    if self.store.bytes_in_use <= target:
+                        return
+                    await loop.run_in_executor(None, self._spill_one, oid)
+
+    def _spill_one(self, oid: ObjectID):
+        """Move one sealed object's bytes out of the arena. Runs off-loop
+        (disk IO). A previously-spilled object whose file is still valid
+        (restore keeps it) skips the write — dropping the arena copy is
+        enough. Safe vs concurrent gets: the buffer ref pins the bytes
+        while copying; after delete, readers miss and take the pull path
+        which restores from disk."""
+        self._spilling_now.add(oid)
+        try:
+            path = self._spilled.get(oid)
+            if path is None or not os.path.exists(path):
+                try:
+                    buf = self.store.get_buffer(oid, timeout_ms=0)
+                except ObjectStoreError:
+                    return  # raced an eviction/delete: nothing to spill
+                path = os.path.join(self.spill_dir, oid.hex())
+                tmp = path + ".tmp"
+                try:
+                    os.makedirs(self.spill_dir, exist_ok=True)
+                    with open(tmp, "wb") as f:
+                        f.write(buf)
+                    os.replace(tmp, path)
+                except OSError:
+                    # disk full / unwritable: remember and move on
+                    self._spill_failed_at[oid] = time.monotonic()
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    return
+                finally:
+                    self.store.release(oid)
+                self._spilled[oid] = path
+                metrics.objects_spilled.inc()
+            self.store.delete(oid)
+        finally:
+            self._spilling_now.discard(oid)
+            if oid in self._freed_while_spilling:
+                self._freed_while_spilling.discard(oid)
+                self._drop_spill_file(oid)
+
+    def _restore_spilled(self, oid: ObjectID) -> bool:
+        """Disk -> arena (blocking; call off-loop). Leaves the file in
+        place until the object is freed, so repeated pressure cycles
+        re-spill without rewriting unchanged bytes."""
+        path = self._spilled.get(oid)
+        if path is None:
+            return False
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            self._spilled.pop(oid, None)
+            return False
+        try:
+            self.store.put_raw(oid, payload)
+        except ObjectStoreError:
+            return self.store.contains(oid)  # raced another restore
+        metrics.objects_restored.inc()
+        return True
+
+    def _drop_spill_file(self, oid: ObjectID):
+        if oid in self._spilling_now:
+            # a spill is writing this object's file right now; the spill's
+            # finally will see the marker and drop the file it just made
+            self._freed_while_spilling.add(oid)
+            return
+        self._spill_failed_at.pop(oid, None)
+        path = self._spilled.pop(oid, None)
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     # -------------------------------------------- cross-node DAG channels
     # (the RegisterMutableObjectReader role, ref: core_worker.proto:577 +
@@ -904,6 +1039,9 @@ class Raylet:
         oid = ObjectID(p["object_id"])
         if self.store.contains(oid):
             return True
+        if oid in self._spilled:  # restore beats a network pull
+            if await self._ensure_local_bytes(oid):
+                return True
         fut = self._active_pulls.get(oid)
         if fut is not None:
             return await asyncio.shield(fut)
@@ -1005,11 +1143,43 @@ class Raylet:
                     pass
             await c.close()
 
+    async def _ensure_local_bytes(self, oid: ObjectID) -> bool:
+        """Restore a spilled object into the arena if needed (peer fetches
+        and local pulls both land here before touching the store).
+
+        Spills FIRST when the restore wouldn't fit below the pressure
+        threshold: a restore-triggered eviction could otherwise destroy a
+        resident object that has no disk copy yet."""
+        if self.store.contains(oid):
+            return True
+        path = self._spilled.get(oid)
+        if path is None:
+            return False
+        try:
+            need = os.path.getsize(path)
+        except OSError:
+            need = 0
+        cap = max(1, self.store.capacity)
+        loop = asyncio.get_running_loop()
+        # retry across transient full-arena conditions: the bytes exist on
+        # disk, so "arena fully pinned by reader views right now" must wait
+        # for releases, not surface as object-lost
+        deadline = time.monotonic() + 30.0
+        while True:
+            if self.store.bytes_in_use + need > self.cfg.object_spilling_threshold * cap:
+                await self._spill_until_low_water(extra_need=need)
+            if await loop.run_in_executor(None, self._restore_spilled, oid):
+                return True
+            if oid not in self._spilled or time.monotonic() > deadline:
+                return False
+            await asyncio.sleep(0.2)
+
     async def rpc_fetch_object_meta(self, conn, p):
         """Start of a transfer: pin the object (one store ref held for the
         whole transfer so eviction/owner-delete can't yank it mid-stream);
         the peer releases via fetch_object_done or by disconnecting."""
         oid = ObjectID(p["object_id"])
+        await self._ensure_local_bytes(oid)
         try:
             buf = self.store.get_buffer(oid, timeout_ms=0)
         except Exception:
@@ -1036,6 +1206,7 @@ class Raylet:
 
     async def rpc_fetch_object_chunk(self, conn, p):
         oid = ObjectID(p["object_id"])
+        await self._ensure_local_bytes(oid)
         try:
             buf = self.store.get_buffer(oid, timeout_ms=0)
         except Exception:
@@ -1050,6 +1221,7 @@ class Raylet:
     async def rpc_fetch_object(self, conn, p):
         """Single-frame fetch for objects at or below one chunk."""
         oid = ObjectID(p["object_id"])
+        await self._ensure_local_bytes(oid)
         try:
             buf = self.store.get_buffer(oid, timeout_ms=0)
         except Exception:

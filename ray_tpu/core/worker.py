@@ -694,7 +694,27 @@ class Worker:
         holder in the GCS object directory (shared by task returns and
         streamed items)."""
         size = serialization.total_size(meta, buffers)
-        buf = self.core.store.create(oid, size)
+        if self.core.spill_pressure(size):
+            try:  # free arena by spill, not eviction (local_object_manager.h)
+                await self.core.raylet.call("spill_now", {"need": size})
+            except Exception:
+                pass
+        from ray_tpu.core.object_store import ObjectStoreFullError
+
+        for attempt in range(5):
+            try:
+                buf = self.core.store.create(oid, size)
+                break
+            except ObjectStoreFullError:
+                # arena full of pinned data: give spills / reader releases
+                # a beat instead of failing the task on transient pressure
+                if attempt == 4:
+                    raise
+                try:
+                    await self.core.raylet.call("spill_now", {"need": size})
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2 * (attempt + 1))
         serialization.pack_into(meta, buffers, buf)
         self.core.store.seal(oid)
         import pickle

@@ -118,4 +118,6 @@ tasks_finished = Counter("rt_tasks_finished", "task replies applied, by outcome"
 actor_calls = Counter("rt_actor_calls", "actor method calls submitted")
 objects_put = Counter("rt_objects_put", "objects created via put")
 object_bytes_put = Counter("rt_object_bytes_put", "bytes written via put")
+objects_spilled = Counter("rt_objects_spilled", "objects spilled to disk")
+objects_restored = Counter("rt_objects_restored", "spilled objects restored")
 task_exec_seconds = Histogram("rt_task_exec_seconds", "worker-side task execution time")

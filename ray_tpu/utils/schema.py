@@ -95,6 +95,9 @@ CATALOG: dict[str, dict[str, dict]] = {
         "get_log": {"since": (1, 1), "fields": {
             "worker_id": "hex (prefix ok)", "stream": "out|err",
             "tail": "int bytes", "->": "str | None"}},
+        "spill_now": {"since": (1, 2), "fields": {
+            "need": "int bytes of headroom wanted — spill pass runs to "
+                    "low-water (ref: local_object_manager.h:42)"}},
         # cross-node DAG channels (the RegisterMutableObjectReader role,
         # ref: core_worker.proto:577)
         "channel_create": {"since": (1, 2), "fields": {

@@ -1,0 +1,110 @@
+"""Object spilling/restore tests (ref: local_object_manager.h:42 —
+spill sealed objects to disk under arena pressure, restore on demand).
+
+Put objects cannot be reconstructed from lineage, so getting every value
+back after overflowing the arena proves spill+restore did the work."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+MB = 1024 * 1024
+
+
+@pytest.fixture()
+def small_arena():
+    # a deliberately tiny arena: 64MB total; min overhead leaves ~60MB data.
+    # init() writes object_store_memory into the process-global config —
+    # restore it afterwards so later test modules get the default arena.
+    from ray_tpu.config import get_config, set_config
+
+    old = get_config().object_store_memory
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * MB)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    cfg = get_config()
+    cfg.object_store_memory = old
+    set_config(cfg)
+
+
+def test_put_twice_arena_capacity_all_restored(small_arena):
+    """VERDICT r2 done-criterion: put 2x arena capacity, get everything
+    back — without lineage re-execution (puts have none)."""
+    n_objects = 32  # 32 x 4MB = 128MB through a 64MB arena
+    refs = []
+    for i in range(n_objects):
+        refs.append(ray_tpu.put(np.full(MB // 2, i, dtype=np.int64)))  # 4MB
+    # every value must come back intact, including the earliest (spilled)
+    for i, r in enumerate(refs):
+        v = ray_tpu.get(r, timeout=120)
+        assert v.nbytes == 4 * MB
+        assert int(v[0]) == i and int(v[-1]) == i
+    # and again in reverse order (restores may re-spill under pressure)
+    for i in reversed(range(n_objects)):
+        v = ray_tpu.get(refs[i], timeout=120)
+        assert int(v[0]) == i
+
+
+def test_task_results_survive_pressure(small_arena):
+    """Task returns spill too; gets must restore rather than re-execute.
+    The task writes a side-effect marker so re-execution is detectable."""
+    import os
+    import tempfile
+
+    tag = os.path.join(tempfile.mkdtemp(), "exec_count")
+
+    @ray_tpu.remote
+    def produce(i, tag):
+        import os
+
+        with open(f"{tag}.{i}", "a") as f:
+            f.write("x")
+        import numpy as np
+
+        return np.full(MB // 2, i, dtype=np.int64)  # 4MB
+
+    refs = [produce.remote(i, tag) for i in range(24)]  # 96MB > arena
+    # consume one value at a time: ray-style zero-copy gets PIN the arena
+    # bytes, so a driver cannot hold 2x-arena of live views at once (same
+    # constraint as the reference's plasma) — but sequential consumption
+    # must see every value, restored from disk as needed
+    for i in range(24):
+        v = ray_tpu.get(refs[i], timeout=180)
+        assert int(v[0]) == i
+        del v
+    # read them all again — restores, not re-executions
+    for i in range(24):
+        v = ray_tpu.get(refs[i], timeout=120)
+        assert int(v[-1]) == i
+        del v
+    import os as _os
+
+    for i in range(24):
+        with open(f"{tag}.{i}") as f:
+            assert f.read() == "x", f"task {i} re-executed instead of restored"
+
+
+def test_spill_files_cleaned_on_free(small_arena):
+    """Freeing an object drops its spill file (no disk leak)."""
+    import glob
+    import os
+
+    from ray_tpu.core import api
+
+    core = api.get_core()
+    raylet = api._owned_cluster.raylets[0]
+    refs = [ray_tpu.put(np.full(MB // 2, i, dtype=np.int64)) for i in range(20)]
+    # force pressure so some spill
+    spilled_dir = raylet.spill_dir
+    del refs  # drop all -> owner frees -> spill files must go away
+
+    import time
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        files = glob.glob(os.path.join(spilled_dir, "*")) if os.path.isdir(spilled_dir) else []
+        if not files:
+            break
+        time.sleep(0.5)
+    assert not files, f"leaked spill files: {files[:3]}"
