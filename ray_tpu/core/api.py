@@ -62,6 +62,10 @@ def init(
     global _core, _io, _owned_cluster
     if _core is not None:
         return
+    if address is None:
+        # drivers launched by `job submit` auto-join their cluster
+        # (ref: RAY_ADDRESS honored by ray.init)
+        address = os.environ.get("RT_ADDRESS") or None
     cfg = get_config()
     if object_store_memory:
         cfg.object_store_memory = object_store_memory

@@ -75,14 +75,89 @@ def build_app():
 
         return handler
 
-    app = web.Application()
+    # job submissions ship runtime_env packages inline (base64) — the
+    # default 1MB body cap would reject any real working_dir
+    app = web.Application(client_max_size=256 * 1024 * 1024)
     app.router.add_get("/", index)
     app.router.add_get("/api/cluster", _json(lambda: _plain(state.list_nodes())))
     app.router.add_get("/api/tasks", _json(lambda: _plain(state.list_tasks())))
     app.router.add_get("/api/actors", _json(lambda: _plain(state.list_actors())))
     app.router.add_get("/api/metrics", _json(lambda: _plain(state.cluster_metrics())))
     app.router.add_get("/api/timeline", _json(lambda: state.timeline()))
+    _add_job_routes(app)
     return app
+
+
+def _add_job_routes(app):
+    """Job REST API (ref: dashboard/modules/job REST head + sdk.py):
+
+        POST /api/jobs                  {entrypoint, runtime_env, packages}
+        GET  /api/jobs                  list
+        GET  /api/jobs/{id}             status record
+        GET  /api/jobs/{id}/logs        captured driver output
+        POST /api/jobs/{id}/stop
+    """
+    import asyncio
+    import base64
+
+    from aiohttp import web
+
+    from ray_tpu import job as jobmod
+
+    async def submit(request):
+        body = await request.json()
+        try:
+            def do():
+                from ray_tpu.core import api
+
+                core = api.get_core()
+                for digest, blob_b64 in (body.get("packages") or {}).items():
+                    core._run_sync(core.gcs.call("kv_put", {
+                        "ns": "runtime_env_packages", "key": digest,
+                        "value": base64.b64decode(blob_b64)}))
+                env = body.get("runtime_env")
+                if env:
+                    env = {**env, "_packaged": True}
+                return jobmod.submit_job(
+                    body["entrypoint"], runtime_env=env,
+                    job_id=body.get("submission_id"),
+                    metadata=body.get("metadata"),
+                )
+
+            job_id = await asyncio.to_thread(do)
+            return web.json_response({"job_id": job_id})
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=400)
+
+    async def listing(request):
+        return web.json_response(await asyncio.to_thread(jobmod.list_jobs))
+
+    async def status(request):
+        try:
+            rec = await asyncio.to_thread(
+                jobmod.job_status, request.match_info["job_id"])
+            return web.json_response(rec)
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+
+    async def logs(request):
+        try:
+            text = await asyncio.to_thread(
+                jobmod.job_logs, request.match_info["job_id"])
+            return web.json_response({"logs": text})
+        except KeyError as e:
+            return web.json_response({"error": str(e)}, status=404)
+
+    async def stop(request):
+        ok = await asyncio.to_thread(
+            jobmod.stop_job, request.match_info["job_id"])
+        return web.json_response({"stopped": bool(ok)})
+
+    app.router.add_post("/api/jobs", submit)
+    app.router.add_get("/api/jobs", listing)
+    app.router.add_get("/api/jobs/{job_id}", status)
+    app.router.add_get("/api/jobs/{job_id}/logs", logs)
+    app.router.add_post("/api/jobs/{job_id}/stop", stop)
 
 
 def _plain(obj):

@@ -218,6 +218,64 @@ def cmd_dashboard(args):
     run_dashboard(args.host, args.port)
 
 
+def cmd_job(args):
+    """Job submission CLI (ref: `ray job submit/status/logs/list/stop`).
+    With --dashboard-url, goes through the REST API + SDK; otherwise
+    connects directly to the cluster (bare-shell mode)."""
+    from ray_tpu import job as jobmod
+
+    if getattr(args, "entrypoint", None) is not None:
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+        if not args.entrypoint:
+            sys.exit("job submit needs an entrypoint, e.g. -- python script.py")
+    if getattr(args, "dashboard_url", None):
+        client = jobmod.JobSubmissionClient(args.dashboard_url)
+        if args.job_cmd == "submit":
+            env = {"working_dir": args.working_dir} if args.working_dir else None
+            import shlex
+
+            jid = client.submit_job(entrypoint=shlex.join(args.entrypoint),
+                                    runtime_env=env)
+            print(jid)
+            if args.wait:
+                while client.get_job_status(jid) not in (
+                        "SUCCEEDED", "FAILED", "STOPPED"):
+                    time.sleep(0.5)
+                info = client.get_job_info(jid)
+                print(f"{info['status']}: {info.get('message', '')}")
+                sys.exit(0 if info["status"] == "SUCCEEDED" else 1)
+        elif args.job_cmd == "status":
+            print(json.dumps(client.get_job_info(args.job_id), indent=2))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.job_id), end="")
+        elif args.job_cmd == "list":
+            print(json.dumps(client.list_jobs(), indent=2))
+        elif args.job_cmd == "stop":
+            print("stopped" if client.stop_job(args.job_id) else "not running")
+        return
+
+    _connect(_resolve_address(args))
+    if args.job_cmd == "submit":
+        env = {"working_dir": args.working_dir} if args.working_dir else None
+        import shlex
+
+        jid = jobmod.submit_job(shlex.join(args.entrypoint), runtime_env=env)
+        print(jid)
+        if args.wait:
+            rec = jobmod.wait_job(jid, timeout=3600)
+            print(f"{rec['status']}: {rec.get('message', '')}")
+            sys.exit(0 if rec["status"] == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        print(json.dumps(jobmod.job_status(args.job_id), indent=2))
+    elif args.job_cmd == "logs":
+        print(jobmod.job_logs(args.job_id), end="")
+    elif args.job_cmd == "list":
+        print(json.dumps(jobmod.list_jobs(), indent=2))
+    elif args.job_cmd == "stop":
+        print("stopped" if jobmod.stop_job(args.job_id) else "not running")
+
+
 def cmd_autoscaler_monitor(args):
     """Internal: run the autoscaler reconciler (launched by start --head)."""
     from ray_tpu.autoscaler import Autoscaler, AutoscalerConfig, LocalSubprocessProvider
@@ -286,6 +344,28 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=8265)
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("job", help="submit and manage cluster jobs")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--address", default=None)
+    js.add_argument("--dashboard-url", default=None)
+    js.add_argument("--working-dir", default=None)
+    js.add_argument("--wait", action="store_true",
+                    help="block until the job finishes; exit 0 on success")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run, e.g. -- python script.py")
+    js.set_defaults(fn=cmd_job)
+    for verb in ("status", "logs", "stop"):
+        jp = jsub.add_parser(verb)
+        jp.add_argument("job_id")
+        jp.add_argument("--address", default=None)
+        jp.add_argument("--dashboard-url", default=None)
+        jp.set_defaults(fn=cmd_job)
+    jp = jsub.add_parser("list")
+    jp.add_argument("--address", default=None)
+    jp.add_argument("--dashboard-url", default=None)
+    jp.set_defaults(fn=cmd_job)
 
     p = sub.add_parser("_autoscaler_monitor")
     p.add_argument("--address", required=True)

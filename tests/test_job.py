@@ -1,0 +1,101 @@
+"""Job submission tests (ref test strategy: dashboard/modules/job tests —
+submit an entrypoint, watch status, fetch logs; REST + SDK + direct)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import job as jobmod
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_job_lifecycle_direct(rt):
+    jid = jobmod.submit_job(
+        "python -c \"import os; print('hello from job', os.environ['RT_JOB_ID'])\""
+    )
+    rec = jobmod.wait_job(jid, timeout=120)
+    assert rec["status"] == "SUCCEEDED", rec
+    logs = jobmod.job_logs(jid)
+    assert "hello from job" in logs and jid in logs
+    listed = jobmod.list_jobs()
+    assert any(r["job_id"] == jid for r in listed)
+
+
+def test_job_failure_reported(rt):
+    jid = jobmod.submit_job("python -c 'raise SystemExit(3)'")
+    rec = jobmod.wait_job(jid, timeout=120)
+    assert rec["status"] == "FAILED"
+    assert "3" in rec["message"]
+
+
+def test_job_connects_to_cluster(rt):
+    """The entrypoint's ray_tpu.init() must join THIS cluster (RT_ADDRESS),
+    proven by reading back a KV marker the driver sets via a task."""
+    code = (
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # auto-joins via RT_ADDRESS
+        "@ray_tpu.remote\n"
+        "def f(): return sum(range(10))\n"
+        "print('RESULT', ray_tpu.get(f.remote()))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    with open(os.path.join(d, "entry.py"), "w") as f:
+        f.write(code)
+    jid = jobmod.submit_job("python entry.py", runtime_env={"working_dir": d})
+    rec = jobmod.wait_job(jid, timeout=180)
+    assert rec["status"] == "SUCCEEDED", (rec, jobmod.job_logs(jid))
+    assert "RESULT 45" in jobmod.job_logs(jid)
+
+
+def test_job_stop(rt):
+    jid = jobmod.submit_job("python -c 'import time; time.sleep(600)'")
+    # wait for RUNNING
+    deadline = time.monotonic() + 60
+    while jobmod.job_status(jid)["status"] == "PENDING":
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    assert jobmod.stop_job(jid)
+    rec = jobmod.wait_job(jid, timeout=60)
+    assert rec["status"] == "STOPPED"
+
+
+def test_job_rest_api_and_sdk(rt):
+    """SDK -> REST -> manager round trip, working_dir shipped as blobs."""
+    import asyncio
+
+    from ray_tpu.dashboard import start_dashboard_async
+    from ray_tpu.core import api
+
+    core = api.get_core()
+    runner, (host, port) = core._run_sync(start_dashboard_async("127.0.0.1", 0))
+    try:
+        client = jobmod.JobSubmissionClient(f"http://{host}:{port}")
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        with open(os.path.join(d, "go.py"), "w") as f:
+            f.write("print('rest job ran', 7 * 6)\n")
+        jid = client.submit_job(entrypoint="python go.py",
+                                runtime_env={"working_dir": d})
+        deadline = time.monotonic() + 120
+        while client.get_job_status(jid) not in ("SUCCEEDED", "FAILED", "STOPPED"):
+            assert time.monotonic() < deadline
+            time.sleep(0.3)
+        info = client.get_job_info(jid)
+        assert info["status"] == "SUCCEEDED", info
+        assert "rest job ran 42" in client.get_job_logs(jid)
+        assert any(r["job_id"] == jid for r in client.list_jobs())
+    finally:
+        core._run_sync(runner.cleanup())
