@@ -329,7 +329,66 @@ def run_model(quick: bool) -> dict:
     return out
 
 
-def write_benchvs(micro: dict, model: dict | None) -> None:
+def run_llm_engine(quick: bool) -> dict:
+    """Continuous-batching engine decode throughput (the owned vLLM-role
+    engine): N concurrent requests share the paged-KV decode batch."""
+    import asyncio
+
+    import jax
+
+    from ray_tpu.llm.engine import ContinuousBatchingEngine
+    from ray_tpu.models.llama import LlamaConfig, llama_init
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu and not quick:
+        cfg = LlamaConfig(vocab_size=32_000, d_model=1024, n_layers=8,
+                          n_heads=8, n_kv_heads=8, d_ff=4096,
+                          max_seq_len=2048, dtype="bfloat16")
+        max_batch, max_tokens, n_req = 16, 64, 48
+        page_size, n_pages, max_seq = 32, 1024, 512
+        prompt_len = 64
+    else:
+        cfg = LlamaConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                          n_kv_heads=4, d_ff=256, max_seq_len=512,
+                          dtype="float32")
+        max_batch, max_tokens, n_req = 4, 12, 8
+        page_size, n_pages, max_seq = 16, 128, 128
+        prompt_len = 16
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+
+    async def go():
+        eng = ContinuousBatchingEngine(
+            params, cfg, max_batch=max_batch, page_size=page_size,
+            n_pages=n_pages, max_seq_len=max_seq)
+        await eng.start()
+        await eng.generate(prompts[0], max_tokens=2)  # compile both programs
+        tokens0 = eng.tokens_out
+        t0 = time.perf_counter()
+        await asyncio.gather(
+            *[eng.generate(p, max_tokens=max_tokens) for p in prompts])
+        dt = time.perf_counter() - t0
+        produced = eng.tokens_out - tokens0
+        await eng.stop()
+        return produced, dt
+
+    produced, dt = asyncio.run(go())
+    return {
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+        "concurrent_requests": n_req,
+        "max_batch": max_batch,
+        "decode_tokens_per_s": produced / dt,
+    }
+
+
+def write_benchvs(micro: dict, model: dict | None,
+                  llm: dict | None = None) -> None:
     lines = [
         "# BENCHVS — ours vs reference (BASELINE.md, Ray 2.46.0 release metrics)",
         "",
@@ -365,6 +424,18 @@ def write_benchvs(micro: dict, model: dict | None) -> None:
             "No reference model-throughput numbers are checked in "
             "(BASELINE.md: 'No ML-model numbers'); MFU is vs chip bf16 peak.",
         ]
+    if llm:
+        lines += [
+            "",
+            "## LLM engine: continuous-batching decode "
+            f"({llm['device']}, platform={llm['platform']})",
+            "",
+            f"{llm['concurrent_requests']} concurrent requests over a "
+            f"max_batch={llm['max_batch']} paged-KV decode loop: "
+            f"**{llm['decode_tokens_per_s']:,.0f} tokens/s**. "
+            "(The reference delegates this engine to vLLM; no comparable "
+            "number is checked into its repo.)",
+        ]
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCHVS.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
 
@@ -390,12 +461,19 @@ def main():
                 print(f"model bench failed (attempt {attempt + 1}): {e!r}",
                       file=sys.stderr)
 
-    raw = {"micro": micro, "model": model}
+    llm = None
+    if do_model:
+        try:
+            llm = run_llm_engine(args.quick)
+        except Exception as e:
+            print(f"llm engine bench failed: {e!r}", file=sys.stderr)
+
+    raw = {"micro": micro, "model": model, "llm_engine": llm}
     root = os.path.dirname(os.path.abspath(__file__))
     with open(os.path.join(root, "bench_results.json"), "w") as f:
         json.dump(raw, f, indent=2)
     if micro:
-        write_benchvs(micro, model)
+        write_benchvs(micro, model, llm)
 
     value = micro.get(HEADLINE)
     if value is not None:

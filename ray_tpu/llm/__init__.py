@@ -1,21 +1,34 @@
 """ray_tpu.llm — LLM batch inference + serving on the ray_tpu runtime.
 
 TPU-native counterpart of ray.llm (ref: python/ray/llm/): the engine is
-not vLLM but a jit-compiled prefill + lax.scan KV-cache decode over the
-native Llama implementation (static shapes, batched MXU matmuls).
+not vLLM but owned — a jit-compiled prefill + decode over the native
+Llama implementation (static shapes, batched MXU matmuls), with a
+continuous-batching paged-KV engine for serving.
 
 - generation: prefill/decode_step/generate with left-padded ragged batches
-- serving: LLMServer deployment (@serve.batch coalescing) +
-  build_llm_deployment
+- engine: ContinuousBatchingEngine — paged KV, decode-step admission,
+  token streaming, LoRA multiplexing
+- serving: LLMServer (@serve.batch coalescing) and LLMEngineServer
+  (continuous batching + streaming) deployments
 - batch: build_llm_processor over ray_tpu.data datasets
 """
 from ray_tpu.llm.batch import build_llm_processor
+from ray_tpu.llm.engine import ContinuousBatchingEngine, EngineFull
 from ray_tpu.llm.generation import generate, generate_tokens, pad_prompts
-from ray_tpu.llm.serving import LLMServer, build_llm_deployment
+from ray_tpu.llm.serving import (
+    LLMEngineServer,
+    LLMServer,
+    build_llm_deployment,
+    build_llm_engine_deployment,
+)
 
 __all__ = [
+    "ContinuousBatchingEngine",
+    "EngineFull",
+    "LLMEngineServer",
     "LLMServer",
     "build_llm_deployment",
+    "build_llm_engine_deployment",
     "build_llm_processor",
     "generate",
     "generate_tokens",

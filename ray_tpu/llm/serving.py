@@ -79,6 +79,97 @@ class LLMServer:
         return await self._batched(request)
 
 
+class LLMEngineServer:
+    """Deployment around the continuous-batching engine (ref: the vLLM
+    engine the reference delegates to, vllm_engine.py:95 — owned here).
+    Requests join the running decode batch at step granularity; responses
+    can stream token-by-token; "model" selects a LoRA adapter
+    (ref: serve/multiplex.py model multiplexing)."""
+
+    def __init__(self, model_config, params=None, params_fn=None, *,
+                 max_batch: int = 8, page_size: int = 16, n_pages: int = 512,
+                 max_seq_len: int = 512, eos_id: int | None = None,
+                 lora_adapters: dict | None = None, lora_rank: int = 8,
+                 default_max_tokens: int = 32):
+        from ray_tpu.utils.device import configure_jax
+
+        configure_jax()
+        if params is None:
+            params = params_fn() if params_fn is not None else None
+        if params is None:
+            import jax
+
+            from ray_tpu.models.llama import llama_init
+
+            params = llama_init(jax.random.PRNGKey(0), model_config)
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+
+        self.engine = ContinuousBatchingEngine(
+            params, model_config, max_batch=max_batch, page_size=page_size,
+            n_pages=n_pages, max_seq_len=max_seq_len, eos_id=eos_id,
+            lora_adapters=lora_adapters, lora_rank=lora_rank)
+        self.default_max_tokens = default_max_tokens
+
+    async def _ensure_started(self):
+        await self.engine.start()
+
+    def _submit(self, request: dict) -> int:
+        return self.engine.submit(
+            list(request["prompt_tokens"]),
+            max_tokens=int(request.get("max_tokens", self.default_max_tokens)),
+            temperature=float(request.get("temperature", 0.0)),
+            adapter=request.get("model"),
+        )
+
+    async def __call__(self, request: dict) -> dict:
+        """Full completion: {prompt_tokens, max_tokens?, temperature?,
+        model?} -> {completion_tokens, usage}."""
+        await self._ensure_started()
+        t0 = time.monotonic()
+        rid = self._submit(request)
+        out = [t async for t in self.engine.stream(rid)]
+        return {
+            "completion_tokens": out,
+            "usage": {
+                "prompt_tokens": len(request["prompt_tokens"]),
+                "completion_tokens": len(out),
+                "latency_s": time.monotonic() - t0,
+            },
+        }
+
+    async def stream(self, request: dict):
+        """Async generator of token ids — served to callers through the
+        handle's .stream() (one ObjectRef per token)."""
+        await self._ensure_started()
+        rid = self._submit(request)
+        async for tok in self.engine.stream(rid):
+            yield tok
+
+    def engine_stats(self) -> dict:
+        return {"steps": self.engine.steps, "tokens_out": self.engine.tokens_out,
+                "waiting": len(self.engine.waiting),
+                "free_pages": len(self.engine.free_pages)}
+
+
+def build_llm_engine_deployment(model_config, *, params=None, params_fn=None,
+                                num_replicas: int = 1, num_tpus: float = 0.0,
+                                name: str = "LLMEngineServer", **engine_kw):
+    """Bound serve application around the owned engine."""
+    from ray_tpu import serve
+
+    opts: dict = {}
+    if num_tpus:
+        opts["num_tpus"] = num_tpus
+    dep = serve.deployment(
+        LLMEngineServer,
+        name=name,
+        num_replicas=num_replicas,
+        max_ongoing_requests=64,
+        ray_actor_options=opts,
+    )
+    return dep.bind(model_config, params, params_fn, **engine_kw)
+
+
 def build_llm_deployment(model_config, *, params=None, params_fn=None,
                          num_replicas: int = 1, max_batch_size: int = 8,
                          num_tpus: float = 0.0, name: str = "LLMServer"):

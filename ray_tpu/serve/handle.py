@@ -218,6 +218,75 @@ class _Router:
         self.track(rid, ref)
         return ref
 
+    def route_streaming(self, method: str, args: tuple, kwargs: dict):
+        """Stream a request from the DRIVER thread: yields one ObjectRef
+        per item. The replica's in-flight count stays raised for the
+        stream's whole life so pow-2 routing sees streaming load."""
+        import ray_tpu
+
+        self._ensure_poll_loop()
+        chosen = self._choose()
+        if chosen is None:
+            core = _core()
+            fut = asyncio.run_coroutine_threadsafe(
+                self._wait_for_replicas(), core.loop)
+            fut.result(35.0)
+            chosen = self._choose()
+            if chosen is None:
+                raise RayServeException("no replicas available")
+        rid = chosen["replica_id"]
+        with self.lock:
+            actor = self.handles.get(rid)
+        if actor is None:
+            actor = ray_tpu.get_actor(chosen["actor_name"])
+            with self.lock:
+                self.handles[rid] = actor
+        gen = actor.handle_request_streaming.options(
+            num_returns="streaming").remote(method, args, kwargs)
+        return self._count_stream(rid, gen)
+
+    def _count_stream(self, rid: str, gen):
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+        try:
+            yield from gen
+        finally:
+            with self.lock:
+                if self.inflight.get(rid, 0) > 0:
+                    self.inflight[rid] -= 1
+
+    async def route_streaming_async(self, method: str, args: tuple,
+                                    kwargs: dict):
+        """Loop-thread variant (composing deployments): async generator of
+        ObjectRefs; never blocks the core loop waiting for membership."""
+        self._ensure_poll_loop()
+        if self._choose() is None:
+            await self._wait_for_replicas()
+        chosen = self._choose()
+        if chosen is None:
+            raise RayServeException("no replicas available")
+        rid = chosen["replica_id"]
+        with self.lock:
+            actor = self.handles.get(rid)
+        if actor is None:
+            actor = await _core().get_actor_by_name_async(chosen["actor_name"])
+            if actor is None:
+                raise RayServeException(
+                    f"replica actor {chosen['actor_name']} gone")
+            with self.lock:
+                self.handles[rid] = actor
+        gen = actor.handle_request_streaming.options(
+            num_returns="streaming").remote(method, args, kwargs)
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+        try:
+            async for ref in gen:
+                yield ref
+        finally:
+            with self.lock:
+                if self.inflight.get(rid, 0) > 0:
+                    self.inflight[rid] -= 1
+
     def track(self, rid: str, ref):
         """Count the request against the replica until its result is ready."""
         core = _core()
@@ -271,6 +340,16 @@ class _MethodCaller:
 
     def remote(self, *args, **kwargs):
         return self._handle._invoke(self._method, args, kwargs)
+
+    def stream(self, *args, **kwargs):
+        """Call an async-generator deployment method; yields one ObjectRef
+        per item (ref: serve streaming DeploymentResponseGenerator). From
+        the driver: a sync generator; inside async actors: an async one."""
+        router = _router_for(self._handle.app_name,
+                             self._handle.deployment_name)
+        if _on_core_loop():
+            return router.route_streaming_async(self._method, args, kwargs)
+        return router.route_streaming(self._method, args, kwargs)
 
 
 class DeploymentHandle:

@@ -86,6 +86,23 @@ class Replica:
         finally:
             self._ongoing -= 1
 
+    async def handle_request_streaming(self, method: str, args: tuple,
+                                       kwargs: dict):
+        """Streaming requests: the user method must be an async generator;
+        items ride the actor streaming-generator plane back to the caller
+        (ref: serve streaming responses over ReportGeneratorItemReturns)."""
+        if self._gate is None:
+            self._gate = asyncio.Semaphore(self.max_ongoing_requests)
+        self._ongoing += 1
+        self._total += 1
+        try:
+            async with self._gate:
+                fn = getattr(self.user, method) if method else self.user
+                async for item in fn(*args, **kwargs):
+                    yield item
+        finally:
+            self._ongoing -= 1
+
     # ------------------------------------------------------------ lifecycle
     def get_metrics(self) -> dict:
         return {

@@ -115,3 +115,164 @@ def test_batch_inference_over_dataset(rt, tiny):
     assert all(len(row["completion_tokens"]) == 3 for row in out)
     # same prompt -> same greedy completion everywhere
     assert len({tuple(row["completion_tokens"]) for row in out}) == 1
+
+
+# ------------------------------------------------ continuous-batching engine
+def _run(coro):
+    import asyncio
+
+    return asyncio.run(coro)
+
+
+def test_engine_parity_with_batched_generate(tiny):
+    """Paged-KV continuous batching must produce exactly the greedy tokens
+    of the static-batch generate path."""
+    from ray_tpu.llm import ContinuousBatchingEngine, generate
+
+    cfg, params = tiny
+
+    async def go():
+        eng = ContinuousBatchingEngine(params, cfg, max_batch=4, page_size=8,
+                                       n_pages=64, max_seq_len=128)
+        await eng.start()
+        prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11, 12, 13, 14, 15, 16, 17]]
+        import asyncio
+
+        outs = await asyncio.gather(
+            *[eng.generate(p, max_tokens=8) for p in prompts])
+        await eng.stop()
+        return outs
+
+    outs = _run(go())
+    ref = generate(params, tiny[0], [[1, 2, 3, 4, 5], [7, 8, 9],
+                                     [11, 12, 13, 14, 15, 16, 17]],
+                   max_new_tokens=8, temperature=0.0)
+    assert outs == ref
+
+
+def test_engine_mid_decode_admission(tiny):
+    """VERDICT r2 done-criterion: a request admitted while another is
+    mid-decode finishes WITHOUT waiting for the running batch."""
+    from ray_tpu.llm import ContinuousBatchingEngine
+
+    cfg, params = tiny
+
+    async def go():
+        import asyncio
+
+        eng = ContinuousBatchingEngine(params, cfg, max_batch=4, page_size=8,
+                                       n_pages=64, max_seq_len=128)
+        await eng.start()
+        long_task = asyncio.get_event_loop().create_task(
+            eng.generate([1, 2, 3], max_tokens=110))
+        while eng.steps < 5:  # the long request is decoding now
+            await asyncio.sleep(0.01)
+        short = await eng.generate([5, 6], max_tokens=4)
+        long_done_when_short_finished = long_task.done()
+        long_out = await long_task
+        await eng.stop()
+        return short, long_out, long_done_when_short_finished
+
+    short, long_out, long_done = _run(go())
+    assert len(short) == 4
+    assert len(long_out) == 110
+    assert not long_done, "short request waited for the long batch to drain"
+
+
+def test_engine_streaming_and_page_reclaim(tiny):
+    from ray_tpu.llm import ContinuousBatchingEngine
+
+    cfg, params = tiny
+
+    async def go():
+        eng = ContinuousBatchingEngine(params, cfg, max_batch=2, page_size=8,
+                                       n_pages=32, max_seq_len=64)
+        await eng.start()
+        free0 = len(eng.free_pages)
+        rid = eng.submit([9, 10], max_tokens=12)
+        toks = [t async for t in eng.stream(rid)]
+        # run several rounds: page leak would exhaust the pool
+        for _ in range(6):
+            await eng.generate([3, 1, 4, 1, 5], max_tokens=10)
+        free1 = len(eng.free_pages)
+        await eng.stop()
+        return toks, free0, free1
+
+    toks, free0, free1 = _run(go())
+    assert len(toks) == 12
+    assert free0 == free1, f"page leak: {free0} -> {free1}"
+
+
+def test_engine_lora_multiplex(tiny):
+    """Two adapters in ONE decode batch must produce their own outputs
+    (and differ from base when the adapter is non-trivial)."""
+    import numpy as np
+
+    from ray_tpu.llm import ContinuousBatchingEngine
+
+    cfg, params = tiny
+    rng = np.random.default_rng(0)
+    r = 4
+    D, Oq = cfg.d_model, cfg.n_heads * cfg.head_dim
+    adapters = {
+        "alpha": {"wq_a": rng.normal(0, 0.3, (D, r)),
+                  "wq_b": rng.normal(0, 0.3, (r, Oq))},
+        "beta": {},  # zero adapter == base model
+    }
+
+    async def go():
+        import asyncio
+
+        eng = ContinuousBatchingEngine(params, cfg, max_batch=4, page_size=8,
+                                       n_pages=64, max_seq_len=64,
+                                       lora_adapters=adapters, lora_rank=r)
+        await eng.start()
+        prompt = [5, 6, 7, 8]
+        base, alpha, beta = await asyncio.gather(
+            eng.generate(prompt, max_tokens=8),
+            eng.generate(prompt, max_tokens=8, adapter="alpha"),
+            eng.generate(prompt, max_tokens=8, adapter="beta"),
+        )
+        await eng.stop()
+        return base, alpha, beta
+
+    base, alpha, beta = _run(go())
+    assert beta == base, "zero adapter must match the base model"
+    assert alpha != base, "non-trivial adapter produced base outputs"
+
+
+def test_engine_serve_streaming(rt, tiny):
+    """Tokens stream through the serve handle: the first token arrives
+    well before the request completes."""
+    import time
+
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_engine_deployment
+
+    cfg, params = tiny
+    app = build_llm_engine_deployment(
+        cfg, params=params, max_batch=4, page_size=8, n_pages=64,
+        max_seq_len=128)
+    serve.run(app, name="llm_engine")
+    try:
+        handle = serve.get_deployment_handle("LLMEngineServer", "llm_engine")
+        # full completion path
+        out = ray_tpu.get(handle.remote(
+            {"prompt_tokens": [1, 2, 3], "max_tokens": 5}), timeout=120)
+        assert len(out["completion_tokens"]) == 5
+        # streaming path: iterate the ObjectRefGenerator
+        gen = handle.stream.stream({"prompt_tokens": [1, 2, 3],
+                                    "max_tokens": 30})
+        t0 = time.monotonic()
+        toks = []
+        first_at = None
+        for ref in gen:
+            toks.append(ray_tpu.get(ref, timeout=60))
+            if first_at is None:
+                first_at = time.monotonic() - t0
+        total = time.monotonic() - t0
+        assert len(toks) == 30
+        assert first_at < total * 0.7, (
+            f"first token at {first_at:.2f}s of {total:.2f}s — not streaming")
+    finally:
+        serve.delete("llm_engine")
