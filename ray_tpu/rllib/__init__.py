@@ -5,6 +5,9 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
 - env_runner: gymnasium sampling actors (single_agent_env_runner.py:68)
 - learner: jitted PPO updates + learner group (learner_group.py:100)
 - ppo: PPOConfig builder + Algorithm driver (algorithms/ppo/ppo.py:362)
+- dqn: off-policy double-DQN over replay buffers (algorithms/dqn/)
+- replay_buffer: uniform + prioritized rings (utils/replay_buffers/)
+- multi_agent: MultiAgentEnv + MultiAgentEnvRunner (env/multi_agent_*)
 
     from ray_tpu.rllib import PPOConfig
 
@@ -16,19 +19,32 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
         print(algo.train()["episode_return_mean"])
 """
 from ray_tpu.rllib.core import policy_init, policy_logits, sample_action, value_fn
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner, make_dqn_update, q_init, q_values
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.learner import Learner, compute_gae, make_ppo_update
+from ray_tpu.rllib.multi_agent import MultiAgentEnv, MultiAgentEnvRunner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
+    "DQN",
+    "DQNConfig",
+    "DQNEnvRunner",
     "EnvRunner",
     "Learner",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
     "PPO",
     "PPOConfig",
+    "PrioritizedReplayBuffer",
+    "ReplayBuffer",
     "compute_gae",
+    "make_dqn_update",
     "make_ppo_update",
     "policy_init",
     "policy_logits",
+    "q_init",
+    "q_values",
     "sample_action",
     "value_fn",
 ]

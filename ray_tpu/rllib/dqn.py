@@ -1,0 +1,313 @@
+"""DQN: off-policy Q-learning over a replay buffer.
+
+TPU-native counterpart of the reference DQN stack (ref:
+rllib/algorithms/dqn/dqn.py + dqn_rainbow_learner.py): double-DQN
+targets, Huber loss, target-network syncs, epsilon-greedy env runners,
+uniform or prioritized replay. The update is ONE jitted function over a
+sampled batch — per-sample TD errors come back for priority updates.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+def q_init(key, obs_dim: int, n_actions: int, hidden: int = 64):
+    from ray_tpu.rllib.core import mlp_init
+
+    return {"q": mlp_init(key, [obs_dim, hidden, hidden, n_actions])}
+
+
+def q_values(params, obs):
+    from ray_tpu.rllib.core import mlp_apply
+
+    return mlp_apply(params["q"], obs)
+
+
+_greedy_jit = None
+
+
+def _greedy_actions(params, obs):
+    """Jitted env-runner hot path: one dispatch per vector-env step."""
+    global _greedy_jit
+    if _greedy_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        _greedy_jit = jax.jit(
+            lambda p, o: jnp.argmax(q_values(p, o), axis=-1))
+    return _greedy_jit(params, obs)
+
+
+def make_dqn_update(lr: float, gamma: float):
+    """Jitted double-DQN step: online net picks the next action, target
+    net evaluates it; Huber loss with importance weights; returns
+    per-sample |TD| for prioritized replay."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(params, target_params, batch):
+        q = q_values(params, batch["obs"])
+        qa = q[jnp.arange(q.shape[0]), batch["actions"]]
+        next_online = q_values(params, batch["next_obs"])
+        next_a = jnp.argmax(next_online, axis=-1)
+        next_q = q_values(target_params, batch["next_obs"])
+        next_qa = next_q[jnp.arange(next_q.shape[0]), next_a]
+        target = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(next_qa)
+        td = qa - target
+        loss = jnp.mean(batch["weights"] * optax.huber_loss(qa, target))
+        return loss, jnp.abs(td)
+
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, td
+
+    return update, optimizer
+
+
+class DQNEnvRunner(EnvRunner):
+    """Epsilon-greedy sampling that returns flat transitions (ref:
+    single_agent_env_runner.py under an off-policy algorithm)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.epsilon = 1.0
+        # gymnasium >= 1.0 vector envs autoreset on the step AFTER done
+        # (NEXT_STEP mode): that step's "transition" is garbage (action
+        # ignored, obs pair spans two episodes) and must not enter replay
+        self._prev_done = np.zeros(self.num_envs, dtype=bool)
+
+    def set_epsilon(self, eps: float) -> bool:
+        self.epsilon = float(eps)
+        return True
+
+    def sample(self, num_steps: int) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        assert self.params is not None, "set_weights before sample"
+        n_actions = int(self.envs.single_action_space.n)
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        rng = np.random.default_rng(self.seed * 1_000_003 + self._rng_counter)
+        for _ in range(num_steps):
+            self._rng_counter += 1
+            greedy = np.asarray(
+                _greedy_actions(self.params, jnp.asarray(self.obs)))
+            explore = rng.random(self.num_envs) < self.epsilon
+            random_a = rng.integers(0, n_actions, size=self.num_envs)
+            action = np.where(explore, random_a, greedy)
+            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            # bootstrap through time-limit truncation (only a true terminal
+            # zeroes the target), the standard off-policy distinction.
+            # Envs that finished LAST step are doing their autoreset step
+            # now: record nothing for them (keep = ~prev_done).
+            keep = ~self._prev_done
+            if keep.any():
+                obs_l.append(self.obs[keep])
+                act_l.append(action[keep])
+                rew_l.append(np.asarray(reward, dtype=np.float32)[keep])
+                next_l.append(next_obs[keep])
+                done_l.append(np.asarray(term, dtype=np.float32)[keep])
+            done = np.logical_or(term, trunc)
+            self._ep_returns += np.where(keep, reward, 0.0)
+            for i, d in enumerate(done):
+                if d and keep[i]:
+                    self.completed_returns.append(float(self._ep_returns[i]))
+                    self._ep_returns[i] = 0.0
+            self._prev_done = done & keep
+            self.obs = next_obs
+        return {
+            "obs": np.concatenate(obs_l).astype(np.float32),
+            "actions": np.concatenate(act_l).astype(np.int32),
+            "rewards": np.concatenate(rew_l),
+            "next_obs": np.concatenate(next_l).astype(np.float32),
+            "dones": np.concatenate(done_l),
+        }
+
+
+class DQNConfig:
+    """Builder-style config (ref: dqn.py DQNConfig)."""
+
+    def __init__(self):
+        self.env_name: str | None = None
+        self.env_config: dict = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 64
+        self.lr = 1e-3
+        self.gamma = 0.99
+        self.hidden = 64
+        self.buffer_capacity = 50_000
+        self.prioritized = False
+        self.batch_size = 64
+        self.train_batches_per_iter = 32
+        self.target_update_freq = 200  # in update steps
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_iters = 15
+        self.learning_starts = 500  # min buffer size before updates
+        self.seed = 0
+
+    def environment(self, env: str, env_config: dict | None = None):
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, hidden=None,
+                 buffer_capacity=None, prioritized=None, batch_size=None,
+                 train_batches_per_iter=None, target_update_freq=None,
+                 epsilon_decay_iters=None, learning_starts=None):
+        for name, val in (
+                ("lr", lr), ("gamma", gamma), ("hidden", hidden),
+                ("buffer_capacity", buffer_capacity),
+                ("prioritized", prioritized), ("batch_size", batch_size),
+                ("train_batches_per_iter", train_batches_per_iter),
+                ("target_update_freq", target_update_freq),
+                ("epsilon_decay_iters", epsilon_decay_iters),
+                ("learning_starts", learning_starts)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "DQN":
+        if self.env_name is None:
+            raise ValueError("DQNConfig.environment(...) is required")
+        return DQN(self)
+
+
+class DQN:
+    """Off-policy driver (ref: dqn.py DQN.training_step): parallel
+    epsilon-greedy sampling -> replay buffer -> jitted double-DQN updates
+    -> periodic target sync -> weight broadcast."""
+
+    def __init__(self, config: DQNConfig):
+        import jax
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        RunnerCls = ray_tpu.remote(DQNEnvRunner)
+        self.runners = [
+            RunnerCls.options(num_cpus=0.5).remote(
+                config.env_name, config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, env_config=config.env_config,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim, n_actions = ray_tpu.get(
+            self.runners[0].obs_and_action_space.remote(), timeout=120)
+        self.params = q_init(jax.random.PRNGKey(config.seed), obs_dim,
+                             n_actions, config.hidden)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._update, optimizer = make_dqn_update(config.lr, config.gamma)
+        self.opt_state = optimizer.init(self.params)
+        buf_cls = PrioritizedReplayBuffer if config.prioritized else ReplayBuffer
+        self.buffer = buf_cls(config.buffer_capacity, seed=config.seed)
+        self._updates = 0
+        self._iteration = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        ray_tpu.get([r.set_weights.remote(self.params) for r in self.runners],
+                    timeout=120)
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._iteration / max(1, c.epsilon_decay_iters))
+        return c.epsilon_start + frac * (c.epsilon_end - c.epsilon_start)
+
+    def train(self) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        c = self.config
+        eps = self._epsilon()
+        ray_tpu.get([r.set_epsilon.remote(eps) for r in self.runners],
+                    timeout=120)
+        rollouts = ray_tpu.get(
+            [r.sample.remote(c.rollout_fragment_length) for r in self.runners],
+            timeout=600)
+        for ro in rollouts:
+            self.buffer.add_batch(ro)
+        losses = []
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.train_batches_per_iter):
+                batch = self.buffer.sample(c.batch_size)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()
+                      if k != "indices"}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, jb)
+                self.buffer.update_priorities(batch["indices"], np.asarray(td))
+                losses.append(float(loss))
+                self._updates += 1
+                if self._updates % c.target_update_freq == 0:
+                    self.target_params = jax.tree.map(lambda x: x, self.params)
+        self._sync_weights()
+        metrics_list = ray_tpu.get(
+            [r.episode_metrics.remote() for r in self.runners], timeout=120)
+        means = [m["episode_return_mean"] for m in metrics_list
+                 if "episode_return_mean" in m]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (sum(means) / len(means)
+                                    if means else float("nan")),
+            "episodes_this_iter": sum(m.get("episodes", 0)
+                                      for m in metrics_list),
+            "loss": sum(losses) / len(losses) if losses else float("nan"),
+            "epsilon": eps,
+            "buffer_size": len(self.buffer),
+            "num_updates": self._updates,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def stop(self):
+        for a in self.runners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+    @classmethod
+    def as_trainable(cls, config: "DQNConfig", stop_iters: int = 10):
+        def trainable(tune_config: dict):
+            from ray_tpu import tune
+
+            cfg = config
+            if "lr" in tune_config:
+                cfg = cfg.training(lr=tune_config["lr"])
+            algo = cfg.build()
+            try:
+                for _ in range(stop_iters):
+                    tune.report(algo.train())
+            finally:
+                algo.stop()
+
+        return trainable

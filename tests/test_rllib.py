@@ -130,3 +130,188 @@ def test_multi_learner_group_syncs(rt):
             assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
     finally:
         algo.stop()
+
+
+# ------------------------------------------------------------ replay buffers
+def test_replay_buffer_ring_and_sampling():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=8, seed=0)
+    for start in (0, 4, 8):  # wraps at capacity
+        buf.add_batch({
+            "obs": np.arange(start, start + 4, dtype=np.float32)[:, None],
+            "actions": np.zeros(4, dtype=np.int32),
+        })
+    assert len(buf) == 8
+    s = buf.sample(16)
+    # after 12 adds into capacity 8, entries 4..11 survive
+    assert s["obs"].min() >= 4.0 and s["obs"].max() <= 11.0
+    assert np.all(s["weights"] == 1.0)
+
+
+def test_prioritized_buffer_prefers_high_td():
+    from ray_tpu.rllib import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=100, alpha=1.0, beta=1.0, seed=0)
+    buf.add_batch({"obs": np.arange(100, dtype=np.float32)[:, None]})
+    # item 7 gets 100x the priority of everything else
+    prios = np.ones(100)
+    prios[7] = 100.0
+    buf.update_priorities(np.arange(100), prios)
+    s = buf.sample(2000)
+    frac7 = float(np.mean(s["obs"][:, 0] == 7.0))
+    assert frac7 > 0.2, frac7  # ~0.5 expected vs 0.01 uniform
+    # importance weights de-bias: the over-sampled item gets strictly
+    # SMALLER weights than every under-sampled one
+    w7 = s["weights"][s["obs"][:, 0] == 7.0]
+    w_rest = s["weights"][s["obs"][:, 0] != 7.0]
+    assert w7.max() < w_rest.min(), (w7.max(), w_rest.min())
+
+
+def test_dqn_update_moves_q_toward_targets():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import make_dqn_update, q_init, q_values
+
+    params = q_init(jax.random.PRNGKey(0), obs_dim=3, n_actions=2, hidden=16)
+    target = jax.tree.map(lambda x: x, params)
+    update, opt = make_dqn_update(lr=1e-2, gamma=0.0)  # targets = rewards
+    opt_state = opt.init(params)
+    obs = jnp.asarray(np.random.RandomState(0).randn(32, 3), jnp.float32)
+    batch = {
+        "obs": obs, "actions": jnp.zeros(32, jnp.int32),
+        "rewards": jnp.full(32, 5.0), "next_obs": obs,
+        "dones": jnp.ones(32), "weights": jnp.ones(32),
+    }
+    for _ in range(60):
+        params, opt_state, loss, td = update(params, target, opt_state, batch)
+    q = q_values(params, obs)[:, 0]
+    assert float(jnp.abs(q - 5.0).mean()) < 1.0, float(q.mean())
+
+
+def test_dqn_learns_cartpole(rt):
+    """VERDICT r2 done-criterion: the off-policy path beats random on
+    CartPole (random policy averages ~22)."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                     rollout_fragment_length=128)
+        .training(lr=2e-3, batch_size=128, train_batches_per_iter=64,
+                  target_update_freq=100, epsilon_decay_iters=6,
+                  learning_starts=500, prioritized=True, hidden=64)
+        .build()
+    )
+    try:
+        best = 0.0
+        for i in range(14):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if not np.isnan(ret):
+                best = max(best, ret)
+        assert best > 60.0, f"DQN failed to beat random: best={best}"
+    finally:
+        algo.stop()
+
+
+# --------------------------------------------------------------- multi-agent
+class _TwoAgentTag:
+    """Tiny 2-agent env: each agent sees [own_state, other_state] and is
+    rewarded for matching (agent a) / mismatching (agent b) — forces
+    DIFFERENT optimal policies per agent."""
+
+    agents = ["a", "b"]
+
+    def __init__(self):
+        self._state = None
+        self._t = 0
+
+    def reset(self, seed=None):
+        rng = np.random.default_rng(seed)
+        self._state = rng.integers(0, 2, size=2).astype(np.float32)
+        self._t = 0
+        return self._obs()
+
+    def _obs(self):
+        s = self._state
+        return {"a": np.array([s[0], s[1]], np.float32),
+                "b": np.array([s[1], s[0]], np.float32)}
+
+    def step(self, action_dict):
+        self._t += 1
+        a, b = action_dict["a"], action_dict["b"]
+        rew = {"a": 1.0 if a == int(self._state[1]) else 0.0,
+               "b": 1.0 if b != int(self._state[0]) else 0.0}
+        self._state = np.array([a, b], np.float32)
+        done = self._t >= 16
+        terms = {"a": False, "b": False, "__all__": done}
+        return self._obs(), rew, terms, {"__all__": False}, {}
+
+    def observation_space_shape(self, agent_id):
+        return (2,)
+
+    def n_actions(self, agent_id):
+        return 2
+
+
+def test_multi_agent_env_runner_learns_per_policy(rt):
+    """VERDICT r2 done-criterion: 2-agent env through MultiAgentEnvRunner
+    actors; per-policy batches train per-policy PPO updates, and BOTH
+    agents' returns improve (their optimal policies differ)."""
+    import jax
+
+    from ray_tpu.rllib import (
+        MultiAgentEnvRunner,
+        compute_gae,
+        make_ppo_update,
+        policy_init,
+    )
+
+    RunnerCls = ray_tpu.remote(MultiAgentEnvRunner)
+    runners = [
+        RunnerCls.options(num_cpus=0.5).remote(
+            _TwoAgentTag, policy_mapping_fn=lambda aid: aid, seed=i)
+        for i in range(2)
+    ]
+    spaces = ray_tpu.get(runners[0].spaces.remote(), timeout=120)
+    assert set(spaces) == {"a", "b"}
+    params = {pid: policy_init(jax.random.PRNGKey(i), *spaces[pid], hidden=32)
+              for i, pid in enumerate(sorted(spaces))}
+    update, opt = make_ppo_update(clip=0.2, vf_coeff=0.5, entropy_coeff=0.01,
+                                  lr=5e-3, epochs=4, minibatches=2)
+    opt_states = {pid: opt.init(p) for pid, p in params.items()}
+
+    def mean_return(metrics_list, agent):
+        vals = [m[agent]["episode_return_mean"] for m in metrics_list
+                if agent in m]
+        return float(np.mean(vals)) if vals else float("nan")
+
+    first = {}
+    last = {}
+    for it in range(12):
+        ray_tpu.get([r.set_weights.remote(params) for r in runners],
+                    timeout=120)
+        rollouts = ray_tpu.get([r.sample.remote(64) for r in runners],
+                               timeout=300)
+        import jax.numpy as jnp
+
+        for pid in params:
+            batches = [compute_gae(ro[pid], 0.99, 0.95) for ro in rollouts]
+            batch = {k: np.concatenate([b[k] for b in batches])
+                     for k in batches[0]}
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            params[pid], opt_states[pid], _ = update(
+                params[pid], opt_states[pid], batch, jax.random.PRNGKey(it))
+        metrics = ray_tpu.get([r.episode_metrics.remote() for r in runners],
+                              timeout=120)
+        for agent in ("a", "b"):
+            m = mean_return(metrics, agent)
+            if not np.isnan(m):
+                first.setdefault(agent, m)
+                last[agent] = m
+    for agent in ("a", "b"):
+        assert last[agent] > max(first[agent] + 2.0, 12.0), (
+            agent, first[agent], last[agent])
