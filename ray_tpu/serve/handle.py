@@ -53,6 +53,15 @@ class _Router:
         self.replicas: list[dict] = []  # {replica_id, actor_name}
         self.handles: dict[str, object] = {}  # replica_id -> ActorHandle
         self.inflight: dict[str, int] = {}
+        # replica-reported ongoing counts (cross-caller load visibility —
+        # ref: pow_2_router.py:52 queue-len probing): refreshed by a
+        # background probe loop; local inflight alone is blind to OTHER
+        # callers' requests. inflight_at_probe remembers how much of the
+        # reported count was OURS, so scoring doesn't double-count it.
+        self.remote_ongoing: dict[str, int] = {}
+        self.inflight_at_probe: dict[str, int] = {}
+        self._last_request_ts = 0.0
+        self._probe_generation = 0
         self.lock = threading.Lock()
         self._poll_started = False
         self._stopped = False
@@ -88,18 +97,23 @@ class _Router:
             if rid not in live:
                 self.handles.pop(rid, None)
                 self.inflight.pop(rid, None)
+                self.remote_ongoing.pop(rid, None)
 
     def _ensure_poll_loop(self):
         """Background long-poll keeping membership fresh (the LongPollClient
-        role, ref: long_poll.py LongPollClient)."""
+        role, ref: long_poll.py LongPollClient) plus a queue-depth probe
+        loop for cross-caller load visibility."""
         with self.lock:
+            self._last_request_ts = time.monotonic()
             if self._poll_started:
                 return
             self._poll_started = True
+            self._probe_generation += 1
+            gen = self._probe_generation
 
         async def poll():
             failures = 0
-            while not self._stopped:
+            while not self._stopped and self._probe_generation == gen:
                 try:
                     await self._refresh_once(self.version, 10.0)
                     failures = 0
@@ -113,10 +127,58 @@ class _Router:
                         break
                     await asyncio.sleep(0.5)
             with self.lock:
-                self._poll_started = False
+                if self._probe_generation == gen:
+                    self._poll_started = False
             self._controller_handle = None
 
+        async def probe_queue_lens():
+            """Refresh replica-side ongoing counts so pow-2 sees load from
+            EVERY caller (ref: pow_2_router.py queue-len probes). Probes
+            run concurrently with a short timeout, pause when the handle
+            has been idle, and die with their generation (a restarted
+            membership poll starts a fresh pair — no loop accumulation)."""
+            core = _core()
+            while not self._stopped and self._probe_generation == gen:
+                with self.lock:
+                    reps = list(self.replicas)
+                    idle = time.monotonic() - self._last_request_ts > 2.0
+                    alive = self._poll_started
+                if not alive:
+                    break
+                if idle or not reps:
+                    await asyncio.sleep(0.2)  # no traffic: no probe RPCs
+                    continue
+
+                async def probe_one(r):
+                    rid = r["replica_id"]
+                    with self.lock:
+                        actor = self.handles.get(rid)
+                    if actor is None:
+                        try:
+                            actor = await core.get_actor_by_name_async(
+                                r["actor_name"])
+                        except Exception:
+                            return
+                        if actor is None:
+                            return
+                        with self.lock:
+                            self.handles[rid] = actor
+                    try:
+                        with self.lock:
+                            local_now = self.inflight.get(rid, 0)
+                        ref = actor.get_metrics.remote()
+                        (m,) = await core.get_async([ref], 1.0)
+                        with self.lock:
+                            self.remote_ongoing[rid] = int(m.get("ongoing", 0))
+                            self.inflight_at_probe[rid] = local_now
+                    except Exception:
+                        pass  # replica mid-restart: keep the stale value
+
+                await asyncio.gather(*[probe_one(r) for r in reps])
+                await asyncio.sleep(0.15)
+
         _core()._call_on_loop(poll())
+        _core()._call_on_loop(probe_queue_lens())
 
     def stop(self):
         self._stopped = True
@@ -158,7 +220,10 @@ class _Router:
 
     # -------------------------------------------------------------- routing
     def _choose(self) -> dict | None:
-        """Power-of-two-choices over locally tracked in-flight counts."""
+        """Power-of-two-choices over replica queue depth (ref:
+        pow_2_router.py:52): the score combines the replica's REPORTED
+        ongoing count (covers other callers) with this caller's local
+        in-flight count (covers requests the probe hasn't seen yet)."""
         with self.lock:
             reps = list(self.replicas)
             if not reps:
@@ -166,12 +231,16 @@ class _Router:
             if len(reps) == 1:
                 return reps[0]
             a, b = random.sample(reps, 2)
-            return (
-                a
-                if self.inflight.get(a["replica_id"], 0)
-                <= self.inflight.get(b["replica_id"], 0)
-                else b
-            )
+
+            def score(r):
+                # remote count minus the share that was OURS at probe time
+                # (it is already in `inflight`), plus current local inflight
+                rid = r["replica_id"]
+                others = max(0, self.remote_ongoing.get(rid, 0)
+                             - self.inflight_at_probe.get(rid, 0))
+                return others + self.inflight.get(rid, 0)
+
+            return a if score(a) <= score(b) else b
 
     async def route_async(self, method: str, args: tuple, kwargs: dict):
         """Loop-thread path: full async routing; returns the result."""

@@ -34,7 +34,12 @@ from ray_tpu.tune.controller import (
     Trial,
     TuneController,
 )
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler, MedianStoppingRule
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (
     choice,
     generate_variants,
@@ -50,6 +55,7 @@ __all__ = [
     "ASHAScheduler",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PopulationBasedTraining",
     "Result",
     "ResultGrid",
     "TuneConfig",
@@ -181,9 +187,27 @@ class Tuner:
             resources_per_trial=resources,
             storage_path=storage,
             max_failures_per_trial=tc.max_failures_per_trial,
+            trials=getattr(self, "_restored_trials", None),
         )
         trials = controller.run()
         return ResultGrid([Result(t) for t in trials], tc.metric, tc.mode)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable | object,
+                tune_config: TuneConfig | None = None) -> "Tuner":
+        """Resume an interrupted experiment from its storage_path (ref:
+        tune/tuner.py Tuner.restore + execution/experiment_state.py): the
+        controller's periodic snapshots rebuild the trial table; finished
+        trials keep their results, unfinished ones run again from their
+        last checkpoint. Call .fit() on the returned Tuner to continue."""
+        import types
+
+        trials = TuneController.load_experiment_state(path)
+        tuner = cls(trainable, tune_config=tune_config,
+                    run_config=types.SimpleNamespace(storage_path=path,
+                                                    name=None))
+        tuner._restored_trials = trials
+        return tuner
 
 
 def _as_trainable(obj) -> tuple[Callable, dict]:

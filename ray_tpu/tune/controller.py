@@ -21,7 +21,7 @@ from typing import Any, Callable
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.tune import schedulers as sched_mod
-from ray_tpu.tune.schedulers import CONTINUE, STOP
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -102,7 +102,8 @@ class TuneController:
                  scheduler=None, metric: str | None = None, mode: str = "max",
                  max_concurrent_trials: int | None = None,
                  resources_per_trial: dict | None = None,
-                 storage_path: str, max_failures_per_trial: int = 0):
+                 storage_path: str, max_failures_per_trial: int = 0,
+                 trials: list[Trial] | None = None):
         self.trainable = trainable
         self.scheduler = scheduler or sched_mod.FIFOScheduler()
         self.metric = metric
@@ -111,7 +112,8 @@ class TuneController:
         self.resources = dict(resources_per_trial or {"CPU": 1.0})
         self.storage_path = storage_path
         self.max_failures = max_failures_per_trial
-        self.trials = [
+        # restored experiments pass their rebuilt trial table directly
+        self.trials = trials if trials is not None else [
             Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", config=cfg)
             for i, cfg in enumerate(variants)
         ]
@@ -120,8 +122,14 @@ class TuneController:
     # -------------------------------------------------------------- run loop
     def run(self) -> list[Trial]:
         """Event loop (ref: tune_controller.py step :666)."""
+        last_state_write = 0.0
         while True:
             self._start_pending()
+            # periodic state snapshots make a killed driver resumable via
+            # Tuner.restore (ref: experiment_state.py periodic sync)
+            if time.monotonic() - last_state_write > 1.0:
+                self._write_experiment_state()
+                last_state_write = time.monotonic()
             running = [t for t in self.trials if t.status == RUNNING]
             if not running:
                 if all(t.status in (TERMINATED, STOPPED, ERRORED) for t in self.trials):
@@ -194,6 +202,9 @@ class TuneController:
                 if decision == STOP:
                     self._stop_trial(trial)
                     break
+                if decision == EXPLOIT:
+                    self._exploit_trial(trial)
+                    break
             if trial.status == RUNNING and poll["done"]:
                 self._finish_trial(trial, poll)
 
@@ -219,6 +230,24 @@ class TuneController:
         trial.status = STOPPED
         self.scheduler.on_trial_complete(trial.trial_id, trial.metrics or None)
         self._teardown(trial)
+
+    def _exploit_trial(self, trial: Trial):
+        """PBT exploit+explore (ref: tune/schedulers/pbt.py): clone a
+        top-quantile trial's checkpoint, mutate its config, restart this
+        trial from the clone."""
+        donor_id = self.scheduler.pick_donor(exclude=trial.trial_id)
+        donor = next((t for t in self.trials if t.trial_id == donor_id), None)
+        if donor is None or donor.checkpoint_path is None:
+            return  # nothing to clone yet: keep training
+        try:
+            ray_tpu.get(trial.actor.request_stop.remote(), timeout=10)
+        except Exception:
+            pass
+        self._teardown(trial)
+        trial.config = self.scheduler.explore(dict(donor.config))
+        trial.checkpoint_path = donor.checkpoint_path
+        trial.status = PENDING  # relaunch resumes from the donor's state
+        self.scheduler.num_exploits += 1
 
     def _on_trial_failed(self, trial: Trial, error: str):
         trial.failures += 1
@@ -246,8 +275,10 @@ class TuneController:
 
     # ------------------------------------------------------------ experiment
     def _write_experiment_state(self):
-        """Persist trial table for post-hoc analysis / resumability
-        (ref: tune/execution/experiment_state.py)."""
+        """Persist the trial table for resumability + analysis
+        (ref: tune/execution/experiment_state.py). JSON for humans; a
+        pickle sidecar carries full-fidelity configs/history for
+        Tuner.restore."""
         state = [
             {
                 "trial_id": t.trial_id,
@@ -261,6 +292,49 @@ class TuneController:
         ]
         with open(os.path.join(self.storage_path, "experiment_state.json"), "w") as f:
             json.dump(state, f, indent=2, default=str)
+        import pickle
+
+        full = [
+            {
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "status": t.status,
+                "metrics": t.metrics,
+                "history": t.history,
+                "checkpoint_path": t.checkpoint_path,
+                "error": t.error,
+            }
+            for t in self.trials
+        ]
+        tmp = os.path.join(self.storage_path, "experiment_state.pkl.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(full, f)
+        os.replace(tmp, os.path.join(self.storage_path, "experiment_state.pkl"))
+
+    @staticmethod
+    def load_experiment_state(storage_path: str) -> list[Trial]:
+        """Rebuild the trial table from a (possibly killed) experiment's
+        snapshots. Unfinished trials come back PENDING and resume from
+        their last checkpoint; finished ones keep their results."""
+        import pickle
+
+        path = os.path.join(storage_path, "experiment_state.pkl")
+        with open(path, "rb") as f:
+            rows = pickle.load(f)
+        trials = []
+        for r in rows:
+            t = Trial(trial_id=r["trial_id"], config=r["config"])
+            t.metrics = r.get("metrics") or {}
+            t.history = r.get("history") or []
+            t.checkpoint_path = r.get("checkpoint_path")
+            status = r.get("status")
+            if status in (TERMINATED, STOPPED):
+                t.status = status
+                t.error = r.get("error")
+            else:  # PENDING / RUNNING / ERRORED at kill time: run it again
+                t.status = PENDING
+            trials.append(t)
+        return trials
 
 
 def _jsonable(obj):
