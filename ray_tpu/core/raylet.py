@@ -22,6 +22,7 @@ import itertools
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from dataclasses import dataclass, field
@@ -219,6 +220,11 @@ class Raylet:
         # move to disk under arena pressure and restore on demand
         self._spilled: dict[ObjectID, str] = {}  # oid -> file path
         self._spill_lock = asyncio.Lock()
+        # Guards the _spilling_now/_freed_while_spilling handshake between
+        # the loop thread (_drop_spill_file) and spill executor threads
+        # (_spill_one's finally) — membership check + marker add must be
+        # atomic or a freed-during-spill file leaks.
+        self._spill_state_lock = threading.Lock()
         self._spilling_now: set[ObjectID] = set()
         self._freed_while_spilling: set[ObjectID] = set()
         self._spill_failed_at: dict[ObjectID, float] = {}
@@ -842,7 +848,8 @@ class Raylet:
         enough. Safe vs concurrent gets: the buffer ref pins the bytes
         while copying; after delete, readers miss and take the pull path
         which restores from disk."""
-        self._spilling_now.add(oid)
+        with self._spill_state_lock:
+            self._spilling_now.add(oid)
         try:
             path = self._spilled.get(oid)
             if path is None or not os.path.exists(path):
@@ -871,9 +878,11 @@ class Raylet:
                 metrics.objects_spilled.inc()
             self.store.delete(oid)
         finally:
-            self._spilling_now.discard(oid)
-            if oid in self._freed_while_spilling:
+            with self._spill_state_lock:
+                self._spilling_now.discard(oid)
+                freed = oid in self._freed_while_spilling
                 self._freed_while_spilling.discard(oid)
+            if freed:
                 self._drop_spill_file(oid)
 
     def _restore_spilled(self, oid: ObjectID) -> bool:
@@ -897,13 +906,14 @@ class Raylet:
         return True
 
     def _drop_spill_file(self, oid: ObjectID):
-        if oid in self._spilling_now:
-            # a spill is writing this object's file right now; the spill's
-            # finally will see the marker and drop the file it just made
-            self._freed_while_spilling.add(oid)
-            return
-        self._spill_failed_at.pop(oid, None)
-        path = self._spilled.pop(oid, None)
+        with self._spill_state_lock:
+            if oid in self._spilling_now:
+                # a spill is writing this object's file right now; the
+                # spill's finally will see the marker and drop the file
+                self._freed_while_spilling.add(oid)
+                return
+            self._spill_failed_at.pop(oid, None)
+            path = self._spilled.pop(oid, None)
         if path is not None:
             try:
                 os.remove(path)

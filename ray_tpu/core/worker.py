@@ -253,14 +253,7 @@ class Worker:
         try:
             await self._push_task_multi_inner(conn, items, replied)
         except Exception as e:
-            err = {"error": _as_task_error(e)}
-            for corr, _ in items:
-                if corr in replied:
-                    continue
-                try:
-                    await conn.respond(corr, value=err)
-                except Exception:
-                    break  # connection gone: driver handles ConnectionLost
+            await self._error_reply_all(conn, items, replied, e)
 
     async def _push_task_multi_inner(self, conn, items, replied: set):
         i = 0
@@ -351,14 +344,7 @@ class Worker:
         try:
             await self._push_actor_multi_inner(conn, items, replied)
         except Exception as e:
-            err_reply = {"error": _as_task_error(e)}
-            for corr, _ in items:
-                if corr in replied:
-                    continue
-                try:
-                    await conn.respond(corr, value=err_reply)
-                except Exception:
-                    break
+            await self._error_reply_all(conn, items, replied, e)
 
     async def _push_actor_multi_inner(self, conn, items, replied: set):
         loop = asyncio.get_running_loop()
@@ -393,7 +379,14 @@ class Worker:
                 run.append(items[i])
                 i += 1
             if len(run) >= 2:
-                await self._exec_actor_simple_run(conn, run, replied)
+                # Spawn the run instead of awaiting it: a sync method in this
+                # run may block until a LATER async method in the same frame
+                # acts (legal on a serial actor — async methods run on the
+                # loop), so the dispatch loop must keep going while the run
+                # occupies the executor thread.
+                for corr, _ in run:
+                    replied.add(corr)  # the spawned run owns these replies
+                loop.create_task(self._exec_actor_simple_run_task(conn, run))
                 continue
             if run:
                 corr, payload = run[0]
@@ -404,6 +397,27 @@ class Worker:
             i += 1
             replied.add(corr)
             loop.create_task(self._actor_push_respond(conn, corr, payload))
+
+    async def _exec_actor_simple_run_task(self, conn, run):
+        """Task wrapper for a spawned simple run: replies happen in one
+        respond_multi at the end, so on any earlier failure none of the
+        items have been answered — answer them all with the error."""
+        try:
+            await self._exec_actor_simple_run(conn, run, set())
+        except Exception as e:
+            await self._error_reply_all(conn, run, set(), e)
+
+    async def _error_reply_all(self, conn, items, replied: set, e: Exception):
+        """Answer every not-yet-replied item of a multi-push frame with the
+        same error; stop on a dead connection (driver handles the loss)."""
+        err_reply = {"error": _as_task_error(e)}
+        for corr, _ in items:
+            if corr in replied:
+                continue
+            try:
+                await conn.respond(corr, value=err_reply)
+            except Exception:
+                break
 
     async def _exec_actor_simple_run(self, conn, run, replied: set):
         gate = self._seq_gates.setdefault(conn, {"next": 0, "events": {}})

@@ -144,10 +144,12 @@ class JobSupervisor:
             status, msg = STOPPED, "stopped"
         elif rc == 0:
             status, msg = SUCCEEDED, ""
-        elif rc in (-15, -9):
-            status, msg = STOPPED, f"terminated by signal {-rc}"
         else:
-            status, msg = FAILED, f"entrypoint exited with code {rc}"
+            # a signal exit we did NOT request (e.g. the kernel OOM killer
+            # SIGKILLing the driver) is a failure, not a stop
+            status, msg = FAILED, (
+                f"terminated by signal {-rc}" if rc < 0
+                else f"entrypoint exited with code {rc}")
         await loop.run_in_executor(
             None, lambda: self._kv_update(status=status, message=msg,
                                           end_time=time.time()))

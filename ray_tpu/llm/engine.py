@@ -24,6 +24,7 @@ ideas onto XLA's static-shape world:
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -215,6 +216,11 @@ class ContinuousBatchingEngine:
         self.waiting: list[_Request] = []
         self._req_ids = itertools.count(1)
         self._reqs: dict[int, _Request] = {}
+        # finished requests not yet drained by a stream() consumer; bounded
+        # LRU so fire-and-forget submitters can't leak token queues forever
+        self._done: collections.OrderedDict[int, _Request] = (
+            collections.OrderedDict())
+        self._done_cap = 4 * self.B + max_waiting
         self._wake = asyncio.Event()
         self._running = False
         self._task = None
@@ -243,6 +249,7 @@ class ContinuousBatchingEngine:
         for req in list(self._reqs.values()):
             req.out.put_nowait(None)
         self._reqs.clear()
+        self._done.clear()
         self.waiting.clear()
         self.slot_req = [None] * self.B
 
@@ -275,15 +282,25 @@ class ContinuousBatchingEngine:
 
     async def stream(self, req_id: int):
         """Async iterator of generated token ids for one request. Raises
-        if the engine died before the request finished."""
-        req = self._reqs[req_id]
-        while True:
-            item = await req.out.get()
-            if item is None:
-                if self.error is not None and not req.finished:
-                    raise RuntimeError("engine loop died") from self.error
-                break
-            yield item
+        if the engine died before the request finished. The request stays
+        registered until its consumer drains the terminal None here — a
+        caller may finish awaiting something else before streaming and the
+        already-queued tokens must still be reachable."""
+        req = self._reqs.get(req_id)
+        if req is None:
+            req = self._done[req_id]
+        try:
+            while True:
+                item = await req.out.get()
+                if item is None:
+                    if self.error is not None and not req.finished:
+                        raise RuntimeError("engine loop died") from self.error
+                    break
+                yield item
+        finally:
+            # only unregister finished requests: a consumer erroring out
+            # mid-stream must not make cancel() a no-op on a live request
+            self._done.pop(req_id, None)
 
     async def generate(self, prompt_tokens: list[int], **kw) -> list[int]:
         rid = self.submit(prompt_tokens, **kw)
@@ -313,7 +330,13 @@ class ContinuousBatchingEngine:
         self.page_tables[slot, :] = 0
         self.seq_lens[slot] = 0
         if req is not None:
+            # move live → finished-awaiting-drain: stream() can still reach
+            # the queued tokens, cancel() only sees live requests, and the
+            # bounded _done map caps leakage from never-streamed submits
             self._reqs.pop(req.req_id, None)
+            self._done[req.req_id] = req
+            while len(self._done) > self._done_cap:
+                self._done.popitem(last=False)
             req.out.put_nowait(None)
 
     def _admit(self, req: _Request) -> bool:
