@@ -145,5 +145,28 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_chan_read_acquire.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64, ctypes.c_int64, p64, p64]
             lib.rt_chan_read_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_chan_close.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            # task rings (fast-path transport)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            i64 = ctypes.c_int64
+            lib.rt_ring_pair_create.restype = ctypes.c_void_p
+            lib.rt_ring_pair_create.argtypes = [ctypes.c_char_p, u64]
+            lib.rt_ring_pair_open.restype = ctypes.c_void_p
+            lib.rt_ring_pair_open.argtypes = [ctypes.c_char_p]
+            lib.rt_ring_push.restype = ctypes.c_int
+            lib.rt_ring_push.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, u64, i64]
+            lib.rt_ring_push_raw.restype = ctypes.c_int
+            lib.rt_ring_push_raw.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, u64, i64]
+            lib.rt_ring_pop_batch.restype = i64
+            lib.rt_ring_pop_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, u8p, u64, i64]
+            lib.rt_ring_pending.restype = u64
+            lib.rt_ring_pending.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_ring_closed.restype = ctypes.c_int
+            lib.rt_ring_closed.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_ring_pair_close.argtypes = [ctypes.c_void_p]
+            lib.rt_ring_pair_destroy.argtypes = [ctypes.c_char_p]
             _lib = lib
     return _lib

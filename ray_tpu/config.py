@@ -73,6 +73,18 @@ class Config:
     #: (ref: normal_task_submitter.cc direct PushTask pipelining)
     push_batch_size: int = 32
 
+    # --- native fast path (shm task rings; ref: normal_task_submitter.cc
+    # steady-state lease-cached PushTask loop — see core/fastpath.py) ---
+    #: route eligible same-node task submissions over native shm rings
+    fastpath_enabled: bool = True
+    #: per-direction ring capacity in bytes
+    fastpath_ring_bytes: int = 4 * 1024 * 1024
+    #: task records above this size take the RPC path (big args belong in
+    #: the object store, and the pop buffer must always fit one record)
+    fastpath_record_max: int = 256 * 1024
+    #: max unreplied fast-path tasks per worker before spilling to RPC
+    fastpath_inflight_max: int = 4096
+
     # --- memory protection (ref: memory_monitor.h:52) ---
     #: fraction of system memory in use that triggers OOM killing;
     #: <= 0 disables the monitor

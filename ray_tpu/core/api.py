@@ -21,7 +21,7 @@ from typing import Any, Iterable, Sequence
 from ray_tpu.config import Config, get_config, set_config
 from ray_tpu.core.core_client import CoreClient
 from ray_tpu.core.ref import ActorHandle, ObjectRef
-from ray_tpu.utils import rpc
+from ray_tpu.utils import rpc, serialization
 from ray_tpu.utils.ids import PlacementGroupID
 
 _core: CoreClient | None = None
@@ -234,7 +234,30 @@ def get(refs, timeout: float | None = None):
     for r in ref_list:
         if not isinstance(r, ObjectRef):
             raise TypeError(f"ray_tpu.get takes ObjectRefs, got {type(r)}")
-    values = core._run_sync(core.get_async(ref_list, timeout), timeout=None)
+    start = time.monotonic()
+    # fast-path refs resolve straight off the shm reply rings, in this
+    # thread, without a loop round-trip (see core/fastpath.py)
+    fast = core.fast_prepass(ref_list, timeout)
+    slow_refs = ([r for r in ref_list if r.id not in fast]
+                 if fast else ref_list)
+    slow_values = []
+    if slow_refs:
+        remaining = (None if timeout is None
+                     else max(0.0, timeout - (time.monotonic() - start)))
+        slow_values = core._run_sync(
+            core.get_async(slow_refs, remaining), timeout=None)
+    if not fast:
+        return slow_values[0] if single else slow_values
+    it = iter(slow_values)
+    values = []
+    for r in ref_list:
+        hit = fast.get(r.id)
+        if hit is None:
+            values.append(next(it))
+        elif hit[0] == "v":
+            values.append(serialization.unpack(hit[1]))
+        else:
+            raise hit[1]
     return values[0] if single else values
 
 

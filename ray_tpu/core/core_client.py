@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import inspect
 import os
 import sys
 import time
@@ -32,6 +33,7 @@ except ImportError:  # pragma: no cover
     import pickle as cloudpickle
 
 import pickle
+import threading as _threading
 
 from ray_tpu.config import get_config
 from ray_tpu.core import object_store
@@ -91,6 +93,8 @@ class _LeasedWorker:
     busy: bool = False
     idle_since: float = field(default_factory=time.monotonic)
     tpu_chips: list | None = None  # chip ids the lease granted
+    fast_lane: object | None = None  # shm-ring lane (core/fastpath.py)
+    queued: int = 0  # committed batch depth (demand accounting)
 
 
 @dataclass
@@ -102,6 +106,13 @@ class _SchedulingKeyState:
     workers: list[_LeasedWorker] = field(default_factory=list)
     lease_requests_inflight: int = 0
     inflight_tasks: int = 0
+    # EWMA of observed per-task seconds: long tasks dispatch chunk=1 so
+    # backlog stays visible to lease growth / spillback / the autoscaler
+    avg_task_s: float = 0.0
+    # monotonic ts since fast-lane backlog has been continuously high;
+    # only PERSISTENT backlog grows leases (a micro-task burst drains in
+    # milliseconds — spawning workers for it would eat the CPU it needs)
+    fast_backlog_since: float = 0.0
     # persistent-lease-failure breaker: repeated identical errors over real
     # time with zero live workers fail the pending queue (see _request_lease)
     lease_failures: int = 0
@@ -209,8 +220,6 @@ class CoreClient:
         # dispatch; ref: dependency resolver holding arg refs)
         self._inflight_pins: dict[TaskID, list] = {}
         self._ship_collect: list | None = None  # set during arg serialization
-        import threading as _threading
-
         self._rc_lock = _threading.Lock()  # counts are bumped off-loop too
         self._xq: list = []  # thread->loop submission queue (see _call_on_loop)
         self._xq_armed = False
@@ -220,6 +229,20 @@ class CoreClient:
         self.default_runtime_env: dict | None = None  # packaged descriptor
         self._bg = aio.TaskGroup()
         self.task_events = _TaskEventBuffer(self)
+        # ---- native fast path (shm task rings; see core/fastpath.py) ----
+        # _fast_cv guards every map below plus each lane's inflight dict;
+        # reader threads notify it once per reply batch so blocking get()s
+        # resolve without touching the event loop.
+        self._fast_cv = _threading.Condition()
+        self._fast_lanes: list = []
+        self._fast_done: dict[ObjectID, tuple] = {}   # oid -> (status, payload)
+        self._fast_oid_lane: dict[ObjectID, object] = {}
+        self._fast_migrate_q: list = []
+        self._fast_migrate_armed = False
+        self._fast_ineligible_funcs: set[bytes] = set()
+        self._fast_ring_seq = 0
+        self._fast_last_submit = 0.0  # burst detector (see _try_fast_submit)
+        self._fast_demand_kick = 0.0  # rate-limits backlog->pump kicks
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -245,6 +268,8 @@ class CoreClient:
                 self.store = None
         self.job_id = await self.gcs.call("register_job", {})
         self._bg.spawn(self.task_events._flush_loop(), self.loop)
+        if self.cfg.fastpath_enabled and self.store is not None:
+            self._bg.spawn(self._fast_health_loop(), self.loop)
 
     # -------------------------------------------------------------- pubsub
     def _on_push(self, msg):
@@ -874,6 +899,392 @@ class CoreClient:
             return {"ready": True, "error": entry.error}
         return {"ready": True}
 
+    # ------------------------------------------- native fast path (shm rings)
+    # The steady-state submit->execute->reply loop of the reference's C++
+    # NormalTaskSubmitter (normal_task_submitter.cc:28, core_worker.cc:2500)
+    # realized over native SPSC shm rings: see core/fastpath.py for the
+    # design. Everything here degrades to the ordinary RPC path.
+
+    async def _fast_attach(self, key, state, w: _LeasedWorker):
+        """Create a ring pair and hand it to a freshly leased same-node
+        worker. Failure is silent: the lane simply never exists."""
+        from ray_tpu.core import fastpath
+
+        self._fast_ring_seq += 1
+        name = f"rt_fp_{os.getpid()}_{self._fast_ring_seq}"
+        try:
+            ring = fastpath.RingPair.create(name, self.cfg.fastpath_ring_bytes)
+        except Exception:
+            return
+        try:
+            ok = await w.conn.call("attach_fast_ring", {"name": name},
+                                   timeout=10)
+        except Exception:
+            ok = False
+        if not ok or w not in state.workers:
+            ring.close_pair()
+            return
+        lane = fastpath.FastLane(ring, w, key)
+        t = _threading.Thread(target=self._fast_reader, args=(lane,),
+                              name="rt-fastread", daemon=True)
+        lane.reader = t
+        w.fast_lane = lane
+        self._fast_lanes.append(lane)
+        t.start()
+
+    def _try_fast_submit(self, fn, args, kwargs, resources):
+        """User-thread fast submit. Returns an ObjectRef, or None to take
+        the RPC path. Must never raise."""
+        from ray_tpu.core import fastpath
+
+        func_id = getattr(fn, "__rt_func_id__", None)
+        if (func_id is None
+                or not getattr(fn, "__rt_fast_ok__", False)
+                or func_id not in self._registered_funcs
+                or func_id in self._fast_ineligible_funcs):
+            return None
+        for a in args:
+            if isinstance(a, ObjectRef):
+                return None  # top-level refs are value-resolved on the loop
+        if kwargs:
+            for a in kwargs.values():
+                if isinstance(a, ObjectRef):
+                    return None
+        key = (func_id, tuple(sorted(resources.items())), None, -1, None)
+        state = self.sched_keys.get(key)
+        if state is None:
+            return None
+        lanes = [w.fast_lane for w in list(state.workers)
+                 if w.fast_lane is not None and not w.fast_lane.broken]
+        if not lanes:
+            return None
+        # The ring wins by amortizing thread wakes over a pipelined burst;
+        # a lone submit-then-block roundtrip is faster on the RPC path
+        # (the loop threads are already hot). Burst = tasks in flight, or
+        # back-to-back submits from the caller.
+        now = time.perf_counter()
+        burst = (now - self._fast_last_submit) < 0.0002
+        self._fast_last_submit = now
+        if not burst and not any(ln.inflight for ln in lanes):
+            return None
+        cap = self.cfg.fastpath_inflight_max
+        n = len(lanes)
+        start = self._task_counter % n
+        lane = None
+        for i in range(n):
+            cand = lanes[(start + i) % n]
+            if len(cand.inflight) < cap:
+                lane = cand
+                break
+        if lane is None:
+            return None
+        self._task_counter += 1
+        task_id = TaskID.generate()
+        tid = task_id.binary()
+        try:
+            rec = fastpath.pack_task(tid, func_id, args, kwargs)
+        except Exception:
+            return None  # plain pickle can't carry it: cloudpickle path
+        if len(rec) > self.cfg.fastpath_record_max:
+            return None  # big args belong in the object store
+        oid = ObjectID.for_task_return(task_id, 0)
+        light = (fn, args, kwargs, resources)
+        with self._fast_cv:
+            lane.inflight[task_id] = light
+            self._fast_oid_lane[oid] = lane
+        self.memory_store[oid] = _MemEntry()
+        status = lane.ring.push(fastpath.SUB, rec, timeout_ms=0)
+        if status != 0:  # full or closed: undo, use the RPC path
+            with self._fast_cv:
+                owned = lane.inflight.pop(task_id, None) is not None
+                self._fast_oid_lane.pop(oid, None)
+            if not owned:
+                # a concurrent _fast_break_lane snapshotted our inflight
+                # entry and already resubmitted this very task over RPC —
+                # hand out the ref instead of minting a duplicate call
+                return self._new_owned_ref(oid)
+            self.memory_store.pop(oid, None)
+            return None
+        lane.worker.idle_since = time.monotonic()  # keep the lease warm
+        metrics.tasks_submitted.inc()
+        # Demand signaling: tasks queued beyond one-per-worker must still
+        # surface as lease demand (raylet _lease_waiters feeds the
+        # autoscaler and spillback) even though they ride the rings — but
+        # only once the backlog PERSISTS (see fast_backlog_since).
+        if len(lane.inflight) > 1:
+            if state.fast_backlog_since == 0.0:
+                state.fast_backlog_since = now
+            elif (now - state.fast_backlog_since > 0.5
+                    and now - self._fast_demand_kick > 0.25):
+                self._fast_demand_kick = now
+                self._call_on_loop(self._pump(key, state))
+        else:
+            state.fast_backlog_since = 0.0
+        return self._new_owned_ref(oid)
+
+    def _fast_reader(self, lane):
+        """Per-lane sweeper thread: drain the reply ring whenever no
+        blocking get() has claimed consumption (fast_prepass steals the
+        consumer role — one thread hop fewer per result — and the sweeper
+        parks while that streak lasts)."""
+        from ray_tpu.core import fastpath
+
+        ring = lane.ring
+        while not (self._closed or lane.broken):
+            if time.monotonic() - lane.user_wants < 0.5:
+                lane.resume_evt.wait(0.5)  # a get() streak owns the ring
+                lane.resume_evt.clear()
+                continue
+            with lane.rx_lock:
+                recs = ring.pop_batch(fastpath.REP, timeout_ms=200)
+            if recs is None:
+                break  # closed and drained
+            if recs:
+                self._fast_process_replies(lane, recs)
+        self._fast_break_lane(lane)
+        with lane.rx_lock:  # no stealing get() mid-pop
+            ring.close_pair()  # the sweeper owns the unmap (single closer)
+
+    def _fast_process_replies(self, lane, recs):
+        """Record a batch of reply records (any thread): resolve blocking
+        gets via the cv, queue loop-side bookkeeping."""
+        from ray_tpu.core import fastpath
+
+        batch = []
+        with self._fast_cv:
+            for rec in recs:
+                tid_b, status, payload = fastpath.unpack_reply(rec)
+                task_id = TaskID(tid_b)
+                light = lane.inflight.pop(task_id, None)
+                oid = ObjectID.for_task_return(task_id, 0)
+                self._fast_oid_lane.pop(oid, None)
+                if status != fastpath.NEED_SLOW:
+                    self._fast_done[oid] = (status, payload)
+                batch.append((task_id, oid, status, payload, light))
+            self._fast_migrate_q.extend(batch)
+            arm = not self._fast_migrate_armed
+            if arm:
+                self._fast_migrate_armed = True
+            self._fast_cv.notify_all()
+        if arm:
+            try:
+                self.loop.call_soon_threadsafe(self._drain_fast_migrations)
+            except RuntimeError:
+                pass  # loop gone (shutdown)
+
+    def _drain_fast_migrations(self):
+        """Loop-side completion: fill memory-store entries, emit events,
+        resubmit NEED_SLOW tasks via the RPC path."""
+        from ray_tpu.core import fastpath
+
+        with self._fast_cv:
+            batch = self._fast_migrate_q
+            self._fast_migrate_q = []
+            self._fast_migrate_armed = False
+        lanes_to_check = set()
+        for task_id, oid, status, payload, light in batch:
+            if status == fastpath.NEED_SLOW:
+                if light is not None:
+                    self._fast_ineligible_funcs.add(
+                        getattr(light[0], "__rt_func_id__", b""))
+                    spec = self._fast_light_to_spec(task_id, light)
+                    self._bg.spawn(self._submit_async(spec), self.loop)
+                continue
+            entry = self.memory_store.get(oid)
+            name = getattr(light[0], "__name__", "task") if light else "task"
+            if entry is not None and not entry.ready.is_set():
+                if status == fastpath.OK:
+                    entry.packed = payload
+                elif status == fastpath.OK_SHM:
+                    entry.in_shm = True
+                    if light is not None:
+                        # shm results can be evicted: keep real lineage
+                        self._lineage[task_id] = self._fast_light_to_spec(
+                            task_id, light)
+                        self._lineage_live[task_id] = {oid}
+                    self._bg.spawn(self._register_location(oid), self.loop)
+                else:  # ERR
+                    try:
+                        entry.error = pickle.loads(payload)
+                    except Exception as e:  # unpicklable error payload
+                        entry.error = TaskError(f"task failed: {e!r}")
+                entry.ready.set()
+            self._cancelled_tasks.discard(task_id)
+            outcome = "failed" if status == fastpath.ERR else "ok"
+            metrics.tasks_finished.inc(tags={"outcome": outcome})
+            self.task_events.emit(
+                task_id=task_id.hex(), name=name,
+                state="FAILED" if status == fastpath.ERR else "FINISHED")
+            with self._fast_cv:
+                self._fast_done.pop(oid, None)
+        # a drained lane's lease must still be returnable when idle; arm at
+        # most one idle-return watcher per lane drain-down
+        drained = False
+        for lane in [ln for ln in self._fast_lanes if not ln.inflight]:
+            state = self.sched_keys.get(lane.key)
+            if state is None:
+                continue
+            drained = True
+            state.fast_backlog_since = 0.0  # drained: demand pressure gone
+            if not lane.return_armed and lane.worker in state.workers:
+                lane.return_armed = True
+                self._bg.spawn(
+                    self._fast_idle_return(lane, state), self.loop)
+        if drained:
+            self._report_demand()  # clear any stale nonzero raylet report
+
+    async def _fast_idle_return(self, lane, state):
+        try:
+            await self._maybe_return_lease(lane.key, state, lane.worker)
+        finally:
+            lane.return_armed = False
+
+    def _fast_light_to_spec(self, task_id: TaskID, light) -> dict:
+        """Expand a fast-path lineage tuple into a full RPC task spec
+        (reusing the already-issued task id: its refs are in user hands)."""
+        fn, args, kwargs, resources = light
+        return {
+            "task_id": task_id,
+            "name": getattr(fn, "__name__", "task"),
+            "func_id": fn.__rt_func_id__,
+            "language": "python",
+            "func_name": None,
+            "args": list(args),
+            "kwargs": dict(kwargs),
+            "num_returns": 1,
+            "resources": dict(resources),
+            "owner_address": self.address,
+            "max_retries": max(0, self.cfg.default_max_task_retries - 1),
+            "placement_group": None,
+            "bundle_index": -1,
+            "scheduling_node": None,
+            "runtime_env": self.default_runtime_env,
+        }
+
+    def _fast_break_lane(self, lane):
+        """Thread-safe: stop routing to this lane and resubmit whatever is
+        in flight through the RPC path (worker death / lease return)."""
+        with self._fast_cv:
+            if lane.broken:
+                leftovers = {}
+            else:
+                lane.broken = True
+                leftovers = dict(lane.inflight)
+                lane.inflight.clear()
+                for task_id in leftovers:
+                    self._fast_oid_lane.pop(
+                        ObjectID.for_task_return(task_id, 0), None)
+            self._fast_cv.notify_all()
+        if lane.worker is not None and lane.worker.fast_lane is lane:
+            lane.worker.fast_lane = None
+        if lane in self._fast_lanes:
+            try:
+                self._fast_lanes.remove(lane)
+            except ValueError:
+                pass
+        lane.ring.close(0)
+        lane.ring.close(1)
+        if leftovers and not self._closed:
+            def resub():
+                for task_id, light in leftovers.items():
+                    if task_id in self._cancelled_tasks:
+                        continue  # entries already failed by cancel_task
+                    spec = self._fast_light_to_spec(task_id, light)
+                    self._bg.spawn(self._submit_async(spec), self.loop)
+            try:
+                self.loop.call_soon_threadsafe(resub)
+            except RuntimeError:
+                pass
+
+    async def _fast_health_loop(self):
+        """Worker death with an empty loop (nobody mid-RPC to notice):
+        sweep lanes whose worker connection died and recover their tasks."""
+        while not self._closed:
+            await asyncio.sleep(2.0)
+            for lane in list(self._fast_lanes):
+                if lane.broken:
+                    continue
+                w = lane.worker
+                if w.conn is None or w.conn._closed or lane.ring.is_closed(1):
+                    self._fast_break_lane(lane)
+
+    def fast_prepass(self, refs, timeout: float | None) -> dict:
+        """Blocking wait (user thread) for fast-path refs, resolved straight
+        from the reply stream. Returns {oid: ("v", packed) | ("e", exc)};
+        refs it does not resolve (slow, shm, timed out) are left for the
+        normal get path."""
+        if not self._fast_oid_lane and not self._fast_done:
+            return {}
+        from ray_tpu.core import fastpath
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        resolved: dict = {}
+        while True:
+            steal_lane = None
+            with self._fast_cv:
+                pending = set()
+                lanes = set()
+                for r in refs:
+                    oid = r.id
+                    if oid in resolved:
+                        continue
+                    hit = self._fast_done.get(oid)
+                    if hit is not None:
+                        resolved[oid] = hit
+                        continue
+                    lane = self._fast_oid_lane.get(oid)
+                    if lane is None:
+                        continue  # migrated/broken/cancelled: loop path owns it
+                    entry = self.memory_store.get(oid)
+                    if entry is not None and entry.ready.is_set():
+                        continue  # completed via the loop
+                    pending.add(oid)
+                    lanes.add(lane)
+                if not pending:
+                    break
+                if len(lanes) == 1:
+                    steal_lane = next(iter(lanes))
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                break
+            # Single-lane wait: become the reply-ring consumer ourselves —
+            # the result then costs one thread wake (worker pump -> us)
+            # instead of three (pump -> sweeper -> cv -> us).
+            if steal_lane is not None and not steal_lane.broken:
+                steal_lane.user_wants = time.monotonic()
+                if steal_lane.rx_lock.acquire(blocking=False):
+                    try:
+                        pop_ms = int(1000 * min(0.2, remaining or 0.2))
+                        recs = steal_lane.ring.pop_batch(
+                            fastpath.REP, max(1, pop_ms))
+                    finally:
+                        steal_lane.rx_lock.release()
+                    if recs is None:
+                        self._fast_break_lane(steal_lane)
+                    elif recs:
+                        self._fast_process_replies(steal_lane, recs)
+                    continue
+            # sweeper-consumed (or multi-lane) wait; bounded because
+            # loop-side completions (cancel, slow takeover) don't notify
+            with self._fast_cv:
+                again = any(oid in self._fast_done for oid in pending)
+                if not again:
+                    self._fast_cv.wait(
+                        0.05 if remaining is None else min(0.05, remaining))
+        out = {}
+        for oid, (status, payload) in resolved.items():
+            from ray_tpu.core import fastpath
+            if status == fastpath.OK:
+                out[oid] = ("v", payload)
+            elif status == fastpath.ERR:
+                try:
+                    out[oid] = ("e", pickle.loads(payload))
+                except Exception as e:
+                    out[oid] = ("e", TaskError(f"task failed: {e!r}"))
+            # OK_SHM: leave for the normal path (arena read after migration)
+        return out
+
     # ------------------------------------------------------ task submission
     def _register_function(self, fn) -> bytes:
         """Export the function blob to the GCS function table once
@@ -895,6 +1306,12 @@ class CoreClient:
             self._registered_funcs.add(func_id)
         try:
             fn.__rt_func_id__ = func_id
+            # plain sync callables qualify for the shm-ring fast path;
+            # generators/coroutines need the RPC streaming machinery
+            fn.__rt_fast_ok__ = not (
+                inspect.iscoroutinefunction(fn)
+                or inspect.isgeneratorfunction(fn)
+                or inspect.isasyncgenfunction(fn))
         except (AttributeError, TypeError):
             pass
         return func_id
@@ -916,6 +1333,13 @@ class CoreClient:
                 raise TypeError("C++ tasks take positional arguments only")
             func_id = b"cpp:" + func_name.encode()
         else:
+            if (num_returns == 1 and placement_group is None
+                    and scheduling_node is None and runtime_env is None
+                    and name is None and max_retries is None):
+                ref = self._try_fast_submit(
+                    fn, args, kwargs, dict(resources or {"CPU": 1.0}))
+                if ref is not None:
+                    return ref
             func_id = self._register_function(fn)
         self._task_counter += 1
         task_id = TaskID.generate()
@@ -1079,7 +1503,19 @@ class CoreClient:
         # per worker turn (push_task_multi) instead of one frame per task.
         # The backlog is split across ALL free workers first (chunk), so a
         # small burst doesn't pile onto one worker and serialize.
+        # a worker whose fast lane has tasks in flight is not free: its pump
+        # thread is executing ring work, and an RPC batch on top would run
+        # two tasks concurrently on a one-CPU lease
+        # Prefer workers whose fast lane is quiet — an RPC batch on top of
+        # in-flight ring work would run two tasks at once on a one-CPU
+        # lease. Preference, not exclusion: when every lane is busy it is
+        # still better to dispatch (brief oversubscription) than to starve
+        # the batch and trigger a worker spawn that eats the only CPU.
         free = [w for w in state.workers if not w.busy]
+        quiet = [w for w in free
+                 if not (w.fast_lane is not None and w.fast_lane.inflight)]
+        if quiet:
+            free = quiet
         if free and not state.pending.empty():
             # chunk the backlog over free workers PLUS the leases we could
             # still grow into: a batch is committed to its worker, so
@@ -1094,6 +1530,11 @@ class CoreClient:
             targets = len(free) + headroom
             chunk = max(1, min(self.cfg.push_batch_size,
                                -(-state.pending.qsize() // targets)))
+            if state.avg_task_s > 0.05:
+                # long tasks: committing a deep batch to one worker would
+                # serialize them and hide the backlog from lease growth,
+                # spillback and the autoscaler — dispatch one at a time
+                chunk = 1
             for w in free:
                 if state.pending.empty():
                     break
@@ -1112,15 +1553,51 @@ class CoreClient:
         # demand = work still in the queue (the chunking above deliberately
         # leaves backlog in pending when lease headroom exists, so this
         # signal stays live for deep bursts — and goes quiet for small
-        # bursts fully committed to live workers, avoiding spawn churn)
+        # bursts fully committed to live workers, avoiding spawn churn),
+        # plus ring-queued fast tasks beyond one-per-worker — but only
+        # once that backlog persisted (micro-bursts drain in milliseconds
+        # and must not trigger worker spawns that eat their CPU)
+        fast_backlog = 0
+        if (state.fast_backlog_since
+                and time.monotonic() - state.fast_backlog_since > 0.5):
+            fast_backlog = sum(
+                max(0, len(w.fast_lane.inflight) - 1)
+                for w in state.workers if w.fast_lane is not None)
         want = min(
-            state.pending.qsize() - state.lease_requests_inflight,
+            state.pending.qsize() + fast_backlog
+            - state.lease_requests_inflight,
             self.cfg.max_lease_parallelism - state.lease_requests_inflight,
             spawn_cap - state.lease_requests_inflight,
         )
         for _ in range(max(0, want)):
             state.lease_requests_inflight += 1
             self._bg.spawn(self._request_lease(key, state), self.loop)
+        self._report_demand()
+
+    def _report_demand(self):
+        """Tell our raylet how much work is queued that no live lease or
+        in-flight lease request will absorb, so unsatisfiable backlog is
+        visible to the autoscaler even when this driver stops requesting
+        leases (ref: autoscaler v2 resource-demand reporting). Coalesced
+        and only sent on change."""
+        now = time.monotonic()
+        total = 0
+        for state in self.sched_keys.values():
+            backlog = state.pending.qsize()
+            durable = (state.fast_backlog_since
+                       and now - state.fast_backlog_since > 0.5)
+            for w in state.workers:
+                if w.fast_lane is not None and durable:
+                    backlog += max(0, len(w.fast_lane.inflight) - 1)
+                backlog += max(0, w.queued - 1)  # committed beyond executing
+            total += max(0, backlog - state.lease_requests_inflight)
+        if total == getattr(self, "_last_demand_report", 0):
+            return
+        self._last_demand_report = total
+        if self.raylet is not None and not self.raylet._closed:
+            self._bg.spawn(
+                self.raylet.call("report_demand", {"count": total}),
+                self.loop)
 
     async def _request_lease(self, key, state: _SchedulingKeyState):
         try:
@@ -1168,6 +1645,14 @@ class CoreClient:
                     state.workers.append(w)
                     state.lease_failures = 0
                     state.lease_failure_sig = None
+                    if (self.cfg.fastpath_enabled
+                            and self.store is not None
+                            and payload["language"] == "python"
+                            and pg_hex is None
+                            and tuple(raylet_addr)
+                            == tuple(self.raylet_address)):
+                        self._bg.spawn(
+                            self._fast_attach(key, state, w), self.loop)
                     # arm the idle-return timer NOW: a lease granted after
                     # the backlog drained may never run a task, and the
                     # post-task timer alone would leak it (and its CPUs)
@@ -1246,6 +1731,8 @@ class CoreClient:
             if w.tpu_chips:
                 spec["tpu_chips"] = w.tpu_chips
         done: list = []
+        w.queued = len(todo)  # committed depth: demand accounting
+        t_dispatch = time.monotonic()
         try:
             if len(todo) == 1 or key[0].startswith(b"cpp:"):
                 # C++ workers speak the single-push protocol only (their
@@ -1253,6 +1740,7 @@ class CoreClient:
                 for spec in todo:
                     done.append(
                         (spec, await w.conn.call("push_task", {"spec": spec})))
+                    w.queued -= 1
             else:
                 # one frame out, one reply per task back as each finishes
                 futs = w.conn.call_scatter(
@@ -1260,6 +1748,7 @@ class CoreClient:
                 for idx, (spec, fut) in enumerate(zip(todo, futs)):
                     try:
                         done.append((spec, await fut))
+                        w.queued -= 1
                     except rpc.ConnectionLost:
                         # later batch-mates may have RESOLVED before the
                         # connection died (replies arrive out of order):
@@ -1307,6 +1796,7 @@ class CoreClient:
                 self._task_worker.pop(spec["task_id"], None)
                 self._complete_task_error(spec, e)
                 state.inflight_tasks -= 1
+            w.queued = 0
             w.busy = False
             w.idle_since = time.monotonic()
             await self._pump(key, state)
@@ -1316,6 +1806,11 @@ class CoreClient:
             self._task_worker.pop(spec["task_id"], None)
             self._apply_task_reply(spec, reply)
             state.inflight_tasks -= 1
+        w.queued = 0
+        if done:
+            per_task = (time.monotonic() - t_dispatch) / len(done)
+            state.avg_task_s = (0.7 * state.avg_task_s + 0.3 * per_task
+                                if state.avg_task_s else per_task)
         w.busy = False
         w.idle_since = time.monotonic()
         await self._pump(key, state)
@@ -1431,6 +1926,8 @@ class CoreClient:
         so the stream fails fast instead."""
         if w in state.workers:
             state.workers.remove(w)
+        if w.fast_lane is not None:
+            self._fast_break_lane(w.fast_lane)
         self._task_worker.pop(spec["task_id"], None)
         if spec["task_id"] in self._cancelled_tasks:
             self._complete_task_error(
@@ -1456,9 +1953,13 @@ class CoreClient:
         await asyncio.sleep(self.cfg.worker_lease_timeout_s)
         if w.busy or w not in state.workers:
             return
+        if w.fast_lane is not None and w.fast_lane.inflight:
+            return  # fast tasks in flight; their drain re-arms the watcher
         if time.monotonic() - w.idle_since < self.cfg.worker_lease_timeout_s * 0.9:
             return
         state.workers.remove(w)
+        if w.fast_lane is not None:
+            self._fast_break_lane(w.fast_lane)
         try:
             if w.conn is not None:
                 await w.conn.close()
@@ -1979,6 +2480,14 @@ class CoreClient:
     async def close(self):
         await self.task_events.flush()
         self._closed = True
+        for lane in list(self._fast_lanes):
+            # wake pump+sweeper (the sweeper owns the unmap); unlink the
+            # name NOW so daemon threads killed at exit can't leak /dev/shm
+            lane.broken = True
+            lane.resume_evt.set()
+            lane.ring.close(0)
+            lane.ring.close(1)
+            lane.ring.unlink()
         await self._bg.cancel_all()
         # return all leases
         for key, state in self.sched_keys.items():

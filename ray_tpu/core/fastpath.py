@@ -1,0 +1,276 @@
+"""Steady-state task-submission fast path over native shm rings.
+
+The role of the reference's C++ steady-state submit loop (ref:
+src/ray/core_worker/transport/normal_task_submitter.cc:28 lease-cached
+PushTask pipelining, core_worker.cc:2500 SubmitTask): once a lease is
+cached for a scheduling key, pushing one more task of the same shape and
+reading its reply should never touch an event loop, a socket, or a
+serialized RPC frame on either side.
+
+Mechanics: at lease grant the driver creates a :class:`RingPair` — one
+POSIX shm segment holding two SPSC byte rings (native side:
+_native/src/ring.cc) — and tells the worker to attach. Eligible submits
+(plain sync function, inline args, single return, default scheduling)
+pickle ``(task_id, func_id, args, kwargs)`` into the submit ring straight
+from the calling thread; the worker's pump thread pops batches, executes
+on the worker's single task-executor thread, and pushes packed results
+into the reply ring; a driver reader thread completes blocking ``get()``s
+directly and trickles the results onto the event loop for everything else
+(memory-store entries, task events, wait()).
+
+Anything that doesn't fit — object-ref args, generators, actors with
+options, worker death mid-flight — falls back to the ordinary RPC path,
+which stays the single source of truth for scheduling semantics.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pickle
+import struct
+import threading
+
+from ray_tpu import _native
+from ray_tpu.utils import serialization
+
+SUB = 0  # driver -> worker (task records)
+REP = 1  # worker -> driver (result records)
+
+# reply status codes
+OK = 0        # payload = packed inline value
+OK_SHM = 1    # result stored in the node's shm arena under the return oid
+ERR = 2       # payload = pickled TaskError
+NEED_SLOW = 3  # func not executable on the fast path: resubmit via RPC
+
+_ST_OK = 0
+_ST_TIMEOUT = -4
+_ST_CLOSED = -7
+_ST_TOOBIG = -9
+
+
+class RingClosed(Exception):
+    pass
+
+
+class RingPair:
+    """ctypes face of one rt_ring pair (see ring.cc for the protocol).
+
+    Lifecycle safety: any thread may call :meth:`close` (it only flips the
+    in-shm closed flags and wakes sleepers), but :meth:`close_pair` unmaps
+    the segment — it marks the handle dead, wakes every blocked call, and
+    waits for in-flight C calls to drain before the munmap, so no thread
+    can touch freed memory."""
+
+    def __init__(self, name: str, handle: int, owner: bool):
+        self.name = name
+        self._h = handle
+        self._owner = owner
+        self._lib = _native.get_lib()
+        self._popbuf = ctypes.create_string_buffer(1 << 20)
+        self._dead = threading.Event()  # close_pair started
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    @classmethod
+    def create(cls, name: str, cap_each: int) -> "RingPair":
+        lib = _native.get_lib()
+        h = lib.rt_ring_pair_create(name.encode(), cap_each)
+        if not h:
+            raise OSError(f"could not create ring shm {name}")
+        return cls(name, h, owner=True)
+
+    @classmethod
+    def open(cls, name: str) -> "RingPair":
+        lib = _native.get_lib()
+        h = lib.rt_ring_pair_open(name.encode())
+        if not h:
+            raise OSError(f"could not open ring shm {name}")
+        return cls(name, h, owner=False)
+
+    def _enter(self) -> bool:
+        with self._cv:
+            if self._dead.is_set():
+                return False
+            self._inflight += 1
+            return True
+
+    def _exit(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._cv.notify_all()
+
+    def push(self, which: int, payload: bytes, timeout_ms: int = -1) -> int:
+        """Returns a _ST_* status; never raises on full/closed."""
+        if not self._enter():
+            return _ST_CLOSED
+        try:
+            return self._lib.rt_ring_push(
+                self._h, which, payload, len(payload), timeout_ms)
+        finally:
+            self._exit()
+
+    def push_raw(self, which: int, framed: bytes, timeout_ms: int = -1) -> int:
+        if not self._enter():
+            return _ST_CLOSED
+        try:
+            return self._lib.rt_ring_push_raw(
+                self._h, which, framed, len(framed), timeout_ms)
+        finally:
+            self._exit()
+
+    def pop_batch(self, which: int, timeout_ms: int) -> list[bytes] | None:
+        """None once closed AND drained; [] on timeout."""
+        if not self._enter():
+            return None
+        try:
+            n = self._lib.rt_ring_pop_batch(
+                self._h, which,
+                ctypes.cast(self._popbuf, ctypes.POINTER(ctypes.c_uint8)),
+                len(self._popbuf), timeout_ms)
+        finally:
+            self._exit()
+        if n == _ST_CLOSED:
+            return None
+        if n <= 0:
+            return []
+        return unframe(self._popbuf.raw[:n])
+
+    def pending(self, which: int) -> int:
+        if not self._enter():
+            return 0
+        try:
+            return self._lib.rt_ring_pending(self._h, which)
+        finally:
+            self._exit()
+
+    def close(self, which: int) -> None:
+        if not self._enter():
+            return
+        try:
+            self._lib.rt_ring_close(self._h, which)
+        finally:
+            self._exit()
+
+    def is_closed(self, which: int) -> bool:
+        if not self._enter():
+            return True
+        try:
+            return bool(self._lib.rt_ring_closed(self._h, which))
+        finally:
+            self._exit()
+
+    def close_pair(self) -> None:
+        with self._cv:
+            if self._dead.is_set():
+                return
+            self._dead.set()
+        # wake every blocked call (handle still mapped), then wait for the
+        # in-flight count to drain before unmapping
+        self._lib.rt_ring_close(self._h, SUB)
+        self._lib.rt_ring_close(self._h, REP)
+        with self._cv:
+            while self._inflight > 0:
+                self._cv.wait(1.0)
+        self._lib.rt_ring_pair_close(self._h)
+        if self._owner:
+            self._lib.rt_ring_pair_destroy(self.name.encode())
+
+    def unlink(self) -> None:
+        """Remove the shm name now (mapping stays valid until close_pair);
+        idempotent, so teardown can't leak /dev/shm entries even if the
+        owning reader thread never gets to run again."""
+        self._lib.rt_ring_pair_destroy(self.name.encode())
+
+
+def frame(records: list[bytes]) -> bytes:
+    """[u32 len][payload] per record, 8-aligned — rt_ring_push_raw format."""
+    parts = []
+    for rec in records:
+        pad = (-(4 + len(rec))) % 8
+        parts.append(struct.pack("<I", len(rec)) + rec + b"\x00" * pad)
+    return b"".join(parts)
+
+
+def unframe(buf: bytes) -> list[bytes]:
+    out = []
+    off = 0
+    n = len(buf)
+    while off + 4 <= n:
+        (ln,) = struct.unpack_from("<I", buf, off)
+        out.append(buf[off + 4:off + 4 + ln])
+        off += (4 + ln + 7) & ~7
+    return out
+
+
+_SIMPLE = (int, float, str, bytes, bool, type(None))
+
+
+def _simple(x, depth: int = 2) -> bool:
+    if isinstance(x, _SIMPLE):
+        return True
+    if depth:
+        if isinstance(x, (list, tuple)):
+            return all(_simple(v, depth - 1) for v in x)
+        if isinstance(x, dict):
+            return all(isinstance(k, _SIMPLE) and _simple(v, depth - 1)
+                       for k, v in x.items())
+    return False
+
+
+def pack_task(task_id: bytes, func_id: bytes, args, kwargs) -> bytes:
+    """Two-tier arg encoding. Simple immutables take the C pickler (the
+    submission hot path — a Python-level reducer hook here measured ~2x on
+    the whole bench); anything else goes through serialization.pack, whose
+    rules match the RPC path: functions/classes from __main__ or test
+    modules ship by value, jax arrays devolve to numpy, nested ObjectRefs
+    run the borrow protocol. Plain pickle would encode those by reference
+    and silently mean something else on the worker."""
+    if _simple(args) and (not kwargs or _simple(kwargs)):
+        return b"P" + pickle.dumps(
+            (task_id, func_id, args, kwargs), protocol=5)
+    return b"S" + serialization.pack((task_id, func_id, args, kwargs))
+
+
+def unpack_task(rec: bytes):
+    if rec[:1] == b"P":
+        return pickle.loads(rec[1:])
+    return serialization.unpack(rec[1:])
+
+
+def pack_reply(task_id: bytes, status: int, payload: bytes) -> bytes:
+    return struct.pack("<16sI", task_id, status) + payload
+
+
+def unpack_reply(rec: bytes):
+    task_id, status = struct.unpack_from("<16sI", rec)
+    return task_id, status, rec[20:]
+
+
+class FastLane:
+    """Driver-side state for one leased worker's ring (submission side).
+
+    ``inflight`` maps task_id -> the light lineage tuple
+    ``(func_id, args, kwargs, resources, max_retries, name)`` needed to
+    rebuild a full spec if the worker dies. Guarded by the CoreClient's
+    fast condition variable; the reader thread pops entries as replies
+    arrive.
+    """
+
+    __slots__ = ("ring", "worker", "key", "inflight", "broken", "reader",
+                 "return_armed", "rx_lock", "user_wants", "resume_evt")
+
+    def __init__(self, ring: RingPair, worker, key):
+        self.ring = ring
+        self.worker = worker
+        self.key = key
+        self.inflight: dict = {}
+        self.broken = False
+        self.reader: threading.Thread | None = None
+        self.return_armed = False  # one idle lease-return watcher at a time
+        # reply-ring consumer election: a blocking get() steals consumption
+        # from the sweeper thread (one thread hop fewer per result); the
+        # sweeper parks while user_wants is recent.
+        self.rx_lock = threading.Lock()
+        self.user_wants = 0.0  # monotonic ts of the last stealing get()
+        self.resume_evt = threading.Event()

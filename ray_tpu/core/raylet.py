@@ -215,6 +215,10 @@ class Raylet:
         self.all_workers: dict[WorkerID, WorkerHandle] = {}
         self._pending_lease_q: asyncio.Queue = asyncio.Queue()
         self._lease_waiters: list[tuple[dict, asyncio.Future, tuple | None]] = []
+        # client-reported task backlog (work queued driver-side that is not
+        # a parked lease request), summed into the heartbeat demand signal
+        # (ref: autoscaler v2 resource-demand reporting, autoscaler.proto)
+        self._demand_reports: dict[int, int] = {}
         self.cluster_view: list[dict] = []
         # object spilling (ref: local_object_manager.h:42): sealed objects
         # move to disk under arena pressure and restore on demand
@@ -342,8 +346,10 @@ class Raylet:
                      # reordered/stale reports (ray_syncer.h versioning)
                      "version": next(self._view_versions),
                      # demand signal for the autoscaler (ref: autoscaler v2
-                     # resource-demand reporting)
-                     "queued_leases": len(self._lease_waiters)},
+                     # resource-demand reporting): parked lease requests
+                     # plus client-reported driver-side backlog
+                     "queued_leases": len(self._lease_waiters)
+                     + sum(self._demand_reports.values())},
                 )
                 failures = 0
                 if isinstance(reply, dict) and not reply.get("ok", True):
@@ -680,6 +686,7 @@ class Raylet:
         self._lease_waiters = still
 
     def _on_client_disconnect(self, conn):
+        self._demand_reports.pop(id(conn), None)
         for key in [k for k in self._transfer_pins if k[0] is conn]:
             self._release_transfer_pin(conn, key[1])
         for resources, fut, pg_key, waiter_conn in self._lease_waiters:
@@ -758,6 +765,18 @@ class Raylet:
     async def rpc_return_bundle(self, conn, p):
         self.ledger.return_bundle((p["pg_id"], p["bundle_index"]))
         return {"ok": True}
+
+    async def rpc_report_demand(self, conn, p):
+        """Client backlog report: tasks queued driver-side (including shm
+        fast-path rings) that no live lease can absorb. Feeds the
+        autoscaler via the heartbeat demand signal (ref: autoscaler v2
+        resource-demand reporting). Latest report per client wins."""
+        count = int(p.get("count", 0))
+        if count <= 0:
+            self._demand_reports.pop(id(conn), None)
+        else:
+            self._demand_reports[id(conn)] = count
+        return True
 
     # -------------------------------------------------------- object plane
     async def rpc_register_client(self, conn, p):

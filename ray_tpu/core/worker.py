@@ -36,10 +36,11 @@ from ray_tpu.config import get_config
 from ray_tpu.core.core_client import CoreClient, _pack_bytes
 from ray_tpu.core.ref import ObjectRef, TaskError
 from ray_tpu.utils import metrics, rpc, serialization
-from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, WorkerID
+from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 
 
 _current_worker = None  # set by Worker.start(): runtime_context introspection
+_profiler = None  # RT_WORKER_PROFILE_DIR cProfile, dumped on exit_worker
 
 
 class Worker:
@@ -71,6 +72,8 @@ class Worker:
         self._method_groups: dict = {}
         self._group_execs: dict = {}
         self._group_sems: dict = {}
+        # fast-path rings attached by drivers (see core/fastpath.py)
+        self._fast_rings: list = []
 
     async def start(self):
         # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
@@ -232,6 +235,141 @@ class Worker:
             loop.call_soon(os._exit, 1)  # reply first, then die
             return True
         return False
+
+    # ------------------------------------------------ fast path (shm rings)
+    async def rpc_attach_fast_ring(self, conn, p):
+        """Driver attaches a shm task ring (see core/fastpath.py). The pump
+        thread lives until the ring closes (driver teardown or our exit)."""
+        import threading
+
+        from ray_tpu.core import fastpath
+
+        ring = fastpath.RingPair.open(p["name"])
+        self._fast_rings.append(ring)
+        loop = asyncio.get_running_loop()
+        t = threading.Thread(
+            target=self._fast_pump, args=(ring, loop),
+            name="rt-fastpump", daemon=True)
+        t.start()
+        return True
+
+    def _fast_pump(self, ring, loop):
+        """Pump thread: pop task records, execute, reply in one framed
+        push. No asyncio, no sockets — see fastpath.py.
+
+        Normal tasks execute INLINE on this thread rather than hopping to
+        the task executor: on a single-core host each thread handoff
+        measured ~100us — more than the task itself. Normal tasks are
+        stateless by contract (only actors own thread-affine state), so
+        thread identity is not observable; execution stays one-at-a-time
+        per worker because this worker's fast records all flow through
+        this one pump."""
+        from ray_tpu.core import fastpath
+
+        inline_max = self.cfg.max_inline_object_size
+        fast_funcs: dict[bytes, object] = {}
+
+        def load(func_id):
+            fn = fast_funcs.get(func_id)
+            if fn is not None:
+                return fn
+            try:
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._load_function(func_id), loop)
+                fn = fut.result(15)
+            except Exception:
+                fast_funcs[func_id] = False
+                return False
+            if (not callable(fn)
+                    or inspect.iscoroutinefunction(fn)
+                    or inspect.isgeneratorfunction(fn)
+                    or inspect.isasyncgenfunction(fn)):
+                fn = False  # needs the RPC path (streaming/async machinery)
+            fast_funcs[func_id] = fn
+            return fn
+
+        try:
+            while not self._exit_requested:
+                recs = ring.pop_batch(fastpath.SUB, timeout_ms=1000)
+                if recs is None:
+                    break  # ring closed by the driver
+                if not recs:
+                    continue
+                replies = []
+                bad_record = False
+                for rec in recs:
+                    try:
+                        tid, func_id, args, kwargs = fastpath.unpack_task(rec)
+                    except Exception:
+                        # undecodable record: without its task id there is
+                        # nothing to reply to. Flush the replies of the
+                        # batch-mates that ALREADY executed, then close the
+                        # ring so the driver recovers only the rest —
+                        # otherwise completed side effects would re-run.
+                        bad_record = True
+                        break
+                    fn = load(func_id)
+                    if not fn:
+                        replies.append(
+                            fastpath.pack_reply(tid, fastpath.NEED_SLOW, b""))
+                        continue
+                    try:
+                        ok, val = True, fn(*args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001 — reply on
+                        ok, val = False, e
+                    replies.append(
+                        self._fast_pack_result(tid, ok, val, inline_max))
+                status = 0
+                if replies:
+                    status = ring.push_raw(
+                        fastpath.REP, fastpath.frame(replies))
+                if bad_record or status != 0:
+                    break  # ring closed/undecodable: driver recovers
+        finally:
+            # on ANY exit — clean close or unexpected error — close the
+            # ring so the driver's side breaks the lane and resubmits
+            # in-flight tasks instead of waiting forever
+            for i, r in enumerate(self._fast_rings):
+                if r is ring:
+                    del self._fast_rings[i]
+                    break
+            ring.close_pair()
+
+    # every reply record must fit the driver's fixed pop buffer (1 MB); an
+    # oversized record would wedge the ring (pop can never drain it)
+    _FAST_ERR_MAX = 256 * 1024
+
+    def _fast_pack_result(self, tid: bytes, ok: bool, val, inline_max: int):
+        from ray_tpu.core import fastpath
+
+        if not ok:
+            return fastpath.pack_reply(tid, fastpath.ERR,
+                                       self._fast_pack_error(val))
+        try:
+            meta, buffers = serialization.dumps_with_buffers(val)
+            size = serialization.total_size(meta, buffers)
+            if size <= inline_max:
+                return fastpath.pack_reply(
+                    tid, fastpath.OK, _pack_bytes(meta, buffers, size))
+            # big result: place it in the node's arena under the return oid
+            # (same-node owner reads it directly; location registration is
+            # the owner's migration step)
+            oid = ObjectID.for_task_return(TaskID(tid), 0)
+            payload = _pack_bytes(meta, buffers, size)
+            if not self.core.store.contains(oid):  # retry may have stored it
+                self.core.store.put_raw(oid, payload)
+            return fastpath.pack_reply(tid, fastpath.OK_SHM, b"")
+        except Exception as e:
+            return fastpath.pack_reply(tid, fastpath.ERR,
+                                       self._fast_pack_error(e))
+
+    def _fast_pack_error(self, exc) -> bytes:
+        payload = cloudpickle.dumps(_as_task_error(exc))
+        if len(payload) > self._FAST_ERR_MAX:
+            payload = cloudpickle.dumps(TaskError(
+                f"{type(exc).__name__} (detail truncated: pickled error "
+                f"was {len(payload)} bytes)"))
+        return payload
 
     async def rpc_push_task_multi(self, conn, p):
         """Scatter-push handler: ONE frame carries many (corr_id, payload)
@@ -889,6 +1027,11 @@ class Worker:
 
     async def rpc_exit_worker(self, conn, p):
         self._exit_requested = True
+        if _profiler is not None:  # RT_WORKER_PROFILE_DIR diagnosis mode
+            _profiler.disable()
+            _profiler.dump_stats(os.path.join(
+                os.environ["RT_WORKER_PROFILE_DIR"],
+                f"worker-{os.getpid()}.prof"))
         asyncio.get_running_loop().call_later(0.05, os._exit, 0)
         return True
 
@@ -909,6 +1052,22 @@ def main():
         await worker.start()
         await asyncio.Event().wait()
 
+    prof_dir = os.environ.get("RT_WORKER_PROFILE_DIR")
+    if prof_dir:  # perf diagnosis: dump per-worker cProfile stats at exit
+        import cProfile
+        import signal
+
+        global _profiler
+        _profiler = cProfile.Profile()
+        _profiler.enable()
+
+        def _dump(signum, frame):
+            _profiler.disable()
+            _profiler.dump_stats(
+                os.path.join(prof_dir, f"worker-{os.getpid()}.prof"))
+            os._exit(0)
+
+        signal.signal(signal.SIGTERM, _dump)
     try:
         asyncio.run(run())
     except KeyboardInterrupt:

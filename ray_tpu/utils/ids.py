@@ -9,8 +9,10 @@ relies on (ref: src/ray/object_manager/ownership_object_directory.cc).
 
 from __future__ import annotations
 
+import itertools
 import os
 import struct
+import threading as _threading
 
 _TASK_ID_SIZE = 16
 _UNIQUE_ID_SIZE = 16
@@ -93,16 +95,33 @@ class TaskID(BaseID):
     # from normal tasks without per-task state (cancel semantics differ).
     _ACTOR_MARK = 0xA5
 
+    # Normal task ids are a random per-process prefix + a counter: one
+    # urandom syscall per process instead of one per task (ids are a
+    # measurable slice of the submission hot path). itertools.count is the
+    # counter because its __next__ is a single C step — generate() is
+    # called concurrently from user and loop threads and a Python-level
+    # read-modify-write would mint duplicate ids. The final byte is the
+    # kind tag (never _ACTOR_MARK for normal tasks).
+    _gen_prefix: bytes = b""
+    _gen_counter = None
+    _gen_pid: int = -1
+    _gen_lock = _threading.Lock()
+
     @classmethod
     def for_driver(cls, job_id: JobID) -> "TaskID":
         return cls(job_id.binary() + b"\x00" * (cls.SIZE - JobID.SIZE))
 
     @classmethod
     def generate(cls):
-        raw = bytearray(os.urandom(cls.SIZE))
-        if raw[-1] == cls._ACTOR_MARK:
-            raw[-1] ^= 0xFF
-        return cls(bytes(raw))
+        if cls._gen_pid != os.getpid():  # fresh process or fork
+            with cls._gen_lock:
+                if cls._gen_pid != os.getpid():
+                    cls._gen_prefix = os.urandom(cls.SIZE - 8)
+                    cls._gen_counter = itertools.count()
+                    cls._gen_pid = os.getpid()
+        n = next(cls._gen_counter) % (1 << 56)
+        tail = n.to_bytes(7, "little") + b"\x00"
+        return cls(cls._gen_prefix + tail)
 
     @classmethod
     def generate_actor(cls) -> "TaskID":

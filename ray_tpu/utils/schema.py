@@ -17,7 +17,7 @@ from __future__ import annotations
 
 # (major, minor): bump MAJOR for incompatible changes (renamed/removed
 # methods, changed field meaning), MINOR for additions.
-PROTOCOL_VERSION = (1, 2)
+PROTOCOL_VERSION = (1, 3)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -73,6 +73,9 @@ CATALOG: dict[str, dict[str, dict]] = {
             "language": "python|cpp (since 1.1)"}},
         "return_lease": {"since": (1, 0), "fields": {
             "lease_id": "int", "kill": "bool"}},
+        "report_demand": {"since": (1, 3), "fields": {
+            "count": "int — driver-side queued tasks no live lease will "
+                     "absorb (autoscaler demand signal)"}},
         "worker_ready": {"since": (1, 0), "fields": {
             "worker_id": "hex", "address": "(host, port)", "pid": "int",
             "language": "str (since 1.1)"}},
@@ -141,6 +144,9 @@ CATALOG: dict[str, dict[str, dict]] = {
         "exit_worker": {"since": (1, 0), "fields": {}},
         "ping": {"since": (1, 0), "fields": {}},
         "start_dag_loop": {"since": (1, 0), "fields": {"schedule": "dict"}},
+        "attach_fast_ring": {"since": (1, 3), "fields": {
+            "name": "str — shm name of the task RingPair this worker "
+                    "should pump (see core/fastpath.py)"}},
     },
 }
 
