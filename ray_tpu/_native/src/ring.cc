@@ -336,7 +336,16 @@ int64_t rt_ring_pop_batch(void* hp, int which, uint8_t* out, uint64_t outcap,
     uint32_t len32;
     copy_out(data, r->capacity, r->tail, (uint8_t*)&len32, 4);
     uint64_t rec = align_up(4 + (uint64_t)len32, 8);
-    if (written + rec > outcap) break;
+    if (written + rec > outcap) {
+      if (written == 0) {
+        // head record alone exceeds the caller's buffer: returning 0
+        // would look like a timeout forever — surface a hard error so
+        // the caller tears the ring down instead of spinning
+        pthread_mutex_unlock(&r->mu);
+        return kTooBig;
+      }
+      break;
+    }
     copy_out(data, r->capacity, r->tail, out + written, rec);
     __atomic_store_n(&r->tail, r->tail + rec, __ATOMIC_RELEASE);
     written += rec;

@@ -985,11 +985,15 @@ class CoreClient:
             rec = fastpath.pack_task(tid, func_id, args, kwargs)
         except Exception:
             return None  # plain pickle can't carry it: cloudpickle path
-        if len(rec) > self.cfg.fastpath_record_max:
+        # cap also guards the pop buffer: a record the consumer can never
+        # pop would wedge the ring (see rt_ring_pop_batch's kTooBig)
+        if len(rec) > min(self.cfg.fastpath_record_max, (1 << 20) - 64):
             return None  # big args belong in the object store
         oid = ObjectID.for_task_return(task_id, 0)
         light = (fn, args, kwargs, resources)
         with self._fast_cv:
+            if lane.broken:
+                return None  # lost the race with a lane retire/break
             lane.inflight[task_id] = light
             self._fast_oid_lane[oid] = lane
         self.memory_store[oid] = _MemEntry()
@@ -1160,6 +1164,21 @@ class CoreClient:
             "scheduling_node": None,
             "runtime_env": self.default_runtime_env,
         }
+
+    def _fast_try_retire_lane(self, lane) -> bool:
+        """Idle-lease-return teardown: atomically stop new fast submits
+        and confirm nothing is in flight. A worker being retired is ALIVE
+        — its pump drains the ring before exiting — so the break-lane
+        resubmission path must never fire here (a task both drained and
+        resubmitted would execute twice). Returns False (lane stays live)
+        if a racing submit got in between the idle check and the break."""
+        with self._fast_cv:
+            if not lane.broken:
+                if lane.inflight:
+                    return False
+                lane.broken = True
+        self._fast_break_lane(lane)  # leftovers empty by construction
+        return True
 
     def _fast_break_lane(self, lane):
         """Thread-safe: stop routing to this lane and resubmit whatever is
@@ -1957,9 +1976,10 @@ class CoreClient:
             return  # fast tasks in flight; their drain re-arms the watcher
         if time.monotonic() - w.idle_since < self.cfg.worker_lease_timeout_s * 0.9:
             return
+        if w.fast_lane is not None and not self._fast_try_retire_lane(
+                w.fast_lane):
+            return  # a submit raced the idle check: lane is live again
         state.workers.remove(w)
-        if w.fast_lane is not None:
-            self._fast_break_lane(w.fast_lane)
         try:
             if w.conn is not None:
                 await w.conn.close()

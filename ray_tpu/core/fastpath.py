@@ -130,7 +130,10 @@ class RingPair:
                 len(self._popbuf), timeout_ms)
         finally:
             self._exit()
-        if n == _ST_CLOSED:
+        if n == _ST_CLOSED or n == _ST_TOOBIG:
+            # closed, or a record that can never fit the pop buffer:
+            # either way this ring is done — the caller breaks the lane
+            # and recovers over RPC
             return None
         if n <= 0:
             return []

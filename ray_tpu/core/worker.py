@@ -319,10 +319,24 @@ class Worker:
                         ok, val = False, e
                     replies.append(
                         self._fast_pack_result(tid, ok, val, inline_max))
+                # chunked reply push: one frame per ~512KB so a big batch
+                # of mid-size results can never exceed the reply ring's
+                # capacity (kTooBig) or the driver's fixed pop buffer
                 status = 0
-                if replies:
+                chunk: list = []
+                chunk_bytes = 0
+                for reply in replies:
+                    if chunk and chunk_bytes + len(reply) > 512 * 1024:
+                        status = ring.push_raw(
+                            fastpath.REP, fastpath.frame(chunk))
+                        if status != 0:
+                            break
+                        chunk, chunk_bytes = [], 0
+                    chunk.append(reply)
+                    chunk_bytes += len(reply)
+                if status == 0 and chunk:
                     status = ring.push_raw(
-                        fastpath.REP, fastpath.frame(replies))
+                        fastpath.REP, fastpath.frame(chunk))
                 if bad_record or status != 0:
                     break  # ring closed/undecodable: driver recovers
         finally:

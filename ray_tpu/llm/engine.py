@@ -51,72 +51,41 @@ def _lora_delta(h, loras, name, aid):
 from ray_tpu.llm.generation import _ffn, _gqa_attn  # noqa: E402
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(5, 6))
-def paged_prefill(params, loras, aid, tokens, pages, kpool, vpool,
-                  true_len, cfg: LlamaConfig):
-    """Process one request's prompt; scatter its KV into ``pages``.
-
-    tokens: [1, Tp] RIGHT-padded prompt; true_len: scalar real length;
-    pages: [n] pool page indices covering Tp (Tp = n * page_size).
-    Returns (last-real-position logits [V], kpool, vpool)."""
-    B, Tp = tokens.shape
-    L, P, PS, KV, hd = kpool.shape
-    cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    positions = jnp.arange(Tp)[None, :]
-    idx = jnp.arange(Tp)
-    mask = idx[None, :, None] >= idx[None, None, :]  # causal
-
-    row = pages[idx // PS]  # pool row per prompt position
-    off = idx % PS
-    x = params["tok"]["embedding"][tokens]
-    for i in range(cfg.n_layers):
-        layer = params[f"layers_{i}"]
-        h = rms_norm(x, layer["attn_norm"]["scale"])
-        q = (h @ layer["wq"]["kernel"] + _lora_delta(h, loras, "wq", aid)
-             ).reshape(B, Tp, cfg.n_heads, hd)
-        k = (h @ layer["wk"]["kernel"]).reshape(B, Tp, KV, hd)
-        v = (h @ layer["wv"]["kernel"] + _lora_delta(h, loras, "wv", aid)
-             ).reshape(B, Tp, KV, hd)
-        q = rope(q, cos, sin, positions)
-        k = rope(k, cos, sin, positions)
-        kpool = kpool.at[i, row, off].set(k[0])
-        vpool = vpool.at[i, row, off].set(v[0])
-        att = _gqa_attn(q, k, v, mask)
-        x = x + att.reshape(B, Tp, -1) @ layer["wo"]["kernel"]
-        x = _ffn(layer, x)
-    x = rms_norm(x, params["norm"]["scale"])
-    logits = x[0, true_len - 1] @ params["lm_head"]["kernel"]
-    return logits, kpool, vpool
-
-
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(6, 7))
-def paged_decode_step(params, loras, aids, tokens, seq_lens, page_tables,
-                      kpool, vpool, active, temps, key, cfg: LlamaConfig):
+def _decode_body(params, loras, aids, tokens, pos, page_tables,
+                 kpool, vpool, active, temps, key, cfg: LlamaConfig):
     """One decode step for every slot (masked where inactive).
 
-    tokens: [B] current input token; seq_lens: [B] tokens already cached
-    (the new token lands at that position); page_tables: [B, MAXP];
-    aids: [B] adapter ids; temps: [B]. Returns (next_tok [B], kpool, vpool).
-    """
+    tokens: [B] current input token; pos: [B] tokens already cached (the
+    new token lands at that position); page_tables: [B, MAXP]; aids: [B]
+    adapter ids; temps: [B]. Returns (next_tok [B], kpool, vpool)."""
     B = tokens.shape[0]
     L, P, PS, KV, hd = kpool.shape
     MAXP = page_tables.shape[1]
     cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
-    pos = seq_lens
     positions = pos[:, None]
     row = jnp.take_along_axis(page_tables, (pos // PS)[:, None], axis=1)[:, 0]
     off = pos % PS
     key_idx = jnp.arange(MAXP * PS)
     mask = key_idx[None, None, :] <= pos[:, None, None]
 
+    Dq = cfg.n_heads * hd
+    Dkv = KV * hd
     x = params["tok"]["embedding"][tokens][:, None, :]
     for i in range(cfg.n_layers):
         layer = params[f"layers_{i}"]
         h = rms_norm(x, layer["attn_norm"]["scale"])
-        q = (h @ layer["wq"]["kernel"] + _lora_delta(h, loras, "wq", aids)
+        # fused qkv / gate-up matmuls: at decode batch sizes each step is
+        # dominated by per-op dispatch, not FLOPs — the concatenated
+        # weights are loop-invariant, so XLA hoists them out of the scan
+        # and every layer runs 2 fat matmuls instead of 5 thin ones
+        wqkv = jnp.concatenate(
+            [layer["wq"]["kernel"], layer["wk"]["kernel"],
+             layer["wv"]["kernel"]], axis=1)
+        qkv = h @ wqkv
+        q = (qkv[..., :Dq] + _lora_delta(h, loras, "wq", aids)
              ).reshape(B, 1, cfg.n_heads, hd)
-        k = (h @ layer["wk"]["kernel"]).reshape(B, 1, KV, hd)
-        v = (h @ layer["wv"]["kernel"] + _lora_delta(h, loras, "wv", aids)
+        k = qkv[..., Dq:Dq + Dkv].reshape(B, 1, KV, hd)
+        v = (qkv[..., Dq + Dkv:] + _lora_delta(h, loras, "wv", aids)
              ).reshape(B, 1, KV, hd)
         q = rope(q, cos, sin, positions)
         k = rope(k, cos, sin, positions)
@@ -126,15 +95,106 @@ def paged_decode_step(params, loras, aids, tokens, seq_lens, page_tables,
         vb = vpool[i][page_tables].reshape(B, MAXP * PS, KV, hd)
         att = _gqa_attn(q, kb, vb, mask)
         x = x + att.reshape(B, 1, -1) @ layer["wo"]["kernel"]
-        x = _ffn(layer, x)
+        hf = rms_norm(x, layer["ffn_norm"]["scale"])
+        w_gu = jnp.concatenate(
+            [layer["w_gate"]["kernel"], layer["w_up"]["kernel"]], axis=1)
+        gu = hf @ w_gu
+        ff = gu.shape[-1] // 2
+        x = x + (jax.nn.silu(gu[..., :ff]) * gu[..., ff:]
+                 ) @ layer["w_down"]["kernel"]
     x = rms_norm(x, params["norm"]["scale"])
     logits = x[:, 0] @ params["lm_head"]["kernel"]
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    sampled = jax.random.categorical(
-        key, logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
-    next_tok = jnp.where(temps > 0, sampled, greedy)
+
+    def sampled():
+        # Threefry bits for [B, V] gumbels are NOT free at decode batch
+        # sizes — only pay when some slot actually samples
+        s = jax.random.categorical(
+            key, logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
+        return jnp.where(temps > 0, s, greedy)
+
+    next_tok = jax.lax.cond(jnp.any(temps > 0), sampled, lambda: greedy)
     return jnp.where(active, next_tok, 0), kpool, vpool
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps"), donate_argnums=(6, 7))
+def paged_decode_multi(params, loras, aids, tokens, seq_lens, page_tables,
+                       kpool, vpool, active, temps, key, cfg: LlamaConfig,
+                       n_steps: int):
+    """``n_steps`` fused decode steps as ONE device program (lax.scan).
+
+    Decode is memory-bound; what killed throughput was the per-step host
+    round trip (dispatch latency + arg upload + token download + asyncio),
+    ~100x the step itself. Fusing K steps amortizes all of it K-fold; the
+    host sees tokens in [K, B] blocks. The final (tokens, positions) carry
+    is returned ON DEVICE so consecutive blocks chain without any host
+    round trip — the engine pipelines the next block's dispatch before
+    syncing this block's tokens. Slots that finish mid-block keep decoding
+    junk — their page-table gathers clip to allocated (or junk) pages,
+    future-position writes are masked until legitimately overwritten, and
+    the host discards the extra tokens, so over-decode is pure (bounded)
+    waste, never corruption."""
+    def step(carry, k):
+        tok, pos, kpool, vpool = carry
+        nxt, kpool, vpool = _decode_body(
+            params, loras, aids, tok, pos, page_tables, kpool, vpool,
+            active, temps, jax.random.fold_in(key, k), cfg)
+        return (nxt, pos + 1, kpool, vpool), nxt
+
+    (tok, pos, kpool, vpool), toks = jax.lax.scan(
+        step, (tokens, seq_lens, kpool, vpool), jnp.arange(n_steps))
+    return toks, tok, pos, kpool, vpool
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(5, 6))
+def paged_prefill_batch(params, loras, aids, tokens, pages, kpool, vpool,
+                        true_lens, temps, key, cfg: LlamaConfig):
+    """Prefill a whole admission wave as ONE batched forward.
+
+    tokens: [N, Tp_pad] right-padded prompts (same pad bucket); pages:
+    [N, n_pages] pool pages per request (dummy rows use the junk page 0);
+    true_lens/temps: [N]. Returns (first tokens [N], kpool, vpool).
+    Batching the wave (instead of scanning rows at batch 1) matters
+    because small-batch steps are per-op-overhead bound; one fat forward
+    amortizes it across the whole wave."""
+    N, Tp = tokens.shape
+    L, P, PS, KV, hd = kpool.shape
+    cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = jnp.arange(Tp)[None, :]
+    idx = jnp.arange(Tp)
+    mask = idx[None, :, None] >= idx[None, None, :]  # causal
+    rows = pages[:, idx // PS]  # [N, Tp] pool row per prompt position
+    offs = jnp.broadcast_to(idx % PS, (N, Tp))
+    x = params["tok"]["embedding"][tokens]  # [N, Tp, D]
+    for i in range(cfg.n_layers):
+        layer = params[f"layers_{i}"]
+        h = rms_norm(x, layer["attn_norm"]["scale"])
+        q = (h @ layer["wq"]["kernel"] + _lora_delta(h, loras, "wq", aids)
+             ).reshape(N, Tp, cfg.n_heads, hd)
+        k = (h @ layer["wk"]["kernel"]).reshape(N, Tp, KV, hd)
+        v = (h @ layer["wv"]["kernel"] + _lora_delta(h, loras, "wv", aids)
+             ).reshape(N, Tp, KV, hd)
+        q = rope(q, cos, sin, positions)
+        k = rope(k, cos, sin, positions)
+        kpool = kpool.at[i, rows, offs].set(k)
+        vpool = vpool.at[i, rows, offs].set(v)
+        att = _gqa_attn(q, k, v, mask)
+        x = x + att.reshape(N, Tp, -1) @ layer["wo"]["kernel"]
+        x = _ffn(layer, x)
+    x = rms_norm(x, params["norm"]["scale"])
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = last @ params["lm_head"]["kernel"]  # [N, V]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled():
+        s = jax.random.categorical(
+            key, logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
+        return jnp.where(temps > 0, s, greedy)
+
+    toks = jax.lax.cond(jnp.any(temps > 0), sampled, lambda: greedy)
+    return toks, kpool, vpool
 
 
 def make_lora_stack(cfg: LlamaConfig, adapters: dict[str, dict], rank: int):
@@ -170,6 +230,7 @@ class _Request:
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     slot: int = -1
     emitted: int = 0
+    planned: int = 0  # tokens scheduled on-device (planned mode)
     cancelled: bool = False
     finished: bool = False  # completed normally (max_tokens or eos)
 
@@ -186,7 +247,8 @@ class ContinuousBatchingEngine:
                  page_size: int = 16, n_pages: int = 256,
                  max_seq_len: int = 512, eos_id: int | None = None,
                  lora_adapters: dict[str, dict] | None = None,
-                 lora_rank: int = 8, max_waiting: int = 256):
+                 lora_rank: int = 8, max_waiting: int = 256,
+                 block_buckets: tuple[int, ...] = (4, 8, 16, 32, 64)):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
@@ -194,6 +256,11 @@ class ContinuousBatchingEngine:
         self.MAXP = -(-max_seq_len // page_size)
         self.eos_id = eos_id
         self.max_waiting = max_waiting
+        # fused-decode block sizes (one compiled program per bucket); the
+        # loop picks the smallest bucket covering the longest remaining
+        # request, so short interactive requests stay low-latency while
+        # long generations amortize dispatch 64x
+        self.block_buckets = tuple(sorted(block_buckets))
         dtype = jnp.dtype(cfg.dtype)
         self.kpool = jnp.zeros(
             (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim),
@@ -330,51 +397,93 @@ class ContinuousBatchingEngine:
         self.page_tables[slot, :] = 0
         self.seq_lens[slot] = 0
         if req is not None:
-            # move live → finished-awaiting-drain: stream() can still reach
-            # the queued tokens, cancel() only sees live requests, and the
-            # bounded _done map caps leakage from never-streamed submits
-            self._reqs.pop(req.req_id, None)
-            self._done[req.req_id] = req
-            while len(self._done) > self._done_cap:
-                self._done.popitem(last=False)
-            req.out.put_nowait(None)
+            self._finish_stream(req)
 
-    def _admit(self, req: _Request) -> bool:
-        """Prefill one waiting request into a free slot (between decode
-        steps — the running batch never drains first)."""
+    def _finish_stream(self, req: _Request) -> None:
+        """Unregister a request and close its token stream: live -> the
+        bounded finished-awaiting-drain map (stream() can still reach the
+        queued tokens; cancel() only sees live requests; the cap bounds
+        leakage from never-streamed submits)."""
+        self._reqs.pop(req.req_id, None)
+        self._done[req.req_id] = req
+        while len(self._done) > self._done_cap:
+            self._done.popitem(last=False)
+        req.out.put_nowait(None)
+
+    def _reserve_slot(self, req: _Request) -> int | None:
+        """Claim a slot + pages for one waiting request (host bookkeeping
+        only; the prefill itself is dispatched per wave)."""
         slot = next((i for i, r in enumerate(self.slot_req) if r is None), -1)
         if slot < 0:
-            return False
+            return None
         Tp = len(req.prompt)
         n_need = -(-(Tp + req.max_tokens) // self.PS)
         pages = self._alloc_pages(n_need)
         if pages is None:
-            return False
-        # pad the prompt to a page multiple (one prefill compile per bucket)
-        Tp_pad = -(-Tp // self.PS) * self.PS
-        toks = np.zeros((1, Tp_pad), np.int32)
-        toks[0, :Tp] = req.prompt
-        n_prompt_pages = Tp_pad // self.PS
-        logits, self.kpool, self.vpool = paged_prefill(
-            self.params, self.loras, jnp.int32(req.adapter),
-            jnp.asarray(toks), jnp.asarray(pages[:n_prompt_pages], jnp.int32),
-            self.kpool, self.vpool, jnp.int32(Tp), self.cfg)
-        if req.temperature > 0:
-            self._rng, sub = jax.random.split(self._rng)
-            tok = int(jax.random.categorical(
-                sub, logits / max(req.temperature, 1e-6)))
-        else:
-            tok = int(jnp.argmax(logits))
+            return None
         req.slot = slot
         self.slot_req[slot] = req
         self.page_tables[slot, :] = 0
         self.page_tables[slot, :n_need] = pages
         self.seq_lens[slot] = Tp
-        self.next_tok[slot] = tok
         self.temps[slot] = req.temperature
         self.aids[slot] = req.adapter
-        self._emit(req, tok)
-        return True
+        return slot
+
+    _WAVE_BUCKETS = (1, 2, 4, 8, 16)
+
+    def _admit_wave(self) -> bool:
+        """Admit every waiting request that fits, prefilling each pad
+        bucket's group in ONE device dispatch (one host sync per group,
+        not per request). Returns True if anything was admitted."""
+        groups = self._admit_dispatch()
+        for reqs, first in groups:
+            first = np.asarray(first)  # ONE sync per group
+            for j, req in enumerate(reqs):
+                self.next_tok[req.slot] = int(first[j])
+                self._emit(req, int(first[j]))
+        return bool(groups)
+
+    def _admit_dispatch(self) -> list[tuple[list[_Request], object]]:
+        """Reserve slots and DISPATCH batched prefills for every waiting
+        request that fits; no host sync — returns [(requests,
+        first-token device array)] per pad-bucket group."""
+        groups: dict[int, list[_Request]] = {}
+        while self.waiting:
+            nxt = self.waiting[0]
+            if nxt.cancelled:
+                self.waiting.pop(0)
+                self._finish_stream(nxt)
+                continue
+            if self._reserve_slot(nxt) is None:
+                break
+            self.waiting.pop(0)
+            Tp_pad = -(-len(nxt.prompt) // self.PS) * self.PS
+            groups.setdefault(Tp_pad, []).append(nxt)
+        out = []
+        for Tp_pad, reqs in groups.items():
+            npages = Tp_pad // self.PS
+            nb = next(b for b in self._WAVE_BUCKETS if b >= len(reqs)) \
+                if len(reqs) <= self._WAVE_BUCKETS[-1] else len(reqs)
+            toks = np.zeros((nb, Tp_pad), np.int32)
+            pages = np.zeros((nb, npages), np.int32)  # dummy rows: junk page
+            aids = np.zeros(nb, np.int32)
+            true_lens = np.ones(nb, np.int32)
+            temps = np.zeros(nb, np.float32)
+            for j, req in enumerate(reqs):
+                toks[j, :len(req.prompt)] = req.prompt
+                pages[j] = self.page_tables[req.slot, :npages]
+                aids[j] = req.adapter
+                true_lens[j] = len(req.prompt)
+                temps[j] = req.temperature
+            self._rng, sub = jax.random.split(self._rng)
+            first, self.kpool, self.vpool = paged_prefill_batch(
+                self.params, self.loras, jnp.asarray(aids),
+                jnp.asarray(toks), jnp.asarray(pages), self.kpool,
+                self.vpool, jnp.asarray(true_lens), jnp.asarray(temps),
+                sub, self.cfg)
+            out.append((reqs, first))
+        return out
 
     def _emit(self, req: _Request, tok: int):
         req.emitted += 1
@@ -384,6 +493,9 @@ class ContinuousBatchingEngine:
                 self.eos_id is not None and tok == self.eos_id):
             req.finished = True
             req.cancelled = True  # finished: reclaim on the next sweep
+            if req.slot < 0:
+                # planned mode already retired the slot; close the stream
+                self._finish_stream(req)
 
     async def _loop(self):
         """Engine driver. Any exception here is fatal for the engine:
@@ -399,25 +511,219 @@ class ContinuousBatchingEngine:
 
             traceback.print_exc()
 
+    @staticmethod
+    def _ramp(emitted: int) -> int:
+        # per-request fusion ramp: fresh requests decode in small blocks
+        # (streaming first-token latency, fast completion of short
+        # requests, bounded admission latency for newcomers), deep ones
+        # amortize dispatch with bigger ones. Capped at 32 — the ramp only
+        # applies at low occupancy, where a 64-block would let a lone
+        # generation schedule so far ahead that a newcomer queues behind
+        # all of it; the 64 bucket is reserved for full batches.
+        if emitted < 8:
+            return 8
+        if emitted < 24:
+            return 16
+        return 32
+
+    def _pick_block(self) -> int:
+        """Fused-steps bucket for this dispatch: the smallest bucket
+        covering every active request's ramp, each capped by its exact
+        remaining count (no over-decode on final blocks). A request about
+        to finish therefore caps the block so it completes — and frees
+        its slot for waiting admissions — without riding out a long
+        batch's block (continuous-batching latency semantics).
+
+        At high occupancy the ramp is skipped: a full batch is the
+        throughput regime, where small early blocks would multiply
+        dispatch round trips for no latency benefit (newcomers can't be
+        admitted into a full batch anyway)."""
+        live = [r for r in self.slot_req
+                if r is not None and not r.cancelled]
+        if not live:
+            return 1
+        if 2 * len(live) >= self.B:
+            want = min(r.max_tokens - r.emitted for r in live)
+        else:
+            want = min(min(self._ramp(r.emitted), r.max_tokens - r.emitted)
+                       for r in live)
+        for b in self.block_buckets:
+            if want <= b:
+                return b
+        return self.block_buckets[-1]
+
+    def _emit_block(self, entry) -> None:
+        """Host-side emission of one synced decode block."""
+        K, toks, slot_snapshot = entry
+        toks = np.asarray(toks)  # [K, B]; blocks until the device is done
+        self.steps += K
+        for i, req in enumerate(slot_snapshot):
+            if req is None:
+                continue
+            if self.slot_req[i] is req:
+                # planned mode may have retired + re-admitted this slot
+                # while the block was in flight; host per-slot state then
+                # belongs to the newcomer
+                self.seq_lens[i] += K
+            for k in range(K):
+                if req.cancelled:
+                    break  # finished/cancelled mid-block: discard rest
+                tok = int(toks[k, i])
+                if self.slot_req[i] is req:
+                    self.next_tok[i] = tok
+                self._emit(req, tok)
+
     async def _loop_inner(self):
+        if self.eos_id is None:
+            await self._loop_planned()
+        else:
+            await self._loop_reactive()
+
+    async def _loop_planned(self):
+        """Fully pipelined driver for length-deterministic generation
+        (no EOS): every request's completion step is known at dispatch
+        time, so slots are retired and re-admitted ON SCHEDULE without
+        ever draining the pipeline — prefills, carry merges and decode
+        blocks stream to the device back to back, and the only host syncs
+        are the trailing token emissions riding two blocks behind."""
+        pending: list = []  # dispatch-ordered: ("prefill",...)|("block",...)
+        carry = None
+
+        def sync_oldest():
+            kind, *rest = pending.pop(0)
+            if kind == "prefill":
+                reqs, first = rest
+                first = np.asarray(first)
+                for j, req in enumerate(reqs):
+                    self._emit(req, int(first[j]))
+            else:
+                self._emit_block(rest)
+
         while self._running:
-            # reclaim finished/cancelled slots, then admit as many waiting
-            # requests as capacity allows
+            # retire slots whose scheduled tokens are all dispatched; their
+            # in-flight junk writes land on pages ordered BEFORE any new
+            # prefill, so immediate reuse is safe (see paged_decode_multi)
             for i, req in enumerate(self.slot_req):
-                if req is not None and req.cancelled:
+                if req is not None and (req.planned >= req.max_tokens
+                                        or req.cancelled):
+                    req.slot = -1  # emission closes the stream at finish
+                    self.slot_req[i] = None
+                    self.free_pages.extend(
+                        int(p) for p in self.page_tables[i] if p != 0)
+                    self.page_tables[i, :] = 0
+                    self.seq_lens[i] = 0
+                    if req.cancelled and not req.finished:
+                        # user-cancelled: no finish emission will ever
+                        # close this stream — close it here
+                        self._finish_stream(req)
+            if self.waiting and any(r is None for r in self.slot_req):
+                groups = self._admit_dispatch()
+                if groups:
+                    if carry is None:
+                        carry = (jnp.asarray(self.next_tok),
+                                 jnp.asarray(self.seq_lens))
+                    tok_d, lens_d = carry
+                    for reqs, first in groups:
+                        slots = jnp.asarray([r.slot for r in reqs],
+                                            jnp.int32)
+                        lens = jnp.asarray(
+                            [len(r.prompt) for r in reqs], jnp.int32)
+                        # device-side carry merge: no host sync
+                        tok_d = tok_d.at[slots].set(first[:len(reqs)])
+                        lens_d = lens_d.at[slots].set(lens)
+                        for r in reqs:
+                            r.planned = 1
+                        pending.append(("prefill", reqs, first))
+                    carry = (tok_d, lens_d)
+            live = [r for r in self.slot_req if r is not None]
+            if not live:
+                while pending:
+                    sync_oldest()
+                    # yield between blocks: consumers must observe tokens
+                    # in emission order, not one burst after the drain
+                    await asyncio.sleep(0)
+                carry = None
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            # pace dispatch to emission + 2 entries: enough run-ahead to
+            # hide the dispatch round trip under device compute, little
+            # enough that a newly arriving request interleaves within a
+            # couple of blocks instead of queueing behind a whole
+            # pre-scheduled generation. Yield right after each sync so
+            # consumers see tokens before the next dispatch (whose first
+            # use may compile) occupies the loop thread.
+            while len(pending) >= 2:
+                sync_oldest()
+                await asyncio.sleep(0)
+            K = self._pick_block_planned()
+            self._rng, sub = jax.random.split(self._rng)
+            if carry is None:
+                carry = (jnp.asarray(self.next_tok),
+                         jnp.asarray(self.seq_lens))
+            tok_d, lens_d = carry
+            active = np.array([r is not None for r in self.slot_req])
+            toks, tok_d, lens_d, self.kpool, self.vpool = paged_decode_multi(
+                self.params, self.loras, jnp.asarray(self.aids),
+                tok_d, lens_d, jnp.asarray(self.page_tables),
+                self.kpool, self.vpool, jnp.asarray(active),
+                jnp.asarray(self.temps), sub, self.cfg, K)
+            carry = (tok_d, lens_d)
+            for r in live:
+                r.planned = min(r.max_tokens, r.planned + K)
+            pending.append(("block", K, toks, list(self.slot_req)))
+            await asyncio.sleep(0)
+
+    def _pick_block_planned(self) -> int:
+        live = [r for r in self.slot_req
+                if r is not None and not r.cancelled]
+        if not live:
+            return 1
+        if 2 * len(live) >= self.B:
+            want = min(r.max_tokens - r.planned for r in live)
+        else:
+            want = min(min(self._ramp(r.planned), r.max_tokens - r.planned)
+                       for r in live)
+        want = max(1, want)
+        for b in self.block_buckets:
+            if want <= b:
+                return b
+        return self.block_buckets[-1]
+
+    async def _loop_reactive(self):
+        # pipeline of dispatched-but-unsynced decode blocks. Depth 2:
+        # block N+1 is enqueued before block N's tokens come back, so the
+        # tunnel round trip rides under device compute. The (tok, pos)
+        # carry chains ON DEVICE between pipelined blocks; it is rebuilt
+        # from host state only after the pipeline drains at admission
+        # points (a new slot changes page_tables/active for the next
+        # dispatch).
+        pending: list = []
+        carry = None  # (tok_dev, lens_dev) device-resident between blocks
+
+        def drain():
+            while pending:
+                self._emit_block(pending.pop(0))
+
+        while self._running:
+            for i, req in enumerate(self.slot_req):
+                if req is not None and req.cancelled and req.slot >= 0:
+                    if pending:
+                        break  # free only with no block in flight
                     self._free_slot(i)
-            while self.waiting:
-                nxt = self.waiting[0]
-                if nxt.cancelled:
-                    self.waiting.pop(0)
-                    nxt.out.put_nowait(None)
-                    self._reqs.pop(nxt.req_id, None)
-                    continue
-                if not self._admit(nxt):
-                    break
-                self.waiting.pop(0)
+            if self.waiting and any(r is None for r in self.slot_req):
+                drain()  # admission changes device-visible state
+                for i, req in enumerate(self.slot_req):
+                    if req is not None and req.cancelled:
+                        self._free_slot(i)
+                if self._admit_wave():
+                    carry = None
             active = np.array([r is not None for r in self.slot_req])
             if not active.any():
+                drain()
                 # idle, OR the head-of-queue request can't be admitted yet
                 # (pages still held elsewhere): either way we must yield —
                 # a bare continue would spin the loop without ever
@@ -428,22 +734,26 @@ class ContinuousBatchingEngine:
                 except asyncio.TimeoutError:
                     pass
                 continue
+            K = self._pick_block()
             self._rng, sub = jax.random.split(self._rng)
-            toks, self.kpool, self.vpool = paged_decode_step(
+            if carry is None:
+                tok_d = jnp.asarray(self.next_tok)
+                lens_d = jnp.asarray(self.seq_lens)
+            else:
+                tok_d, lens_d = carry
+            toks, tok_d, lens_d, self.kpool, self.vpool = paged_decode_multi(
                 self.params, self.loras, jnp.asarray(self.aids),
-                jnp.asarray(self.next_tok), jnp.asarray(self.seq_lens),
-                jnp.asarray(self.page_tables), self.kpool, self.vpool,
-                jnp.asarray(active), jnp.asarray(self.temps), sub, self.cfg)
-            toks = np.asarray(toks)
-            self.steps += 1
-            for i, req in enumerate(self.slot_req):
-                if req is None:
-                    continue
-                self.seq_lens[i] += 1
-                if req.cancelled:
-                    continue
-                tok = int(toks[i])
-                self.next_tok[i] = tok
-                self._emit(req, tok)
-            # hand the loop to consumers/admitters every step
+                tok_d, lens_d, jnp.asarray(self.page_tables),
+                self.kpool, self.vpool, jnp.asarray(active),
+                jnp.asarray(self.temps), sub, self.cfg, K)
+            carry = (tok_d, lens_d)
+            pending.append((K, toks, list(self.slot_req)))
+            if len(pending) >= 2:
+                self._emit_block(pending.pop(0))
+            # a finished request must stop the pipeline at the next
+            # admission point rather than over-decoding forever
+            if any(r is not None and r.cancelled for r in self.slot_req):
+                drain()
+                carry = None
+            # hand the loop to consumers/admitters every block
             await asyncio.sleep(0)
