@@ -987,7 +987,8 @@ class CoreClient:
             return None  # plain pickle can't carry it: cloudpickle path
         # cap also guards the pop buffer: a record the consumer can never
         # pop would wedge the ring (see rt_ring_pop_batch's kTooBig)
-        if len(rec) > min(self.cfg.fastpath_record_max, (1 << 20) - 64):
+        if len(rec) > min(self.cfg.fastpath_record_max,
+                          fastpath.POP_BUF_BYTES - 64):
             return None  # big args belong in the object store
         oid = ObjectID.for_task_return(task_id, 0)
         light = (fn, args, kwargs, resources)

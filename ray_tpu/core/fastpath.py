@@ -36,6 +36,10 @@ from ray_tpu.utils import serialization
 SUB = 0  # driver -> worker (task records)
 REP = 1  # worker -> driver (result records)
 
+# pop-side staging buffer size; every record pushed into a ring MUST fit
+# here or the consumer can never drain it (rt_ring_pop_batch -> kTooBig)
+POP_BUF_BYTES = 1 << 20
+
 # reply status codes
 OK = 0        # payload = packed inline value
 OK_SHM = 1    # result stored in the node's shm arena under the return oid
@@ -66,7 +70,7 @@ class RingPair:
         self._h = handle
         self._owner = owner
         self._lib = _native.get_lib()
-        self._popbuf = ctypes.create_string_buffer(1 << 20)
+        self._popbuf = ctypes.create_string_buffer(POP_BUF_BYTES)
         self._dead = threading.Event()  # close_pair started
         self._inflight = 0
         self._cv = threading.Condition()

@@ -526,7 +526,7 @@ class ContinuousBatchingEngine:
             return 16
         return 32
 
-    def _pick_block(self) -> int:
+    def _pick_block(self, planned: bool = False) -> int:
         """Fused-steps bucket for this dispatch: the smallest bucket
         covering every active request's ramp, each capped by its exact
         remaining count (no over-decode on final blocks). A request about
@@ -537,16 +537,24 @@ class ContinuousBatchingEngine:
         At high occupancy the ramp is skipped: a full batch is the
         throughput regime, where small early blocks would multiply
         dispatch round trips for no latency benefit (newcomers can't be
-        admitted into a full batch anyway)."""
+        admitted into a full batch anyway).
+
+        ``planned`` counts dispatch-scheduled tokens instead of emitted
+        ones (the planned loop runs ahead of emission)."""
         live = [r for r in self.slot_req
                 if r is not None and not r.cancelled]
         if not live:
             return 1
+
+        def done_count(r):
+            return r.planned if planned else r.emitted
+
         if 2 * len(live) >= self.B:
-            want = min(r.max_tokens - r.emitted for r in live)
+            want = min(r.max_tokens - done_count(r) for r in live)
         else:
-            want = min(min(self._ramp(r.emitted), r.max_tokens - r.emitted)
-                       for r in live)
+            want = min(min(self._ramp(done_count(r)),
+                           r.max_tokens - done_count(r)) for r in live)
+        want = max(1, want)
         for b in self.block_buckets:
             if want <= b:
                 return b
@@ -595,7 +603,8 @@ class ContinuousBatchingEngine:
                 reqs, first = rest
                 first = np.asarray(first)
                 for j, req in enumerate(reqs):
-                    self._emit(req, int(first[j]))
+                    if not req.cancelled:  # user-cancelled: stream closed
+                        self._emit(req, int(first[j]))
             else:
                 self._emit_block(rest)
 
@@ -659,7 +668,7 @@ class ContinuousBatchingEngine:
             while len(pending) >= 2:
                 sync_oldest()
                 await asyncio.sleep(0)
-            K = self._pick_block_planned()
+            K = self._pick_block(planned=True)
             self._rng, sub = jax.random.split(self._rng)
             if carry is None:
                 carry = (jnp.asarray(self.next_tok),
@@ -676,22 +685,6 @@ class ContinuousBatchingEngine:
                 r.planned = min(r.max_tokens, r.planned + K)
             pending.append(("block", K, toks, list(self.slot_req)))
             await asyncio.sleep(0)
-
-    def _pick_block_planned(self) -> int:
-        live = [r for r in self.slot_req
-                if r is not None and not r.cancelled]
-        if not live:
-            return 1
-        if 2 * len(live) >= self.B:
-            want = min(r.max_tokens - r.planned for r in live)
-        else:
-            want = min(min(self._ramp(r.planned), r.max_tokens - r.planned)
-                       for r in live)
-        want = max(1, want)
-        for b in self.block_buckets:
-            if want <= b:
-                return b
-        return self.block_buckets[-1]
 
     async def _loop_reactive(self):
         # pipeline of dispatched-but-unsynced decode blocks. Depth 2:

@@ -42,5 +42,13 @@ def rope(x, cos, sin, positions=None):
 
 
 def swiglu(x, w_gate, w_up, w_down):
-    """SwiGLU FFN: (silu(x@Wg) * (x@Wu)) @ Wd."""
-    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+    """SwiGLU FFN: (silu(x@Wg) * (x@Wu)) @ Wd.
+
+    The gate/up products carry a checkpoint name so remat policies can
+    opt into saving them (they are the bulk of a block's recompute);
+    inert unless a policy matches the name."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    gate = checkpoint_name(x @ w_gate, "ffn_hidden")
+    up = checkpoint_name(x @ w_up, "ffn_hidden")
+    return (jax.nn.silu(gate) * up) @ w_down

@@ -303,11 +303,21 @@ def _flash_fwd_rule(qb, kb, vb, causal, sm_scale, block_q, block_k, interpret):
         qb, kb, vb, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (qb, kb, vb, out, lse)
+    # Residuals carry the "attn_out" checkpoint name so the model's remat
+    # policy can SAVE them: without this, rematerialized blocks re-run the
+    # whole O(T^2) forward kernel just to regenerate lse — measured ~10
+    # MFU points at 8k context. lse is saved in slim [BH, T] form (its
+    # kernel layout is lane-broadcast x128) and re-broadcast in the bwd.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out_r = checkpoint_name(out, "attn_out")
+    lse_r = checkpoint_name(lse[:, :, 0], "attn_out")
+    return out, (qb, kb, vb, out_r, lse_r)
 
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, dout):
-    qb, kb, vb, out, lse = res
+    qb, kb, vb, out, lse_slim = res
+    lse = jnp.broadcast_to(lse_slim[..., None], (*lse_slim.shape, _LANES))
     dq, dk, dv = _flash_backward(
         qb, kb, vb, out, lse, dout, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
