@@ -21,6 +21,9 @@ from typing import Any, Iterable, Sequence
 from ray_tpu.config import Config, get_config, set_config
 from ray_tpu.core.core_client import CoreClient
 from ray_tpu.core.ref import ActorHandle, ObjectRef
+# NOTE: ray_tpu.util.scheduling_strategies is imported lazily inside the
+# .remote() methods — ray_tpu.util's __init__ defines @remote actors and
+# importing it here would recurse during package initialization
 from ray_tpu.utils import rpc, serialization
 from ray_tpu.utils.ids import PlacementGroupID
 
@@ -300,7 +303,15 @@ class RemoteFunction:
         resources["CPU"] = float(o.get("num_cpus", 1.0))
         if o.get("num_tpus"):
             resources["TPU"] = float(o["num_tpus"])
+        from ray_tpu.util import scheduling_strategies
+
         pg = o.get("placement_group")
+        strategy = o.get("scheduling_strategy")
+        if isinstance(strategy, scheduling_strategies.
+                      PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            o = {**o, "placement_group_bundle_index":
+                 strategy.placement_group_bundle_index}
         return get_core().submit_task(
             self._fn,
             args,
@@ -311,6 +322,7 @@ class RemoteFunction:
             placement_group=pg.id if isinstance(pg, PlacementGroup) else pg,
             bundle_index=o.get("placement_group_bundle_index", -1),
             scheduling_node=o.get("_scheduling_node"),
+            scheduling_strategy=scheduling_strategies.normalize(strategy),
             name=o.get("name"),
             runtime_env=o.get("runtime_env"),
         )
@@ -333,8 +345,16 @@ class ActorClass:
         return ActorClass(self._cls, **{**self._opts, **opts})
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu.util import scheduling_strategies
+
         o = self._opts
         pg = o.get("placement_group")
+        strategy = o.get("scheduling_strategy")
+        bundle_index = o.get("placement_group_bundle_index", -1)
+        if isinstance(strategy, scheduling_strategies.
+                      PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            bundle_index = strategy.placement_group_bundle_index
         return get_core().create_actor(
             self._cls,
             args,
@@ -345,11 +365,12 @@ class ActorClass:
             max_restarts=int(o.get("max_restarts", 0)),
             max_concurrency=int(o.get("max_concurrency", 1)),
             placement_group=pg.id if isinstance(pg, PlacementGroup) else pg,
-            bundle_index=o.get("placement_group_bundle_index", -1),
+            bundle_index=bundle_index,
             get_if_exists=bool(o.get("get_if_exists", False)),
             lifetime=o.get("lifetime"),
             runtime_env=o.get("runtime_env"),
             concurrency_groups=o.get("concurrency_groups"),
+            scheduling_strategy=scheduling_strategies.normalize(strategy),
         )
 
 

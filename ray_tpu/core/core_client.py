@@ -41,10 +41,12 @@ from ray_tpu.core.object_store import SharedObjectStore
 from ray_tpu.core.ref import (
     ActorError,
     ActorHandle,
+    ConfigurationError,
     GetTimeoutError,
     ObjectLostError,
     ObjectRef,
     ObjectRefGenerator,
+    SchedulingError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -106,6 +108,8 @@ class _SchedulingKeyState:
     workers: list[_LeasedWorker] = field(default_factory=list)
     lease_requests_inflight: int = 0
     inflight_tasks: int = 0
+    strategy: dict | None = None  # wire form of the scheduling strategy
+    affinity_addr: tuple | None = None  # cached node-affinity raylet addr
     # EWMA of observed per-task seconds: long tasks dispatch chunk=1 so
     # backlog stays visible to lease growth / spillback / the autoscaler
     avg_task_s: float = 0.0
@@ -159,6 +163,25 @@ class _TaskEventBuffer:
             )
         except Exception:
             pass
+
+
+def _strategy_key(strategy: dict | None):
+    """Hashable token for the scheduling-strategy part of a lease key
+    (leases are cached per strategy: a SPREAD lease pool must not be
+    reused for a node-pinned task)."""
+    if not strategy:
+        return None
+    t = strategy["type"]
+    if t == "spread":
+        return ("spread",)
+    if t == "node_affinity":
+        return ("na", strategy["node_id"], bool(strategy.get("soft")))
+    if t == "node_label":
+        freeze = lambda d: tuple(sorted(
+            (k, tuple(sorted(v))) for k, v in d.items()))
+        return ("nl", freeze(strategy.get("hard", {})),
+                freeze(strategy.get("soft", {})))
+    return (t,)
 
 
 def _handle_options(spec: dict) -> dict:
@@ -950,7 +973,8 @@ class CoreClient:
             for a in kwargs.values():
                 if isinstance(a, ObjectRef):
                     return None
-        key = (func_id, tuple(sorted(resources.items())), None, -1, None)
+        key = (func_id, tuple(sorted(resources.items())), None, -1, None,
+               None)
         state = self.sched_keys.get(key)
         if state is None:
             return None
@@ -1338,7 +1362,7 @@ class CoreClient:
 
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=None, placement_group=None, bundle_index=-1,
-                    scheduling_node=None, name=None,
+                    scheduling_node=None, scheduling_strategy=None, name=None,
                     runtime_env=None) -> list[ObjectRef] | ObjectRef:
         """Synchronous entry (driver thread) or loop-thread entry (nested).
 
@@ -1355,6 +1379,7 @@ class CoreClient:
         else:
             if (num_returns == 1 and placement_group is None
                     and scheduling_node is None and runtime_env is None
+                    and scheduling_strategy is None
                     and name is None and max_retries is None):
                 ref = self._try_fast_submit(
                     fn, args, kwargs, dict(resources or {"CPU": 1.0}))
@@ -1379,6 +1404,7 @@ class CoreClient:
             "placement_group": placement_group,
             "bundle_index": bundle_index,
             "scheduling_node": scheduling_node,
+            "scheduling_strategy": scheduling_strategy,
             "runtime_env": self._resolve_runtime_env(runtime_env),
         }
         metrics.tasks_submitted.inc()
@@ -1471,8 +1497,10 @@ class CoreClient:
             spec.get("placement_group") and spec["placement_group"].hex(),
             spec.get("bundle_index"),
             spec.get("scheduling_node"),
+            _strategy_key(spec.get("scheduling_strategy")),
         )
         state = self.sched_keys.setdefault(key, _SchedulingKeyState())
+        state.strategy = spec.get("scheduling_strategy")
         state.inflight_tasks += 1
         await state.pending.put(spec)
         await self._pump(key, state)
@@ -1554,6 +1582,10 @@ class CoreClient:
                 # long tasks: committing a deep batch to one worker would
                 # serialize them and hide the backlog from lease growth,
                 # spillback and the autoscaler — dispatch one at a time
+                chunk = 1
+            if (state.strategy or {}).get("type") == "spread":
+                # SPREAD's whole point is one lease per node slice —
+                # a deep batch on one worker would serialize the spread
                 chunk = 1
             for w in free:
                 if state.pending.empty():
@@ -1637,6 +1669,28 @@ class CoreClient:
                 payload["pg_id"] = PlacementGroupID.from_hex(pg_hex)
             raylet_addr = self.raylet_address
             target_node = key[4]
+            strategy = state.strategy
+            if strategy is not None:
+                if strategy["type"] == "node_affinity":
+                    # resolved address cached per scheduling key (stable
+                    # while the node lives); cleared on lease failure so
+                    # a died-and-replaced node re-resolves
+                    addr = state.affinity_addr
+                    if addr is None:
+                        addr = await self._node_address(strategy["node_id"])
+                        state.affinity_addr = addr
+                    if addr is not None:
+                        raylet_addr = tuple(addr)
+                        if not strategy.get("soft"):
+                            payload["no_spill"] = True
+                    elif not strategy.get("soft"):
+                        raise SchedulingError(
+                            f"node {strategy['node_id']} required by "
+                            "NodeAffinitySchedulingStrategy(soft=False) is "
+                            "not alive")
+                    # soft + node gone: fall back to the default policy
+                else:
+                    payload["strategy"] = strategy
             if target_node is not None:
                 payload["no_spill"] = True
                 raylet_addr = tuple(target_node)
@@ -1653,6 +1707,14 @@ class CoreClient:
                 finally:
                     if conn is not self.raylet:
                         await conn.close()
+                if reply.get("infeasible"):
+                    raise SchedulingError(
+                        reply.get("error") or "no node satisfies the "
+                        "task's scheduling strategy")
+                if reply.get("drop_strategy"):
+                    # strategy already satisfied by the redirect target
+                    # (e.g. SPREAD chose it): it should grant locally
+                    payload.pop("strategy", None)
                 if reply.get("granted"):
                     w = _LeasedWorker(
                         lease_id=reply["lease_id"],
@@ -1688,6 +1750,7 @@ class CoreClient:
             # the error text must repeat, the failures must span real time
             # (> 2s, i.e. distinct attempts), and no lease may be live.
             now = time.monotonic()
+            state.affinity_addr = None  # re-resolve after any failure
             # type-only signature: messages embed per-attempt detail
             # (ports, pids, paths) that must not defeat the breaker
             sig = type(e).__name__
@@ -1701,7 +1764,7 @@ class CoreClient:
             # binary etc.): break immediately. Anything else — including
             # worker-start timeouts on a loaded box — gets a high threshold
             # and real elapsed time before we fail the pending tasks.
-            is_config = sig == "ConfigurationError"
+            is_config = isinstance(e, ConfigurationError)
             persistent = not state.workers and (
                 is_config
                 or (
@@ -1725,6 +1788,20 @@ class CoreClient:
         finally:
             state.lease_requests_inflight -= 1
             await self._pump(key, state)
+
+    async def _node_address(self, node_hex: str):
+        """Resolve a node id (hex) to its raylet address via the GCS
+        cluster view; None if the node is unknown or dead. GCS RPC
+        failures propagate — a transient GCS hiccup must retry through
+        the lease backoff path, not masquerade as a dead node and
+        permanently fail hard-affinity tasks."""
+        view = await self.gcs.call("get_cluster", {})
+        for n in view:
+            nid = n.get("node_id")
+            nid_hex = nid.hex() if hasattr(nid, "hex") else str(nid)
+            if nid_hex == node_hex and n.get("alive", True):
+                return n.get("address")
+        return None
 
     async def _run_on_worker(self, key, state, w: _LeasedWorker, specs: list):
         todo = []
@@ -2045,7 +2122,8 @@ class CoreClient:
                           name=None, max_restarts=0, max_concurrency=1,
                           placement_group=None, bundle_index=-1,
                           get_if_exists=False, lifetime=None,
-                          runtime_env=None, concurrency_groups=None) -> dict:
+                          runtime_env=None, concurrency_groups=None,
+                          scheduling_strategy=None) -> dict:
         res = dict(resources or {})
         res.setdefault("CPU", num_cpus)
         # per-method concurrency groups (ref: concurrency_group_manager.cc):
@@ -2088,6 +2166,7 @@ class CoreClient:
             "owner_address": self.address,
             "get_if_exists": get_if_exists,
             "lifetime": lifetime,
+            "scheduling_strategy": scheduling_strategy,
         }
 
     async def _register_actor(self, spec: dict) -> dict:

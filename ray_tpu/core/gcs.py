@@ -118,6 +118,7 @@ class GcsServer:
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[str, ActorID] = {}
         self.pgs: dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self._actor_spread_rr = 0  # SPREAD actor round-robin cursor
         self.job_counter = 0
         self.task_events: list[dict] = []  # ring buffer of task lifecycle events
 
@@ -282,14 +283,18 @@ class GcsServer:
             resources = info.spec.get("resources", {"CPU": 1.0})
             pg_id = info.spec.get("placement_group")
             bundle_index = info.spec.get("bundle_index", -1)
+            strategy = info.spec.get("scheduling_strategy")
             deadline = time.monotonic() + self.cfg.worker_start_timeout_s
             while True:
-                node = self._pick_node(resources, pg_id, bundle_index)
+                node = self._pick_node(resources, pg_id, bundle_index,
+                                       strategy)
                 if node is not None:
                     break
                 if time.monotonic() > deadline:
                     info.state = DEAD
-                    info.death_cause = f"no node can host actor resources {resources}"
+                    info.death_cause = (
+                        f"no node can host actor resources {resources}"
+                        + (f" under strategy {strategy}" if strategy else ""))
                     await self.publish("actors", info.view())
                     return
                 await asyncio.sleep(0.1)
@@ -331,7 +336,8 @@ class GcsServer:
             await self.publish("actors", info.view())
             await self.publish(f"actor:{info.actor_id.hex()}", info.view())
 
-    def _pick_node(self, resources, pg_id=None, bundle_index=-1) -> NodeInfo | None:
+    def _pick_node(self, resources, pg_id=None, bundle_index=-1,
+                   strategy=None) -> NodeInfo | None:
         if pg_id is not None:
             pg = self.pgs.get(pg_id)
             if pg is None or pg.state != "CREATED":
@@ -346,14 +352,40 @@ class GcsServer:
                 if node and node.alive and _fits(resources, node.resources_available):
                     return node
             return None
+        fitting = [node for node in self.nodes.values()
+                   if node.alive and _fits(resources, node.resources_available)]
+        if strategy is not None:
+            # actor-site scheduling strategies (ref: gcs_actor_scheduler
+            # consulting the cluster scheduling policies)
+            from ray_tpu.util.scheduling_strategies import labels_match
+
+            t = strategy.get("type")
+            if t == "node_affinity":
+                node = next((n for n in fitting
+                             if n.node_id.hex() == strategy["node_id"]), None)
+                if node is not None or not strategy.get("soft"):
+                    return node  # hard: only that node (None => retry/DEAD)
+            elif t == "spread":
+                self._actor_spread_rr += 1
+                ordered = sorted(fitting, key=lambda n: n.node_id.hex())
+                if ordered:
+                    return ordered[self._actor_spread_rr % len(ordered)]
+                return None
+            elif t == "node_label":
+                hard = strategy.get("hard", {})
+                soft = strategy.get("soft", {})
+                matching = [n for n in fitting
+                            if labels_match(n.labels, hard)]
+                preferred = [n for n in matching
+                             if labels_match(n.labels, soft)]
+                fitting = preferred or matching
         # hybrid top-k (ref: hybrid_scheduling_policy.h:50 + policy/scorer.h,
         # shared impl in core/policy.py): randomize among comfortable nodes,
         # deterministic best when everything is tight.
         scored = [
             (policy.score(resources, node.resources_total,
                           node.resources_available), node)
-            for node in self.nodes.values()
-            if node.alive and _fits(resources, node.resources_available)
+            for node in fitting
         ]
         return policy.pick(scored)
 

@@ -18,6 +18,12 @@ UTIL_THRESHOLD = 0.75
 TOP_K = 3
 
 
+def fits(resources: dict, available: dict) -> bool:
+    """Every requested dimension is available (1e-9 float slack)."""
+    return all(available.get(k, 0.0) >= v - 1e-9
+               for k, v in resources.items())
+
+
 def score(resources: dict, total: dict, available: dict) -> float:
     """Worst post-placement utilization across the requested dimensions."""
     worst = 0.0

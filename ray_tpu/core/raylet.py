@@ -209,6 +209,7 @@ class Raylet:
         self.gcs: rpc.Connection | None = None
 
         self._lease_ids = itertools.count(1)
+        self._spread_rr = 0  # SPREAD strategy round-robin cursor
         self._view_versions = itertools.count(1)  # resource-view sync versions
         self.leases: dict[int, Lease] = {}
         self.idle_workers: list[WorkerHandle] = []
@@ -582,6 +583,11 @@ class Raylet:
         pg_key = None
         if p.get("pg_id") is not None:
             pg_key = (p["pg_id"], p.get("bundle_index", 0))
+        strategy = p.get("strategy")
+        if strategy is not None:
+            redirect = self._apply_strategy(strategy, resources, p)
+            if redirect is not None:
+                return redirect
         granted = self._try_allocate(resources, pg_key)
         if not granted:
             spill = self._pick_spillback(resources, p)
@@ -634,6 +640,75 @@ class Raylet:
             "node_id": self.node_id,
             "tpu_chips": tpu_chips,
         }
+
+    def _apply_strategy(self, strategy: dict, resources: dict, p: dict):
+        """Strategy-directed placement at the lease site (ref: raylet
+        scheduling policies — spread_scheduling_policy.cc,
+        node_label_scheduling_policy.h:25). Returns a reply dict to send
+        back (spillback / infeasible), or None to continue with the
+        normal local-grant path."""
+        from ray_tpu.util.scheduling_strategies import labels_match
+
+        t = strategy.get("type")
+        if t == "spread":
+            # round-robin over feasible nodes (self included): leases
+            # land on distinct nodes regardless of local headroom
+            nodes = [{"node_id": self.node_id, "address": None,
+                      "labels": self.labels,
+                      "resources_available": self.ledger.available}]
+            nodes += [n for n in self.cluster_view
+                      if n.get("alive", True)
+                      and n["node_id"] != self.node_id]
+            feasible = [
+                n for n in sorted(nodes, key=lambda n: n["node_id"].hex())
+                if policy.fits(resources, n.get("resources_available", {}))
+            ]
+            if not feasible:
+                return None  # nothing fits anywhere: queue locally
+            self._spread_rr += 1
+            chosen = feasible[self._spread_rr % len(feasible)]
+            if chosen["address"] is None:  # ourselves
+                return None
+            # drop_strategy: the target grants locally instead of
+            # re-spreading (its own rr counter would ping-pong the lease)
+            return {"granted": False, "spill_to": tuple(chosen["address"]),
+                    "drop_strategy": True}
+        if t == "node_label":
+            hard = strategy.get("hard", {})
+            soft = strategy.get("soft", {})
+            peers = [n for n in self.cluster_view
+                     if n.get("alive", True)
+                     and n["node_id"] != self.node_id
+                     and labels_match(n.get("labels", {}), hard)]
+            preferred = [n for n in peers
+                         if labels_match(n.get("labels", {}), soft)]
+            if labels_match(self.labels, hard):
+                if not soft or labels_match(self.labels, soft):
+                    return None  # we qualify fully: normal local path
+                if preferred:
+                    # a peer matches hard AND soft; hand over with
+                    # drop_strategy — redirecting with the strategy kept
+                    # would let two hard-matching soft-missing nodes
+                    # spill the lease to each other forever
+                    n = min(preferred, key=lambda n: policy.score(
+                        resources, n.get("resources_total", {}),
+                        n.get("resources_available", {})))
+                    return {"granted": False,
+                            "spill_to": tuple(n["address"]),
+                            "drop_strategy": True}
+                return None  # soft miss everywhere: we still qualify
+            pool = preferred or peers
+            if pool:
+                # local node fails hard: keep the strategy so the target
+                # (which matches hard) re-checks and its own resource
+                # spillback stays label-constrained
+                n = min(pool, key=lambda n: policy.score(
+                    resources, n.get("resources_total", {}),
+                    n.get("resources_available", {})))
+                return {"granted": False, "spill_to": tuple(n["address"])}
+            return {"granted": False, "infeasible": True,
+                    "error": f"no alive node matches labels {hard}"}
+        return None
 
     def _try_allocate(self, resources, pg_key) -> bool:
         if pg_key is not None:
@@ -719,6 +794,14 @@ class Raylet:
         (ref: hybrid_scheduling_policy.h:50, normal_task_submitter.cc:461)."""
         if p.get("no_spill") or p.get("pg_id") is not None:
             return None
+        # hard label constraints restrict where resource pressure may
+        # spill a lease (ref: node_label_scheduling_policy.h:25)
+        hard = None
+        strategy = p.get("strategy")
+        if strategy and strategy.get("type") == "node_label":
+            from ray_tpu.util.scheduling_strategies import labels_match
+
+            hard = strategy.get("hard", {})
         # hybrid top-k among feasible peers (ref: hybrid_scheduling_policy,
         # shared impl in core/policy.py): first-fit would herd every spilled
         # lease from every concurrent client onto the same peer
@@ -726,8 +809,11 @@ class Raylet:
         for n in self.cluster_view:
             if n["node_id"] == self.node_id or not n.get("alive", True):
                 continue
+            if hard is not None and not labels_match(
+                    n.get("labels", {}), hard):
+                continue
             av = n.get("resources_available", {})
-            if not all(av.get(k, 0.0) >= v - 1e-9 for k, v in resources.items()):
+            if not policy.fits(resources, av):
                 continue
             scored.append((
                 policy.score(resources, n.get("resources_total", {}), av),

@@ -64,6 +64,13 @@ class ConfigurationError(RayTpuError):
     the lease-failure breaker fails pending tasks on it immediately."""
 
 
+class SchedulingError(ConfigurationError):
+    """No node can satisfy the task's scheduling strategy (hard node
+    affinity to a dead node, hard labels nothing matches). Fails fast
+    like ConfigurationError rather than parking forever (deliberate
+    deviation from the reference's wait-for-a-matching-node)."""
+
+
 class ObjectRef:
     """Future-like handle to a (possibly pending) remote object."""
 

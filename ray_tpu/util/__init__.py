@@ -2,5 +2,14 @@
 
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.queue import Empty, Full, Queue
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
 
-__all__ = ["ActorPool", "Queue", "Empty", "Full"]
+__all__ = [
+    "ActorPool", "Queue", "Empty", "Full",
+    "NodeAffinitySchedulingStrategy", "NodeLabelSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]

@@ -70,7 +70,9 @@ CATALOG: dict[str, dict[str, dict]] = {
         "lease_worker": {"since": (1, 0), "fields": {
             "resources": "dict", "pg_id": "PGID | None", "bundle_index": "int",
             "owner_bound": "bool", "no_spill": "bool", "for_actor": "ActorID",
-            "language": "python|cpp (since 1.1)"}},
+            "language": "python|cpp (since 1.1)",
+            "strategy": "scheduling-strategy wire dict: {type: spread | "
+                        "node_affinity | node_label, ...} (since 1.3)"}},
         "return_lease": {"since": (1, 0), "fields": {
             "lease_id": "int", "kill": "bool"}},
         "report_demand": {"since": (1, 3), "fields": {

@@ -1,0 +1,163 @@
+"""Task/actor scheduling strategies on a multi-raylet cluster
+(ref test strategy: python/ray/tests/test_scheduling.py +
+test_node_label_scheduling_strategy.py — placement distributions asserted
+against real raylets in one process)."""
+
+import collections
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.ref import SchedulingError
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+)
+
+
+@pytest.fixture()
+def three_node_core():
+    """Driver on node A; B and C carry distinguishing labels."""
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.core_client import CoreClient
+    from ray_tpu.utils import rpc as _rpc
+
+    io = _rpc.EventLoopThread()
+    cluster = Cluster(io=io)
+    node_a = cluster.add_node(num_cpus=4.0, labels={"zone": "a"})
+    cluster.add_node(num_cpus=4.0, labels={"zone": "b", "accel": "tpu"})
+    cluster.add_node(num_cpus=4.0, labels={"zone": "c"})
+    core = CoreClient(loop=io.loop)
+    io.run(core.connect(cluster.gcs_address, node_a.server.address))
+    old = _api._core
+    _api._core = core
+    yield core, cluster
+    _api._core = old
+    try:
+        io.run(core.close(), timeout=10)
+    except Exception:
+        pass
+    cluster.shutdown()
+    io.stop()
+
+
+def _node_of_task():
+    import ray_tpu as rt
+
+    return rt.get_runtime_context().node_id.hex()
+
+
+def _submit(core, strategy, n=1, sleep_s=0.0, resources=None):
+    def probe(s=sleep_s):
+        import time as _t
+
+        if s:
+            _t.sleep(s)
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().node_id.hex()
+
+    refs = [core.submit_task(probe, (), {},
+                             resources=dict(resources or {"CPU": 1.0}),
+                             scheduling_strategy=strategy)
+            for _ in range(n)]
+    return core, refs
+
+
+def _get(core, refs, timeout=180):
+    fast = core.fast_prepass(refs, timeout)
+    assert not fast  # strategies never ride the fast path
+    return core._run_sync(core.get_async(refs, timeout), timeout=timeout + 30)
+
+
+def test_spread_distributes_across_nodes(three_node_core):
+    """SPREAD: concurrent 1-CPU tasks land on >= 2 distinct nodes even
+    though the local node alone could absorb them (ref:
+    spread_scheduling_policy.cc round-robin)."""
+    core, cluster = three_node_core
+    core, refs = _submit(core, {"type": "spread"}, n=6, sleep_s=2.0)
+    nodes = collections.Counter(_get(core, refs))
+    assert len(nodes) >= 2, nodes
+    assert sum(nodes.values()) == 6
+
+
+def test_node_affinity_hard(three_node_core):
+    core, cluster = three_node_core
+    target = cluster.raylets[2].node_id.hex()
+    strategy = NodeAffinitySchedulingStrategy(target).to_wire()
+    core, refs = _submit(core, strategy, n=3)
+    assert set(_get(core, refs)) == {target}
+
+
+def test_node_affinity_hard_dead_node_fails(three_node_core):
+    core, cluster = three_node_core
+    strategy = NodeAffinitySchedulingStrategy("ff" * 16).to_wire()
+    core, refs = _submit(core, strategy, n=1)
+    with pytest.raises(SchedulingError):
+        _get(core, refs, timeout=60)
+
+
+def test_node_affinity_soft_dead_node_falls_back(three_node_core):
+    core, cluster = three_node_core
+    strategy = NodeAffinitySchedulingStrategy("ff" * 16, soft=True).to_wire()
+    core, refs = _submit(core, strategy, n=1)
+    assert _get(core, refs)[0]  # ran somewhere
+
+
+def test_node_label_hard(three_node_core):
+    """Hard labels place only on the matching node — here the driver's
+    own node does NOT match, so the lease must spill to the tpu node."""
+    core, cluster = three_node_core
+    tpu_node = cluster.raylets[1].node_id.hex()
+    strategy = NodeLabelSchedulingStrategy(hard={"accel": "tpu"}).to_wire()
+    core, refs = _submit(core, strategy, n=3)
+    assert set(_get(core, refs)) == {tpu_node}
+
+
+def test_node_label_hard_infeasible_fails(three_node_core):
+    core, cluster = three_node_core
+    strategy = NodeLabelSchedulingStrategy(
+        hard={"accel": "gpu"}).to_wire()
+    core, refs = _submit(core, strategy, n=1)
+    with pytest.raises(SchedulingError):
+        _get(core, refs, timeout=60)
+
+
+def test_node_label_soft_prefers(three_node_core):
+    """Soft labels steer but never block: zone-b preferred, and with
+    capacity there the task lands on it."""
+    core, cluster = three_node_core
+    b = cluster.raylets[1].node_id.hex()
+    strategy = NodeLabelSchedulingStrategy(
+        hard={}, soft={"zone": "b"}).to_wire()
+    core, refs = _submit(core, strategy, n=1)
+    assert _get(core, refs) == [b]
+
+
+def test_actor_scheduling_strategies(three_node_core):
+    """Actors honor affinity + labels at the GCS scheduling site
+    (ref: gcs_actor_scheduler consulting scheduling policies)."""
+    core, cluster = three_node_core
+    target = cluster.raylets[2].node_id.hex()
+
+    class Who:
+        def node(self):
+            import ray_tpu as rt
+
+            return rt.get_runtime_context().node_id.hex()
+
+    h = core.create_actor(
+        Who, (), {}, num_cpus=1.0,
+        scheduling_strategy={"type": "node_affinity", "node_id": target,
+                             "soft": False})
+    ref = core.submit_actor_task(h, "node", (), {})
+    assert _get(core, [ref]) == [target]
+
+    h2 = core.create_actor(
+        Who, (), {}, num_cpus=1.0,
+        scheduling_strategy={"type": "node_label",
+                             "hard": {"accel": ["tpu"]}, "soft": {}})
+    ref2 = core.submit_actor_task(h2, "node", (), {})
+    assert _get(core, [ref2]) == [cluster.raylets[1].node_id.hex()]
