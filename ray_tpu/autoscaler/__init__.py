@@ -10,11 +10,16 @@ pluggable: LocalSubprocessProvider launches real raylet subprocesses
 three methods.
 """
 from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ray_tpu.autoscaler.gke import GKETPUPodProvider
+from ray_tpu.autoscaler.instance_manager import Instance, InstanceManager
 from ray_tpu.autoscaler.node_provider import LocalSubprocessProvider, NodeProvider
 
 __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
+    "GKETPUPodProvider",
+    "Instance",
+    "InstanceManager",
     "LocalSubprocessProvider",
     "NodeProvider",
 ]

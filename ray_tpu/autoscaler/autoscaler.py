@@ -20,6 +20,10 @@ class AutoscalerConfig:
     upscale_delay_s: float = 1.0
     idle_timeout_s: float = 10.0
     poll_interval_s: float = 0.5
+    #: a launched node that never registers with the GCS within this
+    #: window is reclaimed (raylet crashed while the cloud resource
+    #: lives; without this the permanent 'pending' wedges scale-up)
+    pending_timeout_s: float = 300.0
     #: resources for each new node (the provider default if None)
     node_resources: dict | None = None
 
@@ -37,6 +41,8 @@ class Autoscaler:
         self._demand_since: float | None = None
         # GCS node_id hex -> first time seen idle
         self._idle_since: dict[str, float] = {}
+        # provider id -> first time seen launched-but-unregistered
+        self._pending_since: dict[str, float] = {}
         # provider node ids this autoscaler launched (never scales below
         # nodes it doesn't own)
         self._launched: list[str] = []
@@ -83,18 +89,38 @@ class Autoscaler:
         cfg = self.config
         now = time.monotonic()
         alive = [n for n in nodes if n.get("alive")]
-        alive_pids = {int(n.get("pid", 0)) for n in alive}
         total_queued = sum(int(n.get("queued_leases", 0)) for n in alive)
 
-        # prune launched nodes whose processes died
-        live_provider = set(self.provider.non_terminated_nodes())
+        # advance provider-side lifecycle (v2 instance manager state
+        # machine: cloud operations, ALLOCATED->RAY_RUNNING matching);
+        # reconcile() returns the live set so one cloud list serves the
+        # whole pass
+        live_provider = None
+        if hasattr(self.provider, "reconcile"):
+            live_provider = self.provider.reconcile(alive)
+        if live_provider is None:
+            live_provider = set(self.provider.non_terminated_nodes())
+        # prune launched nodes the provider no longer tracks
         self._launched = [l for l in self._launched if l in live_provider]
         # pending = launched but not yet registered with the GCS: while any
         # exist, don't launch more (ref: v2 instance-manager pending states)
-        pending = [
-            l for l in self._launched
-            if (self.provider.pid_of(l) or -1) not in alive_pids
-        ]
+        pending = []
+        for l in self._launched:
+            if any(self.provider.matches(l, n) for n in alive):
+                self._pending_since.pop(l, None)
+                continue
+            first = self._pending_since.setdefault(l, now)
+            if now - first > cfg.pending_timeout_s:
+                # cloud resource lives but its raylet never registered
+                # (crashed during bootstrap): reclaim it or scale-up
+                # wedges behind a permanent 'pending' entry
+                self.provider.terminate_node(l)
+                self._launched.remove(l)
+                self._pending_since.pop(l, None)
+                self.events.append({"ts": time.time(), "action": "reclaim",
+                                    "node": l})
+                continue
+            pending.append(l)
 
         # ---- scale up: queued demand nothing alive can absorb
         if total_queued > 0 and not pending:
@@ -114,12 +140,10 @@ class Autoscaler:
         if len(alive) <= cfg.min_nodes or not self._launched:
             self._idle_since = {}
             return
-        pid_to_provider = {
-            self.provider.pid_of(l): l for l in self._launched
-        }
         for n in alive:
-            node_pid = int(n.get("pid", 0))
-            provider_id = pid_to_provider.get(node_pid)
+            provider_id = next(
+                (l for l in self._launched if self.provider.matches(l, n)),
+                None)
             if provider_id is None:
                 continue  # never touch nodes this autoscaler didn't launch
             nid = n["node_id"].hex() if hasattr(n["node_id"], "hex") else str(n["node_id"])

@@ -23,6 +23,12 @@ class NodeProvider:
     def non_terminated_nodes(self) -> list[str]:
         raise NotImplementedError
 
+    def matches(self, provider_node_id: str, gcs_node: dict) -> bool:
+        """Does this GCS cluster-view row belong to the given provider
+        node? Providers link their instances to registered raylets their
+        own way (local: pid; cloud: an ``instance`` node label)."""
+        raise NotImplementedError
+
 
 class LocalSubprocessProvider(NodeProvider):
     """Launches real raylet subprocesses against one GCS — scaling on a
@@ -75,6 +81,10 @@ class LocalSubprocessProvider(NodeProvider):
     def pid_of(self, provider_node_id: str) -> int | None:
         proc = self._procs.get(provider_node_id)
         return proc.pid if proc is not None else None
+
+    def matches(self, provider_node_id: str, gcs_node: dict) -> bool:
+        pid = self.pid_of(provider_node_id)
+        return pid is not None and int(gcs_node.get("pid", 0)) == pid
 
     def shutdown(self):
         for nid in list(self._procs):

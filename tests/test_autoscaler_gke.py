@@ -1,0 +1,149 @@
+"""GKE TPU pod provider + v2 instance lifecycle against a fake cloud
+(ref test strategy: autoscaler v2 tests driving the reconciler with a
+fake node provider — fake_multi_node/node_provider.py:236)."""
+
+import time
+
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    GKETPUPodProvider,
+    InstanceManager,
+)
+from ray_tpu.autoscaler import instance_manager as im
+
+
+class FakeGKE:
+    """In-memory container-API surface: node pools provision after one
+    poll, delete after one poll — enough asynchrony to exercise the
+    REQUESTED->ALLOCATED and TERMINATING->TERMINATED edges."""
+
+    def __init__(self):
+        self.pools: dict[str, dict] = {}
+        self.calls: list[tuple[str, str]] = []
+
+    def __call__(self, method, path, body=None):
+        self.calls.append((method, path))
+        if method == "POST":
+            pool = dict(body["nodePool"], status="PROVISIONING")
+            self.pools[pool["name"]] = pool
+            return {"name": f"op-create-{pool['name']}"}
+        if method == "DELETE":
+            name = path.rsplit("/", 1)[1]
+            if name in self.pools:
+                self.pools[name]["status"] = "STOPPING"
+            return {"name": f"op-delete-{name}"}
+        # GET: advance the fake cloud one step per poll
+        for pool in list(self.pools.values()):
+            if pool["status"] == "PROVISIONING":
+                pool["status"] = "RUNNING"
+            elif pool["status"] == "STOPPING":
+                del self.pools[pool["name"]]
+        return {"nodePools": list(self.pools.values())}
+
+
+def _gcs_node(pool_name, queued=0, busy=False):
+    class _Nid:
+        def hex(self):
+            return f"node-{pool_name}"
+
+    return {
+        "node_id": _Nid(),
+        "alive": True,
+        "pid": 0,
+        "labels": {"instance": pool_name},
+        "queued_leases": queued,
+        "resources_total": {"CPU": 4.0, "TPU": 16.0, "node": 1.0},
+        "resources_available": (
+            {"CPU": 3.0, "TPU": 12.0, "node": 1.0} if busy
+            else {"CPU": 4.0, "TPU": 16.0, "node": 1.0}),
+    }
+
+
+def test_slice_scale_up_and_drain():
+    """Demand scales a fake TPU slice up (full lifecycle to RAY_RUNNING);
+    idleness drains it back down (to TERMINATED)."""
+    fake = FakeGKE()
+    mgr = InstanceManager(GKETPUPodProvider(
+        "proj", "us-central2-b", "cluster", tpu_type="v5litepod-16",
+        transport=fake))
+    scaler = Autoscaler(
+        ("127.0.0.1", 0), mgr,
+        AutoscalerConfig(min_nodes=1, max_nodes=3, upscale_delay_s=0.05,
+                         idle_timeout_s=0.2))
+    # head node busy with queued TPU demand -> launch a slice
+    head = _gcs_node("head", queued=3, busy=True)
+    head["labels"] = {}
+    scaler._reconcile([head])  # records demand
+    time.sleep(0.06)
+    scaler._reconcile([head])  # past upscale_delay: creates the pool
+    assert any(a == ("POST", mgr.provider.parent + "/nodePools")
+               for a in fake.calls)
+    (pool_name,) = [p for p in fake.pools]
+    assert pool_name.startswith("rt-tpu-")
+    assert fake.pools[pool_name]["placementPolicy"]["tpuTopology"] == "4x4"
+    inst = mgr.instances[pool_name]
+    assert inst.state == im.REQUESTED
+
+    # next pass: fake cloud advances PROVISIONING->RUNNING => ALLOCATED
+    scaler._reconcile([head])
+    assert inst.state == im.ALLOCATED
+    # no second launch while this one is pending registration
+    assert len(fake.pools) == 1
+
+    # the slice's raylet registers with the instance label => RAY_RUNNING
+    slice_node = _gcs_node(pool_name, busy=True)
+    scaler._reconcile([head, slice_node])
+    assert inst.state == im.RAY_RUNNING
+
+    # demand gone, slice idle past the timeout => drained
+    head_idle = _gcs_node("head")
+    head_idle["labels"] = {}
+    idle = _gcs_node(pool_name)
+    scaler._reconcile([head_idle, idle])
+    time.sleep(0.25)
+    scaler._reconcile([head_idle, idle])
+    assert inst.state in (im.RAY_STOPPING, im.TERMINATING)
+    # cloud completes the delete => TERMINATED, pool gone
+    scaler._reconcile([head_idle])
+    scaler._reconcile([head_idle])
+    assert inst.state == im.TERMINATED
+    assert fake.pools == {}
+    assert [e["action"] for e in scaler.events] == ["up", "down"]
+    assert mgr.summary() == {im.TERMINATED: 1}
+
+
+def test_allocation_failure_recorded():
+    def broken(method, path, body=None):
+        if method == "POST":
+            raise RuntimeError("quota exceeded")
+        return {"nodePools": []}
+
+    mgr = InstanceManager(GKETPUPodProvider(
+        "proj", "us-central2-b", "c", transport=broken))
+    try:
+        mgr.create_node(None)
+        assert False, "expected create failure"
+    except RuntimeError:
+        pass
+    (inst,) = mgr.instances.values()
+    assert inst.state == im.ALLOCATION_FAILED
+    assert "quota" in inst.error
+
+
+def test_unknown_tpu_type_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        GKETPUPodProvider("p", "l", "c", tpu_type="v99-9000")
+
+
+def test_provider_ignores_foreign_pools():
+    fake = FakeGKE()
+    fake.pools["user-pool"] = {"name": "user-pool", "status": "RUNNING"}
+    prov = GKETPUPodProvider("p", "l", "c", transport=fake)
+    assert prov.non_terminated_nodes() == []
+    name = prov.create_node(None)
+    assert sorted(prov.non_terminated_nodes()) == [name]
+    # terminating never touches pools it does not own
+    assert "user-pool" in fake.pools
