@@ -100,12 +100,15 @@ class Autoscaler:
             live_provider = self.provider.reconcile(alive)
         if live_provider is None:
             live_provider = set(self.provider.non_terminated_nodes())
-        # prune launched nodes the provider no longer tracks
+        # prune launched nodes the provider no longer tracks (and their
+        # pending timestamps: a reused provider id must not inherit one)
         self._launched = [l for l in self._launched if l in live_provider]
+        for k in [k for k in self._pending_since if k not in self._launched]:
+            del self._pending_since[k]
         # pending = launched but not yet registered with the GCS: while any
         # exist, don't launch more (ref: v2 instance-manager pending states)
         pending = []
-        for l in self._launched:
+        for l in list(self._launched):  # reclaim mutates the list
             if any(self.provider.matches(l, n) for n in alive):
                 self._pending_since.pop(l, None)
                 continue

@@ -85,7 +85,13 @@ class GKETPUPodProvider(NodeProvider):
     # --------------------------------------------------------------- CRUD
     def create_node(self, resources: dict | None = None) -> str:
         machine, chips_per_host, topology = TPU_SLICES[self.tpu_type]
-        hosts = max(1, self._slice_chips() // chips_per_host)
+        # host count derives from the topology's CHIP product (the type
+        # suffix counts TensorCores on v4/v5p — 2 per chip — and would
+        # request a node count GKE rejects against tpuTopology)
+        chips = 1
+        for dim in topology.split("x"):
+            chips *= int(dim)
+        hosts = max(1, chips // chips_per_host)
         self._counter += 1
         name = f"{POOL_PREFIX}{self._counter}"
         body = {
@@ -105,9 +111,6 @@ class GKETPUPodProvider(NodeProvider):
         op = self.transport("POST", f"{self.parent}/nodePools", body)
         self._ops[name] = op.get("name", "")
         return name
-
-    def _slice_chips(self) -> int:
-        return int(self.tpu_type.rsplit("-", 1)[1])
 
     def terminate_node(self, provider_node_id: str) -> None:
         op = self.transport(

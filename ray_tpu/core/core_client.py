@@ -2096,8 +2096,17 @@ class CoreClient:
                 raise ValueError(
                     f"runtime_env path {entry!r} is not a directory"
                 )
-        needs_packaging = (wd and os.path.isdir(wd)) or any(
-            os.path.isdir(p) for p in mods
+        from ray_tpu import runtime_env as _renv
+
+        needs_packaging = (
+            (wd and os.path.isdir(wd))
+            or any(os.path.isdir(p) for p in mods)
+            # plugin fields (pip/uv/...) normalize driver-side: the worker
+            # only ever sees packaged descriptors
+            or any(env.get(name) is not None
+                   and not (isinstance(env[name], dict)
+                            and "digest" in env[name])
+                   for name in _renv._PLUGINS)
         )
         if not needs_packaging:
             return env

@@ -155,6 +155,8 @@ class Worker:
             blobs = {}
             digests = ([] if not desc.get("working_dir") else [desc["working_dir"]])
             digests += list(desc.get("py_modules", []))
+            from ray_tpu.runtime_env import plugin_blob_keys
+
             for d in digests:
                 # node-local content-addressed cache first: warm workers on
                 # this node skip the package transfer entirely
@@ -163,7 +165,14 @@ class Worker:
                 blobs[d] = await self.core.gcs.call(
                     "kv_get", {"ns": "runtime_env_packages", "key": d}
                 )
-            apply_runtime_env(desc, lambda k: blobs.get(k))
+            for key in plugin_blob_keys(desc):
+                blobs[key] = await self.core.gcs.call(
+                    "kv_get", {"ns": "runtime_env_packages", "key": key}
+                )
+            # off-loop: plugin applies can run pip installs for minutes,
+            # and the loop must keep answering pushes and health checks
+            await asyncio.get_running_loop().run_in_executor(
+                None, apply_runtime_env, desc, lambda k: blobs.get(k))
             self._runtime_env_applied = True  # only after success
             # nested submissions from this worker inherit the env
             self.core.default_runtime_env = desc

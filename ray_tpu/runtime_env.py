@@ -2,24 +2,33 @@
 
 TPU-native counterpart of the reference runtime-env subsystem (ref:
 python/ray/_private/runtime_env/working_dir.py — zip+hash upload,
-worker-side download/extract/sys.path; env_vars plugin). The GCS KV is
-the package store (the reference's GCS-backed package URI role):
+worker-side download/extract/sys.path; env_vars plugin; pip.py / uv.py
+package plugins; plugin.py's RuntimeEnvPlugin ABC). The GCS KV is the
+package store (the reference's GCS-backed package URI role):
 
     ray_tpu.init(runtime_env={
         "working_dir": "./my_project",        # zipped -> GCS -> workers
         "env_vars": {"TOKENIZERS_PARALLELISM": "false"},
         "py_modules": ["./libs/extra_pkg"],   # each added to sys.path
+        "pip": ["somepkg==1.2", "/path/to/local.whl"],  # venv-per-env
+        "uv": [...],                          # same, via uv's resolver
     })
 
 Workers apply the env before the first user code runs: extract packages
 to a content-addressed cache, prepend to sys.path, chdir into
-working_dir, export env_vars.
+working_dir, export env_vars; pip/uv requirement sets build a venv keyed
+by the requirement digest (built once per node, shared by every worker
+and cross-checked through a GCS-KV record of the requirement set).
+
+Additional fields are pluggable: subclass :class:`RuntimeEnvPlugin` and
+:func:`register_plugin` it (the reference's plugin.py extension point).
 """
 from __future__ import annotations
 
 import hashlib
 import io
 import os
+import subprocess
 import sys
 import tempfile
 import zipfile
@@ -71,7 +80,11 @@ def package_runtime_env(env: dict, kv_put) -> dict:
             kv_put(digest, blob)
             hashes.append(digest)
         desc[field] = hashes if many else hashes[0]
-    unknown = set(env) - {"working_dir", "py_modules", "env_vars"}
+    for name, plugin in _PLUGINS.items():
+        if env.get(name) is not None:
+            desc[name] = plugin.package(env[name], kv_put)
+    unknown = (set(env) - {"working_dir", "py_modules", "env_vars"}
+               - set(_PLUGINS))
     if unknown:
         raise ValueError(f"unsupported runtime_env fields: {sorted(unknown)}")
     return desc
@@ -115,6 +128,9 @@ def apply_runtime_env(desc: dict, kv_get) -> None:
         if path not in sys.path:
             sys.path.insert(0, path)
         os.chdir(path)
+    for name, plugin in _PLUGINS.items():
+        if desc.get(name) is not None:
+            plugin.apply(desc[name], kv_get)
 
 
 def _materialize(digest: str, kv_get) -> str:
@@ -125,3 +141,175 @@ def _materialize(digest: str, kv_get) -> str:
     if blob is None:
         raise RuntimeError(f"runtime_env package {digest} missing from the GCS")
     return _extract_package(digest, blob)
+
+
+# ------------------------------------------------------------ plugin system
+class RuntimeEnvPlugin:
+    """Extension point for additional runtime_env fields (ref:
+    _private/runtime_env/plugin.py RuntimeEnvPlugin).
+
+    ``package`` runs driver-side once per submission (normalize the user
+    value, upload anything big through ``kv_put``); ``apply`` runs in the
+    worker before user code (materialize, mutate sys.path/os.environ)."""
+
+    name: str = ""
+
+    def package(self, value, kv_put):
+        return value
+
+    def apply(self, value, kv_get) -> None:
+        raise NotImplementedError
+
+
+_PLUGINS: dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a name")
+    _PLUGINS[plugin.name] = plugin
+
+
+def plugin_blob_keys(desc: dict) -> list[str]:
+    """KV keys a worker must prefetch to apply this descriptor's plugin
+    fields (wheel payloads shipped by content)."""
+    keys = []
+    for name in _PLUGINS:
+        value = desc.get(name)
+        if isinstance(value, dict):
+            for r in value.get("requirements", []):
+                if isinstance(r, str) and r.startswith("@WHEEL:"):
+                    keys.append("whl-" + r.split(":", 2)[1])
+    return keys
+
+
+class _PipPlugin(RuntimeEnvPlugin):
+    """Venv-per-requirement-set package installs (ref:
+    _private/runtime_env/pip.py; the uv subclass mirrors uv.py).
+
+    The descriptor carries the normalized requirement list plus a digest
+    of (requirements, python version, tool). Workers build ONE venv per
+    digest under the node-local cache — concurrent workers serialize on
+    an exclusive lock file and reuse the finished build — then splice the
+    venv's site-packages into ``sys.path`` (workers are long-lived
+    processes; re-exec'ing under the venv python would drop their live
+    raylet registration). ``--system-site-packages`` keeps the base
+    environment (jax et al.) visible beneath the env's packages. The
+    requirement set is also recorded in the GCS KV under the digest so
+    any node can reconstruct the env from the descriptor alone."""
+
+    name = "pip"
+    tool = "pip"
+
+    def package(self, value, kv_put):
+        raw = value.get("packages") if isinstance(value, dict) else value
+        raw = [str(r) for r in (raw or [])]
+        if not raw:
+            raise ValueError(f"runtime_env {self.name}: empty requirement list")
+        reqs = []
+        for r in raw:
+            p = os.path.abspath(os.path.expanduser(r))
+            if os.path.isfile(p):
+                # local wheel/sdist: ship by CONTENT — the path means
+                # nothing on other nodes, and hashing bytes (not the path
+                # string) means a rebuilt wheel gets a fresh venv
+                with open(p, "rb") as f:
+                    blob = f.read()
+                d = hashlib.sha1(blob).hexdigest()
+                kv_put(f"whl-{d}", blob)
+                reqs.append(f"@WHEEL:{d}:{os.path.basename(p)}")
+            else:
+                reqs.append(r)
+        digest = hashlib.sha1(
+            ("\n".join(sorted(reqs)) + sys.version + self.tool).encode()
+        ).hexdigest()
+        kv_put(f"reqs-{digest}", "\n".join(reqs).encode())
+        return {"requirements": reqs, "digest": digest}
+
+    def apply(self, value, kv_get) -> None:
+        venv_dir = os.path.join(_cache_dir(), "venvs", value["digest"])
+        done = venv_dir + ".done"
+        if not os.path.exists(done):
+            self._build(venv_dir, done, value["requirements"], kv_get)
+        self._activate(venv_dir)
+
+    # ------------------------------------------------------------- build
+    def _build(self, venv_dir: str, done: str, reqs: list[str],
+               kv_get) -> None:
+        import fcntl
+
+        os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+        with open(venv_dir + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(done):  # another worker built it meanwhile
+                return
+            import venv as venv_mod
+
+            # no ensurepip (it costs ~10s): installs run through the BASE
+            # interpreter's pip targeting the venv via --python
+            venv_mod.create(venv_dir, with_pip=False,
+                            system_site_packages=True, clear=True)
+            lines = []
+            for r in reqs:
+                if r.startswith("@WHEEL:"):
+                    _, d, fname = r.split(":", 2)
+                    blob = kv_get(f"whl-{d}")
+                    if blob is None:
+                        raise RuntimeError(
+                            f"runtime_env wheel whl-{d} missing from GCS")
+                    wpath = os.path.join(venv_dir, fname)
+                    with open(wpath, "wb") as f:
+                        f.write(blob)
+                    lines.append(wpath)
+                else:
+                    lines.append(r)
+            req_file = os.path.join(venv_dir, "requirements.txt")
+            with open(req_file, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            py = os.path.join(venv_dir, "bin", "python")
+            cmd = self._install_cmd(py, req_file)
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"runtime_env {self.name} install failed "
+                    f"({' '.join(cmd)}):\n{proc.stderr[-2000:]}")
+            open(done, "w").close()
+
+    def _install_cmd(self, venv_python: str, req_file: str) -> list[str]:
+        return [sys.executable, "-m", "pip", "--python", venv_python,
+                "install", "--no-input", "-r", req_file]
+
+    # ---------------------------------------------------------- activate
+    def _activate(self, venv_dir: str) -> None:
+        import glob
+
+        sites = glob.glob(os.path.join(
+            venv_dir, "lib", "python*", "site-packages"))
+        for sp in sites:
+            if sp not in sys.path:
+                sys.path.insert(0, sp)
+        os.environ["VIRTUAL_ENV"] = venv_dir
+        os.environ["PATH"] = (os.path.join(venv_dir, "bin") + os.pathsep
+                              + os.environ.get("PATH", ""))
+
+
+class _UvPlugin(_PipPlugin):
+    """uv-resolved variant (ref: _private/runtime_env/uv.py). Falls back
+    to pip when no uv binary is on PATH."""
+
+    name = "uv"
+    tool = "uv"
+
+    def _install_cmd(self, venv_python: str, req_file: str) -> list[str]:
+        import shutil
+
+        uv = shutil.which("uv")
+        if uv is None:
+            return [sys.executable, "-m", "pip", "--python", venv_python,
+                    "install", "--no-input", "-r", req_file]
+        return [uv, "pip", "install", "--python", venv_python,
+                "-r", req_file]
+
+
+register_plugin(_PipPlugin())
+register_plugin(_UvPlugin())

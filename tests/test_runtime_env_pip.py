@@ -1,0 +1,111 @@
+"""pip/uv runtime-env plugins: wheel installed into an isolated
+venv-per-env and imported inside a task (ref test strategy:
+python/ray/tests/test_runtime_env_conda_and_pip.py, offline variant —
+the wheel is built locally so no index access is needed)."""
+
+import base64
+import hashlib
+import os
+import shutil
+import sys
+import zipfile
+
+import pytest
+
+import ray_tpu
+
+PKG = "rt_testwheel"
+
+
+def _make_wheel(tmpdir, version="0.1") -> str:
+    """Handcraft a minimal PEP-427 wheel (no setuptools invocation)."""
+    name = f"{PKG}-{version}-py3-none-any.whl"
+    path = os.path.join(tmpdir, name)
+    dist = f"{PKG}-{version}.dist-info"
+    files = {
+        f"{PKG}/__init__.py": f"__version__ = {version!r}\n"
+                              f"def marker():\n    return 'wheel-ok'\n",
+        f"{dist}/METADATA": (f"Metadata-Version: 2.1\nName: {PKG}\n"
+                             f"Version: {version}\n"),
+        f"{dist}/WHEEL": ("Wheel-Version: 1.0\nGenerator: handmade\n"
+                          "Root-Is-Purelib: true\nTag: py3-none-any\n"),
+    }
+    record_rows = []
+    for rel, content in files.items():
+        data = content.encode()
+        digest = base64.urlsafe_b64encode(
+            hashlib.sha256(data).digest()).rstrip(b"=").decode()
+        record_rows.append(f"{rel},sha256={digest},{len(data)}")
+    record_rows.append(f"{dist}/RECORD,,")
+    files[f"{dist}/RECORD"] = "\n".join(record_rows) + "\n"
+    with zipfile.ZipFile(path, "w") as zf:
+        for rel, content in files.items():
+            zf.writestr(rel, content)
+    return path
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_pip_env_installs_wheel_in_task(rt, tmp_path):
+    wheel = _make_wheel(str(tmp_path))
+    assert PKG not in sys.modules  # the driver env stays clean
+
+    @ray_tpu.remote(runtime_env={"pip": [wheel]})
+    def probe():
+        import rt_testwheel
+
+        return rt_testwheel.marker(), rt_testwheel.__version__
+
+    assert ray_tpu.get(probe.remote(), timeout=180) == ("wheel-ok", "0.1")
+    # the driver process must NOT see the package (isolation)
+    with pytest.raises(ImportError):
+        import rt_testwheel  # noqa: F401
+
+
+def test_pip_env_cache_reused(rt, tmp_path):
+    """Same requirement set: the venv builds once and later tasks reuse
+    it (content-addressed by requirements digest)."""
+    from ray_tpu.runtime_env import _PipPlugin, _cache_dir
+
+    wheel = _make_wheel(str(tmp_path))
+    desc = _PipPlugin().package([wheel], lambda k, b: None)
+    venv_done = os.path.join(_cache_dir(), "venvs",
+                             desc["digest"] + ".done")
+
+    @ray_tpu.remote(runtime_env={"pip": [wheel]})
+    def probe(i):
+        import rt_testwheel
+
+        return i, rt_testwheel.marker()
+
+    assert ray_tpu.get(probe.remote(1), timeout=180) == (1, "wheel-ok")
+    assert os.path.exists(venv_done)
+    stamp = os.path.getmtime(venv_done)
+    assert ray_tpu.get(probe.remote(2), timeout=180) == (2, "wheel-ok")
+    assert os.path.getmtime(venv_done) == stamp  # no rebuild
+
+
+def test_uv_env_installs_wheel_in_task(rt, tmp_path):
+    """uv plugin (falls back to pip when uv is absent — either path must
+    produce a working env)."""
+    wheel = _make_wheel(str(tmp_path), version="0.2")
+
+    @ray_tpu.remote(runtime_env={"uv": [wheel]})
+    def probe():
+        import rt_testwheel
+
+        return rt_testwheel.__version__
+
+    assert ray_tpu.get(probe.remote(), timeout=180) == "0.2"
+
+
+def test_empty_requirements_rejected(rt):
+    from ray_tpu.runtime_env import package_runtime_env
+
+    with pytest.raises(ValueError):
+        package_runtime_env({"pip": []}, lambda k, b: None)
