@@ -85,6 +85,11 @@ class Config:
     #: max unreplied fast-path tasks per worker before spilling to RPC
     fastpath_inflight_max: int = 4096
 
+    # --- tracing (ref: util/tracing/tracing_helper.py span injection) ---
+    #: propagate span contexts through task specs and record spans into
+    #: the task-event pipeline (ray_tpu.state.list_spans / timeline)
+    tracing_enabled: bool = False
+
     # --- memory protection (ref: memory_monitor.h:52) ---
     #: fraction of system memory in use that triggers OOM killing;
     #: <= 0 disables the monitor

@@ -1380,6 +1380,7 @@ class CoreClient:
             if (num_returns == 1 and placement_group is None
                     and scheduling_node is None and runtime_env is None
                     and scheduling_strategy is None
+                    and not self.cfg.tracing_enabled
                     and name is None and max_retries is None):
                 ref = self._try_fast_submit(
                     fn, args, kwargs, dict(resources or {"CPU": 1.0}))
@@ -1410,6 +1411,8 @@ class CoreClient:
         metrics.tasks_submitted.inc()
         self.task_events.emit(task_id=task_id.hex(), name=spec["name"],
                               state="PENDING_ARGS_AVAIL")
+        if self.cfg.tracing_enabled:
+            self._emit_submit_span(spec, spec["name"])
         if num_returns == "streaming":
             self._gen_states[task_id] = _GenState()
             self._call_on_loop(self._submit_async(spec))
@@ -1434,6 +1437,25 @@ class CoreClient:
             refs.append(self._new_owned_ref(roid))
         self._call_on_loop(self._submit_async(spec))
         return refs[0] if num_returns == 1 else refs
+
+    def _emit_submit_span(self, spec: dict, name: str) -> None:
+        """Record a point span for the .remote() call and inject its id as
+        the parent for the executing side's child span (ref:
+        tracing_helper.py:36-60 span-context injection into task specs)."""
+        from ray_tpu.utils import tracing
+
+        parent = tracing.inject()
+        submit_id = tracing._gen_span_id()
+        now = time.time()
+        self.task_events.emit(
+            task_id=spec["task_id"].hex(), name=f"{name}.remote",
+            state="SPAN", span={
+                "trace_id": parent["trace_id"], "span_id": submit_id,
+                "parent_span_id": parent.get("parent_span_id"),
+                "name": f"{name}.remote", "start_ts": now, "end_ts": now,
+            })
+        spec["trace_ctx"] = {"trace_id": parent["trace_id"],
+                             "parent_span_id": submit_id}
 
     def _call_on_loop(self, coro):
         """Run a coroutine (or apply a deleted-ref notice, passed as a bare
@@ -2259,6 +2281,8 @@ class CoreClient:
             "seq": None,
             "concurrency_group": concurrency_group,
         }
+        if self.cfg.tracing_enabled:
+            self._emit_submit_span(spec, method)
         q = self._actor_queues.setdefault(actor_id, [])
         q.append(spec)
         self._call_on_loop(self._ensure_actor_pump(actor_id))

@@ -638,6 +638,38 @@ class Worker:
             replied.add(corr)
         await conn.respond_multi(out)
 
+    def _traced_call(self, spec, fn, args, kwargs):
+        """Run a user callable inside a child span when the spec carries a
+        trace context (ref: tracing_helper.py:36-60 — child spans around
+        execution; the contextvar makes nested .remote() calls chain)."""
+        tc = spec.get("trace_ctx")
+        if not tc:
+            return fn(*args, **kwargs)
+        from ray_tpu.utils import tracing
+
+        name = spec.get("name") or spec.get("method", "task")
+        with tracing.span(f"{name}::run", tc, self._span_sink(spec)):
+            return fn(*args, **kwargs)
+
+    async def _traced_acall(self, spec, coro_fn, args, kwargs):
+        """Async twin of _traced_call for coroutine tasks/actor methods."""
+        tc = spec.get("trace_ctx")
+        if not tc:
+            return await coro_fn(*args, **kwargs)
+        from ray_tpu.utils import tracing
+
+        name = spec.get("name") or spec.get("method", "task")
+        with tracing.span(f"{name}::run", tc, self._span_sink(spec)):
+            return await coro_fn(*args, **kwargs)
+
+    def _span_sink(self, spec):
+        def sink(s):
+            self.core.task_events.emit(
+                task_id=spec["task_id"].hex(), name=s["name"], state="SPAN",
+                span=s, worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid())
+        return sink
+
     def _exec_actor_run_thread(self, specs):
         out = []
         inst = self.actor_instance
@@ -652,7 +684,7 @@ class Worker:
                     k: serialization.unpack(a[1]) if a[0] == "v" else a[1]
                     for k, a in spec["kwargs"].items()
                 }
-                out.append((True, m(*args, **kwargs)))
+                out.append((True, self._traced_call(spec, m, args, kwargs)))
             except Exception as e:
                 out.append((False, e))
         return out
@@ -682,7 +714,7 @@ class Worker:
                     k: serialization.unpack(a[1]) if a[0] == "v" else a[1]
                     for k, a in spec["kwargs"].items()
                 }
-                value = fn(*args, **kwargs)
+                value = self._traced_call(spec, fn, args, kwargs)
                 if inspect.isgenerator(value):
                     value = list(value)
                     if spec["num_returns"] != 1:
@@ -711,9 +743,11 @@ class Worker:
                 return await self._execute_streaming(spec, fn, args, kwargs)
             loop = asyncio.get_running_loop()
             if inspect.iscoroutinefunction(fn):
-                value = await fn(*args, **kwargs)
+                value = await self._traced_acall(spec, fn, args, kwargs)
             else:
-                value = await loop.run_in_executor(self.executor, lambda: fn(*args, **kwargs))
+                value = await loop.run_in_executor(
+                    self.executor,
+                    lambda: self._traced_call(spec, fn, args, kwargs))
                 if inspect.isgenerator(value):
                     # legacy generator semantics (ref: old num_returns=N
                     # generators): materialize; N>1 distributes the items
@@ -981,15 +1015,19 @@ class Worker:
 
                     async def run_grouped(method=method, args=args, kwargs=kwargs):
                         async with sem:  # group-bounded async slots
-                            return await method(*args, **kwargs)
+                            return await self._traced_acall(
+                                spec, method, args, kwargs)
 
                     work = asyncio.get_running_loop().create_task(run_grouped())
                 else:
-                    work = asyncio.get_running_loop().create_task(method(*args, **kwargs))
+                    work = asyncio.get_running_loop().create_task(
+                        self._traced_acall(spec, method, args, kwargs))
             else:
                 loop = asyncio.get_running_loop()
                 executor = self._group_execs.get(group, self.executor)
-                work = loop.run_in_executor(executor, lambda: method(*args, **kwargs))
+                work = loop.run_in_executor(
+                    executor,
+                    lambda: self._traced_call(spec, method, args, kwargs))
         except Exception as e:
             return {"error": _as_task_error(e)}
         finally:

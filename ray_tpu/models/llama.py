@@ -224,12 +224,64 @@ def llama_pp_init(key, cfg: LlamaConfig, n_stages: int) -> dict:
     return {"dense": params, "stages": stacked}
 
 
+def _block_tp(layer, x, cos, sin, cfg: LlamaConfig, tp_axis: str):
+    """Megatron-style tensor-parallel transformer block for use INSIDE a
+    shard_map body (each tp rank holds a weight slice): q/k/v and
+    gate/up are column-parallel (heads / ff split across ranks), wo and
+    w_down row-parallel with a psum to rejoin the residual stream."""
+    from jax import lax
+
+    B, T, D = x.shape
+    hd = cfg.head_dim
+    tp = lax.axis_size(tp_axis)
+    h = rms_norm(x, layer["attn_norm"]["scale"])
+    q = (h @ layer["wq"]["kernel"]).reshape(B, T, cfg.n_heads // tp, hd)
+    k = (h @ layer["wk"]["kernel"]).reshape(B, T, cfg.n_kv_heads // tp, hd)
+    v = (h @ layer["wv"]["kernel"]).reshape(B, T, cfg.n_kv_heads // tp, hd)
+    q = rope(q, cos, sin)
+    k = rope(k, cos, sin)
+    att = attention(q, k, v, causal=True, mesh=None, seq_axis=None,
+                    impl="plain")
+    att = lax.psum(att.reshape(B, T, -1) @ layer["wo"]["kernel"], tp_axis)
+    x = x + att
+    h = rms_norm(x, layer["ffn_norm"]["scale"])
+    ffn = (jax.nn.silu(h @ layer["w_gate"]["kernel"])
+           * (h @ layer["w_up"]["kernel"])) @ layer["w_down"]["kernel"]
+    return x + lax.psum(ffn, tp_axis)
+
+
+def pp_stage_param_specs(stacked_params, *, pp_axis: str = "pp",
+                         tp_axis: str | None = None):
+    """PartitionSpecs for pipeline stage weights: leading stage axis on
+    pp; with ``tp_axis``, attention/ffn weights additionally split
+    Megatron-style (column for wq/wk/wv/w_gate/w_up, row for
+    wo/w_down)."""
+    from jax.sharding import PartitionSpec as P
+
+    col = {"wq", "wk", "wv", "w_gate", "w_up"}
+    row = {"wo", "w_down"}
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if tp_axis:
+            if any(n in col for n in names):
+                return P(pp_axis, *([None] * (leaf.ndim - 2)), tp_axis)
+            if any(n in row for n in names):
+                return P(pp_axis, *([None] * (leaf.ndim - 3)), tp_axis, None)
+        return P(pp_axis)
+
+    return jax.tree_util.tree_map_with_path(spec, stacked_params)
+
+
 def llama_pp_loss(params, batch, cfg: LlamaConfig, mesh, *, n_microbatches: int,
-                  attn_impl: str = "plain", batch_axis: str | None = "dp"):
+                  attn_impl: str = "plain", batch_axis: str | None = "dp",
+                  tp_axis: str | None = None):
     """Next-token CE through a GPipe pipeline over the mesh's pp axis
     (ref: SURVEY §2.3 PP — the reference only gets PP via vLLM config or
     compiled-graph p2p channels; here the pipeline is one jitted SPMD
-    program, see parallel/pipeline.py)."""
+    program, see parallel/pipeline.py). With ``tp_axis`` each stage ALSO
+    runs Megatron tensor parallelism over that mesh axis — the full
+    dp x tp x pp composition in one program."""
     from jax import lax
 
     from ray_tpu.parallel.pipeline import pipeline_apply
@@ -241,16 +293,33 @@ def llama_pp_loss(params, batch, cfg: LlamaConfig, mesh, *, n_microbatches: int,
     cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     block = _maybe_remat_block(cfg)
 
-    def stage_fn(stage_params, h):
-        def layer_step(h, layer):
-            h, _ = block(layer, h, cos, sin, cfg, None, attn_impl, None)
-            return h, None
+    if tp_axis is not None:
+        tp_block = (jax.checkpoint(_block_tp, static_argnums=(4, 5))
+                    if cfg.remat else _block_tp)
 
-        h, _ = lax.scan(layer_step, h, stage_params)
-        return h
+        def stage_fn(stage_params, h):
+            def layer_step(h, layer):
+                return tp_block(layer, h, cos, sin, cfg, tp_axis), None
+
+            h, _ = lax.scan(layer_step, h, stage_params)
+            return h
+
+        param_specs = pp_stage_param_specs(
+            params["stages"], tp_axis=tp_axis)
+    else:
+        def stage_fn(stage_params, h):
+            def layer_step(h, layer):
+                h, _ = block(layer, h, cos, sin, cfg, None, attn_impl, None)
+                return h, None
+
+            h, _ = lax.scan(layer_step, h, stage_params)
+            return h
+
+        param_specs = None
 
     x = pipeline_apply(stage_fn, params["stages"], x, mesh,
-                       n_microbatches=n_microbatches, batch_axis=batch_axis)
+                       n_microbatches=n_microbatches, batch_axis=batch_axis,
+                       param_specs=param_specs)
     x = rms_norm(x, dense["norm"]["scale"])
     return _ce_loss(x @ dense["lm_head"]["kernel"], targets)
 

@@ -73,7 +73,8 @@ def pipeline_spmd_local(stage_fn, stage_params, x_micro, *, axis_name: str = "pp
 
 
 def pipeline_apply(stage_fn, stacked_params, x, mesh, *, n_microbatches: int,
-                   axis_name: str = "pp", batch_axis: str | None = None):
+                   axis_name: str = "pp", batch_axis: str | None = None,
+                   param_specs=None):
     """Run a GPipe pipeline over ``mesh``'s ``axis_name``.
 
     stacked_params: pytree whose leaves have a leading stage axis of size
@@ -84,6 +85,10 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, *, n_microbatches: int,
         instance on B_total/n_microbatches/dp rows per step (so
         B_total/n_microbatches must divide by the dp size; the
         microbatch-step dim itself stays replicated).
+    param_specs: optional per-leaf PartitionSpecs for stacked_params whose
+        FIRST axis entry must be ``axis_name`` — pass tp-sharded weight
+        specs to run tensor parallelism INSIDE each pipeline stage (the
+        stage_fn is then responsible for the matching psums).
     Returns [B_total, ...] final-stage outputs.
     """
     B = x.shape[0]
@@ -91,7 +96,8 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, *, n_microbatches: int,
         raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
     x_micro = x.reshape(n_microbatches, B // n_microbatches, *x.shape[1:])
 
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     x_spec = P(None, batch_axis) if batch_axis else P()
 
     def body(params, xm):

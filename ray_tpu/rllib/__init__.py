@@ -6,6 +6,9 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
 - learner: jitted PPO updates + learner group (learner_group.py:100)
 - ppo: PPOConfig builder + Algorithm driver (algorithms/ppo/ppo.py:362)
 - dqn: off-policy double-DQN over replay buffers (algorithms/dqn/)
+- impala: async sampling + V-trace correction (algorithms/impala/)
+- sac: discrete twin-critic soft actor-critic with autotuned temperature
+  (algorithms/sac/)
 - replay_buffer: uniform + prioritized rings (utils/replay_buffers/)
 - multi_agent: MultiAgentEnv + MultiAgentEnvRunner (env/multi_agent_*)
 
@@ -21,26 +24,37 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
 from ray_tpu.rllib.core import policy_init, policy_logits, sample_action, value_fn
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner, make_dqn_update, q_init, q_values
 from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, make_impala_update, vtrace_returns
 from ray_tpu.rllib.learner import Learner, compute_gae, make_ppo_update
 from ray_tpu.rllib.multi_agent import MultiAgentEnv, MultiAgentEnvRunner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rllib.sac import SAC, SACConfig, SACEnvRunner, make_sac_update, sac_init
 
 __all__ = [
     "DQN",
     "DQNConfig",
     "DQNEnvRunner",
     "EnvRunner",
+    "IMPALA",
+    "IMPALAConfig",
     "Learner",
     "MultiAgentEnv",
     "MultiAgentEnvRunner",
     "PPO",
     "PPOConfig",
     "PrioritizedReplayBuffer",
+    "SAC",
+    "SACConfig",
+    "SACEnvRunner",
     "ReplayBuffer",
     "compute_gae",
     "make_dqn_update",
+    "make_impala_update",
     "make_ppo_update",
+    "make_sac_update",
+    "sac_init",
+    "vtrace_returns",
     "policy_init",
     "policy_logits",
     "q_init",

@@ -71,6 +71,9 @@ class EnvRunner:
             "rewards": np.stack(rew_l),
             "dones": np.stack(done_l),
             "last_value": last_value,        # [N]
+            # bootstrap OBS so off-policy learners (V-trace) can evaluate
+            # it under the CURRENT policy rather than the behavior one
+            "last_obs": np.asarray(self.obs),
         }
 
     def episode_metrics(self) -> dict:

@@ -1,0 +1,244 @@
+"""IMPALA: asynchronous sampling with V-trace off-policy correction.
+
+TPU-native counterpart of the reference IMPALA (ref:
+rllib/algorithms/impala/impala.py + the V-trace math from
+impala/vtrace_*.py, Espeholt et al. 2018): env-runners sample
+continuously with whatever policy they last received — the driver never
+blocks the learner on the slowest runner — and the learner corrects for
+the resulting policy lag with truncated importance weights (rho/c bars).
+Weights broadcast every ``broadcast_interval`` consumed batches, so
+runner policies are deliberately stale in between: exactly the regime
+V-trace exists for.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import ray_tpu
+
+
+def vtrace_returns(behavior_logp, target_logp, rewards, values, last_value,
+                   dones, *, gamma: float, rho_bar: float = 1.0,
+                   c_bar: float = 1.0):
+    """V-trace targets + policy-gradient advantages over [T, N] arrays
+    (jax; runs inside the learner's jitted update)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    rho = jnp.minimum(jnp.exp(target_logp - behavior_logp), rho_bar)
+    c = jnp.minimum(rho, c_bar)
+    not_done = 1.0 - dones.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = rho * (rewards + gamma * not_done * next_values - values)
+
+    def back(acc, xs):
+        delta_t, c_t, nd_t = xs
+        acc = delta_t + gamma * nd_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        back, jnp.zeros_like(last_value), (deltas, c, not_done),
+        reverse=True)
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho * (rewards + gamma * not_done * next_vs - values)
+    return vs, pg_adv
+
+
+def make_impala_update(lr: float, gamma: float, vf_coeff: float,
+                       entropy_coeff: float, rho_bar: float, c_bar: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.core import policy_logits, value_fn
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(params, batch):
+        T, N = batch["actions"].shape
+        obs = batch["obs"]  # [T, N, D]
+        logits = policy_logits(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        values = value_fn(params, obs)
+        vs, pg_adv = vtrace_returns(
+            batch["logp"], target_logp, batch["rewards"], values,
+            value_fn(params, batch["last_obs"]), batch["dones"],
+            gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        pi_loss = -(target_logp * pg_adv).mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        return pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return update, optimizer
+
+
+class IMPALAConfig:
+    """Builder-style config (ref: impala.py IMPALAConfig)."""
+
+    def __init__(self):
+        self.env_name: str | None = None
+        self.env_config: dict = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 64
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_bar = 1.0
+        self.c_bar = 1.0
+        #: consumed batches between weight broadcasts (staleness window)
+        self.broadcast_interval = 1
+        #: batches consumed per train() call
+        self.batches_per_iter = 4
+        self.hidden = 64
+        self.seed = 0
+
+    def environment(self, env: str, env_config: dict | None = None):
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, vf_coeff=None,
+                 entropy_coeff=None, rho_bar=None, c_bar=None,
+                 broadcast_interval=None, batches_per_iter=None, hidden=None):
+        for name, val in (("lr", lr), ("gamma", gamma),
+                          ("vf_coeff", vf_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("rho_bar", rho_bar), ("c_bar", c_bar),
+                          ("broadcast_interval", broadcast_interval),
+                          ("batches_per_iter", batches_per_iter),
+                          ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "IMPALA":
+        if self.env_name is None:
+            raise ValueError("IMPALAConfig.environment(...) is required")
+        return IMPALA(self)
+
+
+class IMPALA:
+    """Async driver (ref: impala.py training_step): a sample request is
+    ALWAYS in flight on every runner; the learner consumes whichever
+    finishes first and only rebroadcasts weights every
+    broadcast_interval batches."""
+
+    def __init__(self, config: IMPALAConfig):
+        import jax
+
+        from ray_tpu.rllib.core import policy_init
+        from ray_tpu.rllib.env_runner import EnvRunner
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        RunnerCls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            RunnerCls.options(num_cpus=0.5).remote(
+                config.env_name, config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, env_config=config.env_config,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim, n_actions = ray_tpu.get(
+            self.runners[0].obs_and_action_space.remote(), timeout=120)
+        self.params = policy_init(jax.random.PRNGKey(config.seed), obs_dim,
+                                  n_actions, config.hidden)
+        self._update, optimizer = make_impala_update(
+            config.lr, config.gamma, config.vf_coeff, config.entropy_coeff,
+            config.rho_bar, config.c_bar)
+        self.opt_state = optimizer.init(self.params)
+        self._iteration = 0
+        self._consumed = 0
+        ray_tpu.get([r.set_weights.remote(self.params) for r in self.runners],
+                    timeout=120)
+        # launch the standing sample requests (the async part)
+        self._inflight = {
+            runner.sample.remote(config.rollout_fragment_length): runner
+            for runner in self.runners
+        }
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        c = self.config
+        losses = []
+        for _ in range(c.batches_per_iter):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=600)
+            ref = ready[0]
+            runner = self._inflight.pop(ref)
+            rollout = ray_tpu.get(ref, timeout=60)
+            # relaunch IMMEDIATELY with the runner's current (stale-ok)
+            # policy — sampling never waits for the learner
+            self._inflight[runner.sample.remote(
+                c.rollout_fragment_length)] = runner
+            batch = {
+                "obs": jnp.asarray(rollout["obs"]),
+                "actions": jnp.asarray(rollout["actions"]),
+                "logp": jnp.asarray(rollout["logp"]),
+                "rewards": jnp.asarray(rollout["rewards"]),
+                "dones": jnp.asarray(rollout["dones"]),
+                "last_obs": jnp.asarray(rollout["last_obs"]),
+            }
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch)
+            losses.append(float(loss))
+            self._consumed += 1
+            if self._consumed % c.broadcast_interval == 0:
+                # fire-and-forget broadcast: staleness is by design
+                runner.set_weights.remote(self.params)
+                for other in self.runners:
+                    if other is not runner:
+                        other.set_weights.remote(self.params)
+        metrics_list = ray_tpu.get(
+            [r.episode_metrics.remote() for r in self.runners], timeout=120)
+        means = [m["episode_return_mean"] for m in metrics_list
+                 if "episode_return_mean" in m]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (sum(means) / len(means)
+                                    if means else float("nan")),
+            "episodes_this_iter": sum(m.get("episodes", 0)
+                                      for m in metrics_list),
+            "loss": sum(losses) / len(losses) if losses else float("nan"),
+            "batches_consumed": self._consumed,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def stop(self):
+        for a in self.runners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -1,0 +1,323 @@
+"""SAC (discrete): twin soft Q critics + entropy-temperature autotuning.
+
+TPU-native counterpart of the reference SAC (ref:
+rllib/algorithms/sac/sac.py + sac_torch_learner.py twin-Q / alpha
+losses), in the discrete-action form (Christodoulou 2019) matching this
+module's gymnasium CartPole-class env surface: expectations over the
+action simplex replace the reparameterized sample, so every update is
+three fat batched matmuls — exactly what the MXU wants.
+
+Losses per batch (s, a, r, s', d):
+  y      = r + gamma (1-d) E_{a'~pi}[ min(Q1t,Q2t)(s',a') - alpha log pi ]
+  L_Q    = MSE(Q1(s,a), y) + MSE(Q2(s,a), y)
+  L_pi   = E_s E_{a~pi}[ alpha log pi(a|s) - min(Q1,Q2)(s,a) ]
+  L_alpha= E_s E_{a~pi}[ -log_alpha (log pi(a|s) + target_entropy) ]
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunner
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+
+
+def sac_init(key, obs_dim: int, n_actions: int, hidden: int = 64,
+             initial_alpha: float = 1.0) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ray_tpu.rllib.core import mlp_init
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "pi": mlp_init(k1, [obs_dim, hidden, hidden, n_actions]),
+        "q1": mlp_init(k2, [obs_dim, hidden, hidden, n_actions]),
+        "q2": mlp_init(k3, [obs_dim, hidden, hidden, n_actions]),
+        "log_alpha": jnp.asarray(float(_np.log(initial_alpha))),
+    }
+
+
+def make_sac_update(lr: float, gamma: float, tau: float,
+                    target_entropy: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.core import mlp_apply
+
+    optimizer = optax.adam(lr)
+
+    def heads(params, obs):
+        logits = mlp_apply(params["pi"], obs)
+        logp = jax.nn.log_softmax(logits)
+        q1 = mlp_apply(params["q1"], obs)
+        q2 = mlp_apply(params["q2"], obs)
+        return logp, q1, q2
+
+    def loss_fn(params, target_params, batch):
+        logp, q1, q2 = heads(params, batch["obs"])
+        alpha = jnp.exp(params["log_alpha"])
+        a = batch["actions"][:, None]
+
+        # --- critic target under the CURRENT policy at s'
+        logp_n, _, _ = heads(params, batch["next_obs"])
+        q1t = mlp_apply(target_params["q1"], batch["next_obs"])
+        q2t = mlp_apply(target_params["q2"], batch["next_obs"])
+        pi_n = jnp.exp(logp_n)
+        soft_v = (pi_n * (jnp.minimum(q1t, q2t)
+                          - jax.lax.stop_gradient(alpha) * logp_n)).sum(-1)
+        y = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(soft_v)
+
+        q1_a = jnp.take_along_axis(q1, a, axis=-1)[:, 0]
+        q2_a = jnp.take_along_axis(q2, a, axis=-1)[:, 0]
+        q_loss = ((q1_a - y) ** 2).mean() + ((q2_a - y) ** 2).mean()
+
+        # --- actor: expectation over the simplex, critics frozen
+        pi = jnp.exp(logp)
+        q_min = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+        pi_loss = (pi * (jax.lax.stop_gradient(alpha) * logp - q_min)) \
+            .sum(-1).mean()
+
+        # --- temperature: push policy entropy toward target_entropy
+        ent_err = jax.lax.stop_gradient((pi * logp).sum(-1)
+                                        + target_entropy)
+        alpha_loss = (-params["log_alpha"] * ent_err).mean()
+        return q_loss + pi_loss + alpha_loss, (q_loss, pi_loss, alpha)
+
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        (loss, (q_loss, pi_loss, alpha)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # polyak target update on the critics only
+        target_params = {
+            "q1": jax.tree.map(lambda t, s: (1 - tau) * t + tau * s,
+                               target_params["q1"], params["q1"]),
+            "q2": jax.tree.map(lambda t, s: (1 - tau) * t + tau * s,
+                               target_params["q2"], params["q2"]),
+        }
+        return params, target_params, opt_state, loss, q_loss, alpha
+
+    return update, optimizer
+
+
+_PICK = None  # lazily jitted module-level sampler (one trace cache)
+
+
+def _pick_action(params, obs, key):
+    global _PICK
+    if _PICK is None:
+        import jax
+
+        from ray_tpu.rllib.core import mlp_apply
+
+        _PICK = jax.jit(lambda p, o, k: jax.random.categorical(
+            k, mlp_apply(p["pi"], o)))
+    return _PICK(params, obs, key)
+
+
+class SACEnvRunner(EnvRunner):
+    """On-policy stochastic sampling into flat replay transitions (same
+    autoreset handling as the DQN runner)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._prev_done = np.zeros(self.num_envs, dtype=bool)
+
+    def sample(self, num_steps: int) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        assert self.params is not None, "set_weights before sample"
+        pick = _pick_action
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(num_steps):
+            self._rng_counter += 1
+            key = jax.random.PRNGKey(
+                self.seed * 1_000_003 + self._rng_counter)
+            action = np.asarray(pick(self.params, jnp.asarray(self.obs), key))
+            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            keep = ~self._prev_done
+            if keep.any():
+                obs_l.append(self.obs[keep])
+                act_l.append(action[keep])
+                rew_l.append(np.asarray(reward, dtype=np.float32)[keep])
+                next_l.append(next_obs[keep])
+                done_l.append(np.asarray(term, dtype=np.float32)[keep])
+            done = np.logical_or(term, trunc)
+            self._ep_returns += np.where(keep, reward, 0.0)
+            for i, d in enumerate(done):
+                if d and keep[i]:
+                    self.completed_returns.append(float(self._ep_returns[i]))
+                    self._ep_returns[i] = 0.0
+            self._prev_done = done & keep
+            self.obs = next_obs
+        return {
+            "obs": np.concatenate(obs_l).astype(np.float32),
+            "actions": np.concatenate(act_l).astype(np.int32),
+            "rewards": np.concatenate(rew_l),
+            "next_obs": np.concatenate(next_l).astype(np.float32),
+            "dones": np.concatenate(done_l),
+        }
+
+
+class SACConfig:
+    """Builder-style config (ref: sac.py SACConfig)."""
+
+    def __init__(self):
+        self.env_name: str | None = None
+        self.env_config: dict = {}
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 64
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.01
+        #: None -> 0.98 * log(n_actions) (the discrete-SAC convention)
+        self.target_entropy: float | None = None
+        #: starting temperature (the autotuner moves it from here)
+        self.initial_alpha = 1.0
+        self.buffer_capacity = 100_000
+        self.batch_size = 256
+        self.learning_starts = 500
+        self.train_batches_per_iter = 16
+        self.hidden = 64
+        self.seed = 0
+
+    def environment(self, env: str, env_config: dict | None = None):
+        self.env_name = env
+        self.env_config = dict(env_config or {})
+        return self
+
+    def env_runners(self, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, *, lr=None, gamma=None, tau=None, target_entropy=None,
+                 initial_alpha=None, buffer_capacity=None, batch_size=None,
+                 learning_starts=None, train_batches_per_iter=None,
+                 hidden=None):
+        for name, val in (("lr", lr), ("gamma", gamma), ("tau", tau),
+                          ("target_entropy", target_entropy),
+                          ("initial_alpha", initial_alpha),
+                          ("buffer_capacity", buffer_capacity),
+                          ("batch_size", batch_size),
+                          ("learning_starts", learning_starts),
+                          ("train_batches_per_iter", train_batches_per_iter),
+                          ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "SAC":
+        if self.env_name is None:
+            raise ValueError("SACConfig.environment(...) is required")
+        return SAC(self)
+
+
+class SAC:
+    """Off-policy driver (ref: sac.py training_step): stochastic-policy
+    sampling -> replay -> twin-critic soft updates with autotuned
+    temperature -> weight broadcast."""
+
+    def __init__(self, config: SACConfig):
+        import jax
+        import numpy as _np
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        RunnerCls = ray_tpu.remote(SACEnvRunner)
+        self.runners = [
+            RunnerCls.options(num_cpus=0.5).remote(
+                config.env_name, config.num_envs_per_runner,
+                seed=config.seed + 1000 * i, env_config=config.env_config,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim, n_actions = ray_tpu.get(
+            self.runners[0].obs_and_action_space.remote(), timeout=120)
+        self.params = sac_init(jax.random.PRNGKey(config.seed), obs_dim,
+                               n_actions, config.hidden,
+                               initial_alpha=config.initial_alpha)
+        self.target_params = {
+            "q1": jax.tree.map(lambda x: x, self.params["q1"]),
+            "q2": jax.tree.map(lambda x: x, self.params["q2"]),
+        }
+        tgt_h = (config.target_entropy if config.target_entropy is not None
+                 else 0.98 * float(_np.log(n_actions)))
+        self._update, optimizer = make_sac_update(
+            config.lr, config.gamma, config.tau, tgt_h)
+        self.opt_state = optimizer.init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self._iteration = 0
+        self._updates = 0
+        self._sync_weights()
+
+    def _sync_weights(self):
+        ray_tpu.get([r.set_weights.remote(self.params) for r in self.runners],
+                    timeout=120)
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        c = self.config
+        rollouts = ray_tpu.get(
+            [r.sample.remote(c.rollout_fragment_length)
+             for r in self.runners], timeout=600)
+        for ro in rollouts:
+            self.buffer.add_batch(ro)
+        losses, alphas = [], []
+        if len(self.buffer) >= c.learning_starts:
+            for _ in range(c.train_batches_per_iter):
+                batch = self.buffer.sample(c.batch_size)
+                jb = {k: jnp.asarray(v) for k, v in batch.items()
+                      if k != "indices"}
+                (self.params, self.target_params, self.opt_state,
+                 loss, _q_loss, alpha) = self._update(
+                    self.params, self.target_params, self.opt_state, jb)
+                losses.append(float(loss))
+                alphas.append(float(alpha))
+                self._updates += 1
+        self._sync_weights()
+        metrics_list = ray_tpu.get(
+            [r.episode_metrics.remote() for r in self.runners], timeout=120)
+        means = [m["episode_return_mean"] for m in metrics_list
+                 if "episode_return_mean" in m]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (sum(means) / len(means)
+                                    if means else float("nan")),
+            "episodes_this_iter": sum(m.get("episodes", 0)
+                                      for m in metrics_list),
+            "loss": sum(losses) / len(losses) if losses else float("nan"),
+            "alpha": sum(alphas) / len(alphas) if alphas else float("nan"),
+            "buffer_size": len(self.buffer),
+            "num_updates": self._updates,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def stop(self):
+        for a in self.runners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -28,6 +28,7 @@ from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.deployment import Application, Deployment, build_specs, deployment
 from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "AutoscalingConfig",
@@ -39,9 +40,12 @@ __all__ = [
     "batch",
     "deployment",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
     "start",
+    "start_grpc_proxy",
     "start_http_proxy",
     "status",
 ]
@@ -50,6 +54,14 @@ __all__ = [
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple:
     """Start the aiohttp ingress actor (ref: serve proxy per node)."""
     from ray_tpu.serve.http_proxy import start_http_proxy as _start
+
+    start()
+    return _start(host, port)
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple:
+    """Start the gRPC ingress actor (ref: proxy.py:530 gRPCProxy)."""
+    from ray_tpu.serve.grpc_proxy import start_grpc_proxy as _start
 
     start()
     return _start(host, port)

@@ -1,0 +1,154 @@
+"""gRPC ingress proxy for ray_tpu.serve.
+
+TPU-native equivalent of the reference gRPCProxy (ref:
+python/ray/serve/_private/proxy.py:530 + grpc_util.py RayServeAPIService)
+— a second ingress speaking gRPC next to the HTTP one, sharing the same
+DeploymentHandle/router path. The service is schemaless (bytes in/bytes
+out with pickled payloads) so no protoc step is needed; the method
+surface mirrors the reference's RayServeAPIService:
+
+    /rayserve.ServeAPI/Healthz       b"" -> b"ok"
+    /rayserve.ServeAPI/ListApplications  b"" -> pickle({app: [deployments]})
+    /rayserve.ServeAPI/Call          pickle(request dict) -> pickle(reply)
+
+        request: {"app": str, "deployment": str, "method": str (opt),
+                  "args": tuple, "kwargs": dict,
+                  "multiplexed_model_id": str (opt)}
+        reply:   {"result": ...} | {"error": str, "status": int}
+
+Use :class:`GrpcIngressClient` (any grpc channel works — the wire format
+is plain gRPC with bytes serializers).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+PROXY_NAME = "SERVE::grpc_proxy"
+SERVICE = "rayserve.ServeAPI"
+
+
+class GrpcProxy:
+    """Async actor hosting the grpc.aio ingress server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server = None
+
+    async def ready(self) -> tuple[str, int]:
+        if self._server is not None:
+            return (self.host, self.port)
+        import grpc
+
+        handlers = {
+            "Healthz": self._healthz,
+            "ListApplications": self._list_applications,
+            "Call": self._call,
+        }
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, call_details):
+                prefix = f"/{SERVICE}/"
+                if not call_details.method.startswith(prefix):
+                    return None
+                fn = handlers.get(call_details.method[len(prefix):])
+                if fn is None:
+                    return None
+                return grpc.unary_unary_rpc_method_handler(
+                    fn, request_deserializer=None,
+                    response_serializer=None)
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        bound = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self.port = bound
+        await self._server.start()
+        return (self.host, self.port)
+
+    async def _healthz(self, request: bytes, context) -> bytes:
+        return b"ok"
+
+    async def _list_applications(self, request: bytes, context) -> bytes:
+        from ray_tpu.core.api import get_core
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        core = get_core()
+        controller = await core.get_actor_by_name_async(CONTROLLER_NAME)
+        if controller is None:
+            return pickle.dumps({})
+        ref = controller.get_status.remote()
+        (status,) = await core.get_async([ref], 10.0)
+        return pickle.dumps({app: list(deps) for app, deps in status.items()})
+
+    async def _call(self, request: bytes, context) -> bytes:
+        from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+
+        try:
+            req = pickle.loads(request)
+            handle = DeploymentHandle(
+                req["deployment"], app_name=req.get("app", "default"),
+                multiplexed_model_id=req.get("multiplexed_model_id", ""))
+            result = await handle._invoke(
+                req.get("method") or "__call__",
+                tuple(req.get("args", ())), dict(req.get("kwargs", {})))
+            return pickle.dumps({"result": result})
+        except RayServeException as e:
+            return pickle.dumps({"error": str(e), "status": 503})
+        except Exception as e:  # noqa: BLE001 — ingress must answer
+            return pickle.dumps({"error": str(e), "status": 500})
+
+    async def shutdown(self) -> bool:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+        return True
+
+
+class GrpcIngressClient:
+    """Minimal sync client for the ingress (tests / SDKs)."""
+
+    def __init__(self, host: str, port: int):
+        import grpc
+
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+
+    def _unary(self, method: str, payload: bytes) -> bytes:
+        fn = self._channel.unary_unary(f"/{SERVICE}/{method}")
+        return fn(payload, timeout=60)
+
+    def healthz(self) -> bool:
+        return self._unary("Healthz", b"") == b"ok"
+
+    def list_applications(self) -> dict:
+        return pickle.loads(self._unary("ListApplications", b""))
+
+    def call(self, deployment: str, *args, app: str = "default",
+             method: str = "", multiplexed_model_id: str = "", **kwargs):
+        reply = pickle.loads(self._unary("Call", pickle.dumps({
+            "app": app, "deployment": deployment, "method": method,
+            "args": args, "kwargs": kwargs,
+            "multiplexed_model_id": multiplexed_model_id,
+        })))
+        if "error" in reply:
+            raise RuntimeError(f"serve error {reply.get('status')}: "
+                               f"{reply['error']}")
+        return reply["result"]
+
+    def close(self):
+        self._channel.close()
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0) -> tuple[str, int]:
+    """Start (or find) the gRPC proxy actor; returns its bound address."""
+    import ray_tpu
+    from ray_tpu.core.api import remote
+
+    handle = ray_tpu.get_core().get_actor_by_name(PROXY_NAME)
+    if handle is None:
+        handle = (
+            remote(GrpcProxy)
+            .options(name=PROXY_NAME, get_if_exists=True, num_cpus=0.1)
+            .remote(host, port)
+        )
+    return tuple(ray_tpu.get(handle.ready.remote(), timeout=30))

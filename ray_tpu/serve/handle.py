@@ -60,6 +60,8 @@ class _Router:
         # reported count was OURS, so scoring doesn't double-count it.
         self.remote_ongoing: dict[str, int] = {}
         self.inflight_at_probe: dict[str, int] = {}
+        # resident multiplexed models per replica (affinity routing)
+        self.models: dict[str, list] = {}
         self._last_request_ts = 0.0
         self._probe_generation = 0
         self.lock = threading.Lock()
@@ -98,6 +100,8 @@ class _Router:
                 self.handles.pop(rid, None)
                 self.inflight.pop(rid, None)
                 self.remote_ongoing.pop(rid, None)
+                self.inflight_at_probe.pop(rid, None)
+                self.models.pop(rid, None)
 
     def _ensure_poll_loop(self):
         """Background long-poll keeping membership fresh (the LongPollClient
@@ -171,6 +175,7 @@ class _Router:
                         with self.lock:
                             self.remote_ongoing[rid] = int(m.get("ongoing", 0))
                             self.inflight_at_probe[rid] = local_now
+                            self.models[rid] = list(m.get("models", ()))
                     except Exception:
                         pass  # replica mid-restart: keep the stale value
 
@@ -219,15 +224,26 @@ class _Router:
                     pass
 
     # -------------------------------------------------------------- routing
-    def _choose(self) -> dict | None:
+    def _choose(self, model_id: str = "") -> dict | None:
         """Power-of-two-choices over replica queue depth (ref:
         pow_2_router.py:52): the score combines the replica's REPORTED
         ongoing count (covers other callers) with this caller's local
-        in-flight count (covers requests the probe hasn't seen yet)."""
+        in-flight count (covers requests the probe hasn't seen yet).
+
+        With a multiplexed ``model_id``, replicas already holding the
+        model shadow the rest (ref: multiplex routing affinity) — a cache
+        hit beats a shorter queue; the pow-2 tie-break still applies
+        within the holding set."""
         with self.lock:
             reps = list(self.replicas)
             if not reps:
                 return None
+            if model_id:
+                holding = [r for r in reps
+                           if model_id in self.models.get(
+                               r["replica_id"], ())]
+                if holding:
+                    reps = holding
             if len(reps) == 1:
                 return reps[0]
             a, b = random.sample(reps, 2)
@@ -242,12 +258,13 @@ class _Router:
 
             return a if score(a) <= score(b) else b
 
-    async def route_async(self, method: str, args: tuple, kwargs: dict):
+    async def route_async(self, method: str, args: tuple, kwargs: dict,
+                          model_id: str = ""):
         """Loop-thread path: full async routing; returns the result."""
         self._ensure_poll_loop()
-        if self._choose() is None:
+        if self._choose(model_id) is None:
             await self._wait_for_replicas()
-        chosen = self._choose()
+        chosen = self._choose(model_id)
         if chosen is None:
             raise RayServeException("no replicas available")
         rid = chosen["replica_id"]
@@ -259,21 +276,22 @@ class _Router:
                 raise RayServeException(f"replica actor {chosen['actor_name']} gone")
             with self.lock:
                 self.handles[rid] = actor
-        ref = actor.handle_request.remote(method, args, kwargs)
+        ref = actor.handle_request.remote(method, args, kwargs, model_id)
         self.track(rid, ref)
         return await ref
 
-    def route_sync(self, method: str, args: tuple, kwargs: dict):
+    def route_sync(self, method: str, args: tuple, kwargs: dict,
+                   model_id: str = ""):
         """Driver-thread path: block briefly for membership; returns ObjectRef."""
         import ray_tpu
 
         self._ensure_poll_loop()
-        chosen = self._choose()
+        chosen = self._choose(model_id)
         if chosen is None:
             core = _core()
             fut = asyncio.run_coroutine_threadsafe(self._wait_for_replicas(), core.loop)
             fut.result(35.0)
-            chosen = self._choose()
+            chosen = self._choose(model_id)
             if chosen is None:
                 raise RayServeException("no replicas available")
         rid = chosen["replica_id"]
@@ -283,7 +301,7 @@ class _Router:
             actor = ray_tpu.get_actor(chosen["actor_name"])
             with self.lock:
                 self.handles[rid] = actor
-        ref = actor.handle_request.remote(method, args, kwargs)
+        ref = actor.handle_request.remote(method, args, kwargs, model_id)
         self.track(rid, ref)
         return ref
 
@@ -392,14 +410,17 @@ class DeploymentResponse:
     """Awaitable returned by handle calls made on an event loop (async
     actors composing deployments); ref: serve/handle.py DeploymentResponse."""
 
-    def __init__(self, router: _Router, method: str, args: tuple, kwargs: dict):
+    def __init__(self, router: _Router, method: str, args: tuple, kwargs: dict,
+                 model_id: str = ""):
         self._router = router
         self._method = method
         self._args = args
         self._kwargs = kwargs
+        self._model_id = model_id
 
     def __await__(self):
-        return self._router.route_async(self._method, self._args, self._kwargs).__await__()
+        return self._router.route_async(
+            self._method, self._args, self._kwargs, self._model_id).__await__()
 
 
 class _MethodCaller:
@@ -425,14 +446,22 @@ class DeploymentHandle:
     """User-facing handle; composable across deployments (ref:
     serve/handle.py:633). From the driver, ``handle.method.remote(*a)``
     returns an ObjectRef for ray_tpu.get; inside async actors it returns an
-    awaitable DeploymentResponse."""
+    awaitable DeploymentResponse. ``options(multiplexed_model_id=...)``
+    tags requests for model-affinity routing (ref: multiplex.py)."""
 
-    def __init__(self, deployment_name: str, app_name: str = "default"):
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
+        self.multiplexed_model_id = multiplexed_model_id
+
+    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                multiplexed_model_id)
 
     def __getattr__(self, name: str) -> _MethodCaller:
-        if name.startswith("_"):
+        if name.startswith("_") or name in ("deployment_name", "app_name",
+                                            "multiplexed_model_id"):
             raise AttributeError(name)
         return _MethodCaller(self, name)
 
@@ -442,8 +471,12 @@ class DeploymentHandle:
     def _invoke(self, method: str, args: tuple, kwargs: dict):
         router = _router_for(self.app_name, self.deployment_name)
         if _on_core_loop():
-            return DeploymentResponse(router, method, args, kwargs)
-        return router.route_sync(method, args, kwargs)
+            return DeploymentResponse(router, method, args, kwargs,
+                                      self.multiplexed_model_id)
+        return router.route_sync(method, args, kwargs,
+                                 self.multiplexed_model_id)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name, self.app_name))
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name,
+                 self.multiplexed_model_id))

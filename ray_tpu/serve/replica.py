@@ -71,18 +71,31 @@ class Replica:
         fn(user_config)
 
     # ------------------------------------------------------------- requests
-    async def handle_request(self, method: str, args: tuple, kwargs: dict):
+    async def handle_request(self, method: str, args: tuple, kwargs: dict,
+                             multiplexed_model_id: str = ""):
         if self._gate is None:
             self._gate = asyncio.Semaphore(self.max_ongoing_requests)
         self._ongoing += 1
         self._total += 1
+        if multiplexed_model_id:
+            # task-local: concurrent requests on this async actor each see
+            # their own id through serve.get_multiplexed_model_id()
+            from ray_tpu.serve.multiplex import _set_request_model_id
+
+            _set_request_model_id(multiplexed_model_id)
         try:
             async with self._gate:
                 fn = getattr(self.user, method) if method else self.user
                 if inspect.iscoroutinefunction(fn):
                     return await fn(*args, **kwargs)
                 loop = asyncio.get_running_loop()
-                return await loop.run_in_executor(self._pool, lambda: fn(*args, **kwargs))
+                # copy_context: the multiplexed-model-id contextvar must be
+                # visible inside sync methods running on the pool thread
+                import contextvars
+
+                ctx = contextvars.copy_context()
+                return await loop.run_in_executor(
+                    self._pool, lambda: ctx.run(fn, *args, **kwargs))
         finally:
             self._ongoing -= 1
 
@@ -105,10 +118,15 @@ class Replica:
 
     # ------------------------------------------------------------ lifecycle
     def get_metrics(self) -> dict:
+        from ray_tpu.serve.multiplex import loaded_model_ids
+
         return {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
             "total": self._total,
+            # resident multiplexed models: the router's affinity signal
+            # (ref: multiplex model-id membership via long-poll)
+            "models": loaded_model_ids(self.user),
         }
 
     def check_health(self) -> bool:

@@ -108,6 +108,24 @@ def list_task_events(limit: int = 10000) -> list[dict]:
     return _call("get_task_events")[-limit:]
 
 
+def list_spans(trace_id: str | None = None, limit: int = 10000) -> list[dict]:
+    """Trace spans recorded through the task-event pipeline (ref:
+    tracing_helper.py spans; enable with Config.tracing_enabled). Each row:
+    trace_id / span_id / parent_span_id / name / start_ts / end_ts plus
+    the task id and executing worker/node."""
+    out = []
+    for ev in _call("get_task_events"):
+        span = ev.get("span")
+        if not span:
+            continue
+        if trace_id is not None and span.get("trace_id") != trace_id:
+            continue
+        out.append({**span, "task_id": ev.get("task_id"),
+                    "worker_id": ev.get("worker_id"),
+                    "node_id": ev.get("node_id")})
+    return out[-limit:]
+
+
 def list_actors(filters=None, limit: int = 1000) -> list[dict]:
     rows = _call("list_actors")
     rows = [dict(r, actor_id=r["actor_id"].hex() if hasattr(r["actor_id"], "hex")
@@ -190,6 +208,20 @@ def timeline(filename: str | None = None) -> list[dict]:
     for ev in events:
         state = ev.get("state")
         tid = ev.get("task_id")
+        if state == "SPAN" and ev.get("span"):
+            s = ev["span"]
+            trace.append({
+                "name": s.get("name", "span"), "cat": "span", "ph": "X",
+                "ts": s["start_ts"] * 1e6,
+                "dur": max(0.0, s["end_ts"] - s["start_ts"]) * 1e6,
+                "pid": (ev.get("node_id") or "driver")[:8],
+                "tid": ev.get("pid", 0),
+                "args": {"trace_id": s.get("trace_id"),
+                         "span_id": s.get("span_id"),
+                         "parent_span_id": s.get("parent_span_id"),
+                         "task_id": tid},
+            })
+            continue
         if state == "RUNNING":
             starts[tid] = ev
         elif state in ("FINISHED", "FAILED") and tid in starts and ev.get("pid"):

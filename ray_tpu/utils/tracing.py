@@ -1,0 +1,126 @@
+"""Distributed task tracing: span propagation across remote calls.
+
+TPU-native counterpart of the reference tracing layer (ref:
+python/ray/util/tracing/tracing_helper.py:36-60 — there OTel span context
+is injected into task specs by decorator wrappers and child spans open
+around execution). Here the span layer is native and always importable
+(no SDK required): spans use OTel-shaped ids (128-bit trace, 64-bit
+span), ride the task-event pipeline into the GCS, and surface through
+``ray_tpu.state.list_spans()`` / the chrome timeline. If the
+``opentelemetry`` API is installed and configured, spans are mirrored
+onto it as well.
+
+Enable with ``Config.tracing_enabled`` (env ``RT_TRACING_ENABLED=1``):
+off by default, the hot path pays one boolean check.
+
+Propagation model: a contextvar holds the active (trace_id, span_id).
+Submitting a task captures it into the spec (``trace_ctx``); executing a
+task opens a child span and activates it for the duration of the user
+function, so nested ``.remote()`` calls chain parent -> child across any
+number of processes.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import time
+
+from ray_tpu.config import get_config
+
+_ctx: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
+    "rt_trace_ctx", default=None)
+
+try:  # probe ONCE: a failed import per span would be a hot-path tax
+    from opentelemetry import trace as _otel_trace
+except Exception:  # pragma: no cover - otel genuinely optional
+    _otel_trace = None
+
+
+def enabled() -> bool:
+    return get_config().tracing_enabled
+
+
+def _gen_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def _gen_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def current() -> tuple[str, str] | None:
+    """(trace_id, span_id) of the active span, if any."""
+    return _ctx.get()
+
+
+def inject() -> dict:
+    """Capture the caller's span context for a task spec; starts a fresh
+    trace when the caller has none (every traced task belongs to some
+    trace — the reference behaves the same for root calls)."""
+    ctx = _ctx.get()
+    if ctx is None:
+        return {"trace_id": _gen_trace_id(), "parent_span_id": None}
+    return {"trace_id": ctx[0], "parent_span_id": ctx[1]}
+
+
+class span:
+    """Context manager recording one span into ``sink`` (a callable
+    taking the span dict — typically the task-event buffer's emit)."""
+
+    def __init__(self, name: str, trace_ctx: dict | None, sink,
+                 **attributes):
+        self.name = name
+        self.sink = sink
+        self.attributes = attributes
+        ctx = trace_ctx or inject()
+        self.trace_id = ctx["trace_id"]
+        self.parent_span_id = ctx.get("parent_span_id")
+        self.span_id = _gen_span_id()
+        self._token = None
+        self._otel = None
+
+    def __enter__(self):
+        self.start = time.time()
+        self._token = _ctx.set((self.trace_id, self.span_id))
+        if _otel_trace is not None:
+            try:  # optional mirror onto a configured OTel SDK
+                self._otel = _otel_trace.get_tracer("ray_tpu").start_span(
+                    self.name)
+            except Exception:
+                self._otel = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _ctx.reset(self._token)
+        end = time.time()
+        if self._otel is not None:
+            try:
+                self._otel.end()
+            except Exception:
+                pass
+        self.sink({
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "name": self.name,
+            "start_ts": self.start,
+            "end_ts": end,
+            "error": repr(exc) if exc is not None else None,
+            **self.attributes,
+        })
+        return False
+
+
+def activate(trace_ctx: dict | None):
+    """Set the ambient context from a spec's trace_ctx WITHOUT opening a
+    span (thread-side helper); returns a reset token or None."""
+    if not trace_ctx:
+        return None
+    return _ctx.set((trace_ctx["trace_id"],
+                     trace_ctx.get("parent_span_id") or _gen_span_id()))
+
+
+def deactivate(token) -> None:
+    if token is not None:
+        _ctx.reset(token)

@@ -315,3 +315,125 @@ def test_multi_agent_env_runner_learns_per_policy(rt):
     for agent in ("a", "b"):
         assert last[agent] > max(first[agent] + 2.0, 12.0), (
             agent, first[agent], last[agent])
+
+
+def test_vtrace_reduces_to_gae_like_onpolicy():
+    """On-policy (behavior == target): rho = c = 1, so V-trace targets
+    equal the lambda=1 GAE returns."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib import vtrace_returns
+
+    T, N = 5, 3
+    rng = np.random.default_rng(0)
+    logp = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    rewards = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    values = jnp.asarray(rng.normal(size=(T, N)).astype(np.float32))
+    last_value = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    dones = jnp.zeros((T, N), dtype=bool)
+    vs, pg_adv = vtrace_returns(logp, logp, rewards, values, last_value,
+                                dones, gamma=0.9)
+    # manual discounted return bootstrap
+    expect = np.zeros((T, N), dtype=np.float32)
+    nxt = np.asarray(last_value)
+    for t in reversed(range(T)):
+        expect[t] = np.asarray(rewards)[t] + 0.9 * nxt
+        nxt = expect[t]
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5)
+    # truncation: a huge behavior logp (tiny rho) kills the correction
+    vs2, _ = vtrace_returns(logp + 10.0, logp, rewards, values, last_value,
+                            dones, gamma=0.9)
+    np.testing.assert_allclose(np.asarray(vs2), np.asarray(values),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_impala_learns_cartpole(rt):
+    """Async e2e: standing sample requests + V-trace updates; mean return
+    must clearly improve."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    algo = (
+        IMPALAConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=64)
+        .training(lr=1e-3, batches_per_iter=8, entropy_coeff=0.01)
+        .build()
+    )
+    try:
+        first = None
+        best = 0.0
+        for _ in range(10):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if first is None and not np.isnan(ret):
+                first = ret
+            if not np.isnan(ret):
+                best = max(best, ret)
+        assert first is not None
+        assert best > max(60.0, first * 1.5), (first, best)
+    finally:
+        algo.stop()
+
+
+def test_sac_update_moves_critics_and_temperature():
+    """One SAC update shrinks the critic error toward the soft target and
+    the autotuned temperature responds to the entropy gap."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.sac import make_sac_update, sac_init
+
+    params = sac_init(jax.random.PRNGKey(0), 4, 2, hidden=32)
+    target = {"q1": params["q1"], "q2": params["q2"]}
+    update, optimizer = make_sac_update(3e-3, 0.99, 0.05,
+                                        target_entropy=0.5)
+    opt_state = optimizer.init(params)
+    rng = np.random.default_rng(0)
+    batch = {
+        "obs": jnp.asarray(rng.normal(size=(64, 4)).astype(np.float32)),
+        "actions": jnp.asarray(rng.integers(0, 2, 64).astype(np.int32)),
+        "rewards": jnp.asarray(rng.normal(size=64).astype(np.float32)),
+        "next_obs": jnp.asarray(rng.normal(size=(64, 4)).astype(np.float32)),
+        "dones": jnp.zeros(64, dtype=jnp.float32),
+    }
+    losses = []
+    alpha0 = float(jnp.exp(params["log_alpha"]))
+    for _ in range(50):
+        params, target, opt_state, loss, q_loss, alpha = update(
+            params, target, opt_state, batch)
+        losses.append(float(q_loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    assert float(alpha) != alpha0  # temperature actually adapts
+
+
+def test_sac_learns_cartpole(rt):
+    from ray_tpu.rllib import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                     rollout_fragment_length=64)
+        # test-scale entropy schedule: the 0.98*log|A| convention is
+        # nearly max-entropy for |A|=2 and would pin the policy uniform
+        # within this budget
+        .training(lr=2e-3, batch_size=128, learning_starts=400,
+                  train_batches_per_iter=24, tau=0.02,
+                  target_entropy=0.25, initial_alpha=0.3)
+        .build()
+    )
+    try:
+        first = None
+        best = 0.0
+        for _ in range(12):
+            result = algo.train()
+            ret = result["episode_return_mean"]
+            if first is None and not np.isnan(ret):
+                first = ret
+            if not np.isnan(ret):
+                best = max(best, ret)
+        assert first is not None
+        assert best > max(60.0, first * 1.5), (first, best)
+    finally:
+        algo.stop()

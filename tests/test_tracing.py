@@ -1,0 +1,96 @@
+"""Span propagation across remote calls (ref test strategy:
+python/ray/tests/test_tracing.py — assert spans exist and parent->child
+linkage holds across a .remote() boundary)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+@pytest.fixture(scope="module")
+def rt():
+    from ray_tpu.config import Config, set_config
+
+    cfg = Config.from_env()
+    cfg.tracing_enabled = True
+    set_config(cfg)
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    cfg2 = Config.from_env()
+    set_config(cfg2)
+
+
+def _spans_for(task_name: str, deadline_s: float = 30.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        spans = state.list_spans()
+        hit = [s for s in spans if task_name in s.get("name", "")]
+        if hit:
+            return spans, hit
+        time.sleep(0.3)
+    raise AssertionError(f"no spans named *{task_name}* in {state.list_spans()}")
+
+
+def test_remote_call_parent_child_linkage(rt):
+    @ray_tpu.remote
+    def traced_leaf():
+        return 7
+
+    assert ray_tpu.get(traced_leaf.remote(), timeout=120) == 7
+    spans, run_spans = _spans_for("traced_leaf::run")
+    run = run_spans[-1]
+    # the execution span's parent is the .remote() submit span, same trace
+    submit = [s for s in spans
+              if s["span_id"] == run["parent_span_id"]]
+    assert submit, (run, spans)
+    assert submit[0]["name"] == "traced_leaf.remote"
+    assert submit[0]["trace_id"] == run["trace_id"]
+    assert run["end_ts"] >= run["start_ts"]
+
+
+def test_nested_remote_calls_chain_across_processes(rt):
+    @ray_tpu.remote
+    def inner():
+        return 1
+
+    @ray_tpu.remote
+    def outer():
+        import ray_tpu as rt_mod
+
+        return rt_mod.get(inner.remote(), timeout=60)
+
+    assert ray_tpu.get(outer.remote(), timeout=120) == 1
+    spans, outer_runs = _spans_for("outer::run")
+    _, inner_runs = _spans_for("inner::run")
+    outer_run = outer_runs[-1]
+    inner_run = inner_runs[-1]
+    # one trace end to end
+    assert inner_run["trace_id"] == outer_run["trace_id"]
+    # inner::run <- inner.remote (submitted INSIDE outer) <- outer::run
+    inner_submit = [s for s in spans
+                    if s["span_id"] == inner_run["parent_span_id"]]
+    assert inner_submit and inner_submit[0]["name"] == "inner.remote"
+    assert inner_submit[0]["parent_span_id"] == outer_run["span_id"]
+
+
+def test_actor_call_spans(rt):
+    @ray_tpu.remote
+    class A:
+        def work(self):
+            return "done"
+
+    a = A.remote()
+    assert ray_tpu.get(a.work.remote(), timeout=120) == "done"
+    spans, runs = _spans_for("work::run")
+    run = runs[-1]
+    submit = [s for s in spans if s["span_id"] == run["parent_span_id"]]
+    assert submit and submit[0]["name"] == "work.remote"
+
+
+def test_timeline_carries_spans(rt):
+    rows = state.timeline()
+    assert any(r.get("cat") == "span" for r in rows)
