@@ -502,12 +502,23 @@ def main():
         except Exception as e:
             print(f"llm engine bench failed: {e!r}", file=sys.stderr)
 
-    raw = {"micro": micro, "model": model, "llm_engine": llm}
     root = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(root, "bench_results.json"), "w") as f:
+    out_path = os.path.join(root, "bench_results.json")
+    # partial runs (--micro / --model) keep the other sections from the
+    # previous results file rather than clobbering them with null
+    raw = {"micro": micro, "model": model, "llm_engine": llm}
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+        for key in raw:
+            if not raw[key]:
+                raw[key] = prev.get(key)
+    except (OSError, json.JSONDecodeError):
+        pass
+    with open(out_path, "w") as f:
         json.dump(raw, f, indent=2)
-    if micro:
-        write_benchvs(micro, model, llm)
+    if raw["micro"]:
+        write_benchvs(raw["micro"], raw["model"], raw["llm_engine"])
 
     value = micro.get(HEADLINE)
     if value is not None:

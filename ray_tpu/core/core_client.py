@@ -266,6 +266,7 @@ class CoreClient:
         self._fast_ring_seq = 0
         self._fast_last_submit = 0.0  # burst detector (see _try_fast_submit)
         self._fast_demand_kick = 0.0  # rate-limits backlog->pump kicks
+        self._fast_actor_lanes: dict[ActorID, object] = {}
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -1014,25 +1015,9 @@ class CoreClient:
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
             return None  # big args belong in the object store
-        oid = ObjectID.for_task_return(task_id, 0)
-        light = (fn, args, kwargs, resources)
-        with self._fast_cv:
-            if lane.broken:
-                return None  # lost the race with a lane retire/break
-            lane.inflight[task_id] = light
-            self._fast_oid_lane[oid] = lane
-        self.memory_store[oid] = _MemEntry()
-        status = lane.ring.push(fastpath.SUB, rec, timeout_ms=0)
-        if status != 0:  # full or closed: undo, use the RPC path
-            with self._fast_cv:
-                owned = lane.inflight.pop(task_id, None) is not None
-                self._fast_oid_lane.pop(oid, None)
-            if not owned:
-                # a concurrent _fast_break_lane snapshotted our inflight
-                # entry and already resubmitted this very task over RPC —
-                # hand out the ref instead of minting a duplicate call
-                return self._new_owned_ref(oid)
-            self.memory_store.pop(oid, None)
+        ref = self._fast_register_and_push(lane, task_id, rec,
+                                           (fn, args, kwargs, resources))
+        if ref is None:
             return None
         lane.worker.idle_since = time.monotonic()  # keep the lease warm
         metrics.tasks_submitted.inc()
@@ -1049,7 +1034,142 @@ class CoreClient:
                 self._call_on_loop(self._pump(key, state))
         else:
             state.fast_backlog_since = 0.0
+        return ref
+
+    def _fast_register_and_push(self, lane, task_id: TaskID, rec: bytes,
+                                light) -> ObjectRef | None:
+        """Shared submit tail for task and actor lanes: register the
+        in-flight entry under the cv, create the pending memory-store
+        entry, push; on failure undo — unless a concurrent break-lane
+        already snapshotted our entry and resubmitted it over RPC, in
+        which case the ref is handed out as-is (no duplicate call)."""
+        from ray_tpu.core import fastpath
+
+        oid = ObjectID.for_task_return(task_id, 0)
+        with self._fast_cv:
+            if lane.broken or lane.retired:
+                return None  # lost the race with a lane retire/break
+            lane.inflight[task_id] = light
+            self._fast_oid_lane[oid] = lane
+        self.memory_store[oid] = _MemEntry()
+        status = lane.ring.push(fastpath.SUB, rec, timeout_ms=0)
+        if status != 0:  # full or closed: undo, use the RPC path
+            with self._fast_cv:
+                owned = lane.inflight.pop(task_id, None) is not None
+                self._fast_oid_lane.pop(oid, None)
+            if not owned:
+                return self._new_owned_ref(oid)
+            self.memory_store.pop(oid, None)
+            return None
         return self._new_owned_ref(oid)
+
+    async def _fast_actor_attach(self, actor_id: ActorID, conn):
+        """Ring lane to a same-node actor's worker: actor calls then skip
+        the loop + socket entirely, with the ring's SPSC order AS the
+        per-caller FIFO (ref: actor_task_submitter.h:75 ordered sends)."""
+        from types import SimpleNamespace
+
+        from ray_tpu.core import fastpath
+
+        existing = self._fast_actor_lanes.get(actor_id)
+        if existing is not None:
+            if not existing.broken and existing.worker.conn is conn:
+                return  # live lane on this very connection
+            # stale lane from a previous (dead) connection: break it now
+            # rather than waiting for the health sweep — otherwise the
+            # reconnected actor would silently stay on the RPC path
+            self._fast_break_lane(existing)
+        info = self._actor_info.get(actor_id)
+        if info is None or info.get("node_id") != self.node_id:
+            return
+        self._fast_ring_seq += 1
+        name = f"rt_fp_{os.getpid()}_a{self._fast_ring_seq}"
+        try:
+            ring = fastpath.RingPair.create(name, self.cfg.fastpath_ring_bytes)
+        except Exception:
+            return
+        try:
+            ok = await conn.call("attach_fast_ring",
+                                 {"name": name, "kind": "actor"}, timeout=10)
+        except Exception:
+            ok = False
+        if not ok or self._actor_conns.get(actor_id) is not conn:
+            ring.close_pair()
+            return
+        lane = fastpath.FastLane(
+            ring,
+            SimpleNamespace(conn=conn, fast_lane=None, idle_since=0.0,
+                            queued=0),
+            ("actor", actor_id))
+        t = _threading.Thread(target=self._fast_reader, args=(lane,),
+                              name="rt-fastread-actor", daemon=True)
+        lane.reader = t
+        self._fast_actor_lanes[actor_id] = lane
+        self._fast_lanes.append(lane)
+        t.start()
+
+    def _try_fast_actor_submit(self, actor_id: ActorID, method: str,
+                               args, kwargs):
+        """User-thread fast actor call; None -> RPC path. An ineligible
+        argument RETIRES the lane (permanent RPC downgrade) so ring and
+        socket traffic can never reorder a caller's calls."""
+        from ray_tpu.core import fastpath
+
+        lane = self._fast_actor_lanes.get(actor_id)
+        if lane is None or lane.broken or lane.retired:
+            return None
+        # per-caller FIFO: never overtake queued/in-flight RPC calls
+        if self._actor_queues.get(actor_id) or self._actor_inflight.get(
+                actor_id):
+            return None
+        for a in args:
+            if isinstance(a, ObjectRef):
+                lane.retired = True
+                return None
+        if kwargs:
+            for a in kwargs.values():
+                if isinstance(a, ObjectRef):
+                    lane.retired = True
+                    return None
+        task_id = TaskID.generate_actor()
+        tid = task_id.binary()
+        try:
+            rec = fastpath.pack_task(tid, b"am:" + method.encode(), args,
+                                     kwargs)
+        except Exception:
+            lane.retired = True
+            return None
+        if len(rec) > min(self.cfg.fastpath_record_max,
+                          fastpath.POP_BUF_BYTES - 64):
+            lane.retired = True
+            return None
+        ref = self._fast_register_and_push(
+            lane, task_id, rec, ("actor", actor_id, method, args, kwargs))
+        if ref is not None:
+            metrics.actor_calls.inc()
+        return ref
+
+    def _fast_resubmit(self, task_id: TaskID, light) -> None:
+        """Loop-side: re-route a fast-path call through the RPC path
+        (worker death, NEED_SLOW)."""
+        if light[0] == "actor":
+            _, actor_id, method, args, kwargs = light
+            spec = {
+                "task_id": task_id,
+                "actor_id": actor_id,
+                "method": method,
+                "args": list(args),
+                "kwargs": dict(kwargs),
+                "num_returns": 1,
+                "owner_address": self.address,
+                "seq": None,
+                "concurrency_group": None,
+            }
+            self._actor_queues.setdefault(actor_id, []).append(spec)
+            self._bg.spawn(self._ensure_actor_pump(actor_id), self.loop)
+        else:
+            spec = self._fast_light_to_spec(task_id, light)
+            self._bg.spawn(self._submit_async(spec), self.loop)
 
     def _fast_reader(self, lane):
         """Per-lane sweeper thread: drain the reply ring whenever no
@@ -1114,20 +1234,33 @@ class CoreClient:
         for task_id, oid, status, payload, light in batch:
             if status == fastpath.NEED_SLOW:
                 if light is not None:
-                    self._fast_ineligible_funcs.add(
-                        getattr(light[0], "__rt_func_id__", b""))
-                    spec = self._fast_light_to_spec(task_id, light)
-                    self._bg.spawn(self._submit_async(spec), self.loop)
+                    if light[0] == "actor":
+                        # one ineligible method downgrades the whole lane:
+                        # partial fast/slow mixing would break FIFO
+                        lane = self._fast_actor_lanes.get(light[1])
+                        if lane is not None:
+                            lane.retired = True
+                    else:
+                        self._fast_ineligible_funcs.add(
+                            getattr(light[0], "__rt_func_id__", b""))
+                    self._fast_resubmit(task_id, light)
                 continue
             entry = self.memory_store.get(oid)
-            name = getattr(light[0], "__name__", "task") if light else "task"
+            if light is None:
+                name = "task"
+            elif light[0] == "actor":
+                name = light[2]
+            else:
+                name = getattr(light[0], "__name__", "task")
             if entry is not None and not entry.ready.is_set():
                 if status == fastpath.OK:
                     entry.packed = payload
                 elif status == fastpath.OK_SHM:
                     entry.in_shm = True
-                    if light is not None:
+                    if light is not None and light[0] != "actor":
                         # shm results can be evicted: keep real lineage
+                        # (actor calls have no reconstruction, as in the
+                        # reference — actor state is not replayable)
                         self._lineage[task_id] = self._fast_light_to_spec(
                             task_id, light)
                         self._lineage_live[task_id] = {oid}
@@ -1221,6 +1354,9 @@ class CoreClient:
             self._fast_cv.notify_all()
         if lane.worker is not None and lane.worker.fast_lane is lane:
             lane.worker.fast_lane = None
+        if lane.key and lane.key[0] == "actor":
+            if self._fast_actor_lanes.get(lane.key[1]) is lane:
+                self._fast_actor_lanes.pop(lane.key[1], None)
         if lane in self._fast_lanes:
             try:
                 self._fast_lanes.remove(lane)
@@ -1233,8 +1369,7 @@ class CoreClient:
                 for task_id, light in leftovers.items():
                     if task_id in self._cancelled_tasks:
                         continue  # entries already failed by cancel_task
-                    spec = self._fast_light_to_spec(task_id, light)
-                    self._bg.spawn(self._submit_async(spec), self.loop)
+                    self._fast_resubmit(task_id, light)
             try:
                 self.loop.call_soon_threadsafe(resub)
             except RuntimeError:
@@ -2256,6 +2391,12 @@ class CoreClient:
         numbers and pipelines pushes — the reference's ActorTaskSubmitter
         shape (ref: actor_task_submitter.h:75, ordered sends + out-of-order
         replies)."""
+        if (num_returns == 1 and concurrency_group is None
+                and not self.cfg.tracing_enabled):
+            ref = self._try_fast_actor_submit(handle.actor_id, method,
+                                              args, kwargs)
+            if ref is not None:
+                return ref
         task_id = TaskID.generate_actor()
         actor_id = handle.actor_id
         metrics.actor_calls.inc()
@@ -2355,6 +2496,12 @@ class CoreClient:
             spec["_resolved"] = True
             if pins:
                 self._inflight_pins[spec["task_id"]] = pins
+        # per-caller FIFO across the fast->RPC downgrade: ring records
+        # already in flight must complete before any RPC call dispatches
+        lane = self._fast_actor_lanes.get(spec["actor_id"])
+        if lane is not None:
+            while lane.inflight and not lane.broken:
+                await asyncio.sleep(0.001)
         conn = await self._actor_connection(spec["actor_id"])
         if self._actor_recover_pending.get(spec["actor_id"]):
             # a connection died while this dispatch was suspended: the
@@ -2478,6 +2625,9 @@ class CoreClient:
                 self._actor_info.pop(actor_id, None)
                 info = None
         self._actor_conns[actor_id] = conn
+        if (self.cfg.fastpath_enabled and self.store is not None
+                and not self.cfg.tracing_enabled):
+            self._bg.spawn(self._fast_actor_attach(actor_id, conn), self.loop)
         return conn
 
     async def _refresh_actor(self, actor_id: ActorID):

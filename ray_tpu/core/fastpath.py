@@ -265,7 +265,8 @@ class FastLane:
     """
 
     __slots__ = ("ring", "worker", "key", "inflight", "broken", "reader",
-                 "return_armed", "rx_lock", "user_wants", "resume_evt")
+                 "return_armed", "rx_lock", "user_wants", "resume_evt",
+                 "retired")
 
     def __init__(self, ring: RingPair, worker, key):
         self.ring = ring
@@ -275,6 +276,10 @@ class FastLane:
         self.broken = False
         self.reader: threading.Thread | None = None
         self.return_armed = False  # one idle lease-return watcher at a time
+        # actor lanes: permanently downgraded to the RPC path (the first
+        # ineligible call would otherwise race ring traffic and break the
+        # per-caller FIFO contract); in-flight records still drain
+        self.retired = False
         # reply-ring consumer election: a blocking get() steals consumption
         # from the sweeper thread (one thread hop fewer per result); the
         # sweeper parks while user_wants is recent.

@@ -248,7 +248,10 @@ class Worker:
     # ------------------------------------------------ fast path (shm rings)
     async def rpc_attach_fast_ring(self, conn, p):
         """Driver attaches a shm task ring (see core/fastpath.py). The pump
-        thread lives until the ring closes (driver teardown or our exit)."""
+        thread lives until the ring closes (driver teardown or our exit).
+        kind="actor" rings carry actor method calls: the SPSC order IS the
+        caller's FIFO, execution rides the SAME single task executor as
+        RPC calls so actor state keeps one thread."""
         import threading
 
         from ray_tpu.core import fastpath
@@ -256,11 +259,108 @@ class Worker:
         ring = fastpath.RingPair.open(p["name"])
         self._fast_rings.append(ring)
         loop = asyncio.get_running_loop()
+        if p.get("kind") == "actor":
+            target, targs = self._fast_actor_pump, (ring,)
+        else:
+            target, targs = self._fast_pump, (ring, loop)
         t = threading.Thread(
-            target=self._fast_pump, args=(ring, loop),
+            target=target, args=targs,
             name="rt-fastpump", daemon=True)
         t.start()
         return True
+
+    def _fast_push_replies(self, ring, replies) -> int:
+        """Chunked reply push: one frame per ~512KB so a big batch of
+        mid-size results can never exceed the reply ring's capacity
+        (kTooBig) or the driver's fixed pop buffer."""
+        from ray_tpu.core import fastpath
+
+        status = 0
+        chunk: list = []
+        chunk_bytes = 0
+        for reply in replies:
+            if chunk and chunk_bytes + len(reply) > 512 * 1024:
+                status = ring.push_raw(fastpath.REP, fastpath.frame(chunk))
+                if status != 0:
+                    return status
+                chunk, chunk_bytes = [], 0
+            chunk.append(reply)
+            chunk_bytes += len(reply)
+        if chunk:
+            status = ring.push_raw(fastpath.REP, fastpath.frame(chunk))
+        return status
+
+    def _fast_actor_pump(self, ring):
+        """Pump thread for actor-call rings: pop records, run the methods
+        on the task executor (one consistent thread for actor state,
+        serialized with any RPC-path calls), reply in framed chunks.
+
+        Once ANY record proves ineligible, every subsequent record is
+        NEED_SLOWed too (sticky downgrade): executing later ring records
+        while an earlier one replays over RPC would reorder the caller's
+        calls — replies stream back in ring order, so the driver requeues
+        the whole tail in FIFO order."""
+        from ray_tpu.core import fastpath
+
+        inline_max = self.cfg.max_inline_object_size
+        downgraded = False
+
+        def run_batch(items):
+            # ON the task executor thread
+            inst = self.actor_instance
+            out = []
+            for tid, mname, args, kwargs in items:
+                try:
+                    out.append((True, getattr(inst, mname)(*args, **kwargs)))
+                except BaseException as e:  # noqa: BLE001
+                    out.append((False, e))
+            return out
+
+        try:
+            while not self._exit_requested:
+                recs = ring.pop_batch(fastpath.SUB, timeout_ms=1000)
+                if recs is None:
+                    break
+                if not recs:
+                    continue
+                runnable = []
+                replies = []
+                order = []  # (tid, "run"|reply)
+                for rec in recs:
+                    tid, mkey, args, kwargs = fastpath.unpack_task(rec)
+                    mname = mkey[3:].decode()  # b"am:<method>"
+                    m = getattr(self.actor_instance, mname, None)
+                    if (downgraded
+                            or self.actor_instance is None
+                            or not callable(m)
+                            or inspect.iscoroutinefunction(m)
+                            or inspect.isgeneratorfunction(m)
+                            or inspect.isasyncgenfunction(m)
+                            or self._method_groups.get(mname)):
+                        downgraded = True
+                        order.append((tid, fastpath.pack_reply(
+                            tid, fastpath.NEED_SLOW, b"")))
+                        continue
+                    runnable.append((tid, mname, args, kwargs))
+                    order.append((tid, None))
+                outcomes = iter(
+                    self.executor.submit(run_batch, runnable).result()
+                    if runnable else ())
+                for tid, pre in order:
+                    if pre is not None:
+                        replies.append(pre)
+                        continue
+                    ok, val = next(outcomes)
+                    replies.append(
+                        self._fast_pack_result(tid, ok, val, inline_max))
+                if self._fast_push_replies(ring, replies) != 0:
+                    break
+        finally:
+            for i, r in enumerate(self._fast_rings):
+                if r is ring:
+                    del self._fast_rings[i]
+                    break
+            ring.close_pair()
 
     def _fast_pump(self, ring, loop):
         """Pump thread: pop task records, execute, reply in one framed
@@ -328,24 +428,7 @@ class Worker:
                         ok, val = False, e
                     replies.append(
                         self._fast_pack_result(tid, ok, val, inline_max))
-                # chunked reply push: one frame per ~512KB so a big batch
-                # of mid-size results can never exceed the reply ring's
-                # capacity (kTooBig) or the driver's fixed pop buffer
-                status = 0
-                chunk: list = []
-                chunk_bytes = 0
-                for reply in replies:
-                    if chunk and chunk_bytes + len(reply) > 512 * 1024:
-                        status = ring.push_raw(
-                            fastpath.REP, fastpath.frame(chunk))
-                        if status != 0:
-                            break
-                        chunk, chunk_bytes = [], 0
-                    chunk.append(reply)
-                    chunk_bytes += len(reply)
-                if status == 0 and chunk:
-                    status = ring.push_raw(
-                        fastpath.REP, fastpath.frame(chunk))
+                status = self._fast_push_replies(ring, replies)
                 if bad_record or status != 0:
                     break  # ring closed/undecodable: driver recovers
         finally:

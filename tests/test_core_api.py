@@ -436,3 +436,63 @@ def test_method_num_returns_annotation(rt):
         assert ray_tpu.get([r1, r2], timeout=120) == ["a", "b"]
     finally:
         ray_tpu.kill(s)
+
+
+def test_actor_fast_lane_fifo_across_downgrade(rt):
+    """Same-node actor calls ride the shm ring; an ineligible call
+    (ObjectRef arg) permanently downgrades the lane to RPC — and the
+    caller's submission order must hold exactly across that switch."""
+    import time as _t
+
+    @ray_tpu.remote(num_cpus=0)
+    class Log:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            if not isinstance(x, int):
+                x = int(x)
+            self.log.append(x)
+            return len(self.log)
+
+        def get_log(self):
+            return list(self.log)
+
+    a = Log.remote()
+    ray_tpu.get(a.add.remote(-1), timeout=120)  # conn + lane attach
+    _t.sleep(0.5)
+    refs = [a.add.remote(i) for i in range(5)]
+    refs.append(a.add.remote(ray_tpu.put(100)))  # ineligible: retires lane
+    refs += [a.add.remote(i) for i in range(5, 10)]
+    ray_tpu.get(refs, timeout=120)
+    log = ray_tpu.get(a.get_log.remote(), timeout=60)
+    assert log == [-1, 0, 1, 2, 3, 4, 100, 5, 6, 7, 8, 9], log
+
+
+def test_actor_fast_lane_survives_restart(rt):
+    """Actor crash + restart: the stale ring lane breaks, calls replay
+    over RPC, and a fresh lane attaches to the new incarnation."""
+    import os
+    import signal
+    import time as _t
+
+    @ray_tpu.remote(num_cpus=0, max_restarts=2)
+    class P:
+        def pid(self):
+            return os.getpid()
+
+    r = P.remote()
+    p1 = ray_tpu.get(r.pid.remote(), timeout=120)
+    ray_tpu.get([r.pid.remote() for _ in range(5)], timeout=60)  # lane warm
+    os.kill(p1, signal.SIGKILL)
+    _t.sleep(1)
+    p2 = None
+    for _ in range(30):
+        try:
+            p2 = ray_tpu.get(r.pid.remote(), timeout=60)
+            break
+        except Exception:
+            _t.sleep(1)
+    assert p2 is not None and p2 != p1
+    assert set(ray_tpu.get([r.pid.remote() for _ in range(20)],
+                           timeout=60)) == {p2}
