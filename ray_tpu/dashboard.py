@@ -83,6 +83,18 @@ def build_app():
     app.router.add_get("/api/tasks", _json(lambda: _plain(state.list_tasks())))
     app.router.add_get("/api/actors", _json(lambda: _plain(state.list_actors())))
     app.router.add_get("/api/metrics", _json(lambda: _plain(state.cluster_metrics())))
+
+    async def prometheus(request):
+        # Prometheus scrape endpoint (text exposition format); the
+        # conventional path so a scrape_config needs only the address.
+        # to_thread: the render calls the GCS synchronously and must not
+        # run on the core loop
+        import asyncio
+
+        text = await asyncio.to_thread(state.prometheus_metrics)
+        return web.Response(text=text, content_type="text/plain")
+
+    app.router.add_get("/metrics", prometheus)
     app.router.add_get("/api/timeline", _json(lambda: state.timeline()))
     _add_job_routes(app)
     return app

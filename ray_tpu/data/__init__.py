@@ -10,12 +10,14 @@ stream (ref: data/dataset.py:1731 streaming_split).
 
 from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
+    ActorPoolStrategy,
     Dataset,
     GroupedDataset,
     from_arrow,
     from_items,
     from_numpy,
     from_pandas,
+    read_binary_files,
     read_csv,
     read_json,
     read_numpy,
@@ -28,6 +30,7 @@ from ray_tpu.data.iterator import DataIterator  # noqa: F401
 range = _range  # noqa: A001  (mirror ray.data.range naming)
 
 __all__ = [
+    "ActorPoolStrategy",
     "BlockAccessor",
     "DataIterator",
     "Dataset",
@@ -37,6 +40,7 @@ __all__ = [
     "from_numpy",
     "from_pandas",
     "range",
+    "read_binary_files",
     "read_csv",
     "read_json",
     "read_numpy",

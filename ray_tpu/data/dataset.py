@@ -34,6 +34,19 @@ from ray_tpu.data.executor import (
 DEFAULT_BLOCK_ROWS = 1000
 
 
+class ActorPoolStrategy:
+    """compute= strategy for map_batches: a fixed pool of stateful map
+    actors (ref: ActorPoolStrategy in python/ray/data/_internal/compute.py
+    — the autoscaling min/max pool collapses to a fixed size here)."""
+
+    def __init__(self, size: int = 2, *,
+                 max_tasks_in_flight_per_actor: int = 2):
+        if size < 1:
+            raise ValueError("ActorPoolStrategy size must be >= 1")
+        self.size = size
+        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+
+
 class Dataset:
     def __init__(self, plan: Plan):
         self._plan = plan
@@ -41,18 +54,50 @@ class Dataset:
     # ---------------------------------------------------------- transforms
     def map_batches(self, fn: Callable, *, batch_size: int | None = None,
                     batch_format: str | None = "numpy",
-                    fn_kwargs: dict | None = None) -> "Dataset":
+                    fn_kwargs: dict | None = None,
+                    compute=None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: dict | None = None,
+                    num_cpus: float = 1.0) -> "Dataset":
         """Apply fn to whole blocks rendered as ``batch_format``
-        (ref: dataset.py map_batches). batch_size re-chunks first when given."""
+        (ref: dataset.py map_batches). batch_size re-chunks first when
+        given. ``compute=ActorPoolStrategy(size=N)`` (or a callable CLASS
+        as fn) runs the map on a pool of stateful actors — construct the
+        class once per actor and amortize model loads across blocks
+        (ref: actor_pool_map_operator.py)."""
         kwargs = fn_kwargs or {}
+        ds = self
+        if batch_size is not None:
+            ds = ds.repartition_by_rows(batch_size)
+        if compute is not None or isinstance(fn, type):
+            from ray_tpu.data.executor import ActorPoolMapBlocks
+
+            strategy = compute or ActorPoolStrategy()
+            if isinstance(fn, type):
+                cls = fn
+
+                class _Callable(cls):  # render batches + kwargs inside
+                    def __call__(self, block, _k=kwargs, _bf=batch_format):
+                        batch = BlockAccessor.for_block(block).to_batch(_bf)
+                        return super().__call__(batch, **_k)
+
+                target = _Callable
+            else:
+                def target(block, _fn=fn, _k=kwargs, _bf=batch_format):
+                    batch = BlockAccessor.for_block(block).to_batch(_bf)
+                    return _fn(batch, **_k) if _k else _fn(batch)
+            return Dataset(ds._plan.with_op(ActorPoolMapBlocks(
+                "map_batches(actors)", target,
+                size=strategy.size,
+                max_tasks_per_actor=strategy.max_tasks_in_flight_per_actor,
+                fn_constructor_args=fn_constructor_args,
+                fn_constructor_kwargs=fn_constructor_kwargs,
+                num_cpus=num_cpus)))
 
         def apply(block):
             batch = BlockAccessor.for_block(block).to_batch(batch_format)
             return fn(batch, **kwargs) if kwargs else fn(batch)
 
-        ds = self
-        if batch_size is not None:
-            ds = ds.repartition_by_rows(batch_size)
         return Dataset(ds._plan.with_op(MapBlocks("map_batches", apply)))
 
     def map(self, fn: Callable) -> "Dataset":
@@ -581,5 +626,24 @@ def read_numpy(paths) -> Dataset:
 
     def make(path):
         return lambda: {"data": np.load(path)}
+
+    return Dataset(Plan([make(p) for p in files]))
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file: {"bytes": ...[, "path": ...]} (ref:
+    read_api.py read_binary_files)."""
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            with open(path, "rb") as f:
+                data = f.read()
+            row = {"bytes": data}
+            if include_paths:
+                row["path"] = path
+            return [row]
+
+        return read
 
     return Dataset(Plan([make(p) for p in files]))

@@ -98,6 +98,95 @@ class MapBlocks(Operator):
             stats.wall_s += time.perf_counter() - t0
 
 
+class _MapWorker:
+    """Stateful map actor (ref: _MapWorker in
+    execution/operators/actor_pool_map_operator.py): constructs the
+    user's callable class ONCE, then applies it per block — the whole
+    point of actor compute is amortizing expensive setup (model loads,
+    connections) across blocks."""
+
+    def __init__(self, fn_or_cls, fn_constructor_args: tuple,
+                 fn_constructor_kwargs: dict):
+        if isinstance(fn_or_cls, type):
+            self._fn = fn_or_cls(*fn_constructor_args,
+                                 **fn_constructor_kwargs)
+        else:
+            self._fn = fn_or_cls
+
+    def apply(self, block):
+        from ray_tpu.data.block import normalize_block
+
+        return normalize_block(self._fn(block))
+
+
+class ActorPoolMapBlocks(Operator):
+    """Map over a pool of stateful actors (ref:
+    execution/operators/actor_pool_map_operator.py + ActorPoolStrategy):
+    blocks dispatch to the least-loaded live actor, bounded in flight;
+    output order is preserved. Actors are created lazily on first use and
+    killed when the stream ends."""
+
+    def __init__(self, name: str, fn_or_cls, *, size: int = 2,
+                 max_tasks_per_actor: int = 2,
+                 fn_constructor_args: tuple = (),
+                 fn_constructor_kwargs: dict | None = None,
+                 num_cpus: float = 1.0):
+        self.name = name
+        self.fn_or_cls = fn_or_cls
+        self.size = max(1, int(size))
+        self.max_tasks_per_actor = max(1, int(max_tasks_per_actor))
+        self.fn_constructor_args = tuple(fn_constructor_args)
+        self.fn_constructor_kwargs = dict(fn_constructor_kwargs or {})
+        self.num_cpus = num_cpus
+
+    def transform(self, refs, stats):
+        t0 = time.perf_counter()
+        WorkerCls = ray_tpu.remote(_MapWorker)
+        actors = [
+            WorkerCls.options(num_cpus=self.num_cpus).remote(
+                self.fn_or_cls, self.fn_constructor_args,
+                self.fn_constructor_kwargs)
+            for _ in range(self.size)
+        ]
+        load = [0] * self.size
+        inflight: collections.deque = collections.deque()  # (ref, actor_i)
+        issued: list = []
+        cap = self.size * self.max_tasks_per_actor
+        try:
+            for ref in refs:
+                while len(inflight) >= cap:
+                    done, ai = inflight.popleft()
+                    load[ai] -= 1
+                    yield done
+                ai = min(range(self.size), key=load.__getitem__)
+                load[ai] += 1
+                out = actors[ai].apply.remote(ref)
+                issued.append(out)
+                inflight.append((out, ai))
+                stats.tasks += 1
+            while inflight:
+                done, ai = inflight.popleft()
+                load[ai] -= 1
+                yield done
+        finally:
+            stats.wall_s += time.perf_counter() - t0
+            # yielded refs may still be BACKED by pending actor tasks (a
+            # downstream barrier op collects refs before resolving them):
+            # the pool must outlive every issued task, not just the
+            # generator — wait without fetching, then kill
+            try:
+                if issued:
+                    ray_tpu.wait(issued, num_returns=len(issued),
+                                 timeout=600, fetch_local=False)
+            except Exception:
+                pass
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+
+
 class LimitOp(Operator):
     name = "limit"
 
@@ -175,12 +264,57 @@ class RepartitionOp(Operator):
         stats.wall_s += time.perf_counter() - t0
 
 
+def _shuffle_rows(block, s):
+    import numpy as np
+
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    perm = np.random.RandomState(s).permutation(n)
+    if isinstance(block, dict):
+        return {k: np.asarray(v)[perm] for k, v in block.items()}
+    return [block[i] for i in perm]
+
+
+@ray_tpu.remote
+def _shuffle_split(block, seed: int, n_parts: int):
+    """Map stage of the push-based shuffle: randomly permute this block's
+    rows and cut them into n_parts slices (one per merger). Called with
+    num_returns=n_parts so the slices stay in the object plane — the
+    driver only ever handles refs."""
+    import numpy as np
+
+    block = _shuffle_rows(block, seed)
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    bounds = np.linspace(0, n, n_parts + 1).astype(int)
+    parts = tuple(acc.slice(int(bounds[i]), int(bounds[i + 1]))
+                  for i in range(n_parts))
+    return parts if n_parts > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _shuffle_merge(seed: int, *parts):
+    """Merge stage: concatenate one partition's slices from every mapper
+    (or every round-merge) and re-permute rows."""
+    merged = BlockAccessor.concat([p for p in parts
+                                   if BlockAccessor.for_block(p).num_rows()])
+    return normalize_block(_shuffle_rows(merged, seed))
+
+
 class ShuffleOp(Operator):
-    """Barrier: random permutation of rows (ref: push-based shuffle reduced
-    to a two-stage map: permute block order + per-block row shuffle + round-
-    robin re-slice; exact global shuffle at this scale)."""
+    """Barrier: exact global random permutation of rows.
+
+    Small inputs use the simple per-block permute + reorder. Larger ones
+    run a PUSH-BASED two-stage shuffle (ref: _internal/planner/exchange/
+    push_based_shuffle_task_scheduler.py): mappers split each block into P
+    random slices; merges run in ROUNDS as mapper outputs appear, so merge
+    work overlaps the map stage and no single task ever touches more than
+    ~round_size block slices — the property that lets the reference
+    shuffle 100TB without head-of-line materialization."""
 
     name = "random_shuffle"
+    PUSH_THRESHOLD = 8  # blocks; below this the simple path is cheaper
+    ROUND = 4           # mappers per merge round
 
     def __init__(self, seed: int | None = None):
         self.seed = seed
@@ -193,25 +327,57 @@ class ShuffleOp(Operator):
         if not in_refs:
             return
         rng = np.random.RandomState(self.seed)
+        try:
+            if len(in_refs) <= self.PUSH_THRESHOLD:
+                yield from self._simple(in_refs, rng, stats)
+            else:
+                yield from self._push_based(in_refs, rng, stats)
+        finally:
+            stats.wall_s += time.perf_counter() - t0
+
+    def _simple(self, in_refs, rng, stats):
         seed_for = [int(rng.randint(0, 2**31 - 1)) for _ in in_refs]
-
-        def shuffle_rows(block, s):
-            acc = BlockAccessor.for_block(block)
-            n = acc.num_rows()
-            perm = np.random.RandomState(s).permutation(n)
-            if isinstance(block, dict):
-                return {k: np.asarray(v)[perm] for k, v in block.items()}
-            return [block[i] for i in perm]
-
         shuffled = [
-            _apply_op.remote(lambda b, s=s: shuffle_rows(b, s), r)
+            _apply_op.remote(lambda b, s=s: _shuffle_rows(b, s), r)
             for r, s in zip(in_refs, seed_for)
         ]
         stats.tasks += len(shuffled)
-        order = rng.permutation(len(shuffled))
-        for i in order:
+        for i in rng.permutation(len(shuffled)):
             yield shuffled[i]
-        stats.wall_s += time.perf_counter() - t0
+
+    def _push_based(self, in_refs, rng, stats):
+        n_parts = max(2, min(len(in_refs),
+                             int(len(in_refs) ** 0.5) + 1))
+        # per-partition accumulators of round-merge refs
+        partials: list[list] = [[] for _ in range(n_parts)]
+        round_splits: list = []
+
+        def flush_round():
+            # partial merges per partition over this round's mappers:
+            # merge work starts while later mappers still run (the "push")
+            for p in range(n_parts):
+                parts = [splits[p] for splits in round_splits]
+                if parts:
+                    partials[p].append(_shuffle_merge.remote(
+                        int(rng.randint(0, 2**31 - 1)), *parts))
+                    stats.tasks += 1
+            round_splits.clear()
+
+        for r in in_refs:
+            split = _shuffle_split.options(num_returns=n_parts).remote(
+                r, int(rng.randint(0, 2**31 - 1)), n_parts)
+            stats.tasks += 1
+            round_splits.append(split if isinstance(split, list) else [split])
+            if len(round_splits) >= self.ROUND:
+                flush_round()
+        flush_round()
+        out = [
+            _shuffle_merge.remote(int(rng.randint(0, 2**31 - 1)), *parts)
+            for parts in partials if parts
+        ]
+        stats.tasks += len(out)
+        for i in rng.permutation(len(out)):
+            yield out[i]
 
 
 class SortOp(Operator):

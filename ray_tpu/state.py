@@ -182,6 +182,8 @@ def cluster_metrics() -> dict[str, Any]:
         snap = pickle.loads(blob)
         for name, m in snap.get("metrics", {}).items():
             slot = agg.setdefault(name, {"type": m["type"], "values": {}})
+            if "boundaries" in m:
+                slot.setdefault("boundaries", m["boundaries"])
             for tag_key, v in m.get("values", {}).items():
                 if m["type"] == "counter":
                     slot["values"][tag_key] = slot["values"].get(tag_key, 0.0) + v
@@ -194,6 +196,56 @@ def cluster_metrics() -> dict[str, Any]:
                     cur["counts"] = [a + b for a, b in zip(cur["counts"], v["counts"])]
                     cur["sum"] += v["sum"]
     return agg
+
+
+def prometheus_metrics() -> str:
+    """Render the aggregated cluster metrics in the Prometheus text
+    exposition format (ref: dashboard/modules/metrics — there a sidecar
+    agent exposes OpenCensus metrics to a Prometheus scraper; here the
+    dashboard's /metrics endpoint serves the same role directly)."""
+    import ast
+
+    def esc(v) -> str:
+        # exposition-format escaping: one bad label value must not make
+        # Prometheus reject the whole scrape
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    def labels(tag_key: str) -> str:
+        try:
+            pairs = ast.literal_eval(tag_key)
+        except (ValueError, SyntaxError):
+            return ""
+        if not pairs:
+            return ""
+        inner = ",".join(f'{k}="{esc(v)}"' for k, v in pairs)
+        return "{" + inner + "}"
+
+    lines: list[str] = []
+    for name, m in sorted(cluster_metrics().items()):
+        pname = name.replace(".", "_").replace("-", "_")
+        if not pname.startswith("rt_"):
+            pname = "rt_" + pname  # runtime metrics are already rt_*
+        kind = m["type"]
+        lines.append(f"# TYPE {pname} {kind}")
+        if kind in ("counter", "gauge"):
+            for tag_key, v in m["values"].items():
+                lines.append(f"{pname}{labels(tag_key)} {v}")
+            continue
+        bounds = list(m.get("boundaries") or [])
+        for tag_key, v in m["values"].items():
+            lab = labels(tag_key)
+            base = lab[1:-1] if lab else ""
+            cum = 0
+            for i, count in enumerate(v["counts"]):
+                cum += count
+                le = bounds[i] if i < len(bounds) else "+Inf"
+                parts = ([base] if base else []) + [f'le="{le}"']
+                lines.append(
+                    f"{pname}_bucket{{{','.join(parts)}}} {cum}")
+            lines.append(f"{pname}_sum{lab} {v['sum']}")
+            lines.append(f"{pname}_count{lab} {cum}")
+    return "\n".join(lines) + "\n"
 
 
 # ------------------------------------------------------------------ timeline

@@ -159,6 +159,12 @@ def test_dashboard_endpoints():
             assert any(t["name"] == "touch" for t in tasks)
             metrics = json.loads(get("/api/metrics"))
             assert "rt_tasks_submitted" in metrics
+            # Prometheus text exposition (scrape endpoint)
+            prom = get("/metrics").decode()
+            assert "# TYPE rt_tasks_submitted counter" in prom
+            assert "rt_rt_" not in prom  # no double prefixing
+            assert "rt_task_exec_seconds_bucket" in prom
+            assert 'le="+Inf"' in prom
         finally:
             asyncio.run_coroutine_threadsafe(runner.cleanup(), core.loop).result(10)
     finally:

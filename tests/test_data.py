@@ -246,3 +246,77 @@ def test_write_read_roundtrip(rt, tmp_path):
     ds.write_csv(csv_dir)
     back_csv = data.read_csv(csv_dir + "/part-*.csv")
     assert sorted(r["a"] for r in back_csv.take_all()) == list(range(10))
+
+
+def test_actor_pool_map_batches(rt):
+    """compute=ActorPoolStrategy: a callable CLASS constructs once per
+    actor and its state amortizes across blocks (ref:
+    actor_pool_map_operator.py)."""
+    from ray_tpu.data import ActorPoolStrategy
+
+    class AddConst:
+        def __init__(self, c):
+            import os
+
+            self.c = c
+            self.pid = os.getpid()
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"id": batch["id"] + self.c, "pid": np.full(
+                len(batch["id"]), self.pid)}
+
+    ds = rtd.range(200, parallelism=10)
+    out = ds.map_batches(
+        AddConst, compute=ActorPoolStrategy(2), fn_constructor_args=(1000,),
+        num_cpus=0.1,
+    ).take_all()
+    assert sorted(r["id"] for r in out) == list(range(1000, 1200))
+    pids = {r["pid"] for r in out}
+    assert 1 <= len(pids) <= 2  # the pool, not one task process per block
+
+
+def test_push_based_shuffle_exact_permutation(rt):
+    """Above the push threshold the two-stage shuffle runs — and it must
+    still be an exact permutation of the rows."""
+    n = 2000
+    ds = rtd.range(n, parallelism=16)  # 16 blocks > PUSH_THRESHOLD
+    out = ds.random_shuffle(seed=7).take_all()
+    ids = [r["id"] for r in out]
+    assert sorted(ids) == list(range(n))
+    assert ids != list(range(n))  # actually shuffled
+
+
+def test_read_binary_files(rt, tmp_path):
+    import os
+
+    p1 = tmp_path / "a.bin"
+    p1.write_bytes(b"\x00\x01\x02")
+    p2 = tmp_path / "b.bin"
+    p2.write_bytes(b"hello")
+    ds = rtd.read_binary_files([str(p1), str(p2)], include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert rows[0]["bytes"] == b"\x00\x01\x02"
+    assert rows[1]["bytes"] == b"hello"
+    assert os.path.basename(rows[1]["path"]) == "b.bin"
+
+
+def test_actor_pool_feeds_downstream_barrier(rt):
+    """Actor-pool outputs consumed by a barrier op (shuffle collects refs
+    before resolving): the pool must outlive its pending tasks."""
+    from ray_tpu.data import ActorPoolStrategy
+
+    class Slow:
+        def __init__(self):
+            import time as _t
+
+            _t.sleep(0.5)
+
+        def __call__(self, batch):
+            return batch
+
+    ds = rtd.range(300, parallelism=12)
+    out = (ds.map_batches(Slow, compute=ActorPoolStrategy(2), num_cpus=0.1)
+           .random_shuffle(seed=1).take_all())
+    assert sorted(r["id"] for r in out) == list(range(300))
