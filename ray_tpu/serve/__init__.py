@@ -29,6 +29,13 @@ from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.deployment import Application, Deployment, build_specs, deployment
 from ray_tpu.serve.handle import DeploymentHandle, RayServeException
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.schema import (
+    DeploymentSchema,
+    ServeApplicationSchema,
+    ServeDeploySchema,
+    build_config,
+    deploy_config,
+)
 
 __all__ = [
     "AutoscalingConfig",
@@ -36,6 +43,11 @@ __all__ = [
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",
+    "DeploymentSchema",
+    "ServeApplicationSchema",
+    "ServeDeploySchema",
+    "build_config",
+    "deploy_config",
     "RayServeException",
     "batch",
     "deployment",
@@ -154,18 +166,20 @@ def shutdown():
 
     if not ray_tpu.is_initialized():
         return
+    from ray_tpu.serve.grpc_proxy import PROXY_NAME as GRPC_PROXY_NAME
     from ray_tpu.serve.http_proxy import PROXY_NAME
 
-    proxy = ray_tpu.get_core().get_actor_by_name(PROXY_NAME)
-    if proxy is not None:
-        try:
-            ray_tpu.get(proxy.shutdown.remote(), timeout=10)
-        except Exception:
-            pass
-        try:
-            ray_tpu.kill(proxy)
-        except Exception:
-            pass
+    for proxy_name in (PROXY_NAME, GRPC_PROXY_NAME):
+        proxy = ray_tpu.get_core().get_actor_by_name(proxy_name)
+        if proxy is not None:
+            try:
+                ray_tpu.get(proxy.shutdown.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(proxy)
+            except Exception:
+                pass
     controller = ray_tpu.get_core().get_actor_by_name(CONTROLLER_NAME)
     if controller is None:
         return

@@ -1,0 +1,21 @@
+"""Importable app module for the declarative serve config test."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=1)
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+
+@serve.deployment(num_replicas=1)
+class Front:
+    def __init__(self, doubler):
+        self.doubler = doubler
+
+    async def __call__(self, x):
+        return await self.doubler.remote(x) + 1
+
+
+app = Front.bind(Doubler.bind())

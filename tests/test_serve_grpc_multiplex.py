@@ -113,3 +113,70 @@ def test_multiplexed_eviction(rt):
     assert loads["a"] == 2
     loads = call("c")  # "c" stayed resident (b was evicted by a's reload)
     assert loads["c"] == 1
+
+
+def test_declarative_deploy_config(rt, tmp_path):
+    """serve.deploy_config: YAML/dict app config -> imported, overridden,
+    running (ref: serve/schema.py ServeDeploySchema + serve deploy)."""
+    import yaml
+
+    cfg = {
+        "applications": [{
+            "name": "schema_app",
+            "import_path": "tests._serve_schema_app:app",
+            "deployments": [
+                {"name": "Doubler", "num_replicas": 2},
+                {"name": "Front", "max_ongoing_requests": 4},
+            ],
+        }]
+    }
+    path = tmp_path / "serve.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    handles = serve.deploy_config(str(path))
+    out = ray_tpu.get(handles["schema_app"].remote(20), timeout=120)
+    assert out == 41  # 2*20 + 1
+    st = serve.status()["schema_app"]
+    assert set(st) == {"Doubler", "Front"}
+
+    # unknown deployment name in the config fails loudly
+    bad = {"applications": [{
+        "name": "bad", "import_path": "tests._serve_schema_app:app",
+        "deployments": [{"name": "Nope"}]}]}
+    with pytest.raises(ValueError, match="Nope"):
+        serve.deploy_config(bad)
+
+
+def test_schema_validation_errors():
+    from ray_tpu.serve.schema import ServeDeploySchema
+
+    with pytest.raises(ValueError, match="applications"):
+        ServeDeploySchema.from_dict({})
+    with pytest.raises(ValueError, match="duplicate"):
+        ServeDeploySchema.from_dict({"applications": [
+            {"name": "a", "import_path": "m:x"},
+            {"name": "a", "import_path": "m:y"}]})
+    with pytest.raises(ValueError, match="unknown"):
+        ServeDeploySchema.from_dict({"applications": [
+            {"name": "a", "import_path": "m:x", "bogus": 1}]})
+
+
+def test_deploy_config_does_not_mutate_module_singletons(rt):
+    """Overrides apply to per-deploy copies: re-deploying the same module
+    without overrides must see the decorator defaults (the reference's
+    options() copy semantics)."""
+    import importlib
+
+    import tests._serve_schema_app as app_mod
+
+    before = app_mod.Doubler.config.num_replicas
+    serve.deploy_config({"applications": [{
+        "name": "mut_check", "import_path": "tests._serve_schema_app:app",
+        "deployments": [{"name": "Doubler", "num_replicas": 2}]}]})
+    importlib.reload  # no-op: module stays cached, which is the point
+    assert app_mod.Doubler.config.num_replicas == before
+
+    # unsupported fields are rejected loudly, before anything deploys
+    with pytest.raises(ValueError, match="route_prefix"):
+        serve.deploy_config({"applications": [{
+            "name": "rp", "import_path": "tests._serve_schema_app:app",
+            "route_prefix": "/x"}]})
