@@ -99,6 +99,17 @@ def run_micro(window: float) -> dict[str, float]:
             lambda: ray_tpu.put(big), window=max(window, 2.0)
         ) * (big.nbytes / 1e9)
 
+        def settle():
+            # measurement hygiene on a 1-vCPU box: let ref-GC frees, spill
+            # threads and idle-lease returns from the previous section
+            # drain so they don't tax the next section's numbers
+            import gc
+
+            gc.collect()
+            time.sleep(1.5)
+
+        settle()
+
         # ------------------------------------------------------------- tasks
         @ray_tpu.remote
         def small_value():
@@ -128,6 +139,8 @@ def run_micro(window: float) -> dict[str, float]:
         results["multi_client_tasks_async"] = timeit(
             multi_client, window=max(window, 2.0), multiplier=2000
         )
+
+        settle()
 
         # ------------------------------------------------------------ actors
         @ray_tpu.remote(num_cpus=0)
