@@ -1169,6 +1169,27 @@ class Worker:
         finally:
             ex.shutdown(wait=False)
 
+    async def rpc_dump_stack(self, conn, p):
+        """On-demand stack capture of every thread in this worker (ref:
+        dashboard/modules/reporter/profile_manager.py:82 — there py-spy
+        attaches externally; here the worker self-reports, which needs no
+        ptrace capability and works in containers)."""
+        import traceback as tb
+
+        frames = sys._current_frames()
+        out = []
+        import threading as _threading
+
+        names = {t.ident: t.name for t in _threading.enumerate()}
+        for tid, frame in frames.items():
+            out.append({
+                "thread_id": tid,
+                "name": names.get(tid, "?"),
+                "stack": "".join(tb.format_stack(frame)),
+            })
+        return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
+                "threads": out}
+
     async def rpc_exit_worker(self, conn, p):
         self._exit_requested = True
         if _profiler is not None:  # RT_WORKER_PROFILE_DIR diagnosis mode

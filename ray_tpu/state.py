@@ -60,6 +60,33 @@ def get_log(worker_id: str, *, stream: str = "out", tail: int = 64 * 1024,
     return core._run_sync(fetch())
 
 
+def get_stack(worker_id: str, *, node_address: tuple | None = None) -> dict | None:
+    """On-demand per-thread stack dump of a live worker (ref: the
+    dashboard reporter's py-spy endpoint, profile_manager.py:82 — here the
+    worker self-reports via RPC, so no ptrace capability is needed).
+    ``worker_id`` may be a hex prefix; ``node_address`` targets a remote
+    node's raylet."""
+    core = _core()
+
+    async def fetch():
+        if node_address is None or tuple(node_address) == tuple(core.raylet_address):
+            conn = core.raylet
+            owns = False
+        else:
+            from ray_tpu.utils import rpc as _rpc
+
+            conn = await _rpc.connect(*node_address, timeout=10)
+            owns = True
+        try:
+            return await conn.call("dump_worker_stack",
+                                   {"worker_id": worker_id})
+        finally:
+            if owns:
+                await conn.close()
+
+    return core._run_sync(fetch())
+
+
 def _match(row: dict, filters) -> bool:
     for key, op, value in filters or ():
         have = row.get(key)

@@ -78,6 +78,9 @@ CATALOG: dict[str, dict[str, dict]] = {
         "report_demand": {"since": (1, 3), "fields": {
             "count": "int — driver-side queued tasks no live lease will "
                      "absorb (autoscaler demand signal)"}},
+        "dump_worker_stack": {"since": (1, 3), "fields": {
+            "worker_id": "hex prefix — proxies a dump_stack RPC to the "
+                         "matching worker (live stack profiling)"}},
         "worker_ready": {"since": (1, 0), "fields": {
             "worker_id": "hex", "address": "(host, port)", "pid": "int",
             "language": "str (since 1.1)"}},
@@ -148,7 +151,9 @@ CATALOG: dict[str, dict[str, dict]] = {
         "start_dag_loop": {"since": (1, 0), "fields": {"schedule": "dict"}},
         "attach_fast_ring": {"since": (1, 3), "fields": {
             "name": "str — shm name of the task RingPair this worker "
-                    "should pump (see core/fastpath.py)"}},
+                    "should pump (see core/fastpath.py)",
+            "kind": "'actor' for actor-call rings (since 1.3)"}},
+        "dump_stack": {"since": (1, 3), "fields": {}},
     },
 }
 

@@ -180,3 +180,32 @@ def test_get_log_worker_stdout(rt):
                     msg="no stderr log")
     assert "needle-on-stderr-67890" in err
     assert state.get_log(wid, stream="bogus") is None
+
+
+def test_get_stack_live_worker(rt):
+    """On-demand stack dump of a worker mid-task (the py-spy role,
+    self-reported over RPC)."""
+    import time as _t
+
+    @ray_tpu.remote
+    def busy_sleeper():
+        import time
+
+        time.sleep(8.0)  # a recognizable frame to find in the dump
+        return 1
+
+    ref = busy_sleeper.remote()
+    workers = []
+    deadline = _t.time() + 20
+    while _t.time() < deadline and not workers:  # task events flush ~2s
+        _t.sleep(0.5)
+        workers = [t for t in state.list_tasks()
+                   if t.get("name") == "busy_sleeper" and t.get("worker_id")
+                   and t.get("state") == "RUNNING"]
+    assert workers, state.list_tasks()
+    dump = state.get_stack(workers[-1]["worker_id"])
+    assert dump and dump["threads"], dump
+    joined = "\n".join(t["stack"] for t in dump["threads"])
+    assert "busy_sleeper" in joined or "time.sleep" in joined or \
+        "sleep" in joined
+    assert ray_tpu.get(ref, timeout=120) == 1
