@@ -494,17 +494,24 @@ class Raylet:
     async def rpc_dump_worker_stack(self, conn, p):
         """Proxy an on-demand stack dump to one of this node's workers
         (ref: dashboard reporter profiling endpoints). worker_id may be a
-        hex prefix; unique match required."""
+        hex prefix; unique match required. Degrades to None (like
+        get_log) for missing/ambiguous ids, dead workers, and workers
+        that don't speak the RPC (C++)."""
         prefix = (p.get("worker_id") or "")
+        if not prefix:
+            return None
         matches = [w for wid, w in self.all_workers.items()
                    if wid.hex().startswith(prefix)]
         if len(matches) != 1 or matches[0].address is None:
             return None
-        wconn = await rpc.connect(*matches[0].address, timeout=5)
         try:
-            return await wconn.call("dump_stack", {}, timeout=10)
-        finally:
-            await wconn.close()
+            wconn = await rpc.connect(*matches[0].address, timeout=5)
+            try:
+                return await wconn.call("dump_stack", {}, timeout=10)
+            finally:
+                await wconn.close()
+        except Exception:
+            return None
 
     async def rpc_get_log(self, conn, p):
         """Serve a worker's captured stdout/stderr tail (ref: state API

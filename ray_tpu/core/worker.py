@@ -1174,19 +1174,14 @@ class Worker:
         dashboard/modules/reporter/profile_manager.py:82 — there py-spy
         attaches externally; here the worker self-reports, which needs no
         ptrace capability and works in containers)."""
-        import traceback as tb
+        import threading
 
-        frames = sys._current_frames()
-        out = []
-        import threading as _threading
-
-        names = {t.ident: t.name for t in _threading.enumerate()}
-        for tid, frame in frames.items():
-            out.append({
-                "thread_id": tid,
-                "name": names.get(tid, "?"),
-                "stack": "".join(tb.format_stack(frame)),
-            })
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = [{
+            "thread_id": tid,
+            "name": names.get(tid, "?"),
+            "stack": "".join(traceback.format_stack(frame)),
+        } for tid, frame in sys._current_frames().items()]
         return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
                 "threads": out}
 

@@ -32,12 +32,9 @@ def _call(method: str, payload: dict | None = None):
     return core._run_sync(core.gcs.call(method, payload or {}))
 
 
-def get_log(worker_id: str, *, stream: str = "out", tail: int = 64 * 1024,
-            node_address: tuple | None = None) -> str | None:
-    """Tail a worker's captured stdout/stderr (ref: ray.util.state.get_log
-    over the session log tree). ``worker_id`` may be a hex prefix; pass
-    ``node_address`` for a worker on another node (defaults to the local
-    raylet)."""
+def _raylet_call(method: str, payload: dict, node_address: tuple | None):
+    """Call the local raylet (or a named node's) with connection cleanup —
+    the shared scaffolding for node-addressed state calls."""
     core = _core()
 
     async def fetch():
@@ -50,14 +47,23 @@ def get_log(worker_id: str, *, stream: str = "out", tail: int = 64 * 1024,
             conn = await _rpc.connect(*node_address, timeout=10)
             owns = True
         try:
-            return await conn.call(
-                "get_log", {"worker_id": worker_id, "stream": stream,
-                            "tail": tail})
+            return await conn.call(method, payload)
         finally:
             if owns:
                 await conn.close()
 
     return core._run_sync(fetch())
+
+
+def get_log(worker_id: str, *, stream: str = "out", tail: int = 64 * 1024,
+            node_address: tuple | None = None) -> str | None:
+    """Tail a worker's captured stdout/stderr (ref: ray.util.state.get_log
+    over the session log tree). ``worker_id`` may be a hex prefix; pass
+    ``node_address`` for a worker on another node (defaults to the local
+    raylet)."""
+    return _raylet_call(
+        "get_log", {"worker_id": worker_id, "stream": stream, "tail": tail},
+        node_address)
 
 
 def get_stack(worker_id: str, *, node_address: tuple | None = None) -> dict | None:
@@ -66,25 +72,8 @@ def get_stack(worker_id: str, *, node_address: tuple | None = None) -> dict | No
     worker self-reports via RPC, so no ptrace capability is needed).
     ``worker_id`` may be a hex prefix; ``node_address`` targets a remote
     node's raylet."""
-    core = _core()
-
-    async def fetch():
-        if node_address is None or tuple(node_address) == tuple(core.raylet_address):
-            conn = core.raylet
-            owns = False
-        else:
-            from ray_tpu.utils import rpc as _rpc
-
-            conn = await _rpc.connect(*node_address, timeout=10)
-            owns = True
-        try:
-            return await conn.call("dump_worker_stack",
-                                   {"worker_id": worker_id})
-        finally:
-            if owns:
-                await conn.close()
-
-    return core._run_sync(fetch())
+    return _raylet_call("dump_worker_stack", {"worker_id": worker_id},
+                        node_address)
 
 
 def _match(row: dict, filters) -> bool:
