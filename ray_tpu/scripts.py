@@ -164,6 +164,26 @@ def _pid_alive(pid: int) -> bool:
         return False
 
 
+def cmd_serve(args):
+    """serve deploy/status/shutdown against a live cluster (ref: the
+    reference's `serve` CLI group mounted on `ray`, scripts.py:2734)."""
+    rt = _connect(_resolve_address(args))
+    from ray_tpu import serve
+
+    try:
+        if args.serve_cmd == "deploy":
+            handles = serve.deploy_config(args.config)
+            for name in handles:
+                print(f"deployed application {name!r}")
+        elif args.serve_cmd == "status":
+            print(json.dumps(serve.status(), indent=2, default=str))
+        elif args.serve_cmd == "shutdown":
+            serve.shutdown()
+            print("serve shut down")
+    finally:
+        rt.shutdown()
+
+
 def cmd_status(args):
     rt = _connect(_resolve_address(args))
     nodes = rt.nodes()
@@ -366,6 +386,19 @@ def main(argv=None):
     jp.add_argument("--address", default=None)
     jp.add_argument("--dashboard-url", default=None)
     jp.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("serve", help="deploy and manage serve applications")
+    ssub = p.add_subparsers(dest="serve_cmd", required=True)
+    sp = ssub.add_parser("deploy", help="deploy apps from a YAML config")
+    sp.add_argument("config", help="path to a ServeDeploySchema YAML")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve)
+    sp = ssub.add_parser("status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve)
+    sp = ssub.add_parser("shutdown")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("_autoscaler_monitor")
     p.add_argument("--address", required=True)
