@@ -132,6 +132,8 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_store_list_spillable.restype = ctypes.c_int
             lib.rt_store_list_spillable.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, p64, ctypes.c_int]
+            lib.rt_copy_nt.restype = None
+            lib.rt_copy_nt.argtypes = [ctypes.c_void_p, ctypes.c_void_p, u64]
             lib.rt_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64, p64]
             lib.rt_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
             lib.rt_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, p64, p64]

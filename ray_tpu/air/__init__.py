@@ -1,0 +1,21 @@
+"""AIR-surface extras: experiment-tracking integrations + callbacks.
+
+Mirrors the reference's ray.air integration layer (ref:
+python/ray/air/integrations/wandb.py WandbLoggerCallback,
+mlflow.py MLflowLoggerCallback): thin logger callbacks the tune
+controller invokes on every trial report/completion. Import-gated — a
+missing wandb/mlflow package fails at CONSTRUCTION (loudly, driver-side),
+never mid-experiment on a worker.
+"""
+
+from ray_tpu.air.integrations import (
+    LoggerCallback,
+    MLflowLoggerCallback,
+    WandbLoggerCallback,
+)
+
+__all__ = [
+    "LoggerCallback",
+    "MLflowLoggerCallback",
+    "WandbLoggerCallback",
+]

@@ -18,7 +18,6 @@ accelerators/tpu.py's type map.
 from __future__ import annotations
 
 import json
-import time
 import urllib.request
 
 from ray_tpu.autoscaler.node_provider import NodeProvider
@@ -78,7 +77,15 @@ class GKETPUPodProvider(NodeProvider):
                        f"/clusters/{cluster}")
         self.tpu_type = tpu_type
         self.transport = transport
-        self._counter = int(time.time()) % 100_000
+        # Pool names must survive provider restarts: a counter alone can
+        # collide with rt-tpu-* pools left by a previous autoscaler run
+        # started within the same second (GKE would 409 → surface as
+        # ALLOCATION_FAILED). A per-provider random token makes every
+        # incarnation's names disjoint without an extra startup GET.
+        import uuid
+
+        self._counter = 0
+        self._token = uuid.uuid4().hex[:6]
         # pool name -> last create/delete operation name (poll handles)
         self._ops: dict[str, str] = {}
 
@@ -93,7 +100,7 @@ class GKETPUPodProvider(NodeProvider):
             chips *= int(dim)
         hosts = max(1, chips // chips_per_host)
         self._counter += 1
-        name = f"{POOL_PREFIX}{self._counter}"
+        name = f"{POOL_PREFIX}{self._token}-{self._counter}"
         body = {
             "nodePool": {
                 "name": name,

@@ -167,14 +167,68 @@ class XlaCollectiveGroup(Communicator):
         self.allreduce(np.zeros(1, dtype=np.float32))
 
     # ------------------------------------------------------------------- p2p
+    # eager send/recv metadata protocol: fixed (2 + _META_MAXDIMS,) int32
+    # header [ndim, dtype_code, d0, d1, ...] ppermuted ahead of the payload,
+    # so the receiver can allocate its SPMD contribution without knowing the
+    # shape a priori (ref: nccl_collective_group.py:376 — NCCL recv gets
+    # shape/dtype from the caller's preallocated tensor; here the fabric
+    # itself carries it).
+    _META_MAXDIMS = 8
+    _META_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "bool",
+                    "float16", "bfloat16", "int16", "uint16", "uint32",
+                    "uint64", "int8", "complex64"]
+
     def send(self, value, dst_rank: int) -> None:
         """P2P over a 2-rank submesh; both sides must call (SPMD pairing).
-        The dag layer schedules send/recv as matching program points, the
-        same contract the reference documents for its NCCL channels."""
-        self._sendrecv(np.asarray(value), self._rank, dst_rank)
+        Pairs with :meth:`recv`: a fixed-shape metadata ppermute first,
+        then the payload. The dag layer's tensor channels skip the
+        metadata phase (they carry shape out of band) via sendrecv()."""
+        value = np.asarray(value)
+        if value.ndim > self._META_MAXDIMS:
+            raise ValueError(
+                f"eager send supports at most {self._META_MAXDIMS} dims, "
+                f"got {value.ndim}")
+        try:
+            code = self._META_DTYPES.index(str(value.dtype))
+        except ValueError:
+            raise ValueError(
+                f"eager send cannot negotiate dtype {value.dtype}; known: "
+                f"{self._META_DTYPES}") from None
+        if value.dtype.itemsize == 8 and not self._jax.config.jax_enable_x64:
+            # the staging device arrays would silently coerce to 32 bits
+            # in flight (wrapping int64, truncating float64) — refuse
+            # loudly rather than return corrupted data wearing the right
+            # dtype label
+            raise ValueError(
+                f"eager send of {value.dtype} needs jax_enable_x64 "
+                "(values would be silently truncated to 32 bits); enable "
+                "x64 or cast to a 32-bit dtype first")
+        meta = np.zeros(2 + self._META_MAXDIMS, np.int32)
+        meta[0] = value.ndim
+        meta[1] = code
+        meta[2:2 + value.ndim] = value.shape
+        self._sendrecv(meta, self._rank, dst_rank)
+        self._sendrecv(value, self._rank, dst_rank)
 
     def recv(self, src_rank: int):
-        return self._sendrecv(None, src_rank, self._rank)
+        """Eager receive: learn shape/dtype from the metadata ppermute,
+        contribute zeros of that shape to the payload ppermute."""
+        meta_in = np.zeros(2 + self._META_MAXDIMS, np.int32)
+        meta = self._sendrecv(meta_in, src_rank, self._rank)
+        ndim, code = int(meta[0]), int(meta[1])
+        shape = tuple(int(d) for d in meta[2:2 + ndim])
+        name = self._META_DTYPES[code]
+        if name == "bfloat16":
+            # both sides must contribute the SAME dtype (one SPMD program)
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dtype = np.dtype(name)
+        out = self._sendrecv(np.zeros(shape, dtype), src_rank, self._rank)
+        # honor the negotiated dtype: without jax_enable_x64 the staging
+        # device arrays coerce 64-bit types to 32-bit in flight
+        return np.asarray(out).astype(dtype, copy=False)
 
     def _sendrecv(self, value, src: int, dst: int):
         import jax
@@ -187,14 +241,6 @@ class XlaCollectiveGroup(Communicator):
             return np.asarray(value)
         if self._world_size == 1:
             raise RuntimeError("p2p needs world_size > 1")
-        if value is None:
-            # receiver contributes zeros of unknown shape: the dag layer
-            # carries shape metadata; here we require the caller's value on
-            # send side only, receiver learns shape via allgather of shape
-            raise NotImplementedError(
-                "eager recv requires shape negotiation; use sendrecv() or "
-                "the dag tensor channels"
-            )
         garr = self._global(value)
         perm = [(src, dst)]
 

@@ -68,9 +68,16 @@ class Cluster:
         return raylet
 
     def remove_node(self, raylet: Raylet) -> None:
-        """Kill a node (chaos testing; ref: test_utils.py:1419 ResourceKiller)."""
+        """Gracefully stop a node (drains leases, says goodbye)."""
         self.raylets.remove(raylet)
         self.io.run(raylet.stop())
+
+    def kill_node(self, raylet: Raylet) -> None:
+        """Hard-kill a node (chaos testing; ref: test_utils.py:1419
+        ResourceKiller): workers SIGKILLed, no lease returns, no GCS
+        goodbye — failure is discovered, not announced."""
+        self.raylets.remove(raylet)
+        self.io.run(raylet.kill())
 
     def shutdown(self) -> None:
         for raylet in list(self.raylets):

@@ -112,6 +112,8 @@ class GcsServer:
         self.server = rpc.RpcServer(host, port)
         self.server.add_routes(self)
         self.server.on_disconnect = self._on_disconnect
+        self._wal_f = None  # lazily opened append handle (see _journal)
+        self._wal_broken = False  # write failed irrecoverably: snapshots only
 
         self.kv: dict[str, dict[str, bytes]] = {}
         self.nodes: dict[NodeID, NodeInfo] = {}
@@ -132,8 +134,15 @@ class GcsServer:
 
     # ------------------------------------------------------------------ pubsub
     async def publish(self, channel: str, message: Any):
-        if channel in ("actors", "pgs") or channel.startswith("actor:"):
-            self.mark_dirty()  # actor/pg table changed alongside this event
+        if channel == "actors":
+            # actor-table choke point: every actor state transition
+            # publishes here — journal the entry's current state
+            aid = message.get("actor_id") if isinstance(message, dict) else None
+            info = self.actors.get(aid)
+            if info is not None:
+                self._journal(("actor", info))
+        elif channel in ("pgs",) or channel.startswith("actor:"):
+            self.mark_dirty()  # covered by the periodic snapshot
         dead = []
         for conn in self.subs.get(channel, ()):  # push-based: no long-poll
             try:
@@ -154,7 +163,10 @@ class GcsServer:
         if exists and not p.get("overwrite", True):
             return False
         ns[p["key"]] = p["value"]
-        self.mark_dirty()
+        if p.get("ns", "") != "metrics":
+            self._journal(("kvput", p.get("ns", ""), p["key"], p["value"]))
+        else:
+            self.mark_dirty()
         return True
 
     async def rpc_kv_get(self, conn, p):
@@ -165,7 +177,7 @@ class GcsServer:
         return {k: ns.get(k) for k in p["keys"]}
 
     async def rpc_kv_del(self, conn, p):
-        self.mark_dirty()
+        self._journal(("kvdel", p.get("ns", ""), p["key"]))
         return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
 
     async def rpc_kv_exists(self, conn, p):
@@ -179,7 +191,7 @@ class GcsServer:
     # -------------------------------------------------------------------- jobs
     async def rpc_register_job(self, conn, p):
         self.job_counter += 1
-        self.mark_dirty()
+        self._journal(("job", self.job_counter))
         return JobID(self.job_counter.to_bytes(4, "little"))
 
     # ------------------------------------------------------------------- nodes
@@ -270,8 +282,10 @@ class GcsServer:
             max_restarts=spec.get("max_restarts", 0),
         )
         self.actors[actor_id] = info
+        self._journal(("actor", info))
         if name:
             self.named_actors[name] = actor_id
+            self._journal(("name", name, actor_id))
         self._bg.spawn(self._schedule_actor(info))
         return info.view()
 
@@ -451,6 +465,7 @@ class GcsServer:
             await self.publish(f"actor:{info.actor_id.hex()}", info.view())
             if info.name and self.named_actors.get(info.name) == info.actor_id:
                 del self.named_actors[info.name]
+                self._journal(("namedel", info.name))
 
     # -------------------------------------------------------- placement groups
     async def rpc_create_placement_group(self, conn, p):
@@ -461,6 +476,7 @@ class GcsServer:
         strategy = p.get("strategy", "PACK")
         pg = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy, state="PENDING")
         self.pgs[pg_id] = pg
+        self._journal(("pg", pg))
 
         assignment = self._place_bundles(bundles, strategy)
         if assignment is None:
@@ -502,6 +518,7 @@ class GcsServer:
             await c.close()
         pg.state = "CREATED"
         pg.bundle_nodes = [n.node_id for n in assignment]
+        self._journal(("pg", pg))
         return {"state": "CREATED", "bundle_nodes": pg.bundle_nodes}
 
     def _place_bundles(self, bundles, strategy) -> list[NodeInfo] | None:
@@ -561,6 +578,7 @@ class GcsServer:
                 pass
         pg.state = "REMOVED"
         pg.bundle_nodes = []
+        self._journal(("pg", pg))
         return True
 
     async def rpc_get_placement_group(self, conn, p):
@@ -610,22 +628,117 @@ class GcsServer:
                         )
 
     def _restore(self):
-        """Recover durable tables from the snapshot (ref: GCS FT via Redis
-        store_client — here an atomic pickle snapshot). Volatile state
-        (node registry, metrics) is rebuilt by re-registration."""
+        """Recover durable tables: atomic pickle snapshot + write-ahead
+        journal replay (ref role: GCS FT via the Redis store client,
+        src/ray/gcs/gcs_server/store_client/redis_store_client.cc — there
+        every table op journals through Redis; here ops append to a WAL
+        between snapshots, so a kill between two mutations loses neither).
+        Volatile state (node registry, metrics) is rebuilt by
+        re-registration."""
         import pickle as _p
 
-        if not self.persist_path or not os.path.exists(self.persist_path):
+        if not self.persist_path:
             return
-        with open(self.persist_path, "rb") as f:
-            snap = _p.load(f)
-        self.kv = snap.get("kv", {})
-        self.kv.pop("metrics", None)
-        self.job_counter = snap.get("job_counter", 0)
-        self.actors = snap.get("actors", {})
-        self.named_actors = snap.get("named_actors", {})
-        self.pgs = snap.get("pgs", {})
+        if os.path.exists(self.persist_path):
+            with open(self.persist_path, "rb") as f:
+                snap = _p.load(f)
+            self.kv = snap.get("kv", {})
+            self.kv.pop("metrics", None)
+            self.job_counter = snap.get("job_counter", 0)
+            self.actors = snap.get("actors", {})
+            self.named_actors = snap.get("named_actors", {})
+            self.pgs = snap.get("pgs", {})
+        self._replay_wal()
         self._restored_at = time.monotonic()
+
+    # ------------------------------------------------------------- WAL
+    # Append-only op log between snapshots. Each record is
+    # [u32 len][pickle(op)]; a torn tail (kill mid-append) is detected by
+    # the length prefix and dropped. Replay is idempotent set-style, so
+    # replaying a WAL that predates the latest snapshot converges to the
+    # snapshot state or later.
+    @property
+    def _wal_path(self):
+        return self.persist_path + ".wal" if self.persist_path else None
+
+    def _journal(self, op: tuple) -> None:
+        if not self.persist_path or self._wal_broken:
+            self.mark_dirty()
+            return
+        import pickle as _p
+        import struct as _s
+
+        try:
+            if self._wal_f is None:
+                self._wal_f = open(self._wal_path, "ab")
+            pos = self._wal_f.tell()
+            try:
+                rec = _p.dumps(op)
+                self._wal_f.write(_s.pack("<I", len(rec)) + rec)
+                self._wal_f.flush()  # survives process kill (page cache)
+            except Exception:
+                # a PARTIAL record would poison every later append
+                # (replay stops at the first unreadable record): wind the
+                # file back to the last good boundary, or stop journaling
+                # until the next snapshot truncation if even that fails
+                try:
+                    self._wal_f.truncate(pos)
+                    self._wal_f.seek(pos)
+                except Exception:
+                    self._wal_broken = True
+                    self._wal_f = None
+        except Exception:
+            self._wal_broken = True  # can't open: snapshots only
+            self._wal_f = None
+        self.mark_dirty()
+
+    def _replay_wal(self):
+        import pickle as _p
+        import struct as _s
+
+        path = self._wal_path
+        if not path or not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            buf = f.read()
+        off = 0
+        while off + 4 <= len(buf):
+            (ln,) = _s.unpack_from("<I", buf, off)
+            if off + 4 + ln > len(buf):
+                break  # torn tail from a kill mid-append
+            try:
+                op = _p.loads(buf[off + 4:off + 4 + ln])
+            except Exception:
+                break
+            off += 4 + ln
+            kind = op[0]
+            if kind == "kvput":
+                self.kv.setdefault(op[1], {})[op[2]] = op[3]
+            elif kind == "kvdel":
+                self.kv.get(op[1], {}).pop(op[2], None)
+            elif kind == "job":
+                self.job_counter = max(self.job_counter, op[1])
+            elif kind == "actor":
+                self.actors[op[1].actor_id] = op[1]
+            elif kind == "name":
+                self.named_actors[op[1]] = op[2]
+            elif kind == "namedel":
+                self.named_actors.pop(op[1], None)
+            elif kind == "pg":
+                self.pgs[op[1].pg_id] = op[1]
+
+    def _truncate_wal(self):
+        if not self._wal_path:
+            return
+        try:
+            if self._wal_f is not None:
+                self._wal_f.close()
+                self._wal_f = None
+            with open(self._wal_path, "wb"):
+                pass  # the snapshot now covers everything journaled
+            self._wal_broken = False  # fresh file: journaling can resume
+        except Exception:
+            pass
 
     def mark_dirty(self):
         self._dirty = True
@@ -656,6 +769,7 @@ class GcsServer:
             with open(tmp, "wb") as f:
                 f.write(snap)
             os.replace(tmp, self.persist_path)  # atomic snapshot
+            self._truncate_wal()  # the snapshot covers everything journaled
             return True
         except Exception:
             return False
@@ -705,8 +819,15 @@ def main():
                         help="snapshot file for durable tables (GCS FT)")
     args = parser.parse_args()
 
+    # run the server from the CANONICAL module: under `python -m` this
+    # file executes as __main__, and anything pickled with __main__-homed
+    # classes (ActorInfo/PlacementGroupInfo in the WAL, most importantly)
+    # would be unloadable by any normally-importing process
+    import ray_tpu.core.gcs as _canonical
+
     async def run():
-        gcs = GcsServer(args.host, args.port, persist_path=args.persist)
+        gcs = _canonical.GcsServer(
+            args.host, args.port, persist_path=args.persist)
         host, port = await gcs.start()
         line = f"{host}:{port}"
         if args.address_file:

@@ -219,7 +219,12 @@ class Raylet:
         # client-reported task backlog (work queued driver-side that is not
         # a parked lease request), summed into the heartbeat demand signal
         # (ref: autoscaler v2 resource-demand reporting, autoscaler.proto)
-        self._demand_reports: dict[int, int] = {}
+        # keyed by the live Connection OBJECT (identity hash): an id()
+        # key could alias a new connection after CPython address reuse,
+        # letting a dead client's stale backlog skew the autoscaler
+        # demand signal. The dict entry pins the conn until disconnect
+        # pops it, so aliasing is impossible.
+        self._demand_reports: dict[object, int] = {}
         self.cluster_view: list[dict] = []
         # object spilling (ref: local_object_manager.h:42): sealed objects
         # move to disk under arena pressure and restore on demand
@@ -491,12 +496,12 @@ class Raylet:
         self.cgroups.isolate_worker(worker_id.hex(), proc.pid, None)
         return w
 
-    async def rpc_dump_worker_stack(self, conn, p):
-        """Proxy an on-demand stack dump to one of this node's workers
-        (ref: dashboard reporter profiling endpoints). worker_id may be a
-        hex prefix; unique match required. Degrades to None (like
-        get_log) for missing/ambiguous ids, dead workers, and workers
-        that don't speak the RPC (C++)."""
+    async def _proxy_worker_call(self, p, method: str, payload: dict):
+        """Proxy an on-demand RPC to one of this node's workers (ref:
+        dashboard reporter profiling endpoints). worker_id may be a hex
+        prefix; unique match required. Degrades to None (like get_log)
+        for missing/ambiguous ids, dead workers, and workers that don't
+        speak the RPC (C++)."""
         prefix = (p.get("worker_id") or "")
         if not prefix:
             return None
@@ -507,11 +512,21 @@ class Raylet:
         try:
             wconn = await rpc.connect(*matches[0].address, timeout=5)
             try:
-                return await wconn.call("dump_stack", {}, timeout=10)
+                return await wconn.call(method, payload, timeout=10)
             finally:
                 await wconn.close()
         except Exception:
             return None
+
+    async def rpc_dump_worker_stack(self, conn, p):
+        return await self._proxy_worker_call(p, "dump_stack", {})
+
+    async def rpc_heap_profile_worker(self, conn, p):
+        """Proxy heap-profile control/snapshots to a worker (the memray /
+        profile_manager.py:191 role; tracemalloc in-process)."""
+        return await self._proxy_worker_call(
+            p, "heap_profile",
+            {k: p[k] for k in ("action", "top", "nframes") if k in p})
 
     async def rpc_get_log(self, conn, p):
         """Serve a worker's captured stdout/stderr tail (ref: state API
@@ -783,7 +798,7 @@ class Raylet:
         self._lease_waiters = still
 
     def _on_client_disconnect(self, conn):
-        self._demand_reports.pop(id(conn), None)
+        self._demand_reports.pop(conn, None)
         for key in [k for k in self._transfer_pins if k[0] is conn]:
             self._release_transfer_pin(conn, key[1])
         for resources, fut, pg_key, waiter_conn in self._lease_waiters:
@@ -881,9 +896,9 @@ class Raylet:
         resource-demand reporting). Latest report per client wins."""
         count = int(p.get("count", 0))
         if count <= 0:
-            self._demand_reports.pop(id(conn), None)
+            self._demand_reports.pop(conn, None)
         else:
-            self._demand_reports[id(conn)] = count
+            self._demand_reports[conn] = count
         return True
 
     # -------------------------------------------------------- object plane
@@ -1368,6 +1383,31 @@ class Raylet:
         finally:
             del buf
             self.store.release(oid)
+
+    async def kill(self):
+        """Chaos-test hard death (ref: test_utils.py:1419 ResourceKiller
+        SIGKILLing raylets): SIGKILL every worker, drop the server with no
+        lease returns / GCS goodbyes — peers must discover the loss via
+        missed heartbeats and recover by retry + lineage."""
+        import signal as _signal
+
+        self._stopping = True
+        await self._bg.cancel_all()
+        for w in self.all_workers.values():
+            try:
+                os.kill(w.proc.pid, _signal.SIGKILL)
+            except Exception:
+                pass
+        await self.server.stop()
+        if self.gcs is not None:
+            try:
+                await self.gcs.close()
+            except Exception:
+                pass
+        try:
+            self.store.destroy()
+        except Exception:
+            pass
 
     async def stop(self):
         self._stopping = True

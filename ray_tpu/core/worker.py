@@ -74,6 +74,14 @@ class Worker:
         self._group_sems: dict = {}
         # fast-path rings attached by drivers (see core/fastpath.py)
         self._fast_rings: list = []
+        # one-task-per-worker guard for NORMAL tasks: ring-pump inline
+        # execution and RPC-path executor runs must never run two tasks
+        # at once on this one-CPU lease (the driver's quiet-lane worker
+        # preference is best-effort, not an exclusion). Uncontended in
+        # the pure-ring and pure-RPC steady states.
+        import threading as _threading
+
+        self._exec_mutex = _threading.Lock()
 
     async def start(self):
         # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
@@ -256,6 +264,15 @@ class Worker:
 
         from ray_tpu.core import fastpath
 
+        if (p.get("kind") == "actor"
+                and getattr(self, "_actor_max_concurrency", 1) > 1):
+            # Threaded actors (max_concurrency > 1) must not take the ring
+            # lane: the pump runs records strictly sequentially through one
+            # executor job, so methods that legitimately block on each
+            # other (wait()/signal() coordination) would deadlock. Mirror
+            # the RPC batched-run gate (see _actor_max_concurrency == 1
+            # check in the dispatch path) by refusing the attach outright.
+            return False
         ring = fastpath.RingPair.open(p["name"])
         self._fast_rings.append(ring)
         loop = asyncio.get_running_loop()
@@ -332,6 +349,7 @@ class Worker:
                     m = getattr(self.actor_instance, mname, None)
                     if (downgraded
                             or self.actor_instance is None
+                            or getattr(self, "_actor_max_concurrency", 1) > 1
                             or not callable(m)
                             or inspect.iscoroutinefunction(m)
                             or inspect.isgeneratorfunction(m)
@@ -422,10 +440,24 @@ class Worker:
                         replies.append(
                             fastpath.pack_reply(tid, fastpath.NEED_SLOW, b""))
                         continue
+                    # _exec_mutex: an RPC-path normal task may be on the
+                    # executor thread right now (the driver's quiet-lane
+                    # preference is not an exclusion). Bounded acquire,
+                    # NOT a blocking one: the RPC task may itself be
+                    # waiting on THIS ring record (nested get on a ref
+                    # buried in a container arg) — on contention reply
+                    # NEED_SLOW so the driver reroutes to a free worker
+                    # instead of deadlocking the lease.
+                    if not self._exec_mutex.acquire(timeout=0.05):
+                        replies.append(
+                            fastpath.pack_reply(tid, fastpath.NEED_SLOW, b""))
+                        continue
                     try:
                         ok, val = True, fn(*args, **kwargs)
                     except BaseException as e:  # noqa: BLE001 — reply on
                         ok, val = False, e
+                    finally:
+                        self._exec_mutex.release()
                     replies.append(
                         self._fast_pack_result(tid, ok, val, inline_max))
                 status = self._fast_push_replies(ring, replies)
@@ -786,25 +818,26 @@ class Worker:
         """Thread-side body of the simple-batch fast path: no awaits, no
         loop interaction — just call the user functions back to back."""
         out = []
-        for spec in run:
-            try:
-                fn = self._func_cache[spec["func_id"]]
-                args = [
-                    serialization.unpack(a[1]) if a[0] == "v" else a[1]
-                    for a in spec["args"]
-                ]
-                kwargs = {
-                    k: serialization.unpack(a[1]) if a[0] == "v" else a[1]
-                    for k, a in spec["kwargs"].items()
-                }
-                value = self._traced_call(spec, fn, args, kwargs)
-                if inspect.isgenerator(value):
-                    value = list(value)
-                    if spec["num_returns"] != 1:
-                        value = tuple(value)
-                out.append((True, value))
-            except Exception as e:
-                out.append((False, e))
+        with self._exec_mutex:  # exclude concurrent ring-pump inline exec
+            for spec in run:
+                try:
+                    fn = self._func_cache[spec["func_id"]]
+                    args = [
+                        serialization.unpack(a[1]) if a[0] == "v" else a[1]
+                        for a in spec["args"]
+                    ]
+                    kwargs = {
+                        k: serialization.unpack(a[1]) if a[0] == "v" else a[1]
+                        for k, a in spec["kwargs"].items()
+                    }
+                    value = self._traced_call(spec, fn, args, kwargs)
+                    if inspect.isgenerator(value):
+                        value = list(value)
+                        if spec["num_returns"] != 1:
+                            value = tuple(value)
+                    out.append((True, value))
+                except Exception as e:
+                    out.append((False, e))
         return out
 
     async def rpc_push_task(self, conn, p):
@@ -828,17 +861,21 @@ class Worker:
             if inspect.iscoroutinefunction(fn):
                 value = await self._traced_acall(spec, fn, args, kwargs)
             else:
-                value = await loop.run_in_executor(
-                    self.executor,
-                    lambda: self._traced_call(spec, fn, args, kwargs))
-                if inspect.isgenerator(value):
-                    # legacy generator semantics (ref: old num_returns=N
-                    # generators): materialize; N>1 distributes the items
-                    value = await loop.run_in_executor(self.executor, list, value)
-                    if spec["num_returns"] == 1:
-                        pass  # a single list return
-                    else:
-                        value = tuple(value)
+                def _run_locked():
+                    with self._exec_mutex:  # one task per worker
+                        out = self._traced_call(spec, fn, args, kwargs)
+                        if inspect.isgenerator(out):
+                            # legacy generator semantics (ref: old
+                            # num_returns=N generators): materialize
+                            # UNDER the mutex — the user code is the
+                            # generator body, not the call that made it
+                            return list(out), True
+                        return out, False
+
+                value, was_gen = await loop.run_in_executor(
+                    self.executor, _run_locked)
+                if was_gen and spec["num_returns"] != 1:
+                    value = tuple(value)  # N>1 distributes the items
             results = await self._store_results(spec["task_id"], spec["num_returns"], value)
             dur = time.monotonic() - t0
             metrics.task_exec_seconds.observe(dur)
@@ -1184,6 +1221,38 @@ class Worker:
         } for tid, frame in sys._current_frames().items()]
         return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
                 "threads": out}
+
+    async def rpc_heap_profile(self, conn, p):
+        """On-demand heap profiling via tracemalloc (the memray role of
+        the reference's profile_manager.py:191, reimplemented in-process:
+        no external profiler attach, works in containers).
+
+        action="start" begins tracing (nframes deep); "snapshot" returns
+        the top-N allocation sites grouped by traceback since start;
+        "stop" ends tracing and frees the bookkeeping."""
+        import tracemalloc
+
+        action = p.get("action", "snapshot")
+        if action == "start":
+            if not tracemalloc.is_tracing():
+                tracemalloc.start(int(p.get("nframes", 8)))
+            return {"tracing": True}
+        if action == "stop":
+            tracemalloc.stop()
+            return {"tracing": False}
+        if not tracemalloc.is_tracing():
+            return {"error": "not tracing: call action='start' first"}
+        snap = tracemalloc.take_snapshot()
+        top = snap.statistics("traceback")[: int(p.get("top", 20))]
+        stats = [{
+            "size_bytes": s.size,
+            "count": s.count,
+            "traceback": s.traceback.format(),
+        } for s in top]
+        current, peak = tracemalloc.get_traced_memory()
+        return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
+                "current_bytes": current, "peak_bytes": peak,
+                "top": stats}
 
     async def rpc_exit_worker(self, conn, p):
         self._exit_requested = True

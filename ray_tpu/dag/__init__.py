@@ -4,7 +4,8 @@ cross-node channels mirrored over the raylet transfer plane and collective
 nodes riding the collective backend."""
 
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
-from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.compiled_dag import (CompiledDAG, CompiledDAGFuture,
+                                      CompiledDAGRef)
 from ray_tpu.dag.dag_node import (
     ClassMethodNode,
     CollectiveNode,
@@ -20,6 +21,7 @@ __all__ = [
     "ShmChannel",
     "CompiledDAG",
     "CompiledDAGRef",
+    "CompiledDAGFuture",
     "ClassMethodNode",
     "CollectiveNode",
     "DAGNode",

@@ -64,12 +64,37 @@ class CompiledDAGRef:
         return self._value
 
 
+class CompiledDAGFuture:
+    """Awaitable result of one execute_async() iteration (ref:
+    compiled_dag_ref.py:154 CompiledDAGFuture). The blocking channel read
+    runs in a thread executor, so awaiting never stalls the event loop;
+    like the reference, a future may only be awaited once (results must
+    drain in execute order)."""
+
+    def __init__(self, dag: "CompiledDAG", version: int):
+        self._dag = dag
+        self._version = version
+        self._awaited = False
+
+    def __await__(self):
+        if self._awaited:
+            raise RuntimeError(
+                "CompiledDAGFuture can only be awaited once")
+        self._awaited = True
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(
+            None, self._dag._read_output, self._version, None).__await__()
+
+
 class CompiledDAG:
     def __init__(self, root: DAGNode, *, buffer_size_bytes: int = 8 << 20,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, overlap: bool = True):
         self.root = root
         self.buffer_size = buffer_size_bytes
         self.timeout_s = timeout_s
+        self.overlap = overlap  # READ/COMPUTE/WRITE interleave (runner.py)
         self._compiled = False
         self._torn_down = False
         self._exec_version = 0
@@ -245,14 +270,47 @@ class CompiledDAG:
         for sched in schedules.values():
             sched.sort(key=lambda t: t["node_index"])
 
+        # Overlap safety per actor: the prefetch thread reads ALL of an
+        # iteration's channels before any compute, so it deadlocks if one
+        # of an actor's channel reads transitively depends on a node the
+        # SAME actor executes this iteration (a -> b -> a shapes). Those
+        # actors fall back to the lazy sequential schedule.
+        deps: dict[int, set] = {}
+
+        def transitive_actors(n) -> set:
+            got = deps.get(id(n))
+            if got is not None:
+                return got
+            acc: set = set()
+            if id(n) in node_actor:
+                acc.add(node_actor[id(n)])
+            for a in getattr(n, "args", ()):  # InputNode has no args
+                if isinstance(a, DAGNode):
+                    acc |= transitive_actors(a)
+            deps[id(n)] = acc
+            return acc
+
+        overlap_ok: dict[bytes, bool] = {}
+        for n in body:
+            akey = node_actor[id(n)]
+            for a in n.args:
+                if (isinstance(a, DAGNode)
+                        and node_actor.get(id(a)) != akey
+                        and akey in transitive_actors(a)):
+                    overlap_ok[akey] = False
+            overlap_ok.setdefault(akey, True)
+
         # start the per-actor loops (long-running RPC; replies on teardown)
         self.input_channel = self.channels[id(self.input_node)]
         self.leaf_channels = [self.channels[id(leaf)] for leaf in self.leaves]
         self._actor_handles = {node_actor[id(n)]: n.actor_handle for n in body}
         for akey, sched in schedules.items():
             handle = self._actor_handles[akey]
-            fut = core.start_dag_loop(handle, {"tasks": sched,
-                                               "chan_size": self.buffer_size})
+            fut = core.start_dag_loop(handle, {
+                "tasks": sched,
+                "chan_size": self.buffer_size,
+                "overlap": self.overlap and overlap_ok.get(akey, True),
+            })
             self._loop_futures.append(fut)
         # give loops a beat to attach to channels before first execute
         time.sleep(0.05)
@@ -280,6 +338,22 @@ class CompiledDAG:
         self.input_channel.write(value, timeout_ms=int(self.timeout_s * 1000))
         self._exec_version += 1
         return CompiledDAGRef(self, self._exec_version)
+
+    async def execute_async(self, value: Any) -> CompiledDAGFuture:
+        """Async twin of execute() (ref: compiled_dag_node.py:2617
+        execute_async): the input write (which can block on channel
+        backpressure) runs in a thread executor, and the returned future
+        is awaited — not .get()ed — for the result."""
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            None, self.input_channel.write, value,
+            int(self.timeout_s * 1000))
+        self._exec_version += 1
+        return CompiledDAGFuture(self, self._exec_version)
 
     def _read_output(self, version: int, timeout: float | None):
         deadline_ms = int((timeout or self.timeout_s) * 1000)

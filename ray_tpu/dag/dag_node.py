@@ -26,11 +26,11 @@ class DAGNode:
         self.upstream = upstream
 
     def experimental_compile(self, *, buffer_size_bytes: int = 8 << 20,
-                             timeout_s: float = 30.0):
+                             timeout_s: float = 30.0, overlap: bool = True):
         from ray_tpu.dag.compiled_dag import CompiledDAG
 
         return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes,
-                           timeout_s=timeout_s)
+                           timeout_s=timeout_s, overlap=overlap)
 
     # -- traversal helpers ---------------------------------------------------
     def walk(self, seen: set | None = None):

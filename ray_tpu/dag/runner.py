@@ -6,12 +6,24 @@ thread inside the actor's worker process, blocking on native channel
 conditions (ctypes calls release the GIL), so the actor's normal RPC surface
 stays live. Zero per-iteration task submissions — each iteration is
 READ(chans) → COMPUTE(method) → WRITE(chan) straight against shared memory.
+
+Overlap mode (the reference's READ/COMPUTE/WRITE op interleaving, ref:
+dag/dag_node_operation.py:14 + dag_operation_future.py): channel READs run
+one iteration AHEAD on a prefetch thread and WRITEs drain on a writer
+thread, so a stage's blocking input wait + deserialize and its output's
+backpressure wait ride UNDER the current compute instead of serializing
+with it — the substrate pipeline-parallel serving needs.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
+
 from ray_tpu.dag.channel import ChannelClosed, ShmChannel
 from ray_tpu.utils.ids import ObjectID
+
+_CLOSED = object()  # prefetch sentinel: upstream channel closed
 
 
 def run_dag_loop(worker, schedule: dict) -> dict:
@@ -26,6 +38,25 @@ def run_dag_loop(worker, schedule: dict) -> dict:
         return c
 
     tasks = schedule["tasks"]
+    if schedule.get("overlap", True):
+        return _run_overlapped(worker, tasks, chan, chans)
+    return _run_sequential(worker, tasks, chan)
+
+
+def _exec_task(worker, t, args):
+    if t.get("collective"):
+        # collective op node: the group's rendezvous synchronizes the
+        # members (ref: dag/collective_node.py + aDAG allreduce);
+        # XLA/ICI group on TPU, CPU fake in tests
+        from ray_tpu.collective import collective as col
+
+        fn = getattr(col, t["collective"])
+        return fn(args[0], group_name=t["group"])
+    method = getattr(worker.actor_instance, t["method"])
+    return method(*args)
+
+
+def _run_sequential(worker, tasks, chan) -> dict:
     iterations = 0
     try:
         while True:
@@ -42,20 +73,118 @@ def run_dag_loop(worker, schedule: dict) -> dict:
                         args.append(local_vals[v])
                     else:  # static
                         args.append(v)
-                if t.get("collective"):
-                    # collective op node: the group's rendezvous synchronizes
-                    # the members (ref: dag/collective_node.py + aDAG
-                    # allreduce); XLA/ICI group on TPU, CPU fake in tests
-                    from ray_tpu.collective import collective as col
-
-                    fn = getattr(col, t["collective"])
-                    out = fn(args[0], group_name=t["group"])
-                else:
-                    method = getattr(worker.actor_instance, t["method"])
-                    out = method(*args)
+                out = _exec_task(worker, t, args)
                 local_vals[t["node_index"]] = out
                 if t["out_chan"] is not None:
                     chan(t["out_chan"]).write(out)
             iterations += 1
     except ChannelClosed:
         return {"iterations": iterations}
+
+
+def _run_overlapped(worker, tasks, chan, chans) -> dict:
+    """READ one iteration ahead + WRITE behind, COMPUTE in the middle.
+
+    One prefetch thread walks the schedule's channel reads in order
+    (preserving per-channel version order) and stages each iteration's
+    read-set in a depth-1 queue; one writer thread drains a depth-1 queue
+    of outputs. Depth 1 keeps the end-to-end backpressure contract: at
+    most one iteration's values are buffered per stage beyond what the
+    depth-1 channels themselves hold."""
+    # channel ids each iteration reads, in schedule order (deduped)
+    read_ids: list[bytes] = []
+    for t in tasks:
+        for kind, v in t["args"]:
+            if kind == "chan" and v not in read_ids:
+                read_ids.append(v)
+
+    reads_q: queue.Queue = queue.Queue(maxsize=1)
+    writes_q: queue.Queue = queue.Queue(maxsize=1)
+    stop = threading.Event()
+
+    def prefetch():
+        try:
+            while not stop.is_set():
+                batch = {}
+                for cid in read_ids:
+                    batch[cid] = chan(cid).read()
+                reads_q.put(batch)
+        except ChannelClosed:
+            reads_q.put(_CLOSED)
+        except BaseException as e:  # noqa: BLE001 — surface on compute side
+            reads_q.put(e)
+
+    def drain_writes():
+        try:
+            while True:
+                item = writes_q.get()
+                if item is None:
+                    return
+                cid, value = item
+                chan(cid).write(value)
+        except BaseException as e:  # noqa: BLE001
+            write_err.append(e)
+            # keep draining so the compute side never deadlocks on put()
+            while writes_q.get() is not None:
+                pass
+
+    write_err: list = []
+    threads = []
+    if read_ids:
+        tr = threading.Thread(target=prefetch, name="rt-dag-read", daemon=True)
+        tr.start()
+        threads.append(tr)
+    tw = threading.Thread(target=drain_writes, name="rt-dag-write", daemon=True)
+    tw.start()
+
+    iterations = 0
+    try:
+        while True:
+            if read_ids:
+                batch = reads_q.get()
+                if batch is _CLOSED:
+                    raise ChannelClosed("upstream")
+                if isinstance(batch, BaseException):
+                    raise batch
+            else:
+                batch = {}
+            if write_err:
+                raise write_err[0]
+            local_vals: dict[int, object] = {}
+            for t in tasks:
+                args = []
+                for kind, v in t["args"]:
+                    if kind == "chan":
+                        args.append(batch[v])
+                    elif kind == "local":
+                        args.append(local_vals[v])
+                    else:  # static
+                        args.append(v)
+                out = _exec_task(worker, t, args)
+                local_vals[t["node_index"]] = out
+                if t["out_chan"] is not None:
+                    writes_q.put((t["out_chan"], out))
+            iterations += 1
+    except ChannelClosed:
+        return {"iterations": iterations}
+    finally:
+        stop.set()
+        # close channels FIRST: unblocks a prefetch thread mid-read and a
+        # writer thread stuck on backpressure (the driver's teardown close
+        # already does this for the normal path; this covers error exits).
+        # Only then enqueue the writer's stop sentinel — the queue may be
+        # full until the unblocked writer drains it.
+        for c in chans.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        # drain the read queue so a prefetch thread blocked in put()
+        # (error exits leave staged batches behind) can run, observe the
+        # closed channels and exit instead of leaking with its payloads
+        for _ in range(3):
+            try:
+                reads_q.get(timeout=0.2)
+            except queue.Empty:
+                break
+        writes_q.put(None)

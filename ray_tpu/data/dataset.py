@@ -116,12 +116,11 @@ class Dataset:
 
     def filter(self, fn: Callable) -> "Dataset":
         def apply(block):
-            if isinstance(block, dict):
-                mask = np.asarray(
-                    [bool(fn(r)) for r in BlockAccessor.for_block(block).rows()]
-                )
-                return {k: np.asarray(v)[mask] for k, v in block.items()}
-            return [r for r in block if fn(r)]
+            acc = BlockAccessor.for_block(block)
+            if acc.is_tabular():
+                mask = np.asarray([bool(fn(r)) for r in acc.rows()])
+                return acc.mask(mask)
+            return [r for r in acc.block if fn(r)]
 
         return Dataset(self._plan.with_op(MapBlocks("filter", apply)))
 
@@ -134,7 +133,9 @@ class Dataset:
 
     def drop_columns(self, cols: list[str]) -> "Dataset":
         return self.map_batches(
-            lambda b: {k: v for k, v in b.items() if k not in set(cols)},
+            lambda b: {k: v for k, v in
+                       BlockAccessor.for_block(b).columns().items()
+                       if k not in set(cols)},
             batch_format="numpy",
         )
 
@@ -165,6 +166,67 @@ class Dataset:
         """Group rows by a key column (ref: dataset.py groupby ->
         grouped_data.py; hash-aggregated map-side partials + one merge)."""
         return GroupedDataset(self, key)
+
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             suffix: str = "_r", num_partitions: int | None = None,
+             ) -> "Dataset":
+        """Distributed hash join on a key column (ref:
+        _internal/execution/operators/join.py:28 JoinOperator +
+        hash_shuffle.py): both sides hash-partition their blocks by key
+        (map side, one task per block), then each partition builds a hash
+        table from its left rows and probes the right rows (one task per
+        partition). Output columns: the key, left columns, right columns
+        (name collisions on the right take ``suffix``).
+
+        how: "inner" | "left" | "right" | "outer". Missing sides of
+        outer rows are null-filled (Arrow take-with-null semantics).
+        """
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unknown join how={how!r}")
+        left_refs = list(self.iter_block_refs())
+        right_refs = list(other.iter_block_refs())
+        P = num_partitions or builtins.min(
+            16, builtins.max(len(left_refs), len(right_refs), 1))
+
+        @ray_tpu.remote(num_returns=P)
+        def shard(block):
+            acc = BlockAccessor.for_block(block)
+            n = acc.num_rows()
+            if n == 0:
+                empty = acc.slice(0, 0)
+                return tuple(empty for _ in builtins.range(P)) \
+                    if P > 1 else empty
+            keys = acc.column(on) if acc.is_tabular() \
+                else [r[on] for r in acc.rows()]
+            part = np.array([_key_shard(k, P) for k in keys])
+            outs = tuple(acc.take(np.nonzero(part == p)[0])
+                         for p in builtins.range(P))
+            return outs if P > 1 else outs[0]
+
+        @ray_tpu.remote
+        def join_partition(n_left, *parts):
+            return _hash_join_blocks(
+                list(parts[:n_left]), list(parts[n_left:]), on, how, suffix)
+
+        lsh = [shard.remote(r) for r in left_refs]
+        rsh = [shard.remote(r) for r in right_refs]
+
+        def col(shards, p):
+            if P == 1:
+                return list(shards)
+            return [s[p] for s in shards]
+
+        out_refs = [
+            join_partition.remote(
+                len(lsh), *col(lsh, p), *col(rsh, p))
+            for p in builtins.range(P)
+        ]
+        # hand the partition refs straight to the plan (NO remote re-fetch
+        # hop: a read task get()ing a ref would hold the only lease on a
+        # 1-CPU node while the join tasks it waits on need one)
+        from ray_tpu.data.executor import InjectRefs
+
+        return Dataset(Plan([], (InjectRefs("join", out_refs),)))
 
     def union(self, other: "Dataset") -> "Dataset":
         if self._plan.ops or other._plan.ops:
@@ -225,10 +287,10 @@ class Dataset:
         vals: list = []
         for block in self.iter_blocks():
             acc = BlockAccessor.for_block(block)
-            if isinstance(block, dict):
-                col = on or next(iter(block))
+            if acc.is_tabular():
+                col = on or acc.column_names()[0]
                 if acc.num_rows():
-                    vals.append(np.asarray(block[col]))
+                    vals.append(acc.column(col))
             else:
                 rows = [r[on] if on else r for r in acc.rows()]
                 if rows:
@@ -432,28 +494,11 @@ class GroupedDataset:
 
         @ray_tpu.remote(num_returns=P)
         def partition(block):
-            import zlib
-
-            def canon(k):
-                # equal dict keys must route identically: 1 == 1.0 == True
-                # share a float encoding; str/bytes get their own spaces
-                # (process-stable, unlike randomized str hash())
-                if isinstance(k, str):
-                    return b"s:" + k.encode()
-                if isinstance(k, bytes):
-                    return b"b:" + k
-                if isinstance(k, (bool, int, float)):
-                    try:
-                        return b"n:" + repr(float(k)).encode()
-                    except OverflowError:
-                        return b"i:" + repr(int(k)).encode()
-                return b"o:" + repr(k).encode()
-
             acc = BlockAccessor.for_block(block)
             shards: list[dict] = [{} for _ in builtins.range(P)]
             for row in acc.rows():
                 k = row[key]
-                shards[zlib.crc32(canon(k)) % P].setdefault(k, []).append(row)
+                shards[_key_shard(k, P)].setdefault(k, []).append(row)
             return tuple(shards) if P > 1 else shards[0]
 
         @ray_tpu.remote
@@ -491,6 +536,109 @@ class _HoldBlock:
 
 
 # ------------------------------------------------------------------ sources
+def _key_shard(k, P: int) -> int:
+    """Stable partition of a join/group key (equal keys route identically
+    across processes; 1 == 1.0 == True share an encoding)."""
+    import zlib
+
+    if isinstance(k, np.generic):
+        k = k.item()
+    if isinstance(k, str):
+        b = b"s:" + k.encode()
+    elif isinstance(k, bytes):
+        b = b"b:" + k
+    elif isinstance(k, (bool, int, float)):
+        try:
+            b = b"n:" + repr(float(k)).encode()
+        except OverflowError:
+            b = b"i:" + repr(int(k)).encode()
+    else:
+        b = b"o:" + repr(k).encode()
+    return zlib.crc32(b) % P
+
+
+def _hash_join_blocks(left_parts: list, right_parts: list, on: str,
+                      how: str, suffix: str):
+    """One partition's hash join: build key -> row-indices from the left,
+    probe the right; row selection via Arrow take with null indices so
+    outer rows null-fill naturally (ref: join.py:28 hash join build/probe)."""
+    import pyarrow as pa
+    import pyarrow.compute  # noqa: F401 — pa.compute is not auto-imported
+
+    def side(parts):
+        acc = BlockAccessor.for_block(BlockAccessor.concat(parts))
+        if not acc.is_tabular() and acc.num_rows():
+            # rows-list side (e.g. from_items): pivot to columnar once
+            acc = BlockAccessor.for_block(rows_to_columns(list(acc.rows())))
+        return acc
+
+    lt = side(left_parts)
+    rt = side(right_parts)
+    n_l, n_r = lt.num_rows(), rt.num_rows()
+    if (n_l == 0 and how in ("inner", "left")) or (
+            n_r == 0 and how in ("inner", "right")):
+        return []
+    lkeys = lt.column(on).tolist() if n_l else []
+    rkeys = rt.column(on).tolist() if n_r else []
+    pos: dict = {}
+    for i, k in enumerate(lkeys):
+        pos.setdefault(k, []).append(i)
+    li: list = []
+    ri: list = []
+    matched = np.zeros(n_l, dtype=bool)
+    for j, k in enumerate(rkeys):
+        hits = pos.get(k)
+        if hits:
+            matched[hits] = True
+            for i in hits:
+                li.append(i)
+                ri.append(j)
+        elif how in ("right", "outer"):
+            li.append(None)
+            ri.append(j)
+    if how in ("left", "outer"):
+        for i in np.nonzero(~matched)[0]:
+            li.append(int(i))
+            ri.append(None)
+    if not li:
+        return []
+
+    def table_of(acc):
+        b = acc.block
+        if isinstance(b, pa.Table):
+            return b
+        t = acc.to_batch("pyarrow") if acc.num_rows() else pa.table({})
+        return t
+
+    ltab = table_of(lt) if n_l else None
+    rtab = table_of(rt) if n_r else None
+    lsel = ltab.take(pa.array(li, type=pa.int64())) if ltab is not None \
+        else None
+    rsel = rtab.take(pa.array(ri, type=pa.int64())) if rtab is not None \
+        else None
+    out: dict = {}
+    # key column: from whichever side has it per row
+    if lsel is not None and rsel is not None and on in rsel.column_names:
+        lk, rk = lsel[on], rsel[on]
+        out[on] = pa.chunked_array([
+            pa.compute.if_else(pa.compute.is_valid(lk.combine_chunks()),
+                               lk.combine_chunks(), rk.combine_chunks())])
+    elif lsel is not None:
+        out[on] = lsel[on]
+    else:
+        out[on] = rsel[on]
+    if lsel is not None:
+        for name in lsel.column_names:
+            if name != on:
+                out[name] = lsel[name]
+    if rsel is not None:
+        for name in rsel.column_names:
+            if name == on:
+                continue
+            out[name + suffix if name in out else name] = rsel[name]
+    return pa.table(out)
+
+
 def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
     if parallelism <= 0:
         parallelism = max(1, min(8, n // DEFAULT_BLOCK_ROWS or 1))

@@ -74,6 +74,23 @@ class Operator:
         raise NotImplementedError
 
 
+class InjectRefs(Operator):
+    """Source-style op: yields pre-computed block refs (join outputs and
+    other already-launched distributed results) into the stream."""
+
+    def __init__(self, name: str, refs: list):
+        self.name = name
+        self.refs = list(refs)
+
+    def transform(self, refs: Iterator, stats: OpStats) -> Iterator:
+        def gen():
+            yield from refs  # upstream (usually empty for a ref source)
+            yield from self.refs
+            stats.tasks += len(self.refs)
+
+        return gen()
+
+
 class MapBlocks(Operator):
     """map_batches / map / filter / flat_map all lower to this
     (ref: execution/operators/map_operator.py)."""
@@ -270,9 +287,7 @@ def _shuffle_rows(block, s):
     acc = BlockAccessor.for_block(block)
     n = acc.num_rows()
     perm = np.random.RandomState(s).permutation(n)
-    if isinstance(block, dict):
-        return {k: np.asarray(v)[perm] for k, v in block.items()}
-    return [block[i] for i in perm]
+    return acc.take(perm)
 
 
 @ray_tpu.remote
@@ -401,11 +416,11 @@ class SortOp(Operator):
 
         def sort_block(block):
             acc = BlockAccessor.for_block(block)
-            if isinstance(block, dict):
-                idx = np.argsort(np.asarray(block[key]), kind="stable")
+            if acc.is_tabular():
+                idx = np.argsort(acc.column(key), kind="stable")
                 if desc:
                     idx = idx[::-1]
-                return {k: np.asarray(v)[idx] for k, v in block.items()}
+                return acc.take(idx)
             rows = list(acc.rows())
             getter = (lambda r: r[key]) if key else (lambda r: r)
             return sorted(rows, key=getter, reverse=desc)

@@ -629,8 +629,8 @@ class ContinuousBatchingEngine:
                 groups = self._admit_dispatch()
                 if groups:
                     if carry is None:
-                        carry = (jnp.asarray(self.next_tok),
-                                 jnp.asarray(self.seq_lens))
+                        carry = (jnp.asarray(self.next_tok.copy()),
+                                 jnp.asarray(self.seq_lens.copy()))
                     tok_d, lens_d = carry
                     for reqs, first in groups:
                         slots = jnp.asarray([r.slot for r in reqs],
@@ -670,16 +670,22 @@ class ContinuousBatchingEngine:
                 await asyncio.sleep(0)
             K = self._pick_block(planned=True)
             self._rng, sub = jax.random.split(self._rng)
+            # .copy() on every host array that this loop later mutates
+            # (page_tables/seq_lens/next_tok/aids/temps): PJRT CPU
+            # zero-copies aligned numpy buffers into device arrays, so a
+            # retire/emission mutation while the async dispatch is still
+            # in flight would corrupt the program's view of them (race
+            # observed as garbage decode tokens under load).
             if carry is None:
-                carry = (jnp.asarray(self.next_tok),
-                         jnp.asarray(self.seq_lens))
+                carry = (jnp.asarray(self.next_tok.copy()),
+                         jnp.asarray(self.seq_lens.copy()))
             tok_d, lens_d = carry
             active = np.array([r is not None for r in self.slot_req])
             toks, tok_d, lens_d, self.kpool, self.vpool = paged_decode_multi(
-                self.params, self.loras, jnp.asarray(self.aids),
-                tok_d, lens_d, jnp.asarray(self.page_tables),
+                self.params, self.loras, jnp.asarray(self.aids.copy()),
+                tok_d, lens_d, jnp.asarray(self.page_tables.copy()),
                 self.kpool, self.vpool, jnp.asarray(active),
-                jnp.asarray(self.temps), sub, self.cfg, K)
+                jnp.asarray(self.temps.copy()), sub, self.cfg, K)
             carry = (tok_d, lens_d)
             for r in live:
                 r.planned = min(r.max_tokens, r.planned + K)
@@ -730,15 +736,15 @@ class ContinuousBatchingEngine:
             K = self._pick_block()
             self._rng, sub = jax.random.split(self._rng)
             if carry is None:
-                tok_d = jnp.asarray(self.next_tok)
-                lens_d = jnp.asarray(self.seq_lens)
+                tok_d = jnp.asarray(self.next_tok.copy())
+                lens_d = jnp.asarray(self.seq_lens.copy())
             else:
                 tok_d, lens_d = carry
             toks, tok_d, lens_d, self.kpool, self.vpool = paged_decode_multi(
-                self.params, self.loras, jnp.asarray(self.aids),
-                tok_d, lens_d, jnp.asarray(self.page_tables),
+                self.params, self.loras, jnp.asarray(self.aids.copy()),
+                tok_d, lens_d, jnp.asarray(self.page_tables.copy()),
                 self.kpool, self.vpool, jnp.asarray(active),
-                jnp.asarray(self.temps), sub, self.cfg, K)
+                jnp.asarray(self.temps.copy()), sub, self.cfg, K)
             carry = (tok_d, lens_d)
             pending.append((K, toks, list(self.slot_req)))
             if len(pending) >= 2:

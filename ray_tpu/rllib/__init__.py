@@ -11,6 +11,9 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
   (algorithms/sac/)
 - replay_buffer: uniform + prioritized rings (utils/replay_buffers/)
 - multi_agent: MultiAgentEnv + MultiAgentEnvRunner (env/multi_agent_*)
+- appo: async PPO — IMPALA sampling + clipped surrogate (algorithms/appo/)
+- offline: experience JSONL IO + BC + discrete CQL (rllib/offline/,
+  algorithms/bc/, algorithms/cql/)
 
     from ray_tpu.rllib import PPOConfig
 
@@ -21,17 +24,30 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
     for _ in range(10):
         print(algo.train()["episode_return_mean"])
 """
+from ray_tpu.rllib.appo import APPO, APPOConfig, make_appo_update
 from ray_tpu.rllib.core import policy_init, policy_logits, sample_action, value_fn
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner, make_dqn_update, q_init, q_values
 from ray_tpu.rllib.env_runner import EnvRunner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, make_impala_update, vtrace_returns
 from ray_tpu.rllib.learner import Learner, compute_gae, make_ppo_update
+from ray_tpu.rllib.offline import (BC, CQL, BCConfig, CQLConfig,
+                                   OfflineData, collect_rollouts,
+                                   write_rollouts)
 from ray_tpu.rllib.multi_agent import MultiAgentEnv, MultiAgentEnvRunner
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 from ray_tpu.rllib.sac import SAC, SACConfig, SACEnvRunner, make_sac_update, sac_init
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "BC",
+    "BCConfig",
+    "CQL",
+    "CQLConfig",
+    "OfflineData",
+    "collect_rollouts",
+    "write_rollouts",
     "DQN",
     "DQNConfig",
     "DQNEnvRunner",

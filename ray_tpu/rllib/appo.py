@@ -1,0 +1,91 @@
+"""APPO: asynchronous PPO — IMPALA's async sampling + V-trace correction
+with PPO's clipped surrogate objective.
+
+TPU-native counterpart of the reference APPO (ref:
+rllib/algorithms/appo/appo.py + appo_learner.py: "APPO is an
+IMPALA-variant that uses a PPO surrogate loss on V-trace-corrected
+advantages"). The driver IS the IMPALA driver (standing sample requests,
+stale-ok broadcasts); only the learner loss differs:
+
+    ratio    = pi_target(a|s) / pi_behavior(a|s)
+    L_pi     = -min(ratio * A_vtrace, clip(ratio, 1±eps) * A_vtrace)
+
+so a runner's policy-lag shows up twice, both times bounded: in the
+V-trace rho/c truncation of the TARGETS and in the clipped ratio of the
+SURROGATE.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace_returns
+
+
+def make_appo_update(lr: float, gamma: float, vf_coeff: float,
+                     entropy_coeff: float, rho_bar: float, c_bar: float,
+                     clip: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.core import policy_logits, value_fn
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(params, batch):
+        obs = batch["obs"]  # [T, N, D]
+        logits = policy_logits(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+        values = value_fn(params, obs)
+        vs, pg_adv = vtrace_returns(
+            batch["logp"], target_logp, batch["rewards"], values,
+            value_fn(params, batch["last_obs"]), batch["dones"],
+            gamma=gamma, rho_bar=rho_bar, c_bar=c_bar)
+        vs = jax.lax.stop_gradient(vs)
+        adv = jax.lax.stop_gradient(pg_adv)
+        # PPO clipped surrogate on the V-trace advantages (appo_learner)
+        ratio = jnp.exp(target_logp - batch["logp"])
+        surr = jnp.minimum(
+            ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        return pi_loss + vf_coeff * vf_loss - entropy_coeff * entropy
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return update, optimizer
+
+
+class APPOConfig(IMPALAConfig):
+    """Builder config (ref: appo.py APPOConfig — an IMPALAConfig with the
+    PPO clip parameter)."""
+
+    def __init__(self):
+        super().__init__()
+        self.clip = 0.2
+
+    def training(self, *, clip=None, **kw):
+        if clip is not None:
+            self.clip = clip
+        super().training(**kw)
+        return self
+
+    def _build_update(self):
+        return make_appo_update(
+            self.lr, self.gamma, self.vf_coeff, self.entropy_coeff,
+            self.rho_bar, self.c_bar, self.clip)
+
+    def build(self) -> "APPO":
+        if self.env_name is None:
+            raise ValueError("APPOConfig.environment(...) is required")
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """The IMPALA async driver with the APPO learner update."""

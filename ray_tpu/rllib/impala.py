@@ -136,6 +136,12 @@ class IMPALAConfig:
                 setattr(self, name, val)
         return self
 
+    def _build_update(self):
+        """(update_fn, optimizer) — subclass hook (APPO swaps the loss)."""
+        return make_impala_update(
+            self.lr, self.gamma, self.vf_coeff, self.entropy_coeff,
+            self.rho_bar, self.c_bar)
+
     def build(self) -> "IMPALA":
         if self.env_name is None:
             raise ValueError("IMPALAConfig.environment(...) is required")
@@ -169,9 +175,7 @@ class IMPALA:
             self.runners[0].obs_and_action_space.remote(), timeout=120)
         self.params = policy_init(jax.random.PRNGKey(config.seed), obs_dim,
                                   n_actions, config.hidden)
-        self._update, optimizer = make_impala_update(
-            config.lr, config.gamma, config.vf_coeff, config.entropy_coeff,
-            config.rho_bar, config.c_bar)
+        self._update, optimizer = config._build_update()
         self.opt_state = optimizer.init(self.params)
         self._iteration = 0
         self._consumed = 0

@@ -54,6 +54,7 @@ class MultiAgentEnvRunner:
         self.map_fn = policy_mapping_fn or (lambda aid: "default")
         self.seed = seed
         self._rng_counter = 0
+        self._episode_counter = 0
         self.policies: dict = {}  # policy_id -> params
         self.obs = self.env.reset(seed=seed)
         self._dead: set = set()  # agents terminated before "__all__"
@@ -154,7 +155,16 @@ class MultiAgentEnvRunner:
                 if d and not done_all:
                     dead.add(a)
             if done_all:
-                self.obs = self.env.reset()
+                # Deterministically seeded mid-run resets: reset() with no
+                # seed pulls OS entropy (np.random.default_rng(None)),
+                # making every sample() run — and any learning test built
+                # on it — nondeterministic run to run.
+                self._episode_counter += 1
+                try:
+                    self.obs = self.env.reset(
+                        seed=self.seed * 1_000_003 + self._episode_counter)
+                except TypeError:  # env whose reset() takes no seed
+                    self.obs = self.env.reset()
                 dead.clear()
             else:
                 # envs may omit finished agents from their obs dicts

@@ -1,0 +1,429 @@
+"""Offline RL: experience IO + Behavior Cloning + discrete CQL.
+
+TPU-native counterpart of the reference offline stack (ref:
+rllib/offline/offline_data.py + json_reader.py sample-batch JSON files;
+rllib/algorithms/bc/bc.py; rllib/algorithms/cql/cql.py). Experiences are
+JSONL fragments ({obs, actions, rewards, dones, next_obs} per line, the
+SampleBatch shape); readers fan file shards out as ray_tpu tasks and
+learners train jitted updates over the materialized transitions:
+
+  - BC:  supervised cross-entropy of the policy on logged actions — the
+    simplest offline baseline, and the imitation anchor.
+  - CQL (discrete): SAC's twin soft critics + a conservative penalty
+    ``logsumexp(Q) - Q(a_logged)`` that pushes down Q on actions the
+    behavior policy never took, so the learned policy can't exploit
+    out-of-distribution overestimates (Kumar et al. 2020).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+# ------------------------------------------------------------------------ IO
+def write_rollouts(path: str, fragments: list[dict]) -> int:
+    """Append sample fragments as JSONL (ref: offline json_writer.py).
+    Each fragment: dict of array-likes keyed obs/actions/rewards/dones
+    (+ optionally next_obs). Returns rows written."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    n = 0
+    with open(path, "a") as f:
+        for frag in fragments:
+            row = {k: np.asarray(v).tolist() for k, v in frag.items()}
+            f.write(json.dumps(row) + "\n")
+            n += len(row.get("actions", ()))
+    return n
+
+
+def collect_rollouts(env_name: str, path: str, *, num_steps: int = 1000,
+                     num_envs: int = 2, seed: int = 0, policy_params=None,
+                     hidden: int = 64, env_config: dict | None = None) -> int:
+    """Roll a (random or given) policy in an env and log the experience —
+    the `rllib train ... --output` role. Returns transitions written."""
+    import jax
+
+    from ray_tpu.rllib.core import policy_init
+    from ray_tpu.rllib.env_runner import EnvRunner
+
+    runner = EnvRunner(env_name, num_envs=num_envs, seed=seed,
+                       env_config=env_config)
+    obs_dim, n_actions = runner.obs_and_action_space()
+    params = policy_params if policy_params is not None else policy_init(
+        jax.random.PRNGKey(seed), obs_dim, n_actions, hidden)
+    runner.set_weights(params)
+    frags = []
+    written = 0
+    steps = 0
+    while steps < num_steps:
+        take = min(128, num_steps - steps)
+        ro = runner.sample(take)
+        T, N = ro["actions"].shape
+        # flatten [T, N] to transitions; next_obs via the shifted obs rows
+        next_obs = np.concatenate(
+            [ro["obs"][1:], np.repeat(ro["last_obs"][None], 1, 0)], axis=0)
+        frags.append({
+            "obs": ro["obs"].reshape(T * N, -1),
+            "actions": ro["actions"].reshape(-1),
+            "rewards": ro["rewards"].reshape(-1),
+            "dones": ro["dones"].reshape(-1).astype(np.float32),
+            "next_obs": next_obs.reshape(T * N, -1),
+        })
+        steps += take
+    written = write_rollouts(path, frags)
+    return written
+
+
+@ray_tpu.remote
+def _read_shard(path: str) -> dict:
+    cols: dict[str, list] = {}
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            for k, v in row.items():
+                cols.setdefault(k, []).append(np.asarray(v))
+    return {k: np.concatenate(v) for k, v in cols.items()} if cols else {}
+
+
+class OfflineData:
+    """Reader over one or more JSONL experience files (ref:
+    offline_data.py OfflineData): file shards load as parallel tasks,
+    transitions concatenate into one in-memory table served as seeded
+    minibatches."""
+
+    def __init__(self, paths: str | list[str], *, seed: int = 0):
+        if isinstance(paths, str):
+            paths = [paths]
+        expanded: list[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                expanded.extend(
+                    os.path.join(p, f) for f in sorted(os.listdir(p))
+                    if f.endswith((".json", ".jsonl")))
+            else:
+                expanded.append(p)
+        if not expanded:
+            raise ValueError(f"no offline data under {paths!r}")
+        shards = ray_tpu.get([_read_shard.remote(p) for p in expanded],
+                             timeout=600)
+        shards = [s for s in shards if s]
+        self.table = {
+            k: np.concatenate([s[k] for s in shards]) for k in shards[0]
+        }
+        self.n = len(self.table["actions"])
+        self._rng = np.random.default_rng(seed)
+
+    def minibatch(self, size: int) -> dict:
+        idx = self._rng.integers(0, self.n, size=min(size, self.n))
+        return {k: v[idx] for k, v in self.table.items()}
+
+
+# ------------------------------------------------------------------------ BC
+def make_bc_update(lr: float):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.core import policy_logits
+
+    optimizer = optax.adam(lr)
+
+    def loss_fn(params, batch):
+        logp = jax.nn.log_softmax(policy_logits(params, batch["obs"]))
+        picked = jnp.take_along_axis(
+            logp, batch["actions"][:, None], axis=-1)[:, 0]
+        return -picked.mean()
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return update, optimizer
+
+
+class BCConfig:
+    """Builder config (ref: bc.py BCConfig)."""
+
+    def __init__(self):
+        self.paths: list[str] | str | None = None
+        self.lr = 1e-3
+        self.batch_size = 256
+        self.updates_per_iter = 64
+        self.hidden = 64
+        self.seed = 0
+        self.obs_dim: int | None = None
+        self.n_actions: int | None = None
+
+    def offline_data(self, paths):
+        self.paths = paths
+        return self
+
+    def training(self, *, lr=None, batch_size=None, updates_per_iter=None,
+                 hidden=None):
+        for name, val in (("lr", lr), ("batch_size", batch_size),
+                          ("updates_per_iter", updates_per_iter),
+                          ("hidden", hidden)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "BC":
+        if self.paths is None:
+            raise ValueError("BCConfig.offline_data(...) is required")
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning learner (ref: bc.py — the marl_module reduces to
+    a supervised policy head here)."""
+
+    def __init__(self, config: BCConfig):
+        import jax
+
+        from ray_tpu.rllib.core import policy_init
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        self.data = OfflineData(config.paths, seed=config.seed)
+        obs_dim = config.obs_dim or self.data.table["obs"].shape[-1]
+        n_actions = config.n_actions or int(
+            self.data.table["actions"].max()) + 1
+        self.params = policy_init(
+            jax.random.PRNGKey(config.seed), obs_dim, n_actions,
+            config.hidden)
+        self._update, optimizer = make_bc_update(config.lr)
+        self.opt_state = optimizer.init(self.params)
+        self._iteration = 0
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        losses = []
+        for _ in range(self.config.updates_per_iter):
+            mb = self.data.minibatch(self.config.batch_size)
+            batch = {"obs": jnp.asarray(mb["obs"], jnp.float32),
+                     "actions": jnp.asarray(mb["actions"], jnp.int32)}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, batch)
+            losses.append(float(loss))
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "loss": sum(losses) / len(losses),
+            "num_transitions": self.data.n,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def evaluate(self, num_episodes: int = 4, env_name: str | None = None,
+                 env_config: dict | None = None) -> dict:
+        """Greedy rollouts of the cloned policy (ref: bc evaluation)."""
+        import gymnasium as gym
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.rllib.core import policy_logits
+
+        env = gym.make(env_name, **(env_config or {}))
+        returns = []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=1000 + ep)
+            total, done = 0.0, False
+            while not done:
+                logits = policy_logits(self.params,
+                                       jnp.asarray(obs[None], jnp.float32))
+                a = int(np.asarray(logits).argmax())
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r)
+                done = term or trunc
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "episodes": num_episodes}
+
+    def stop(self):
+        pass
+
+
+# ----------------------------------------------------------------------- CQL
+def make_cql_update(lr: float, gamma: float, tau: float,
+                    target_entropy: float, cql_alpha: float):
+    """Discrete CQL = discrete SAC + conservative penalty
+    ``E[logsumexp Q - Q(a_logged)]`` on both critics (ref: cql.py /
+    cql_learner — there on top of continuous SAC)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.rllib.core import mlp_apply
+
+    optimizer = optax.adam(lr)
+
+    def heads(params, obs):
+        logits = mlp_apply(params["pi"], obs)
+        logp = jax.nn.log_softmax(logits)
+        return logp, mlp_apply(params["q1"], obs), mlp_apply(params["q2"], obs)
+
+    def loss_fn(params, target_params, batch):
+        logp, q1, q2 = heads(params, batch["obs"])
+        alpha = jnp.exp(params["log_alpha"])
+        a = batch["actions"][:, None]
+
+        logp_n, _, _ = heads(params, batch["next_obs"])
+        q1t = mlp_apply(target_params["q1"], batch["next_obs"])
+        q2t = mlp_apply(target_params["q2"], batch["next_obs"])
+        pi_n = jnp.exp(logp_n)
+        soft_v = (pi_n * (jnp.minimum(q1t, q2t)
+                          - jax.lax.stop_gradient(alpha) * logp_n)).sum(-1)
+        y = batch["rewards"] + gamma * (1.0 - batch["dones"]) * \
+            jax.lax.stop_gradient(soft_v)
+
+        q1_a = jnp.take_along_axis(q1, a, axis=-1)[:, 0]
+        q2_a = jnp.take_along_axis(q2, a, axis=-1)[:, 0]
+        bellman = ((q1_a - y) ** 2).mean() + ((q2_a - y) ** 2).mean()
+        # conservative term: penalize Q mass off the logged actions
+        cql = ((jax.scipy.special.logsumexp(q1, axis=-1) - q1_a).mean()
+               + (jax.scipy.special.logsumexp(q2, axis=-1) - q2_a).mean())
+        q_loss = bellman + cql_alpha * cql
+
+        pi = jnp.exp(logp)
+        q_min = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+        pi_loss = (pi * (jax.lax.stop_gradient(alpha) * logp - q_min)) \
+            .sum(-1).mean()
+        ent_err = jax.lax.stop_gradient((pi * logp).sum(-1) + target_entropy)
+        alpha_loss = (-params["log_alpha"] * ent_err).mean()
+        return q_loss + pi_loss + alpha_loss, (bellman, cql)
+
+    @jax.jit
+    def update(params, target_params, opt_state, batch):
+        (loss, (bellman, cql)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, target_params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        target_params = {
+            "q1": jax.tree.map(lambda t, s: (1 - tau) * t + tau * s,
+                               target_params["q1"], params["q1"]),
+            "q2": jax.tree.map(lambda t, s: (1 - tau) * t + tau * s,
+                               target_params["q2"], params["q2"]),
+        }
+        return params, target_params, opt_state, loss, bellman, cql
+
+    return update, optimizer
+
+
+class CQLConfig:
+    """Builder config (ref: cql.py CQLConfig)."""
+
+    def __init__(self):
+        self.paths = None
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005
+        self.cql_alpha = 1.0
+        self.n_actions: int | None = None
+        self.batch_size = 256
+        self.updates_per_iter = 64
+        self.hidden = 64
+        self.seed = 0
+        self.target_entropy: float | None = None
+
+    def offline_data(self, paths):
+        self.paths = paths
+        return self
+
+    def training(self, *, lr=None, gamma=None, tau=None, cql_alpha=None,
+                 batch_size=None, updates_per_iter=None, hidden=None,
+                 target_entropy=None, n_actions=None):
+        for name, val in (("lr", lr), ("gamma", gamma), ("tau", tau),
+                          ("cql_alpha", cql_alpha), ("n_actions", n_actions),
+                          ("batch_size", batch_size),
+                          ("updates_per_iter", updates_per_iter),
+                          ("hidden", hidden),
+                          ("target_entropy", target_entropy)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def build(self) -> "CQL":
+        if self.paths is None:
+            raise ValueError("CQLConfig.offline_data(...) is required")
+        return CQL(self)
+
+
+class CQL:
+    """Offline discrete-CQL learner over logged transitions."""
+
+    def __init__(self, config: CQLConfig):
+        import jax
+        import numpy as _np
+
+        from ray_tpu.rllib.sac import sac_init
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        self.data = OfflineData(config.paths, seed=config.seed)
+        obs_dim = self.data.table["obs"].shape[-1]
+        # a narrow behavior policy may never take the last action(s):
+        # allow the action-space size to be given explicitly
+        n_actions = config.n_actions or int(
+            self.data.table["actions"].max()) + 1
+        self.params = sac_init(jax.random.PRNGKey(config.seed), obs_dim,
+                               n_actions, config.hidden)
+        self.target_params = {
+            "q1": jax.tree.map(lambda x: x, self.params["q1"]),
+            "q2": jax.tree.map(lambda x: x, self.params["q2"]),
+        }
+        tgt_ent = config.target_entropy
+        if tgt_ent is None:
+            tgt_ent = 0.98 * float(_np.log(n_actions))
+        self._update, optimizer = make_cql_update(
+            config.lr, config.gamma, config.tau, tgt_ent, config.cql_alpha)
+        self.opt_state = optimizer.init(self.params)
+        self._iteration = 0
+
+    def train(self) -> dict:
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        losses, cqls = [], []
+        for _ in range(self.config.updates_per_iter):
+            mb = self.data.minibatch(self.config.batch_size)
+            batch = {
+                "obs": jnp.asarray(mb["obs"], jnp.float32),
+                "actions": jnp.asarray(mb["actions"], jnp.int32),
+                "rewards": jnp.asarray(mb["rewards"], jnp.float32),
+                "dones": jnp.asarray(mb["dones"], jnp.float32),
+                "next_obs": jnp.asarray(mb["next_obs"], jnp.float32),
+            }
+            out = self._update(self.params, self.target_params,
+                               self.opt_state, batch)
+            self.params, self.target_params, self.opt_state = out[:3]
+            losses.append(float(out[3]))
+            cqls.append(float(out[5]))
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "loss": sum(losses) / len(losses),
+            "cql_penalty": sum(cqls) / len(cqls),
+            "num_transitions": self.data.n,
+            "time_this_iter_s": time.monotonic() - t0,
+        }
+
+    def get_weights(self):
+        return self.params
+
+    def stop(self):
+        pass

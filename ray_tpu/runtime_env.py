@@ -73,9 +73,23 @@ def package_runtime_env(env: dict, kv_put) -> dict:
         hashes = []
         for p in paths:
             p = os.path.abspath(os.path.expanduser(p))
-            if not os.path.isdir(p):
-                raise ValueError(f"runtime_env {field}: {p!r} is not a directory")
-            blob = _zip_dir(p)
+            if os.path.isdir(p):
+                blob = _zip_dir(p)
+            elif (field == "py_modules" and os.path.isfile(p)
+                    and p.endswith(".py")):
+                # single-module shorthand (ref: py_modules.py accepts
+                # files): a one-entry zip keeps the extract path uniform
+                import io as _io
+                import zipfile as _zf
+
+                buf = _io.BytesIO()
+                with _zf.ZipFile(buf, "w", _zf.ZIP_DEFLATED) as z:
+                    z.write(p, os.path.basename(p))
+                blob = buf.getvalue()
+            else:
+                raise ValueError(
+                    f"runtime_env {field}: {p!r} is not a directory"
+                    + (" or .py file" if field == "py_modules" else ""))
             digest = hashlib.sha1(blob).hexdigest()
             kv_put(digest, blob)
             hashes.append(digest)
@@ -293,6 +307,112 @@ class _PipPlugin(RuntimeEnvPlugin):
                               + os.environ.get("PATH", ""))
 
 
+class _CondaPlugin(RuntimeEnvPlugin):
+    """Conda environments (ref: _private/runtime_env/conda.py).
+
+    value = an existing env name (activate its site-packages) or an
+    environment dict ({"dependencies": [...]}, the environment.yml
+    shape) built once per content digest. Hard-gated on a conda binary:
+    a host without conda fails at PACKAGE time (driver side, loudly)
+    rather than half-applying on a worker."""
+
+    name = "conda"
+
+    def _conda(self) -> str:
+        import shutil
+
+        exe = shutil.which("conda") or shutil.which("mamba")
+        if exe is None:
+            raise RuntimeError(
+                "runtime_env conda: no conda/mamba binary on PATH "
+                "(install one, or use the pip/uv runtime_env instead)")
+        return exe
+
+    def package(self, value, kv_put):
+        self._conda()  # fail driver-side when conda is absent
+        if isinstance(value, str):
+            return {"env_name": value}
+        if isinstance(value, dict):
+            import json as _json
+
+            spec = _json.dumps(value, sort_keys=True)
+            digest = hashlib.sha1(spec.encode()).hexdigest()
+            kv_put(f"conda-{digest}", spec.encode())
+            return {"spec_digest": digest}
+        raise ValueError("runtime_env conda: expected env name or dict")
+
+    def apply(self, value, kv_get) -> None:
+        import glob
+        import json as _json
+
+        conda = self._conda()
+        if "env_name" in value:
+            out = subprocess.run([conda, "env", "list", "--json"],
+                                 capture_output=True, text=True)
+            envs = _json.loads(out.stdout or "{}").get("envs", [])
+            prefix = next((e for e in envs
+                           if os.path.basename(e) == value["env_name"]),
+                          None)
+            if prefix is None:
+                raise RuntimeError(
+                    f"runtime_env conda: env {value['env_name']!r} not found")
+        else:
+            digest = value["spec_digest"]
+            prefix = os.path.join(_cache_dir(), "conda", digest)
+            done = prefix + ".done"
+            if not os.path.exists(done):
+                import fcntl
+
+                os.makedirs(os.path.dirname(prefix), exist_ok=True)
+                with open(prefix + ".lock", "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    if not os.path.exists(done):
+                        blob = kv_get(f"conda-{digest}")
+                        if blob is None:
+                            raise RuntimeError(
+                                f"runtime_env conda spec {digest} missing")
+                        spec_file = prefix + ".yml"
+                        import yaml
+
+                        with open(spec_file, "w") as f:
+                            yaml.safe_dump(_json.loads(blob), f)
+                        proc = subprocess.run(
+                            [conda, "env", "create", "-p", prefix,
+                             "-f", spec_file, "--yes"],
+                            capture_output=True, text=True)
+                        if proc.returncode != 0:
+                            raise RuntimeError(
+                                "runtime_env conda create failed:\n"
+                                + proc.stderr[-2000:])
+                        open(done, "w").close()
+        sites = glob.glob(os.path.join(
+            prefix, "lib", "python*", "site-packages"))
+        for sp in sites:
+            if sp not in sys.path:
+                sys.path.insert(0, sp)
+        os.environ["CONDA_PREFIX"] = prefix
+        os.environ["PATH"] = (os.path.join(prefix, "bin") + os.pathsep
+                              + os.environ.get("PATH", ""))
+
+
+class _ImageUriPlugin(RuntimeEnvPlugin):
+    """image_uri placeholder (ref: _private/runtime_env/image_uri.py runs
+    workers inside a podman container). Worker-in-container needs raylet
+    spawn integration, not a sys.path splice — reject loudly instead of
+    silently ignoring the field."""
+
+    name = "image_uri"
+
+    def package(self, value, kv_put):
+        raise NotImplementedError(
+            "runtime_env image_uri is not supported by this runtime: "
+            "workers run as host processes (use pip/uv/conda envs, or run "
+            "the whole node inside the image)")
+
+    def apply(self, value, kv_get) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
 class _UvPlugin(_PipPlugin):
     """uv-resolved variant (ref: _private/runtime_env/uv.py). Falls back
     to pip when no uv binary is on PATH."""
@@ -313,3 +433,5 @@ class _UvPlugin(_PipPlugin):
 
 register_plugin(_PipPlugin())
 register_plugin(_UvPlugin())
+register_plugin(_CondaPlugin())
+register_plugin(_ImageUriPlugin())

@@ -71,6 +71,27 @@ class ServeController:
         await self._ensure_loop()
         key = f"{app_name}/{name}"
         existing = self._deployments.get(key)
+        if (existing is not None and not existing.deleting
+                and self._only_scale_changed(existing.spec, spec)):
+            # lightweight update (ref: deployment_state.py lightweight
+            # config updates): same code + per-replica config, only
+            # num_replicas/autoscaling changed — adjust the target and
+            # let the reconciler add/remove the delta (downscale then
+            # exercises compaction) instead of restarting every replica
+            existing.spec = spec
+            auto = spec["config"].autoscaling_config
+            if auto is not None:
+                # autoscaled deployment: keep the CURRENT scale, clamped
+                # into the new bounds — resetting to min_replicas would
+                # kill loaded replicas on a bounds-only update
+                existing.target_replicas = max(
+                    auto.min_replicas,
+                    min(auto.max_replicas, existing.target_replicas))
+            else:
+                existing.target_replicas = spec["config"].initial_replicas()
+            existing._pending_decision = None
+            await self._bump_version()
+            return True
         if existing is not None and not existing.deleting:
             # in-place update: new code/config. Unpublish the old replicas
             # FIRST (version bump) so routers stop sending to them, then
@@ -92,6 +113,27 @@ class ServeController:
         self._deployments[key] = _DeploymentState(app_name, name, spec)
         await self._bump_version()
         return True
+
+    @staticmethod
+    def _only_scale_changed(old_spec: dict, new_spec: dict) -> bool:
+        """True when the new spec differs from the old ONLY in replica
+        count / autoscaling bounds — everything live replicas were
+        constructed with (code, args, per-replica config) is identical."""
+        import dataclasses
+
+        try:
+            if (old_spec["serialized_cls"] != new_spec["serialized_cls"]
+                    or old_spec["init_args"] != new_spec["init_args"]
+                    or old_spec["init_kwargs"] != new_spec["init_kwargs"]):
+                return False
+            oc = dataclasses.asdict(old_spec["config"])
+            nc = dataclasses.asdict(new_spec["config"])
+            for k in ("num_replicas", "autoscaling_config"):
+                oc.pop(k, None)
+                nc.pop(k, None)
+            return oc == nc
+        except Exception:
+            return False  # anything incomparable: full replacement
 
     async def delete_app(self, app_name: str) -> bool:
         for st in list(self._deployments.values()):
@@ -190,8 +232,18 @@ class ServeController:
             await self._bump_version()
             return
 
-        # 1. start missing replicas
+        # 1. start missing replicas — SPREAD across alive nodes (fewest
+        # replicas of THIS deployment first), the deployment-scheduler
+        # role of the reference (ref: serve/_private/
+        # deployment_scheduler.py:275 SPREAD placement + compaction)
         cfg = st.spec["config"]
+        alive_nodes: list[str] | None = None
+        if (len(st.replicas) < st.target_replicas
+                and "scheduling_strategy" not in cfg.ray_actor_options):
+            # ONE cluster-view fetch per reconcile pass; placement-intent
+            # counts (target_node below) keep the SPREAD choice fresh as
+            # this pass starts several replicas
+            alive_nodes = await self._alive_nodes()
         while len(st.replicas) < st.target_replicas:
             rid = f"{st.name}#{uuid.uuid4().hex[:8]}"
             actor_name = f"SERVE_REPLICA::{st.app_name}/{rid}"
@@ -199,6 +251,18 @@ class ServeController:
 
             opts = dict(cfg.ray_actor_options)
             opts.setdefault("num_cpus", 0.1)
+            target_node = None
+            if "scheduling_strategy" not in opts:
+                target_node = self._pick_spread_node(st, alive_nodes)
+                if target_node is not None:
+                    from ray_tpu.util.scheduling_strategies import (
+                        NodeAffinitySchedulingStrategy,
+                    )
+
+                    # soft: placement is a preference — a full/dead node
+                    # must not block replica startup
+                    opts["scheduling_strategy"] = (
+                        NodeAffinitySchedulingStrategy(target_node, soft=True))
             handle = (
                 ray_tpu.remote(Replica)
                 .options(
@@ -222,11 +286,31 @@ class ServeController:
                 "healthy": True,
                 "ready": False,
                 "ping": None,
+                "target_node": target_node,
             }
 
-        # 2. stop surplus replicas (prefer the least-loaded)
+        # 2. stop surplus replicas — COMPACT: drain minority nodes first
+        # (stop replicas on the node hosting the fewest of this
+        # deployment), tie-broken by least-loaded, so downscale
+        # consolidates the survivors onto fewer nodes (ref:
+        # deployment_scheduler.py compaction on downscale)
         while len(st.replicas) > st.target_replicas:
-            rid = min(st.replicas, key=lambda r: st.metrics.get(r, 0))
+            node_counts: dict = {}
+            for rec in st.replicas.values():
+                nid = rec.get("node_id")
+                if nid is not None:
+                    node_counts[nid] = node_counts.get(nid, 0) + 1
+
+            def stop_rank(r):
+                rec = st.replicas[r]
+                nid = rec.get("node_id")
+                # unknown-node replicas rank as majority (stop last among
+                # equals on load) — their node may be the compaction target
+                count = node_counts.get(nid, len(st.replicas)) \
+                    if nid is not None else len(st.replicas)
+                return (count, st.metrics.get(r, 0))
+
+            rid = min(st.replicas, key=stop_rank)
             rec = st.replicas.pop(rid)
             st.metrics.pop(rid, None)
             await self._stop_replica(st, rid, rec, drain=True)
@@ -246,6 +330,32 @@ class ServeController:
         # 4. autoscaling decision
         self._autoscale(st)
 
+    async def _alive_nodes(self) -> list[str] | None:
+        from ray_tpu.core.api import get_core
+
+        try:
+            nodes = await get_core().gcs.call("get_cluster", {})
+        except Exception:
+            return None
+        return [n["node_id"].hex() for n in nodes if n.get("alive", True)]
+
+    def _pick_spread_node(self, st: _DeploymentState,
+                          alive: list[str] | None) -> str | None:
+        """SPREAD target: the alive node hosting the fewest replicas of
+        this deployment. None on single-node clusters (or when the view
+        is unavailable) — the default scheduler handles those fine."""
+        if not alive or len(alive) <= 1:
+            return None
+        counts = {nid: 0 for nid in alive}
+        for rec in st.replicas.values():
+            # placement intent stands in until the actor table confirms
+            # (several replicas start within one reconcile pass, all
+            # before any probe has resolved a node_id)
+            nid = rec.get("node_id") or rec.get("target_node")
+            if nid in counts:
+                counts[nid] += 1
+        return min(alive, key=lambda nid: counts[nid])
+
     async def _probe_replicas(self, st: _DeploymentState):
         from ray_tpu.core.api import get_core
 
@@ -260,6 +370,15 @@ class ServeController:
                     cfg.health_check_timeout_s + 1,
                 )
                 st.metrics[rid] = int(m["ongoing"])
+                if rec.get("node_id") is None:
+                    # record placement once, for SPREAD counts + compaction
+                    try:
+                        info = await core.gcs.call(
+                            "get_actor", {"actor_id": rec["handle"].actor_id})
+                        if info and info.get("node_id") is not None:
+                            rec["node_id"] = info["node_id"].hex()
+                    except Exception:
+                        pass
                 if not rec.get("ready"):
                     rec["ready"] = True
                     await self._bump_version()

@@ -147,10 +147,38 @@ def deploy_config(config) -> dict:
     return handles
 
 
+def _copy_graph(node, memo: dict):
+    """Rebuild an Application graph with fresh nodes (sharing Deployment
+    objects and non-Application args). importlib returns the CACHED module,
+    so the module-level Application object is the same across deploys —
+    mutating its nodes would leak one deploy's overrides into the next.
+    Memoized by original-node identity so diamond graphs keep sharing a
+    single copy per node (Application._collect checks node identity)."""
+    from ray_tpu.serve.deployment import Application
+
+    got = memo.get(id(node))
+    if got is not None:
+        return got
+
+    def cp(a):
+        return _copy_graph(a, memo) if isinstance(a, Application) else a
+
+    new = Application(
+        node.deployment,
+        tuple(cp(a) for a in node.init_args),
+        {k: cp(v) for k, v in node.init_kwargs.items()},
+    )
+    memo[id(node)] = new
+    return new
+
+
 def _with_overrides(bound, app: ServeApplicationSchema):
-    """Validate + apply deployment overrides via Deployment.options()
-    copies — the module-level Deployment singletons (shared across
-    imports) are never mutated."""
+    """Validate + apply deployment overrides on a COPY of the bound graph
+    via Deployment.options() copies — neither the module-level Deployment
+    singletons nor the cached module's Application nodes are mutated, so
+    a later deploy (or plain serve.run of the same import) sees the
+    decorator defaults."""
+    bound = _copy_graph(bound, {})
     nodes: dict = {}
     bound._collect(nodes)
     overrides = {d.name: d for d in app.deployments}

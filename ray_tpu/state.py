@@ -76,6 +76,21 @@ def get_stack(worker_id: str, *, node_address: tuple | None = None) -> dict | No
                         node_address)
 
 
+def get_heap_profile(worker_id: str, *, action: str = "snapshot",
+                     top: int = 20,
+                     node_address: tuple | None = None) -> dict | None:
+    """On-demand heap profile of a live worker (ref: the dashboard
+    reporter's memray endpoint, profile_manager.py:191 — here tracemalloc
+    in-process, no external attach). Call once with action="start", let
+    the workload run, then action="snapshot" returns the top allocation
+    sites; action="stop" ends tracing. ``worker_id`` may be a hex
+    prefix."""
+    return _raylet_call(
+        "heap_profile_worker",
+        {"worker_id": worker_id, "action": action, "top": top},
+        node_address)
+
+
 def _match(row: dict, filters) -> bool:
     for key, op, value in filters or ():
         have = row.get(key)

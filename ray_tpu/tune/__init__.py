@@ -41,6 +41,8 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    Searcher,
+    TPESearcher,
     choice,
     generate_variants,
     grid_search,
@@ -53,6 +55,8 @@ from ray_tpu.tune.session import get_checkpoint, report
 
 __all__ = [
     "ASHAScheduler",
+    "Searcher",
+    "TPESearcher",
     "FIFOScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
@@ -80,6 +84,8 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int | None = None
     scheduler: object | None = None
+    search_alg: object | None = None  # a search.Searcher (e.g. TPESearcher)
+    callbacks: list | None = None  # air.LoggerCallback instances
     seed: int | None = None
     max_failures_per_trial: int = 0
 
@@ -164,9 +170,15 @@ class Tuner:
             ray_tpu.init()
         tc = self.tune_config
         trainable, resources = _as_trainable(self.trainable)
-        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
-        if not variants:
-            variants = [{}]
+        if tc.search_alg is not None:
+            # suggest-driven: the controller creates trials on demand so
+            # later suggestions observe earlier results (TPE semantics)
+            variants = []
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+            if not variants:
+                variants = [{}]
         storage = None
         if self.run_config is not None:
             storage = getattr(self.run_config, "storage_path", None)
@@ -188,6 +200,9 @@ class Tuner:
             storage_path=storage,
             max_failures_per_trial=tc.max_failures_per_trial,
             trials=getattr(self, "_restored_trials", None),
+            searcher=tc.search_alg,
+            num_samples=tc.num_samples,
+            callbacks=tc.callbacks,
         )
         trials = controller.run()
         return ResultGrid([Result(t) for t in trials], tc.metric, tc.mode)

@@ -103,9 +103,22 @@ class TuneController:
                  max_concurrent_trials: int | None = None,
                  resources_per_trial: dict | None = None,
                  storage_path: str, max_failures_per_trial: int = 0,
-                 trials: list[Trial] | None = None):
+                 trials: list[Trial] | None = None,
+                 searcher=None, num_samples: int | None = None,
+                 callbacks: list | None = None):
         self.trainable = trainable
         self.scheduler = scheduler or sched_mod.FIFOScheduler()
+        # suggest-driven search (ref: tune_controller + SearchGenerator):
+        # trials are appended on demand up to num_samples, so each
+        # suggest() observes every completed trial so far
+        self.searcher = searcher
+        self.num_samples = num_samples or 1
+        self._searcher_exhausted = False  # suggest() returned None
+        # driver-side logger callbacks (ref: tune/logger LoggerCallback;
+        # air/integrations wandb+mlflow ride this hook)
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            cb.setup(os.path.basename(storage_path))
         self.metric = metric
         self.mode = mode
         self.max_concurrent = max_concurrent_trials or 4
@@ -124,6 +137,7 @@ class TuneController:
         """Event loop (ref: tune_controller.py step :666)."""
         last_state_write = 0.0
         while True:
+            self._maybe_suggest()
             self._start_pending()
             # periodic state snapshots make a killed driver resumable via
             # Tuner.restore (ref: experiment_state.py periodic sync)
@@ -132,14 +146,43 @@ class TuneController:
                 last_state_write = time.monotonic()
             running = [t for t in self.trials if t.status == RUNNING]
             if not running:
-                if all(t.status in (TERMINATED, STOPPED, ERRORED) for t in self.trials):
+                done_count = 0 if (self.searcher is None
+                                   or self._searcher_exhausted) \
+                    else self.num_samples
+                if (len(self.trials) >= done_count
+                        and all(t.status in (TERMINATED, STOPPED, ERRORED)
+                                for t in self.trials)):
                     break
                 time.sleep(0.02)
                 continue
             self._poll_running(running)
             time.sleep(0.02)
         self._write_experiment_state()
+        for cb in self.callbacks:
+            try:
+                cb.on_experiment_end()
+            except Exception:
+                pass
         return self.trials
+
+    def _maybe_suggest(self):
+        if self.searcher is None:
+            return
+        active = sum(1 for t in self.trials
+                     if t.status in (PENDING, RUNNING))
+        while (not self._searcher_exhausted
+               and len(self.trials) < self.num_samples
+               and active < self.max_concurrent):
+            tid = f"trial_{len(self.trials):05d}_{uuid.uuid4().hex[:6]}"
+            cfg = self.searcher.suggest(tid)
+            if cfg is None:
+                # the searcher is done producing configs: run() must
+                # terminate after the existing trials finish, not wait
+                # for num_samples that will never come
+                self._searcher_exhausted = True
+                break
+            self.trials.append(Trial(trial_id=tid, config=cfg))
+            active += 1
 
     def _start_pending(self):
         running = sum(1 for t in self.trials if t.status == RUNNING)
@@ -181,6 +224,11 @@ class TuneController:
             self.trainable, trial.config, trial.checkpoint_path, len(trial.history)
         )
         trial.status = RUNNING
+        for cb in self.callbacks:
+            try:
+                cb.on_trial_start(trial.trial_id, trial.config)
+            except Exception:
+                pass
 
     def _poll_running(self, running: list[Trial]):
         polls = []
@@ -196,6 +244,11 @@ class TuneController:
             for metrics, ckpt_path in poll["reports"]:
                 trial.metrics = metrics
                 trial.history.append(metrics)
+                for cb in self.callbacks:
+                    try:
+                        cb.on_trial_result(trial.trial_id, metrics)
+                    except Exception:
+                        pass
                 if ckpt_path:
                     trial.checkpoint_path = ckpt_path
                 decision = self.scheduler.on_result(trial.trial_id, metrics)
@@ -219,6 +272,14 @@ class TuneController:
             return
         trial.status = STOPPED if r.get("stopped") else TERMINATED
         self.scheduler.on_trial_complete(trial.trial_id, trial.metrics or None)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id,
+                                            trial.metrics or None)
+        for cb in self.callbacks:
+            try:
+                cb.on_trial_complete(trial.trial_id, trial.metrics or None)
+            except Exception:
+                pass
         self._teardown(trial)
 
     def _stop_trial(self, trial: Trial):
@@ -229,6 +290,14 @@ class TuneController:
             pass
         trial.status = STOPPED
         self.scheduler.on_trial_complete(trial.trial_id, trial.metrics or None)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id,
+                                            trial.metrics or None)
+        for cb in self.callbacks:
+            try:
+                cb.on_trial_complete(trial.trial_id, trial.metrics or None)
+            except Exception:
+                pass
         self._teardown(trial)
 
     def _exploit_trial(self, trial: Trial):
@@ -258,6 +327,13 @@ class TuneController:
             trial.status = ERRORED
             trial.error = error
             self.scheduler.on_trial_complete(trial.trial_id, None)
+            if self.searcher is not None:
+                self.searcher.on_trial_complete(trial.trial_id, None)
+            for cb in self.callbacks:
+                try:
+                    cb.on_trial_complete(trial.trial_id, None)
+                except Exception:
+                    pass
 
     def _teardown(self, trial: Trial):
         if trial.actor is not None:

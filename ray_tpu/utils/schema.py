@@ -17,7 +17,7 @@ from __future__ import annotations
 
 # (major, minor): bump MAJOR for incompatible changes (renamed/removed
 # methods, changed field meaning), MINOR for additions.
-PROTOCOL_VERSION = (1, 3)
+PROTOCOL_VERSION = (1, 4)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -78,6 +78,10 @@ CATALOG: dict[str, dict[str, dict]] = {
         "report_demand": {"since": (1, 3), "fields": {
             "count": "int — driver-side queued tasks no live lease will "
                      "absorb (autoscaler demand signal)"}},
+        "heap_profile_worker": {"since": (1, 4), "fields": {
+            "worker_id": "hex prefix — proxies a heap_profile RPC",
+            "action": "start | snapshot | stop",
+            "top": "snapshot: top-N allocation sites"}},
         "dump_worker_stack": {"since": (1, 3), "fields": {
             "worker_id": "hex prefix — proxies a dump_stack RPC to the "
                          "matching worker (live stack profiling)"}},
@@ -154,6 +158,10 @@ CATALOG: dict[str, dict[str, dict]] = {
                     "should pump (see core/fastpath.py)",
             "kind": "'actor' for actor-call rings (since 1.3)"}},
         "dump_stack": {"since": (1, 3), "fields": {}},
+        "heap_profile": {"since": (1, 4), "fields": {
+            "action": "start | snapshot | stop (tracemalloc control)",
+            "top": "snapshot: top-N allocation sites",
+            "nframes": "start: traceback depth"}},
     },
 }
 

@@ -154,6 +154,16 @@ def _restore_jax(np_val):
     return jax.device_put(np_val)
 
 
+def _restore_arrow_table(buf):
+    import pyarrow as pa
+
+    # pa.py_buffer wraps the (possibly shm-backed) view without copying;
+    # IPC open_stream then maps the table's columns straight onto it —
+    # the zero-copy read path of the reference's Arrow blocks
+    # (ref: _internal/arrow_block.py + arrow serialization)
+    return pa.ipc.open_stream(pa.py_buffer(buf)).read_all()
+
+
 class _Pickler(pickle.Pickler):
     """Pickler with a jax.Array reducer (only when jax is already imported).
 
@@ -163,10 +173,23 @@ class _Pickler(pickle.Pickler):
     by always cloudpickling function payloads."""
 
     jax_array_type = None
+    arrow_table_type = None
 
     def reducer_override(self, obj):
         if self.jax_array_type is not None and isinstance(obj, self.jax_array_type):
             return (_restore_jax, (np.asarray(obj),))
+        if (self.arrow_table_type is not None
+                and isinstance(obj, self.arrow_table_type)):
+            import pyarrow as pa
+
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, obj.schema) as w:
+                w.write_table(obj)
+            # PickleBuffer rides the protocol-5 out-of-band path: the IPC
+            # payload lands in shm unsplit, and readers re-open it
+            # zero-copy (see _restore_arrow_table)
+            return (_restore_arrow_table,
+                    (pickle.PickleBuffer(sink.getvalue()),))
         if isinstance(obj, (types.FunctionType, type)) and module_ships_by_value(
             getattr(obj, "__module__", None)
         ):
@@ -183,6 +206,13 @@ def _jax_array_type():
     return jax.Array if jax is not None else None
 
 
+def _arrow_table_type():
+    import sys
+
+    pa = sys.modules.get("pyarrow")
+    return pa.Table if pa is not None else None
+
+
 def serialize(obj: Any) -> tuple[bytes, list]:
     """Returns (pickle_header_bytes, out_of_band_buffers)."""
     buffers: list = []
@@ -190,6 +220,7 @@ def serialize(obj: Any) -> tuple[bytes, list]:
     try:
         p = _Pickler(f, protocol=5, buffer_callback=buffers.append)
         p.jax_array_type = _jax_array_type()
+        p.arrow_table_type = _arrow_table_type()
         p.dump(obj)
         header = f.getvalue()
     except Exception:
@@ -215,6 +246,29 @@ def total_size(meta: bytes, buffers: list) -> int:
     return total
 
 
+_NT_MIN = 1 << 20  # below this, streaming stores don't pay for the sfence
+
+
+def _copy_buffer(dest: memoryview, start: int, mv: memoryview) -> None:
+    """One wire-buffer copy; large copies take the native non-temporal
+    path (rt_copy_nt: streaming stores skip the destination
+    read-for-ownership — the dest is shm another process will read, so
+    there is no point pulling it through this core's cache)."""
+    n = mv.nbytes
+    if n >= _NT_MIN:
+        try:
+            from ray_tpu import _native
+
+            lib = _native.get_lib()
+            d = np.frombuffer(dest[start:start + n], dtype=np.uint8)
+            s = np.frombuffer(mv, dtype=np.uint8)
+            lib.rt_copy_nt(d.ctypes.data, s.ctypes.data, n)
+            return
+        except Exception:
+            pass  # no native lib (client mode): plain slice copy
+    dest[start:start + n] = mv
+
+
 def pack_into(meta: bytes, buffers: list, dest: memoryview) -> int:
     """Write the wire layout into ``dest``; returns bytes written."""
     struct.pack_into("<I", dest, 0, len(meta))
@@ -225,7 +279,7 @@ def pack_into(meta: bytes, buffers: list, dest: memoryview) -> int:
         mv = memoryview(b).cast("B")
         start = _align(off)
         if mv.nbytes:
-            dest[start : start + mv.nbytes] = mv
+            _copy_buffer(dest, start, mv)
         off = start + mv.nbytes
     return off
 

@@ -50,6 +50,31 @@ rs = comm.reducescatter(np.array([[1.0], [2.0]], dtype=np.float32))
 assert float(rs[0][0]) == 2.0 * (rank + 1), rs
 
 comm.barrier()
+
+# eager p2p with shape negotiation (VERDICT r4 task 10): rank 0 sends a
+# shape the receiver has never been told; recv learns it from the
+# metadata ppermute (ref: nccl_collective_group.py:376 plain recv)
+if rank == 0:
+    col.send(np.arange(6, dtype=np.float32).reshape(2, 3) + 1.0, 1,
+             group_name="xg2")
+else:
+    got = col.recv(0, group_name="xg2")
+    assert got.shape == (2, 3) and got.dtype == np.float32, got
+    assert float(got[1][2]) == 6.0, got
+
+# int16 payload exercises a second negotiated dtype; 64-bit dtypes are
+# gated on jax_enable_x64 (silently-truncating sends are refused)
+if rank == 0:
+    got = col.recv(1, group_name="xg2")
+    assert got.shape == (3,) and got.dtype == np.int16 and int(got[2]) == 9
+else:
+    col.send(np.array([7, 8, 9], dtype=np.int16), 0, group_name="xg2")
+    try:
+        col.send(np.array([2 ** 35], dtype=np.int64), 0, group_name="xg2")
+        raise AssertionError("int64 send without x64 must refuse")
+    except ValueError:
+        pass
+
 print(f"CHILD-{rank}-OK", flush=True)
 ray_tpu.shutdown()
 """

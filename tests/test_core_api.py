@@ -438,6 +438,35 @@ def test_method_num_returns_annotation(rt):
         ray_tpu.kill(s)
 
 
+def test_threaded_actor_sync_methods_overlap(rt):
+    """max_concurrency > 1 actors must never ride the ring fast lane: the
+    pump runs ring records sequentially in one executor job, so two sync
+    methods that coordinate (wait/signal) would deadlock. Regression for
+    the attach-time + per-record gates in worker.rpc_attach_fast_ring /
+    _fast_actor_pump."""
+    import threading
+
+    @ray_tpu.remote(num_cpus=0, max_concurrency=2)
+    class Coord:
+        def __init__(self):
+            self.evt = threading.Event()
+
+        def wait_for_signal(self):
+            return self.evt.wait(timeout=30)
+
+        def signal(self):
+            self.evt.set()
+            return "signaled"
+
+    a = Coord.remote()
+    try:
+        waiter = a.wait_for_signal.remote()
+        assert ray_tpu.get(a.signal.remote(), timeout=60) == "signaled"
+        assert ray_tpu.get(waiter, timeout=60) is True
+    finally:
+        ray_tpu.kill(a)
+
+
 def test_actor_fast_lane_fifo_across_downgrade(rt):
     """Same-node actor calls ride the shm ring; an ineligible call
     (ObjectRef arg) permanently downgrades the lane to RPC — and the

@@ -320,3 +320,57 @@ def test_actor_pool_feeds_downstream_barrier(rt):
     out = (ds.map_batches(Slow, compute=ActorPoolStrategy(2), num_cpus=0.1)
            .random_shuffle(seed=1).take_all())
     assert sorted(r["id"] for r in out) == list(range(300))
+
+
+# ---------------------------------------------------------------- hash join
+def _join_to_pandas(ds):
+    import pandas as pd
+
+    rows = ds.take_all()
+    return pd.DataFrame(rows)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
+def test_join_matches_pandas_merge(rt, how):
+    """VERDICT r4 task 5 done-criterion: distributed hash join equals
+    pandas merge on every join type (ref: …/operators/join.py:28)."""
+    import pandas as pd
+
+    from ray_tpu import data
+
+    left = pd.DataFrame({
+        "k": [1, 2, 2, 3, 5, 7],
+        "a": [10.0, 20.0, 21.0, 30.0, 50.0, 70.0],
+    })
+    right = pd.DataFrame({
+        "k": [2, 2, 3, 4, 8],
+        "b": ["x", "y", "z", "w", "v"],
+    })
+    lds = data.from_pandas(left)
+    rds = data.from_pandas(right)
+    got = _join_to_pandas(lds.join(rds, on="k", how=how, num_partitions=3))
+    want = left.merge(right, on="k", how=("outer" if how == "outer" else how))
+    key = ["k", "a", "b"]
+    got = got.reindex(columns=key)
+    want = want.reindex(columns=key)
+    norm = lambda df: sorted(
+        [tuple("<na>" if pd.isna(v) else v for v in row)
+         for row in df.itertuples(index=False)],
+        key=str)
+    assert norm(got) == norm(want), (how, got, want)
+
+
+def test_join_column_collision_and_empty_side(rt):
+    from ray_tpu import data
+
+    lds = data.from_items([{"k": i, "v": i * 10} for i in range(4)])
+    rds = data.from_items([{"k": i, "v": i * 100} for i in range(2, 6)])
+    out = sorted(lds.join(rds, on="k").take_all(), key=lambda r: r["k"])
+    assert [r["k"] for r in out] == [2, 3]
+    assert [r["v"] for r in out] == [20, 30]       # left keeps its name
+    assert [r["v_r"] for r in out] == [200, 300]   # right gets the suffix
+
+    empty = data.from_items([])
+    assert lds.join(empty, on="k").take_all() == []
+    assert sorted(r["k"] for r in lds.join(empty, on="k", how="left")
+                  .take_all()) == [0, 1, 2, 3]

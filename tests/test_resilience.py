@@ -182,3 +182,139 @@ def test_raylet_reconnects_to_restarted_gcs(tmp_path):
             except Exception:
                 pass
         io.stop()
+
+
+# ------------------------------------------------------- GCS write-ahead log
+def test_gcs_wal_survives_kill_between_mutations(tmp_path):
+    """VERDICT r4 task 6: SIGKILL the GCS process between two KV/actor
+    mutations — BOTH must survive recovery via WAL replay, including
+    everything newer than the last snapshot (the snapshot loop runs at
+    1s; the kill lands well inside that window)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from ray_tpu.utils import rpc as _rpc
+    from ray_tpu.utils.ids import ActorID
+
+    snap = str(tmp_path / "gcs.snap")
+    addr_file = str(tmp_path / "gcs.addr")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.gcs", "--persist", snap,
+         "--address-file", addr_file],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    io = _rpc.EventLoopThread()
+    try:
+        deadline = time.monotonic() + 60
+        while not (time.monotonic() > deadline) and not (
+                __import__("os").path.exists(addr_file)):
+            time.sleep(0.1)
+        host, port = open(addr_file).read().strip().split(":")
+
+        async def mutate():
+            c = await _rpc.connect(host, int(port), timeout=10)
+            assert await c.call("kv_put", {"ns": "t", "key": "k1",
+                                           "value": b"v1"})
+            aid = ActorID.generate()
+            await c.call("register_actor", {"spec": {
+                "actor_id": aid, "name": "wal_actor",
+                "resources": {"CPU": 0.0}}})
+            # the SECOND kv mutation — the one a snapshot-only design
+            # loses when the process dies before the next snapshot tick
+            assert await c.call("kv_put", {"ns": "t", "key": "k2",
+                                           "value": b"v2"})
+            await c.close()
+            return aid
+
+        aid = io.run(mutate())
+        os.kill(proc.pid, signal.SIGKILL)  # no final flush, no snapshot
+        proc.wait(timeout=30)
+
+        from ray_tpu.core.gcs import GcsServer
+
+        gcs2 = GcsServer(persist_path=snap)
+        io.run(gcs2.start())
+        try:
+            assert gcs2.kv.get("t", {}).get("k1") == b"v1"
+            assert gcs2.kv.get("t", {}).get("k2") == b"v2", (
+                "second mutation lost: WAL replay failed")
+            assert aid in gcs2.actors, "actor registration lost"
+            assert gcs2.named_actors.get("wal_actor") == aid
+        finally:
+            io.run(gcs2.stop())
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        io.stop()
+
+
+# --------------------------------------------------------------- chaos harness
+def test_chaos_interval_killer_workload_completes():
+    """VERDICT r4 task 7 (ref: _private/test_utils.py:1419
+    ResourceKiller): a 3-node cluster loses a non-head raylet every few
+    seconds — hard kill, no goodbyes — while a retryable task workload
+    runs to completion. Retries + lease spillback must absorb every
+    loss; replacement nodes keep capacity from draining to zero."""
+    import threading
+
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.core_client import CoreClient
+    from ray_tpu.utils import rpc as _rpc
+
+    io = _rpc.EventLoopThread()
+    cluster = Cluster(io=io)
+    head = cluster.add_node(num_cpus=4.0)
+    for _ in range(2):
+        cluster.add_node(num_cpus=4.0)
+    core = CoreClient(loop=io.loop)
+    io.run(core.connect(cluster.gcs_address, head.server.address))
+    old = _api._core
+    _api._core = core
+
+    stop_chaos = threading.Event()
+    kills = {"n": 0}
+
+    def killer():
+        # kill a random non-head raylet every ~2s, then restore capacity
+        import random
+
+        rng = random.Random(0)
+        while not stop_chaos.wait(2.0):
+            victims = [r for r in cluster.raylets if r is not head]
+            if not victims:
+                continue
+            try:
+                cluster.kill_node(rng.choice(victims))
+                kills["n"] += 1
+                cluster.add_node(num_cpus=4.0)
+            except Exception:
+                pass
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        @ray_tpu.remote(max_retries=8, num_cpus=1.0)
+        def work(i):
+            import time as _t
+
+            _t.sleep(0.3)  # long enough that kills land mid-task
+            return i * 2
+
+        results = []
+        for wave in range(6):
+            refs = [work.remote(wave * 8 + j) for j in range(8)]
+            results.extend(ray_tpu.get(refs, timeout=300))
+        assert sorted(results) == [i * 2 for i in range(48)]
+        assert kills["n"] >= 2, f"chaos never struck (kills={kills['n']})"
+    finally:
+        stop_chaos.set()
+        t.join(timeout=10)
+        _api._core = old
+        try:
+            io.run(core.close(), timeout=10)
+        except Exception:
+            pass
+        cluster.shutdown()
+        io.stop()

@@ -122,4 +122,14 @@ def test_unknown_field_rejected():
     from ray_tpu.runtime_env import package_runtime_env
 
     with pytest.raises(ValueError, match="unsupported"):
-        package_runtime_env({"conda": "env.yml"}, lambda k, v: None)
+        package_runtime_env({"bogus_field": 1}, lambda k, v: None)
+    # conda is now a KNOWN field — on a host without the binary it gates
+    # loudly at package time instead (see _CondaPlugin)
+    import shutil
+
+    if shutil.which("conda") is None and shutil.which("mamba") is None:
+        with pytest.raises(RuntimeError, match="conda"):
+            package_runtime_env({"conda": "envname"}, lambda k, v: None)
+    # image_uri rejects explicitly (workers are host processes)
+    with pytest.raises(NotImplementedError, match="image_uri"):
+        package_runtime_env({"image_uri": "img:latest"}, lambda k, v: None)

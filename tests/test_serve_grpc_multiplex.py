@@ -169,11 +169,22 @@ def test_deploy_config_does_not_mutate_module_singletons(rt):
     import tests._serve_schema_app as app_mod
 
     before = app_mod.Doubler.config.num_replicas
+    graph_dep_before = {}
+    app_mod.app._collect(graph_dep_before)
+    doubler_node = graph_dep_before["Doubler"]
+    node_cfg_before = doubler_node.deployment.config.num_replicas
     serve.deploy_config({"applications": [{
         "name": "mut_check", "import_path": "tests._serve_schema_app:app",
         "deployments": [{"name": "Doubler", "num_replicas": 2}]}]})
     importlib.reload  # no-op: module stays cached, which is the point
     assert app_mod.Doubler.config.num_replicas == before
+    # the cached module's Application GRAPH is untouched too: a second
+    # deploy (or a plain serve.run(app)) must not inherit the overrides
+    assert doubler_node.deployment.config.num_replicas == node_cfg_before
+    graph_dep_after = {}
+    app_mod.app._collect(graph_dep_after)
+    assert graph_dep_after["Doubler"] is doubler_node
+    assert graph_dep_after["Doubler"].deployment.config.num_replicas == before
 
     # unsupported fields are rejected loudly, before anything deploys
     with pytest.raises(ValueError, match="route_prefix"):

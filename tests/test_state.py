@@ -209,3 +209,36 @@ def test_get_stack_live_worker(rt):
     assert "busy_sleeper" in joined or "time.sleep" in joined or \
         "sleep" in joined
     assert ray_tpu.get(ref, timeout=120) == 1
+
+
+def test_heap_profile_live_worker(rt):
+    """On-demand heap profile (the memray role, tracemalloc in-process):
+    start tracing, allocate on the worker, snapshot shows the site."""
+    import time as _t
+
+    @ray_tpu.remote
+    def allocator():
+        import time
+
+        hoard = [bytearray(256 * 1024) for _ in range(40)]  # ~10MB
+        time.sleep(6.0)
+        return len(hoard)
+
+    ref = allocator.remote()
+    workers = []
+    deadline = _t.time() + 20
+    while _t.time() < deadline and not workers:
+        _t.sleep(0.5)
+        workers = [t for t in state.list_tasks()
+                   if t.get("name") == "allocator" and t.get("worker_id")
+                   and t.get("state") == "RUNNING"]
+    assert workers, state.list_tasks()
+    wid = workers[-1]["worker_id"]
+    assert state.get_heap_profile(wid, action="start") == {"tracing": True}
+    _t.sleep(1.0)
+    snap = state.get_heap_profile(wid, action="snapshot", top=10)
+    # tracemalloc started AFTER the hoard was allocated, so sizes may be
+    # small — the shape of the reply is the contract
+    assert snap and "current_bytes" in snap and isinstance(snap["top"], list)
+    assert state.get_heap_profile(wid, action="stop") == {"tracing": False}
+    assert ray_tpu.get(ref, timeout=120) == 40
