@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -328,8 +329,18 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, dout):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# default tile sizes, env-overridable for block sweeps (RT_FLASH_BLOCK_Q/K,
+# read at import time).
+# r5 sweep on v5e, 551M model, T=8192 train step (MFU): 512/512 54.2,
+# 512/1024 59.4, 1024/512 55.9, **1024/1024 61.7**; bk=2048 overflows
+# VMEM. Bigger tiles amortize the online-softmax rescale + mask overhead
+# over 4x the MXU work per grid cell. Full table in BENCHVS.md.
+_BLOCK_Q = int(os.environ.get("RT_FLASH_BLOCK_Q", "1024"))
+_BLOCK_K = int(os.environ.get("RT_FLASH_BLOCK_K", "1024"))
+
+
 def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K,
                     interpret: bool | None = None):
     """q/k/v: [B, T, H, D] with equal head counts (GQA expanded upstream).
 
@@ -346,8 +357,15 @@ def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = No
         interpret = not is_tpu()
     B, T, H, D = q.shape
     Tk = k.shape[1]
+    # clamp, then halve until the block divides the sequence: the auto
+    # dispatch admits any T % 512 == 0, so a 1024 default must degrade to
+    # 512 for T = 1536, 2560, ... instead of raising
     block_q = min(block_q, T)
+    while block_q > 128 and T % block_q:
+        block_q //= 2
     block_k = min(block_k, Tk)
+    while block_k > 128 and Tk % block_k:
+        block_k //= 2
     if T % block_q or Tk % block_k:
         raise ValueError(f"seq lens ({T},{Tk}) must divide blocks ({block_q},{block_k})")
 
