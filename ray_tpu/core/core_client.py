@@ -247,6 +247,8 @@ class CoreClient:
         self._xq: list = []  # thread->loop submission queue (see _call_on_loop)
         self._xq_armed = False
         self._xq_linger = False
+        self._xq_lazy: list = []       # deleted-ref notices (5ms timer lane)
+        self._xq_lazy_armed = False
         self._xq_lock = _threading.Lock()
         self._closed = False
         self.default_runtime_env: dict | None = None  # packaged descriptor
@@ -1124,12 +1126,12 @@ class CoreClient:
             return None
         for a in args:
             if isinstance(a, ObjectRef):
-                lane.retired = True
+                self._fast_retire_actor_lane(lane)
                 return None
         if kwargs:
             for a in kwargs.values():
                 if isinstance(a, ObjectRef):
-                    lane.retired = True
+                    self._fast_retire_actor_lane(lane)
                     return None
         task_id = TaskID.generate_actor()
         tid = task_id.binary()
@@ -1137,11 +1139,11 @@ class CoreClient:
             rec = fastpath.pack_task(tid, b"am:" + method.encode(), args,
                                      kwargs)
         except Exception:
-            lane.retired = True
+            self._fast_retire_actor_lane(lane)
             return None
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
-            lane.retired = True
+            self._fast_retire_actor_lane(lane)
             return None
         ref = self._fast_register_and_push(
             lane, task_id, rec, ("actor", actor_id, method, args, kwargs))
@@ -1223,13 +1225,23 @@ class CoreClient:
 
     def _drain_fast_migrations(self):
         """Loop-side completion: fill memory-store entries, emit events,
-        resubmit NEED_SLOW tasks via the RPC path."""
+        resubmit NEED_SLOW tasks via the RPC path.
+
+        Lingers on a 2ms timer while reply traffic flows (stays armed, so
+        reply processors never pay a self-pipe wake per batch — on a
+        one-core host that wake lands between the caller and the worker);
+        disarms after one empty pass."""
         from ray_tpu.core import fastpath
 
         with self._fast_cv:
             batch = self._fast_migrate_q
             self._fast_migrate_q = []
-            self._fast_migrate_armed = False
+            if not batch:
+                self._fast_migrate_armed = False
+                return
+            # armed stays True while this pass runs; the tail decides
+            # between timer-linger (blocking-call traffic) and disarm
+            # (burst traffic) — see below
         lanes_to_check = set()
         for task_id, oid, status, payload, light in batch:
             if status == fastpath.NEED_SLOW:
@@ -1279,6 +1291,15 @@ class CoreClient:
                 state="FAILED" if status == fastpath.ERR else "FINISHED")
             with self._fast_cv:
                 self._fast_done.pop(oid, None)
+        # a RETIRED actor lane whose in-flight records have all drained is
+        # finished forever (permanent RPC downgrade): close its ring so
+        # the worker's executor-resident pump cycle stops — otherwise it
+        # would keep taking 5ms slices of the actor thread ahead of every
+        # RPC-path call for the actor's lifetime
+        for lane in list(self._fast_lanes):
+            if (lane.retired and not lane.broken and not lane.inflight
+                    and lane.key and lane.key[0] == "actor"):
+                self._fast_break_lane(lane)
         # a drained lane's lease must still be returnable when idle; arm at
         # most one idle-return watcher per lane drain-down
         drained = False
@@ -1294,6 +1315,23 @@ class CoreClient:
                     self._fast_idle_return(lane, state), self.loop)
         if drained:
             self._report_demand()  # clear any stale nonzero raylet report
+        # Adaptive linger. Blocking-call traffic (submit/get/submit/get —
+        # one reply per pass) lingers on a sleepy 2ms timer: staying
+        # armed means the reply processor never pays a self-pipe wake,
+        # which on a one-core host lands on the critical path between
+        # caller and worker (~25% of the sync-call round trip). Burst
+        # traffic (pipelined gets, many replies per pass) disarms
+        # instead: there the wake amortizes over the whole batch and the
+        # 2ms pacing throttles the pipeline.
+        if len(batch) < 8:
+            self.loop.call_later(0.002, self._drain_fast_migrations)
+        else:
+            with self._fast_cv:
+                refilled = bool(self._fast_migrate_q)
+                if not refilled:
+                    self._fast_migrate_armed = False
+            if refilled:  # stay armed; immediate re-pass, no recursion
+                self.loop.call_soon(self._drain_fast_migrations)
 
     async def _fast_idle_return(self, lane, state):
         try:
@@ -1322,6 +1360,17 @@ class CoreClient:
             "scheduling_node": None,
             "runtime_env": self.default_runtime_env,
         }
+
+    def _fast_retire_actor_lane(self, lane) -> None:
+        """Permanent RPC downgrade of an actor lane (ineligible call).
+        When nothing is in flight the ring closes right away so the
+        worker's executor-resident pump cycle stops; otherwise the drain
+        path closes it once the last reply lands."""
+        lane.retired = True
+        with self._fast_cv:
+            drained = not lane.inflight and not lane.broken
+        if drained:
+            self._fast_break_lane(lane)
 
     def _fast_try_retire_lane(self, lane) -> bool:
         """Idle-lease-return teardown: atomically stop new fast submits
@@ -1594,12 +1643,28 @@ class CoreClient:
 
     def _call_on_loop(self, coro):
         """Run a coroutine (or apply a deleted-ref notice, passed as a bare
-        ObjectID) on the loop thread, coalescing cross-thread wakeups."""
+        ObjectID) on the loop thread, coalescing cross-thread wakeups.
+
+        Two lanes: coroutines are latency-sensitive (an RPC-path sync
+        call's submission rides here) and arm the drain immediately;
+        deleted-ref notices are pure bookkeeping and ride a lazy 5ms
+        timer, so a blocking-call loop (submit/get/submit/get...) never
+        pays a loop wakeup per iteration just to decrement a refcount —
+        on a one-core host every extra loop wake lands on the critical
+        path between the caller and the worker."""
         if _in_loop(self.loop):
             if type(coro) is ObjectID:
                 self._on_owned_ref_deleted_on_loop(coro)
             else:
                 self._bg.spawn(coro, self.loop)
+            return
+        if type(coro) is ObjectID:
+            with self._xq_lock:
+                self._xq_lazy.append(coro)
+                if self._xq_armed or self._xq_lazy_armed:
+                    return  # an armed drain will sweep the lazy queue too
+                self._xq_lazy_armed = True
+            self.loop.call_soon_threadsafe(self._arm_lazy_xq)
             return
         # Coalesced thread->loop handoff: call_soon_threadsafe writes the
         # loop's self-pipe (a syscall) per call, so a burst of .remote()
@@ -1613,9 +1678,14 @@ class CoreClient:
         if arm:
             self.loop.call_soon_threadsafe(self._drain_xq)
 
+    def _arm_lazy_xq(self):
+        self.loop.call_later(0.005, self._drain_xq)
+
     def _drain_xq(self):
         with self._xq_lock:
-            if not self._xq:
+            lazy = self._xq_lazy
+            self._xq_lazy = []
+            if not self._xq and not lazy:
                 # Linger one extra loop tick before disarming: during a
                 # submission burst the producer refills between ticks, and
                 # staying armed means it never pays the self-pipe wakeup.
@@ -1624,16 +1694,31 @@ class CoreClient:
                     self.loop.call_soon(self._drain_xq)
                 else:
                     self._xq_armed = False
+                    self._xq_lazy_armed = False
                 return
             batch = self._xq
             self._xq = []
-            self._xq_linger = True
+            self._xq_linger = bool(batch)
+        for oid in lazy:
+            self._on_owned_ref_deleted_on_loop(oid)
         for coro in batch:
             if type(coro) is ObjectID:
                 self._on_owned_ref_deleted_on_loop(coro)
             else:
                 self._bg.spawn(coro, self.loop)
-        self.loop.call_soon(self._drain_xq)
+        if batch:
+            # burst linger: immediate re-pass while coroutine traffic flows
+            with self._xq_lock:
+                self._xq_armed = True
+                self._xq_lazy_armed = False
+            self.loop.call_soon(self._drain_xq)
+        else:
+            # lazy-only traffic: stay armed on a sleepy timer instead of
+            # busy-ticking the loop against the critical path
+            with self._xq_lock:
+                self._xq_lazy_armed = True
+                self._xq_armed = False
+            self.loop.call_later(0.005, self._drain_xq)
 
     async def _submit_async(self, spec: dict):
         try:

@@ -277,11 +277,25 @@ class Worker:
         self._fast_rings.append(ring)
         loop = asyncio.get_running_loop()
         if p.get("kind") == "actor":
-            target, targs = self._fast_actor_pump, (ring,)
-        else:
-            target, targs = self._fast_pump, (ring, loop)
+            # Two-mode pump. HOT: a self-resubmitting job on the actor's
+            # single executor thread (_fast_actor_pump_cycle) — ring
+            # records execute inline with ZERO thread handoffs (each
+            # cross-thread wake costs 60-200us on this class of host,
+            # which was most of the sync-call round trip), RPC-path jobs
+            # interleave between cycles. PARKED: after ~100ms of silence
+            # the cycle chain exits and a dedicated thread blocks on the
+            # ring with long timeouts, so an idle actor costs nothing on
+            # the executor; the first batch of a new busy period runs via
+            # one executor handoff, then the chain goes hot again.
+            state = {"downgraded": False, "idle": 0,
+                     "parked": threading.Event()}
+            t = threading.Thread(
+                target=self._fast_actor_park, args=(ring, state),
+                name="rt-fastpark", daemon=True)
+            t.start()
+            return True
         t = threading.Thread(
-            target=target, args=targs,
+            target=self._fast_pump, args=(ring, loop),
             name="rt-fastpump", daemon=True)
         t.start()
         return True
@@ -307,78 +321,143 @@ class Worker:
             status = ring.push_raw(fastpath.REP, fastpath.frame(chunk))
         return status
 
-    def _fast_actor_pump(self, ring):
-        """Pump thread for actor-call rings: pop records, run the methods
-        on the task executor (one consistent thread for actor state,
-        serialized with any RPC-path calls), reply in framed chunks.
+    # hot-mode tuning: 5ms pop slices, ~20 empty slices (~100ms) to park
+    _PUMP_HOT_POP_MS = 5
+    _PUMP_IDLE_CYCLES = 20
 
-        Once ANY record proves ineligible, every subsequent record is
-        NEED_SLOWed too (sticky downgrade): executing later ring records
-        while an earlier one replays over RPC would reorder the caller's
-        calls — replies stream back in ring order, so the driver requeues
-        the whole tail in FIFO order."""
+    def _fast_actor_park(self, ring, state: dict):
+        """Parked-mode keeper thread: blocks on the ring with LONG
+        timeouts (costless while idle), and on traffic executes the first
+        batch via the executor (one handoff) then hands consumption to
+        the executor-resident hot cycle until it idles out again."""
         from ray_tpu.core import fastpath
-
-        inline_max = self.cfg.max_inline_object_size
-        downgraded = False
-
-        def run_batch(items):
-            # ON the task executor thread
-            inst = self.actor_instance
-            out = []
-            for tid, mname, args, kwargs in items:
-                try:
-                    out.append((True, getattr(inst, mname)(*args, **kwargs)))
-                except BaseException as e:  # noqa: BLE001
-                    out.append((False, e))
-            return out
 
         try:
             while not self._exit_requested:
                 recs = ring.pop_batch(fastpath.SUB, timeout_ms=1000)
                 if recs is None:
-                    break
+                    self._fast_pump_close(ring)
+                    return
                 if not recs:
                     continue
-                runnable = []
-                replies = []
-                order = []  # (tid, "run"|reply)
-                for rec in recs:
-                    tid, mkey, args, kwargs = fastpath.unpack_task(rec)
-                    mname = mkey[3:].decode()  # b"am:<method>"
-                    m = getattr(self.actor_instance, mname, None)
-                    if (downgraded
-                            or self.actor_instance is None
-                            or getattr(self, "_actor_max_concurrency", 1) > 1
-                            or not callable(m)
-                            or inspect.iscoroutinefunction(m)
-                            or inspect.isgeneratorfunction(m)
-                            or inspect.isasyncgenfunction(m)
-                            or self._method_groups.get(mname)):
-                        downgraded = True
-                        order.append((tid, fastpath.pack_reply(
-                            tid, fastpath.NEED_SLOW, b"")))
-                        continue
-                    runnable.append((tid, mname, args, kwargs))
-                    order.append((tid, None))
-                outcomes = iter(
-                    self.executor.submit(run_batch, runnable).result()
-                    if runnable else ())
-                for tid, pre in order:
-                    if pre is not None:
-                        replies.append(pre)
-                        continue
-                    ok, val = next(outcomes)
-                    replies.append(
-                        self._fast_pack_result(tid, ok, val, inline_max))
-                if self._fast_push_replies(ring, replies) != 0:
-                    break
-        finally:
-            for i, r in enumerate(self._fast_rings):
-                if r is ring:
-                    del self._fast_rings[i]
-                    break
-            ring.close_pair()
+                state["idle"] = 0
+                state["parked"].clear()
+                try:
+                    self.executor.submit(
+                        self._fast_actor_pump_batch, ring, state, recs)
+                except RuntimeError:  # executor shut down
+                    self._fast_pump_close(ring)
+                    return
+                # the hot chain owns the ring until it parks again
+                while not (state["parked"].wait(1.0)
+                           or self._exit_requested):
+                    pass
+                if state.get("closed"):
+                    return
+        except BaseException:
+            self._fast_pump_close(ring)
+            raise
+
+    def _fast_actor_pump_batch(self, ring, state: dict, recs):
+        """First batch of a busy period (on the executor thread), then
+        chain into the hot cycle."""
+        if self._fast_actor_exec_batch(ring, state, recs):
+            self._fast_actor_pump_cycle(ring, state)
+        else:
+            state["closed"] = True
+            state["parked"].set()
+
+    def _fast_actor_exec_batch(self, ring, state: dict, recs) -> bool:
+        """Execute one batch of ring records inline; False = ring done."""
+        from ray_tpu.core import fastpath
+
+        inline_max = self.cfg.max_inline_object_size
+        inst = self.actor_instance
+        replies = []
+        for rec in recs:
+            tid, mkey, args, kwargs = fastpath.unpack_task(rec)
+            mname = mkey[3:].decode()  # b"am:<method>"
+            m = getattr(inst, mname, None)
+            if (state["downgraded"]
+                    or inst is None
+                    or getattr(self, "_actor_max_concurrency", 1) > 1
+                    or not callable(m)
+                    or inspect.iscoroutinefunction(m)
+                    or inspect.isgeneratorfunction(m)
+                    or inspect.isasyncgenfunction(m)
+                    or self._method_groups.get(mname)):
+                state["downgraded"] = True
+                replies.append(fastpath.pack_reply(
+                    tid, fastpath.NEED_SLOW, b""))
+                continue
+            try:
+                ok, val = True, m(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — reply on
+                ok, val = False, e
+            replies.append(
+                self._fast_pack_result(tid, ok, val, inline_max))
+        return self._fast_push_replies(ring, replies) == 0
+
+    def _fast_actor_pump_cycle(self, ring, state: dict):
+        """ONE pump cycle, ON the actor's single executor thread: pop a
+        batch (short blocking wait — a record arriving mid-wait wakes
+        immediately), execute the methods INLINE (we ARE the actor
+        thread, so state affinity is identical to the RPC path and no
+        cross-thread handoff is paid), reply, then resubmit this cycle to
+        the executor so queued RPC-path jobs get the thread between
+        cycles (their added latency is bounded by the pop timeout).
+
+        Once ANY record proves ineligible, every subsequent record is
+        NEED_SLOWed too (sticky downgrade): executing later ring records
+        while an earlier one replays over RPC would reorder the caller's
+        calls — replies stream back in ring order, so the driver requeues
+        the whole tail in FIFO order (and then retires the lane, closing
+        this ring, which ends the cycling)."""
+        from ray_tpu.core import fastpath
+
+        try:
+            if self._exit_requested:
+                self._fast_pump_close(ring)
+                state["closed"] = True
+                state["parked"].set()
+                return
+            recs = ring.pop_batch(fastpath.SUB,
+                                  timeout_ms=self._PUMP_HOT_POP_MS)
+            if recs is None:
+                self._fast_pump_close(ring)  # driver closed/retired
+                state["closed"] = True
+                state["parked"].set()
+                return
+            if recs:
+                state["idle"] = 0
+                if not self._fast_actor_exec_batch(ring, state, recs):
+                    self._fast_pump_close(ring)
+                    state["closed"] = True
+                    state["parked"].set()
+                    return
+            else:
+                state["idle"] += 1
+                if state["idle"] >= self._PUMP_IDLE_CYCLES:
+                    state["parked"].set()  # hand back to the keeper thread
+                    return
+            self.executor.submit(self._fast_actor_pump_cycle, ring, state)
+        except RuntimeError:
+            # executor shut down mid-resubmit (worker exit)
+            self._fast_pump_close(ring)
+            state["closed"] = True
+            state["parked"].set()
+        except BaseException:  # noqa: BLE001 — never leave the ring open
+            self._fast_pump_close(ring)
+            state["closed"] = True
+            state["parked"].set()
+            raise
+
+    def _fast_pump_close(self, ring):
+        for i, r in enumerate(self._fast_rings):
+            if r is ring:
+                del self._fast_rings[i]
+                break
+        ring.close_pair()
 
     def _fast_pump(self, ring, loop):
         """Pump thread: pop task records, execute, reply in one framed

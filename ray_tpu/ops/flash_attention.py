@@ -340,7 +340,7 @@ _BLOCK_K = int(os.environ.get("RT_FLASH_BLOCK_K", "1024"))
 
 
 def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = None,
-                    block_q: int = _BLOCK_Q, block_k: int = _BLOCK_K,
+                    block_q: int | None = None, block_k: int | None = None,
                     interpret: bool | None = None):
     """q/k/v: [B, T, H, D] with equal head counts (GQA expanded upstream).
 
@@ -357,15 +357,21 @@ def flash_attention(q, k, v, *, causal: bool = True, sm_scale: float | None = No
         interpret = not is_tpu()
     B, T, H, D = q.shape
     Tk = k.shape[1]
-    # clamp, then halve until the block divides the sequence: the auto
-    # dispatch admits any T % 512 == 0, so a 1024 default must degrade to
-    # 512 for T = 1536, 2560, ... instead of raising
-    block_q = min(block_q, T)
-    while block_q > 128 and T % block_q:
-        block_q //= 2
-    block_k = min(block_k, Tk)
-    while block_k > 128 and Tk % block_k:
-        block_k //= 2
+    # DEFAULTED blocks clamp then halve until they divide the sequence
+    # (the auto dispatch admits any T % 512 == 0, so the 1024 default
+    # degrades to 512 for T = 1536, 2560, ... instead of raising);
+    # EXPLICIT blocks stay strict — a tile sweep must fail loudly on a
+    # mismatched T, never silently record results under the wrong label
+    def resolve(requested, default, n):
+        if requested is not None:
+            return requested  # strict: validated below
+        b = min(default, n)
+        while b > 128 and n % b:
+            b //= 2
+        return b
+
+    block_q = resolve(block_q, _BLOCK_Q, T)
+    block_k = resolve(block_k, _BLOCK_K, Tk)
     if T % block_q or Tk % block_k:
         raise ValueError(f"seq lens ({T},{Tk}) must divide blocks ({block_q},{block_k})")
 
