@@ -170,5 +170,58 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_ring_closed.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.rt_ring_pair_close.argtypes = [ctypes.c_void_p]
             lib.rt_ring_pair_destroy.argtypes = [ctypes.c_char_p]
+            # GCS state engine (gcs_core.cc)
+            cp = ctypes.c_char_p
+            lib.rt_gcs_open.restype = ctypes.c_void_p
+            lib.rt_gcs_open.argtypes = [cp]
+            lib.rt_gcs_close.argtypes = [ctypes.c_void_p]
+            lib.rt_gcs_had_snapshot.restype = ctypes.c_int
+            lib.rt_gcs_had_snapshot.argtypes = [ctypes.c_void_p]
+            lib.rt_gcs_wal_records.restype = u64
+            lib.rt_gcs_wal_records.argtypes = [ctypes.c_void_p]
+            lib.rt_gcs_kv_put.restype = ctypes.c_int
+            lib.rt_gcs_kv_put.argtypes = [
+                ctypes.c_void_p, cp, u64, cp, u64, cp, u64,
+                ctypes.c_int, ctypes.c_int]
+            lib.rt_gcs_kv_get.restype = ctypes.c_int
+            lib.rt_gcs_kv_get.argtypes = [
+                ctypes.c_void_p, cp, u64, cp, u64, u8p, u64, p64]
+            lib.rt_gcs_kv_del.restype = ctypes.c_int
+            lib.rt_gcs_kv_del.argtypes = [
+                ctypes.c_void_p, cp, u64, cp, u64, ctypes.c_int]
+            lib.rt_gcs_kv_exists.restype = ctypes.c_int
+            lib.rt_gcs_kv_exists.argtypes = [
+                ctypes.c_void_p, cp, u64, cp, u64]
+            lib.rt_gcs_kv_keys.restype = ctypes.c_int
+            lib.rt_gcs_kv_keys.argtypes = [
+                ctypes.c_void_p, cp, u64, cp, u64, u8p, u64, p64]
+            lib.rt_gcs_kv_count.restype = u64
+            lib.rt_gcs_kv_count.argtypes = [ctypes.c_void_p, cp, u64]
+            lib.rt_gcs_journal_aux.restype = None
+            lib.rt_gcs_journal_aux.argtypes = [ctypes.c_void_p, cp, u64]
+            lib.rt_gcs_wal_ok.restype = ctypes.c_int
+            lib.rt_gcs_wal_ok.argtypes = [ctypes.c_void_p]
+            lib.rt_gcs_snapshot_aux.restype = ctypes.c_int
+            lib.rt_gcs_snapshot_aux.argtypes = [ctypes.c_void_p, u8p, u64, p64]
+            lib.rt_gcs_aux_count.restype = u64
+            lib.rt_gcs_aux_count.argtypes = [ctypes.c_void_p]
+            lib.rt_gcs_aux_get.restype = ctypes.c_int
+            lib.rt_gcs_aux_get.argtypes = [ctypes.c_void_p, u64, u8p, u64, p64]
+            lib.rt_gcs_snapshot.restype = ctypes.c_int
+            lib.rt_gcs_snapshot.argtypes = [ctypes.c_void_p, cp, u64, cp]
+            # RPC mux (mux.cc)
+            lib.rt_mux_create.restype = ctypes.c_void_p
+            lib.rt_mux_create.argtypes = [
+                cp, ctypes.c_uint16, ctypes.POINTER(ctypes.c_uint16),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.rt_mux_recv_batch.restype = i64
+            lib.rt_mux_recv_batch.argtypes = [ctypes.c_void_p, u8p, u64]
+            lib.rt_mux_send.restype = ctypes.c_int
+            lib.rt_mux_send.argtypes = [ctypes.c_void_p, u64, cp, u64]
+            lib.rt_mux_close_conn.argtypes = [ctypes.c_void_p, u64]
+            lib.rt_mux_release.argtypes = [ctypes.c_void_p, u64]
+            lib.rt_mux_port.restype = ctypes.c_uint16
+            lib.rt_mux_port.argtypes = [ctypes.c_void_p]
+            lib.rt_mux_stop.argtypes = [ctypes.c_void_p]
             _lib = lib
     return _lib

@@ -85,6 +85,13 @@ class Config:
     #: max unreplied fast-path tasks per worker before spilling to RPC
     fastpath_inflight_max: int = 4096
 
+    # --- native RPC mux (ref: grpc_server.h:88 completion-queue threads;
+    # _native/src/mux.cc) ---
+    #: serve control-plane RPC off a C++ epoll mux instead of asyncio
+    #: streams (fan-in: N clients never serialize through per-connection
+    #: reader coroutines); falls back to asyncio if the build is missing
+    native_mux_enabled: bool = True
+
     # --- tracing (ref: util/tracing/tracing_helper.py span injection) ---
     #: propagate span contexts through task specs and record spans into
     #: the task-event pipeline (ray_tpu.state.list_spans / timeline)

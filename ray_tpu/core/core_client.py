@@ -204,7 +204,7 @@ class CoreClient:
         self.raylet_address: tuple[str, int] | None = None
         self.node_id: NodeID | None = None
         self.store: SharedObjectStore | None = None
-        self.server = rpc.RpcServer("127.0.0.1", 0)
+        self.server = rpc.make_server("127.0.0.1", 0)
         self.server.add_routes(self)
         self.address: tuple[str, int] | None = None
 

@@ -109,13 +109,17 @@ class GcsServer:
         self.cfg = get_config()
         self.persist_path = persist_path
         self._dirty = False
-        self.server = rpc.RpcServer(host, port)
+        self.server = rpc.make_server(host, port)
         self.server.add_routes(self)
         self.server.on_disconnect = self._on_disconnect
-        self._wal_f = None  # lazily opened append handle (see _journal)
-        self._wal_broken = False  # write failed irrecoverably: snapshots only
+        # Native state engine (C++, _native/src/gcs_core.cc): KV tables,
+        # write-ahead journal, snapshot/recovery all live native; this
+        # process only dispatches RPCs and runs policy (ref role:
+        # src/ray/gcs/gcs_server/store_client/redis_store_client.cc,
+        # gcs_table_storage.h)
+        from ray_tpu.core.gcs_store import NativeGcsStore
 
-        self.kv: dict[str, dict[str, bytes]] = {}
+        self.kvstore = NativeGcsStore(persist_path)
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[str, ActorID] = {}
@@ -157,36 +161,33 @@ class GcsServer:
         return True
 
     # ---------------------------------------------------------------------- kv
+    # All KV state lives in the native engine; puts/dels journal to the
+    # C++ WAL inside the same native call (GIL released throughout).
     async def rpc_kv_put(self, conn, p):
-        ns = self.kv.setdefault(p.get("ns", ""), {})
-        exists = p["key"] in ns
-        if exists and not p.get("overwrite", True):
-            return False
-        ns[p["key"]] = p["value"]
-        if p.get("ns", "") != "metrics":
-            self._journal(("kvput", p.get("ns", ""), p["key"], p["value"]))
-        else:
-            self.mark_dirty()
-        return True
+        ns = p.get("ns", "")
+        journal = ns != "metrics"  # metrics are volatile: snapshot-only
+        ok = self.kvstore.put(ns, p["key"], p["value"],
+                              overwrite=p.get("overwrite", True),
+                              journal=journal)
+        self.mark_dirty()
+        return ok
 
     async def rpc_kv_get(self, conn, p):
-        return self.kv.get(p.get("ns", ""), {}).get(p["key"])
+        return self.kvstore.get(p.get("ns", ""), p["key"])
 
     async def rpc_kv_multi_get(self, conn, p):
-        ns = self.kv.get(p.get("ns", ""), {})
-        return {k: ns.get(k) for k in p["keys"]}
+        return self.kvstore.multi_get(p.get("ns", ""), p["keys"])
 
     async def rpc_kv_del(self, conn, p):
-        self._journal(("kvdel", p.get("ns", ""), p["key"]))
-        return self.kv.get(p.get("ns", ""), {}).pop(p["key"], None) is not None
+        ok = self.kvstore.delete(p.get("ns", ""), p["key"])
+        self.mark_dirty()
+        return ok
 
     async def rpc_kv_exists(self, conn, p):
-        return p["key"] in self.kv.get(p.get("ns", ""), {})
+        return self.kvstore.exists(p.get("ns", ""), p["key"])
 
     async def rpc_kv_keys(self, conn, p):
-        ns = self.kv.get(p.get("ns", ""), {})
-        prefix = p.get("prefix", "")
-        return [k for k in ns if k.startswith(prefix)]
+        return self.kvstore.keys(p.get("ns", ""), p.get("prefix", ""))
 
     # -------------------------------------------------------------------- jobs
     async def rpc_register_job(self, conn, p):
@@ -628,95 +629,43 @@ class GcsServer:
                         )
 
     def _restore(self):
-        """Recover durable tables: atomic pickle snapshot + write-ahead
-        journal replay (ref role: GCS FT via the Redis store client,
-        src/ray/gcs/gcs_server/store_client/redis_store_client.cc — there
-        every table op journals through Redis; here ops append to a WAL
-        between snapshots, so a kill between two mutations loses neither).
-        Volatile state (node registry, metrics) is rebuilt by
-        re-registration."""
+        """Recover durable tables (ref role: GCS FT via the Redis store
+        client, src/ray/gcs/gcs_server/store_client/redis_store_client.cc
+        — there every table op journals through Redis). KV bytes were
+        already recovered by the native engine at open (snapshot +
+        CRC-checked WAL replay, torn tail truncated); this replays the
+        Python-side table ops: the snapshot's pickled table blob, then
+        every journaled op newer than it. Volatile state (node registry,
+        metrics) is rebuilt by re-registration."""
         import pickle as _p
 
         if not self.persist_path:
             return
-        if os.path.exists(self.persist_path):
-            with open(self.persist_path, "rb") as f:
-                snap = _p.load(f)
-            self.kv = snap.get("kv", {})
-            self.kv.pop("metrics", None)
-            self.job_counter = snap.get("job_counter", 0)
-            self.actors = snap.get("actors", {})
-            self.named_actors = snap.get("named_actors", {})
-            self.pgs = snap.get("pgs", {})
-        self._replay_wal()
-        self._restored_at = time.monotonic()
-
-    # ------------------------------------------------------------- WAL
-    # Append-only op log between snapshots. Each record is
-    # [u32 len][pickle(op)]; a torn tail (kill mid-append) is detected by
-    # the length prefix and dropped. Replay is idempotent set-style, so
-    # replaying a WAL that predates the latest snapshot converges to the
-    # snapshot state or later.
-    @property
-    def _wal_path(self):
-        return self.persist_path + ".wal" if self.persist_path else None
-
-    def _journal(self, op: tuple) -> None:
-        if not self.persist_path or self._wal_broken:
-            self.mark_dirty()
-            return
-        import pickle as _p
-        import struct as _s
-
-        try:
-            if self._wal_f is None:
-                self._wal_f = open(self._wal_path, "ab")
-            pos = self._wal_f.tell()
+        if (not self.kvstore.had_snapshot
+                and self.kvstore.wal_records == 0):
+            # truly empty native state: this is either a fresh cluster or
+            # the first start after the engine swap — check for (and
+            # migrate) the pre-native persistence format. Once migration
+            # journals anything, wal_records > 0 on the next start, so an
+            # old legacy snapshot can never clobber newer native state.
+            self._restore_legacy()
+        aux = self.kvstore.recovered_snapshot_aux()
+        if aux:
             try:
-                rec = _p.dumps(op)
-                self._wal_f.write(_s.pack("<I", len(rec)) + rec)
-                self._wal_f.flush()  # survives process kill (page cache)
+                snap = _p.loads(aux)
+                self.job_counter = snap.get("job_counter", 0)
+                self.actors = snap.get("actors", {})
+                self.named_actors = snap.get("named_actors", {})
+                self.pgs = snap.get("pgs", {})
             except Exception:
-                # a PARTIAL record would poison every later append
-                # (replay stops at the first unreadable record): wind the
-                # file back to the last good boundary, or stop journaling
-                # until the next snapshot truncation if even that fails
-                try:
-                    self._wal_f.truncate(pos)
-                    self._wal_f.seek(pos)
-                except Exception:
-                    self._wal_broken = True
-                    self._wal_f = None
-        except Exception:
-            self._wal_broken = True  # can't open: snapshots only
-            self._wal_f = None
-        self.mark_dirty()
-
-    def _replay_wal(self):
-        import pickle as _p
-        import struct as _s
-
-        path = self._wal_path
-        if not path or not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            buf = f.read()
-        off = 0
-        while off + 4 <= len(buf):
-            (ln,) = _s.unpack_from("<I", buf, off)
-            if off + 4 + ln > len(buf):
-                break  # torn tail from a kill mid-append
+                pass  # unreadable table blob: KV still recovered
+        for rec in self.kvstore.recovered_aux_records():
             try:
-                op = _p.loads(buf[off + 4:off + 4 + ln])
+                op = _p.loads(rec)
             except Exception:
-                break
-            off += 4 + ln
+                continue  # CRC passed but unpicklable (version skew): skip
             kind = op[0]
-            if kind == "kvput":
-                self.kv.setdefault(op[1], {})[op[2]] = op[3]
-            elif kind == "kvdel":
-                self.kv.get(op[1], {}).pop(op[2], None)
-            elif kind == "job":
+            if kind == "job":
                 self.job_counter = max(self.job_counter, op[1])
             elif kind == "actor":
                 self.actors[op[1].actor_id] = op[1]
@@ -726,19 +675,103 @@ class GcsServer:
                 self.named_actors.pop(op[1], None)
             elif kind == "pg":
                 self.pgs[op[1].pg_id] = op[1]
+        self._restored_at = time.monotonic()
 
-    def _truncate_wal(self):
-        if not self._wal_path:
-            return
+    def _restore_legacy(self):
+        """One-way migration from the pre-native persistence format (a
+        whole-state pickle snapshot + [u32 len][pickle(op)] WAL). The
+        native engine rejects the old magic and sidelines an unparseable
+        WAL as .wal.legacy; this reads both and re-journals EVERY loaded
+        op into the native WAL, so acknowledged old-format writes are
+        durable immediately — not only after the first snapshot tick."""
+        import pickle as _p
+        import struct as _s
+
+        state_loaded = False
         try:
-            if self._wal_f is not None:
-                self._wal_f.close()
-                self._wal_f = None
-            with open(self._wal_path, "wb"):
-                pass  # the snapshot now covers everything journaled
-            self._wal_broken = False  # fresh file: journaling can resume
+            if os.path.exists(self.persist_path):
+                with open(self.persist_path, "rb") as f:
+                    head = f.read(2)
+                if head[:1] == b"\x80":  # pickle protocol marker
+                    with open(self.persist_path, "rb") as f:
+                        snap = _p.load(f)
+                    for ns, table in snap.get("kv", {}).items():
+                        if ns == "metrics":
+                            continue
+                        for k, v in table.items():
+                            self.kvstore.put(ns, k, v, journal=True)
+                    self.job_counter = snap.get("job_counter", 0)
+                    self.actors = snap.get("actors", {})
+                    self.named_actors = snap.get("named_actors", {})
+                    self.pgs = snap.get("pgs", {})
+                    if self.job_counter:
+                        self.kvstore.journal_aux(
+                            _p.dumps(("job", self.job_counter)))
+                    for info in self.actors.values():
+                        self.kvstore.journal_aux(_p.dumps(("actor", info)))
+                    for name, aid in self.named_actors.items():
+                        self.kvstore.journal_aux(_p.dumps(("name", name, aid)))
+                    for pg in self.pgs.values():
+                        self.kvstore.journal_aux(_p.dumps(("pg", pg)))
+                    state_loaded = True
         except Exception:
             pass
+        legacy_wal = self.persist_path + ".wal.legacy"
+        try:
+            if os.path.exists(legacy_wal):
+                with open(legacy_wal, "rb") as f:
+                    buf = f.read()
+                off = 0
+                while off + 4 <= len(buf):
+                    (ln,) = _s.unpack_from("<I", buf, off)
+                    if off + 4 + ln > len(buf):
+                        break
+                    try:
+                        op = _p.loads(buf[off + 4:off + 4 + ln])
+                    except Exception:
+                        break  # new-format bytes sidelined by a torn head
+                    off += 4 + ln
+                    kind = op[0]
+                    if kind == "kvput":
+                        self.kvstore.put(op[1], op[2], op[3], journal=True)
+                    elif kind == "kvdel":
+                        self.kvstore.delete(op[1], op[2], journal=True)
+                    elif kind == "job":
+                        self.job_counter = max(self.job_counter, op[1])
+                        self.kvstore.journal_aux(_p.dumps(op))
+                    elif kind == "actor":
+                        self.actors[op[1].actor_id] = op[1]
+                        self.kvstore.journal_aux(_p.dumps(op))
+                    elif kind == "name":
+                        self.named_actors[op[1]] = op[2]
+                        self.kvstore.journal_aux(_p.dumps(op))
+                    elif kind == "namedel":
+                        self.named_actors.pop(op[1], None)
+                        self.kvstore.journal_aux(_p.dumps(op))
+                    elif kind == "pg":
+                        self.pgs[op[1].pg_id] = op[1]
+                        self.kvstore.journal_aux(_p.dumps(op))
+                    state_loaded = True
+                # every op above is now in the native WAL (flushed per
+                # append): the legacy copy is redundant
+                os.remove(legacy_wal)
+        except Exception:
+            pass
+        if state_loaded:
+            self.mark_dirty()  # next snapshot converts to native format
+
+    # ------------------------------------------------------------- WAL
+    # Table ops journal as opaque (pickled) aux records through the
+    # native engine's WAL — one binary log, CRC-framed, shared with the
+    # KV ops the engine journals itself (gcs_core.cc).
+    def _journal(self, op: tuple) -> None:
+        import pickle as _p
+
+        try:
+            self.kvstore.journal_aux(_p.dumps(op))
+        except Exception:
+            pass  # snapshot loop still covers the mutation
+        self.mark_dirty()
 
     def mark_dirty(self):
         self._dirty = True
@@ -755,22 +788,19 @@ class GcsServer:
                 self._dirty = True  # keep trying: the write failed
 
     def _write_snapshot(self) -> bool:
+        """Native atomic snapshot: KV bytes stream from C++, the Python
+        tables ride as the pickled aux blob; the WAL truncates inside the
+        same native call."""
         import pickle as _p
 
         try:
-            snap = _p.dumps({
-                "kv": {ns: dict(d) for ns, d in self.kv.items() if ns != "metrics"},
+            aux = _p.dumps({
                 "job_counter": self.job_counter,
                 "actors": dict(self.actors),
                 "named_actors": dict(self.named_actors),
                 "pgs": dict(self.pgs),
             })
-            tmp = self.persist_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(snap)
-            os.replace(tmp, self.persist_path)  # atomic snapshot
-            self._truncate_wal()  # the snapshot covers everything journaled
-            return True
+            return self.kvstore.snapshot(aux, skip_ns="metrics")
         except Exception:
             return False
 
@@ -794,6 +824,7 @@ class GcsServer:
         if self.persist_path and self._dirty:
             self._write_snapshot()  # final flush: acknowledged writes survive
         await self.server.stop()
+        self.kvstore.close()
 
 
 def _fits(req: dict, avail: dict) -> bool:

@@ -203,7 +203,7 @@ class Raylet:
             create=True,
         )
 
-        self.server = rpc.RpcServer(host, 0)
+        self.server = rpc.make_server(host, 0)
         self.server.add_routes(self)
         self.server.on_disconnect = self._on_client_disconnect
         self.gcs: rpc.Connection | None = None
@@ -496,7 +496,8 @@ class Raylet:
         self.cgroups.isolate_worker(worker_id.hex(), proc.pid, None)
         return w
 
-    async def _proxy_worker_call(self, p, method: str, payload: dict):
+    async def _proxy_worker_call(self, p, method: str, payload: dict,
+                                 timeout: float = 10.0):
         """Proxy an on-demand RPC to one of this node's workers (ref:
         dashboard reporter profiling endpoints). worker_id may be a hex
         prefix; unique match required. Degrades to None (like get_log)
@@ -512,7 +513,7 @@ class Raylet:
         try:
             wconn = await rpc.connect(*matches[0].address, timeout=5)
             try:
-                return await wconn.call(method, payload, timeout=10)
+                return await wconn.call(method, payload, timeout=timeout)
             finally:
                 await wconn.close()
         except Exception:
@@ -527,6 +528,15 @@ class Raylet:
         return await self._proxy_worker_call(
             p, "heap_profile",
             {k: p[k] for k in ("action", "top", "nframes") if k in p})
+
+    async def rpc_cpu_profile_worker(self, conn, p):
+        """Proxy a sampled CPU profile (flamegraph data) to a worker (ref:
+        profile_manager.py:82 py-spy `record` role; in-process sampler)."""
+        duration = min(float(p.get("duration_s", 2.0)), 30.0)
+        return await self._proxy_worker_call(
+            p, "cpu_profile",
+            {k: p[k] for k in ("duration_s", "interval_s") if k in p},
+            timeout=duration + 10.0)
 
     async def rpc_get_log(self, conn, p):
         """Serve a worker's captured stdout/stderr tail (ref: state API

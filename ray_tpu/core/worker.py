@@ -360,12 +360,22 @@ class Worker:
 
     def _fast_actor_pump_batch(self, ring, state: dict, recs):
         """First batch of a busy period (on the executor thread), then
-        chain into the hot cycle."""
-        if self._fast_actor_exec_batch(ring, state, recs):
-            self._fast_actor_pump_cycle(ring, state)
-        else:
+        chain into the hot cycle. Any escape hatch closes the ring and
+        wakes the keeper — an exception parked in the unchecked executor
+        Future would otherwise leave the keeper waiting forever while the
+        driver blocks on replies that never come."""
+        try:
+            if self._fast_actor_exec_batch(ring, state, recs):
+                self._fast_actor_pump_cycle(ring, state)
+                return
+        except BaseException:  # noqa: BLE001 — never leave the ring open
+            self._fast_pump_close(ring)
             state["closed"] = True
             state["parked"].set()
+            raise
+        self._fast_pump_close(ring)  # reply push failed: ring is done
+        state["closed"] = True
+        state["parked"].set()
 
     def _fast_actor_exec_batch(self, ring, state: dict, recs) -> bool:
         """Execute one batch of ring records inline; False = ring done."""
@@ -1300,6 +1310,50 @@ class Worker:
         } for tid, frame in sys._current_frames().items()]
         return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
                 "threads": out}
+
+    async def rpc_cpu_profile(self, conn, p):
+        """Sampled CPU profile of this worker: walk every thread's stack
+        at a fixed interval for duration_s and aggregate FOLDED stacks
+        (root;child;leaf -> sample count) — the flamegraph input format
+        (ref: profile_manager.py:82, where py-spy record produces
+        speedscope output externally; here the worker samples itself, so
+        no ptrace and no subprocess). state.get_cpu_profile renders the
+        folded map as speedscope JSON."""
+        import threading
+        import time as _time
+
+        duration = min(float(p.get("duration_s", 2.0)), 30.0)
+        interval = max(float(p.get("interval_s", 0.01)), 0.001)
+
+        def sample():
+            folded: dict[str, int] = {}
+            samples = 0
+            me = threading.get_ident()
+            end = _time.monotonic() + duration
+            while _time.monotonic() < end:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    parts = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        parts.append(
+                            f"{code.co_name} "
+                            f"({os.path.basename(code.co_filename)}"
+                            f":{f.f_lineno})")
+                        f = f.f_back
+                    key = ";".join(reversed(parts))
+                    folded[key] = folded.get(key, 0) + 1
+                samples += 1
+                _time.sleep(interval)
+            return folded, samples
+
+        folded, samples = await asyncio.get_running_loop().run_in_executor(
+            None, sample)
+        return {"pid": os.getpid(), "worker_id": self.worker_id.hex(),
+                "duration_s": duration, "interval_s": interval,
+                "samples": samples, "folded": folded}
 
     async def rpc_heap_profile(self, conn, p):
         """On-demand heap profiling via tracemalloc (the memray role of

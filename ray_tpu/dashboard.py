@@ -1,17 +1,30 @@
-"""Dashboard: JSON API + single-page cluster overview.
+"""Dashboard: JSON API + SPA frontend.
 
 TPU-native counterpart of the reference dashboard role (ref:
-python/ray/dashboard/ — here a small aiohttp app over the state API
-instead of a React bundle + agent tree):
+python/ray/dashboard/ head + python/ray/dashboard/client/src React SPA —
+here an aiohttp app over the state API serving a dependency-free
+hash-routed JS app from ``dashboard_client/``, no build step):
 
-    GET /               one-page HTML overview (auto-refreshing)
-    GET /api/cluster    nodes + resources
-    GET /api/tasks      latest task states
-    GET /api/actors     actor table
-    GET /api/metrics    aggregated cluster metrics
-    GET /api/timeline   chrome-trace events (load into perfetto)
+    GET /                      SPA shell (views: overview/nodes/actors/
+                               tasks/objects/pgs/jobs/serve/metrics/timeline)
+    GET /static/*              SPA assets
+    GET /api/cluster           nodes + resources
+    GET /api/tasks             latest task states
+    GET /api/actors            actor table
+    GET /api/objects           object table (size/location/spill/refs)
+    GET /api/placement_groups  placement group table
+    GET /api/summary/tasks     task counts by state
+    GET /api/serve             serve applications/deployments status
+    GET /api/metrics           aggregated cluster metrics
+    GET /api/timeline          chrome-trace events (load into perfetto)
+    GET /api/workers/{id}/stack  live stack dump (py-spy role)
+    GET /api/workers/{id}/heap   tracemalloc heap profile
 """
 from __future__ import annotations
+
+import os
+
+_CLIENT_DIR = os.path.join(os.path.dirname(__file__), "dashboard_client")
 
 _PAGE = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <style>
@@ -65,6 +78,10 @@ def build_app():
     from ray_tpu import state
 
     async def index(request):
+        path = os.path.join(_CLIENT_DIR, "index.html")
+        if os.path.exists(path):
+            with open(path) as f:
+                return web.Response(text=f.read(), content_type="text/html")
         return web.Response(text=_PAGE, content_type="text/html")
 
     def _json(fn):
@@ -96,6 +113,75 @@ def build_app():
 
     app.router.add_get("/metrics", prometheus)
     app.router.add_get("/api/timeline", _json(lambda: state.timeline()))
+    app.router.add_get(
+        "/api/objects", _json(lambda: _plain(state.list_objects())))
+    app.router.add_get(
+        "/api/placement_groups",
+        _json(lambda: _plain(state.list_placement_groups())))
+    app.router.add_get(
+        "/api/summary/tasks", _json(lambda: _plain(state.summary_tasks())))
+
+    async def serve_status(request):
+        import asyncio
+
+        def do():
+            from ray_tpu import serve
+
+            return _plain(serve.status())
+
+        try:
+            return web.json_response(await asyncio.to_thread(do))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+
+    app.router.add_get("/api/serve", serve_status)
+
+    async def worker_stack(request):
+        import asyncio
+
+        wid = request.match_info["worker_id"]
+        try:
+            res = await asyncio.to_thread(state.get_stack, wid)
+            if res is None:
+                return web.json_response({"error": "worker not found"}, status=404)
+            return web.json_response(_plain(res))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def worker_heap(request):
+        import asyncio
+
+        wid = request.match_info["worker_id"]
+        action = request.query.get("action", "snapshot")
+        try:
+            res = await asyncio.to_thread(
+                state.get_heap_profile, wid, action=action)
+            if res is None:
+                return web.json_response({"error": "worker not found"}, status=404)
+            return web.json_response(_plain(res))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    async def worker_profile(request):
+        import asyncio
+
+        wid = request.match_info["worker_id"]
+        try:
+            res = await asyncio.to_thread(
+                state.get_cpu_profile, wid,
+                duration_s=float(request.query.get("duration_s", 2.0)),
+                format=request.query.get("format", "speedscope"))
+            if res is None:
+                return web.json_response({"error": "worker not found"}, status=404)
+            return web.json_response(_plain(res))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=500)
+
+    app.router.add_get("/api/workers/{worker_id}/stack", worker_stack)
+    app.router.add_get("/api/workers/{worker_id}/heap", worker_heap)
+    app.router.add_get("/api/workers/{worker_id}/profile", worker_profile)
+    if os.path.isdir(_CLIENT_DIR):
+        app.router.add_static("/static", _CLIENT_DIR)
     _add_job_routes(app)
     return app
 

@@ -11,6 +11,7 @@ stream (ref: data/dataset.py:1731 streaming_split).
 from ray_tpu.data.block import BlockAccessor  # noqa: F401
 from ray_tpu.data.dataset import (  # noqa: F401
     ActorPoolStrategy,
+    AggregateFn,
     Dataset,
     GroupedDataset,
     from_arrow,
@@ -31,6 +32,7 @@ range = _range  # noqa: A001  (mirror ray.data.range naming)
 
 __all__ = [
     "ActorPoolStrategy",
+    "AggregateFn",
     "BlockAccessor",
     "DataIterator",
     "Dataset",

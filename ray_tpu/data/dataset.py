@@ -105,7 +105,8 @@ class Dataset:
             rows = [fn(r) for r in BlockAccessor.for_block(block).rows()]
             return rows_to_columns(rows) if rows and isinstance(rows[0], dict) else rows
 
-        return Dataset(self._plan.with_op(MapBlocks("map", apply)))
+        return Dataset(self._plan.with_op(
+            MapBlocks("map", apply, preserves_rows=True)))
 
     def flat_map(self, fn: Callable) -> "Dataset":
         def apply(block):
@@ -140,9 +141,24 @@ class Dataset:
         )
 
     def select_columns(self, cols: list[str]) -> "Dataset":
-        return self.map_batches(
-            lambda b: {k: b[k] for k in cols}, batch_format="numpy"
-        )
+        cols = list(cols)
+
+        def apply(block):
+            batch = BlockAccessor.for_block(block).to_batch("numpy")
+            return {k: batch[k] for k in cols}
+
+        op = MapBlocks("select_columns", apply, preserves_rows=True)
+        # optimizer hook: as the first op over parquet reads this becomes
+        # a column projection on the read itself (optimizer.py
+        # projection_pushdown)
+        op.projected_columns = cols
+        return Dataset(self._plan.with_op(op))
+
+    def explain(self) -> str:
+        """Logical vs optimized physical op chain (ref: Dataset.explain)."""
+        from ray_tpu.data.optimizer import explain
+
+        return explain(self._plan)
 
     def limit(self, n: int) -> "Dataset":
         return Dataset(self._plan.with_op(LimitOp(n)))
@@ -415,69 +431,170 @@ class Dataset:
         return self._write_files(path, "json", wb)
 
 
+class AggregateFn:
+    """A named groupby aggregation (ref: python/ray/data/aggregate.py
+    AggregateFn): ``init() -> state``, ``accumulate(state, row) -> state``,
+    ``merge(a, b) -> state``, ``finalize(state) -> value``."""
+
+    def __init__(self, init: Callable, accumulate: Callable, merge: Callable,
+                 finalize: Callable | None = None, name: str = "agg"):
+        self.init = init
+        self.accumulate = accumulate
+        self.merge = merge
+        self.finalize = finalize or (lambda s: s)
+        self.name = name
+
+
+def _count_agg():
+    return AggregateFn(lambda: 0, lambda s, r: s + 1, lambda a, b: a + b,
+                       name="count()")
+
+
+def _sum_agg(on):
+    return AggregateFn(lambda: 0, lambda s, r: s + r[on], lambda a, b: a + b,
+                       name=f"sum({on})")
+
+
+def _min_agg(on):
+    return AggregateFn(
+        lambda: None, lambda s, r: r[on] if s is None else builtins.min(s, r[on]),
+        lambda a, b: builtins.min(a, b), name=f"min({on})")
+
+
+def _max_agg(on):
+    return AggregateFn(
+        lambda: None, lambda s, r: r[on] if s is None else builtins.max(s, r[on]),
+        lambda a, b: builtins.max(a, b), name=f"max({on})")
+
+
+def _mean_agg(on):
+    return AggregateFn(
+        lambda: (0.0, 0), lambda s, r: (s[0] + r[on], s[1] + 1),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda s: s[0] / s[1] if s[1] else float("nan"), name=f"mean({on})")
+
+
+def _std_agg(on, ddof=1):
+    # Welford-mergeable (count, mean, M2) — numerically stable across
+    # shard merges, unlike sum/sum-of-squares
+    def merge(a, b):
+        (na, ma, m2a), (nb, mb, m2b) = a, b
+        if na == 0:
+            return b
+        if nb == 0:
+            return a
+        n = na + nb
+        d = mb - ma
+        return (n, ma + d * nb / n, m2a + m2b + d * d * na * nb / n)
+
+    def accum(s, r):
+        n, m, m2 = s
+        x = r[on]
+        n += 1
+        d = x - m
+        m += d / n
+        return (n, m, m2 + d * (x - m))
+
+    return AggregateFn(
+        lambda: (0, 0.0, 0.0), accum, merge,
+        lambda s: (s[2] / (s[0] - ddof)) ** 0.5 if s[0] > ddof else float("nan"),
+        name=f"std({on})")
+
+
 class GroupedDataset:
     """Result of Dataset.groupby(key) (ref: grouped_data.py GroupedData:
-    count/sum/min/max/mean/aggregate/map_groups). Aggregations run as
-    map-side partials per block + one merge task — the hash-aggregate
-    shape (ref: execution/operators/hash_aggregate.py) at library scale."""
+    count/sum/min/max/mean/std/aggregate/map_groups). Aggregations run as
+    a distributed hash aggregate (ref: execution/operators/
+    hash_shuffle.py hash-aggregate shape): each block computes per-shard
+    partial states (map side, num_returns=P), then P independent reduce
+    tasks merge and finalize their shard — reduce parallelism P, no
+    single task sees every group."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _aggregate(self, init, accum, merge, finalize, out_name: str) -> Dataset:
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        """Run several aggregations in one pass; output rows are
+        {key, agg1.name: v1, ...} (ref: GroupedData.aggregate)."""
         key = self._key
+        block_refs = list(self._ds.iter_block_refs())
+        if not block_refs:
+            return from_items([])
+        P = builtins.min(len(block_refs), 16) or 1
 
-        @ray_tpu.remote
+        @ray_tpu.remote(num_returns=P)
         def partial(block):
             acc = BlockAccessor.for_block(block)
-            states: dict = {}
+            shards: list[dict] = [{} for _ in builtins.range(P)]
             for row in acc.rows():
                 k = row[key]
-                states[k] = accum(states.get(k, init()), row)
-            return states
+                states = shards[_key_shard(k, P)].get(k)
+                if states is None:
+                    states = [a.init() for a in aggs]
+                    shards[_key_shard(k, P)][k] = states
+                for i, a in enumerate(aggs):
+                    states[i] = a.accumulate(states[i], row)
+            return tuple(shards) if P > 1 else shards[0]
 
         @ray_tpu.remote
-        def reduce(*partials):
-            states: dict = {}
-            for p in partials:
-                for k, s in p.items():
-                    states[k] = merge(states[k], s) if k in states else s
-            return [{key: k, out_name: finalize(s)}
-                    for k, s in sorted(states.items(), key=lambda kv: str(kv[0]))]
+        def reduce_shard(*parts):
+            merged: dict = {}
+            for p in parts:
+                for k, states in p.items():
+                    cur = merged.get(k)
+                    if cur is None:
+                        merged[k] = list(states)
+                    else:
+                        for i, a in enumerate(aggs):
+                            cur[i] = a.merge(cur[i], states[i])
+            return [
+                dict({key: k},
+                     **{a.name: a.finalize(s)
+                        for a, s in zip(aggs, merged[k])})
+                for k in sorted(merged, key=str)
+            ]
 
-        parts = [partial.remote(r) for r in self._ds.iter_block_refs()]
-        rows = ray_tpu.get(reduce.remote(*parts)) if parts else []
-        return from_items(rows)
+        @ray_tpu.remote
+        def merge_sorted(*shard_rows):
+            # shards are tiny (one row per group): a final key-sorted
+            # merge keeps the pre-hash-aggregate contract of globally
+            # key-ordered output without a driver materialization of
+            # anything bigger than the aggregate itself
+            out = [r for rows in shard_rows for r in rows]
+            out.sort(key=lambda r: str(r[key]))
+            return out
+
+        sharded = [partial.remote(r) for r in block_refs]
+        if P == 1:
+            cols = [[s] for s in sharded]
+        else:
+            cols = [[sharded[b][p] for b in builtins.range(len(sharded))]
+                    for p in builtins.range(P)]
+        out_refs = [reduce_shard.remote(*col) for col in cols]
+        from ray_tpu.data.executor import InjectRefs
+
+        return Dataset(Plan(
+            [], (InjectRefs("hash_aggregate",
+                            [merge_sorted.remote(*out_refs)]),)))
 
     def count(self) -> Dataset:
-        return self._aggregate(
-            lambda: 0, lambda s, r: s + 1, lambda a, b: a + b, lambda s: s,
-            "count()")
+        return self.aggregate(_count_agg())
 
     def sum(self, on: str) -> Dataset:
-        return self._aggregate(
-            lambda: 0, lambda s, r: s + r[on], lambda a, b: a + b, lambda s: s,
-            f"sum({on})")
+        return self.aggregate(_sum_agg(on))
 
     def min(self, on: str) -> Dataset:
-        return self._aggregate(
-            lambda: None,
-            lambda s, r: r[on] if s is None else builtins.min(s, r[on]),
-            lambda a, b: builtins.min(a, b), lambda s: s, f"min({on})")
+        return self.aggregate(_min_agg(on))
 
     def max(self, on: str) -> Dataset:
-        return self._aggregate(
-            lambda: None,
-            lambda s, r: r[on] if s is None else builtins.max(s, r[on]),
-            lambda a, b: builtins.max(a, b), lambda s: s, f"max({on})")
+        return self.aggregate(_max_agg(on))
 
     def mean(self, on: str) -> Dataset:
-        return self._aggregate(
-            lambda: (0.0, 0),
-            lambda s, r: (s[0] + r[on], s[1] + 1),
-            lambda a, b: (a[0] + b[0], a[1] + b[1]),
-            lambda s: s[0] / s[1] if s[1] else float("nan"), f"mean({on})")
+        return self.aggregate(_mean_agg(on))
+
+    def std(self, on: str, ddof: int = 1) -> Dataset:
+        return self.aggregate(_std_agg(on, ddof))
 
     def map_groups(self, fn: Callable) -> Dataset:
         """Apply fn(list_of_rows) -> list_of_rows per complete group.
@@ -724,18 +841,33 @@ def read_csv(paths, **pandas_kwargs) -> Dataset:
     return Dataset(Plan([make(p) for p in files]))
 
 
+class _ParquetReadTask:
+    """Projectable parquet read (the optimizer's projection_pushdown
+    retargets ``columns`` when select_columns is the first op)."""
+
+    def __init__(self, path: str, columns: list[str] | None):
+        self.path = path
+        self.columns = columns
+
+    def __call__(self):
+        import pyarrow.parquet as pq
+
+        return normalize_block(pq.read_table(self.path, columns=self.columns))
+
+    def with_columns(self, cols: list[str]) -> "_ParquetReadTask":
+        if self.columns is not None and any(
+                c not in self.columns for c in cols):
+            # refuse rather than silently narrow: the optimizer then keeps
+            # the select_columns op, which raises KeyError at execution —
+            # the same observable behavior as the unoptimized plan
+            raise AttributeError(
+                f"projection {cols} not serveable from {self.columns}")
+        return _ParquetReadTask(self.path, list(cols))
+
+
 def read_parquet(paths, columns: list[str] | None = None) -> Dataset:
     files = _expand_paths(paths)
-
-    def make(path):
-        def read():
-            import pyarrow.parquet as pq
-
-            return normalize_block(pq.read_table(path, columns=columns))
-
-        return read
-
-    return Dataset(Plan([make(p) for p in files]))
+    return Dataset(Plan([_ParquetReadTask(p, columns) for p in files]))
 
 
 def read_json(paths, *, lines: bool = True) -> Dataset:

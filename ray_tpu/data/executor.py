@@ -95,10 +95,15 @@ class MapBlocks(Operator):
     """map_batches / map / filter / flat_map all lower to this
     (ref: execution/operators/map_operator.py)."""
 
-    def __init__(self, name: str, fn: Callable, max_in_flight: int | None = None):
+    def __init__(self, name: str, fn: Callable, max_in_flight: int | None = None,
+                 preserves_rows: bool = False):
         self.name = name
         self.fn = fn
         self.max_in_flight = max_in_flight or DEFAULT_MAX_IN_FLIGHT
+        # optimizer metadata (data/optimizer.py): True only when the op
+        # emits exactly one output row per input row (map, add_column,
+        # select_columns — NOT filter/flat_map/map_batches)
+        self.preserves_rows = preserves_rows
 
     def transform(self, refs, stats):
         inflight: collections.deque = collections.deque()
@@ -444,9 +449,10 @@ def _concat_and_apply(fn, *blocks):
 
 
 class Plan:
-    """Source + operator chain (ref: LogicalPlan/PhysicalPlan collapsed —
-    op fusion is XLA's job on-device; host-side fusion here is just chained
-    MapBlocks with no barrier between them)."""
+    """Source + operator chain (ref: LogicalPlan over the streaming
+    executor). ``execute`` first runs the rule optimizer
+    (data/optimizer.py: redundant-op elimination, limit/projection
+    pushdown, map and read-map fusion), then streams the physical chain."""
 
     def __init__(self, read_tasks: list[Callable], ops: tuple = ()):
         self.read_tasks = list(read_tasks)
@@ -455,8 +461,14 @@ class Plan:
     def with_op(self, op: Operator) -> "Plan":
         return Plan(self.read_tasks, (*self.ops, op))
 
-    def execute(self, max_source_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+    def execute(self, max_source_in_flight: int = DEFAULT_MAX_IN_FLIGHT,
+                _optimize: bool = True):
         """Returns (iterator of block refs, list[OpStats])."""
+        if _optimize:
+            from ray_tpu.data.optimizer import optimize
+
+            return optimize(self).execute(max_source_in_flight,
+                                          _optimize=False)
         all_stats = [OpStats("read")]
 
         def source():

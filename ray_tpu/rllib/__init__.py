@@ -14,6 +14,8 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
 - appo: async PPO — IMPALA sampling + clipped surrogate (algorithms/appo/)
 - offline: experience JSONL IO + BC + discrete CQL (rllib/offline/,
   algorithms/bc/, algorithms/cql/)
+- connectors: ConnectorV2 pipelines between env, module, and learner
+  (rllib/connectors/connector_v2.py, connector_pipeline_v2.py)
 
     from ray_tpu.rllib import PPOConfig
 
@@ -25,6 +27,14 @@ TPU-native counterpart of RLlib's new API stack (ref: rllib/):
         print(algo.train()["episode_return_mean"])
 """
 from ray_tpu.rllib.appo import APPO, APPOConfig, make_appo_update
+from ray_tpu.rllib.connectors import (CastObservations, ClipActions,
+                                      ConnectorCtx, ConnectorPipelineV2,
+                                      ConnectorV2, FlattenObservations,
+                                      LambdaConnector, NormalizeAdvantages,
+                                      NormalizeObservations,
+                                      default_env_to_module,
+                                      default_learner_pipeline,
+                                      default_module_to_env)
 from ray_tpu.rllib.core import policy_init, policy_logits, sample_action, value_fn
 from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner, make_dqn_update, q_init, q_values
 from ray_tpu.rllib.env_runner import EnvRunner
@@ -41,6 +51,18 @@ from ray_tpu.rllib.sac import SAC, SACConfig, SACEnvRunner, make_sac_update, sac
 __all__ = [
     "APPO",
     "APPOConfig",
+    "CastObservations",
+    "ClipActions",
+    "ConnectorCtx",
+    "ConnectorPipelineV2",
+    "ConnectorV2",
+    "FlattenObservations",
+    "LambdaConnector",
+    "NormalizeAdvantages",
+    "NormalizeObservations",
+    "default_env_to_module",
+    "default_learner_pipeline",
+    "default_module_to_env",
     "BC",
     "BCConfig",
     "CQL",

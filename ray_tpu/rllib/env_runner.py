@@ -12,7 +12,8 @@ import numpy as np
 
 class EnvRunner:
     def __init__(self, env_name: str, num_envs: int = 1, seed: int = 0,
-                 env_config: dict | None = None):
+                 env_config: dict | None = None, env_to_module=None,
+                 module_to_env=None):
         import gymnasium as gym
 
         from ray_tpu.utils.device import configure_jax
@@ -29,9 +30,36 @@ class EnvRunner:
         self.obs, _ = self.envs.reset(seed=seed)
         self._ep_returns = np.zeros(num_envs)
         self.completed_returns: list[float] = []
+        # ConnectorV2 pipelines (ref: env_to_module_connector /
+        # module_to_env_connector on the reference env runner); the module
+        # AND the returned rollout see connector-processed observations,
+        # so the learner trains on exactly what the policy acted on
+        from ray_tpu.rllib.connectors import ConnectorCtx
+
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
+        self._e2m_ctx = ConnectorCtx(phase="env_to_module", num_envs=num_envs)
+        self._m2e_ctx = ConnectorCtx(phase="module_to_env", num_envs=num_envs)
+
+    def _module_obs(self, obs):
+        if self.env_to_module is None:
+            return np.asarray(obs)
+        return self.env_to_module(obs, self._e2m_ctx)
 
     def set_weights(self, params) -> bool:
         self.params = params
+        return True
+
+    # -- connector state sync (ref: EnvRunnerGroup merging env-to-module
+    # connector states each iteration, then re-broadcasting) -------------
+    def get_connector_state(self) -> dict:
+        if self.env_to_module is None:
+            return {}
+        return self.env_to_module.get_state()
+
+    def set_connector_state(self, state: dict) -> bool:
+        if self.env_to_module is not None and state:
+            self.env_to_module.set_state(state)
         return True
 
     def sample(self, num_steps: int) -> dict:
@@ -46,11 +74,15 @@ class EnvRunner:
         for _ in range(num_steps):
             self._rng_counter += 1
             key = jax.random.PRNGKey(self.seed * 1_000_003 + self._rng_counter)
-            action, logp, value = sample_action(self.params, self.obs, key)
+            mobs = self._module_obs(self.obs)
+            action, logp, value = sample_action(self.params, mobs, key)
             action = np.asarray(action)
+            if self.module_to_env is not None:
+                action = np.asarray(
+                    self.module_to_env(action, self._m2e_ctx))
             next_obs, reward, term, trunc, _ = self.envs.step(action)
             done = np.logical_or(term, trunc)
-            obs_l.append(self.obs)
+            obs_l.append(mobs)
             act_l.append(action)
             logp_l.append(np.asarray(logp))
             val_l.append(np.asarray(value))
@@ -62,7 +94,11 @@ class EnvRunner:
                     self.completed_returns.append(float(self._ep_returns[i]))
                     self._ep_returns[i] = 0.0
             self.obs = next_obs
-        last_value = np.asarray(value_fn(self.params, self.obs))
+        # bootstrap under the SAME observation transform the policy saw
+        # (update=False would be ideal mid-connector, but one extra batch
+        # of running-stat updates is harmless and keeps the code simple)
+        last_mobs = self._module_obs(self.obs)
+        last_value = np.asarray(value_fn(self.params, last_mobs))
         return {
             "obs": np.stack(obs_l),          # [T, N, obs_dim]
             "actions": np.stack(act_l),      # [T, N]
@@ -73,7 +109,7 @@ class EnvRunner:
             "last_value": last_value,        # [N]
             # bootstrap OBS so off-policy learners (V-trace) can evaluate
             # it under the CURRENT policy rather than the behavior one
-            "last_obs": np.asarray(self.obs),
+            "last_obs": np.asarray(last_mobs),
         }
 
     def episode_metrics(self) -> dict:

@@ -134,6 +134,14 @@ class Learner:
         )
         self.opt_state = optimizer.init(self.params)
         self._step = 0
+        # learner ConnectorV2 pipeline (ref: the learner connector stage):
+        # applied to the host-side train batch after GAE, before device put
+        lc = config.get("learner_connector")
+        from ray_tpu.rllib.connectors import ConnectorCtx, ConnectorV2
+
+        self.learner_pipe = (
+            lc if isinstance(lc, ConnectorV2) or lc is None else lc())
+        self._learner_ctx = ConnectorCtx(phase="learner")
 
     def get_weights(self):
         return self.params
@@ -155,6 +163,8 @@ class Learner:
                 for r in rollouts
             ]
             batch = {k: np.concatenate([b[k] for b in batches]) for k in batches[0]}
+            if self.learner_pipe is not None:
+                batch = self.learner_pipe(batch, self._learner_ctx)
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
             self._step += 1
             key = jax.random.PRNGKey(self.config.get("seed", 0) * 7919 + self._step)

@@ -34,6 +34,13 @@ class PPOConfig:
         self.hidden = 64
         self.seed = 0
         self.collective_backend = "cpu"
+        # ConnectorV2 hooks (ref: algorithm_config
+        # env_to_module_connector / module_to_env_connector /
+        # learner connector): a zero-arg factory OR a pipeline instance
+        # (each actor gets its own copy either way)
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
+        self.learner_connector = None
 
     def environment(self, env: str, env_config: dict | None = None) -> "PPOConfig":
         self.env_name = env
@@ -42,13 +49,19 @@ class PPOConfig:
 
     def env_runners(self, num_env_runners: int | None = None,
                     num_envs_per_env_runner: int | None = None,
-                    rollout_fragment_length: int | None = None) -> "PPOConfig":
+                    rollout_fragment_length: int | None = None,
+                    env_to_module_connector=None,
+                    module_to_env_connector=None) -> "PPOConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def learners(self, num_learners: int | None = None) -> "PPOConfig":
@@ -84,14 +97,32 @@ class PPO:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         self.config = config
+        from ray_tpu.rllib.connectors import ConnectorV2
+
+        def build_pipe(factory_or_pipe):
+            # factories (zero-arg callables) get called per actor; a
+            # pipeline INSTANCE is also callable, so detect it by type —
+            # each actor still gets its own copy via pickling
+            if factory_or_pipe is None or isinstance(factory_or_pipe,
+                                                     ConnectorV2):
+                return factory_or_pipe
+            return factory_or_pipe()
+
         RunnerCls = ray_tpu.remote(EnvRunner)
+        e2m = config.env_to_module_connector
+        m2e = config.module_to_env_connector
         self.runners = [
             RunnerCls.options(num_cpus=0.5).remote(
                 config.env_name, config.num_envs_per_runner,
                 seed=config.seed + 1000 * i, env_config=config.env_config,
+                env_to_module=build_pipe(e2m),
+                module_to_env=build_pipe(m2e),
             )
             for i in range(config.num_env_runners)
         ]
+        self._has_connectors = e2m is not None
+        # merge_states needs a pipeline of the same shape; build it once
+        self._connector_proto = build_pipe(e2m)
         obs_dim, n_actions = ray_tpu.get(
             self.runners[0].obs_and_action_space.remote(), timeout=120
         )
@@ -109,6 +140,7 @@ class PPO:
             "minibatches": config.minibatches,
             "seed": config.seed,
             "collective_backend": config.collective_backend,
+            "learner_connector": config.learner_connector,
         }
         LearnerCls = ray_tpu.remote(Learner)
         group = f"rl_learners_{id(self)}"
@@ -142,6 +174,8 @@ class PPO:
         )
         results = [r for r in results if r["samples"] > 0]
         self._sync_weights()
+        if self._has_connectors and len(self.runners) > 1:
+            self._sync_connector_states()
         metrics_list = ray_tpu.get(
             [r.episode_metrics.remote() for r in self.runners], timeout=120
         )
@@ -159,6 +193,21 @@ class PPO:
             * self.config.num_env_runners,
             "time_this_iter_s": time.monotonic() - t0,
         }
+
+    def _sync_connector_states(self):
+        """Merge env-to-module connector states (running obs statistics)
+        across runners and re-broadcast, so every runner normalizes with
+        the fleet-wide statistics (ref: EnvRunnerGroup connector-state
+        aggregation)."""
+        proto = self._connector_proto
+        states = ray_tpu.get(
+            [r.get_connector_state.remote() for r in self.runners],
+            timeout=120)
+        merged = proto.merge_states([s for s in states if s])
+        if merged:
+            ray_tpu.get(
+                [r.set_connector_state.remote(merged) for r in self.runners],
+                timeout=120)
 
     def get_weights(self):
         return ray_tpu.get(self.learners[0].get_weights.remote(), timeout=120)

@@ -91,6 +91,55 @@ def get_heap_profile(worker_id: str, *, action: str = "snapshot",
         node_address)
 
 
+def get_cpu_profile(worker_id: str, *, duration_s: float = 2.0,
+                    interval_s: float = 0.01, format: str = "folded",
+                    node_address: tuple | None = None):
+    """Sampled CPU profile of a live worker (ref: the dashboard
+    reporter's py-spy `record` endpoint, profile_manager.py:82 — here the
+    worker samples its own threads, no ptrace). format="folded" returns
+    {stack: count} (flamegraph.pl input); format="speedscope" returns a
+    speedscope-format JSON document (load at speedscope.app)."""
+    res = _raylet_call(
+        "cpu_profile_worker",
+        {"worker_id": worker_id, "duration_s": duration_s,
+         "interval_s": interval_s},
+        node_address)
+    if res is None or format != "speedscope":
+        return res
+    return _folded_to_speedscope(res)
+
+
+def _folded_to_speedscope(res: dict) -> dict:
+    """Fold-map -> speedscope 'sampled' profile document."""
+    frames: list[dict] = []
+    frame_ix: dict[str, int] = {}
+    samples: list[list[int]] = []
+    weights: list[float] = []
+    for stack, count in res.get("folded", {}).items():
+        ixs = []
+        for name in stack.split(";"):
+            if name not in frame_ix:
+                frame_ix[name] = len(frames)
+                frames.append({"name": name})
+            ixs.append(frame_ix[name])
+        samples.append(ixs)
+        weights.append(count * res.get("interval_s", 0.01))
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": f"worker {res.get('worker_id', '?')[:12]} "
+                    f"(pid {res.get('pid')})",
+            "unit": "seconds",
+            "startValue": 0,
+            "endValue": res.get("duration_s", 0),
+            "samples": samples,
+            "weights": weights,
+        }],
+    }
+
+
 def _match(row: dict, filters) -> bool:
     for key, op, value in filters or ():
         have = row.get(key)

@@ -536,3 +536,222 @@ class EventLoopThread:
     def stop(self):
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._thread.join(timeout=5)
+
+
+class MuxConnection:
+    """Server-side connection face over the native epoll mux
+    (_native/src/mux.cc). Duck-types the subset of Connection the control
+    plane uses on INCOMING connections: respond/respond_multi/notify/
+    send_nowait/close (server-initiated call() always dials a fresh
+    client connection, never rides an accepted one)."""
+
+    __slots__ = ("_server", "conn_id", "_pending", "_closed", "_ids",
+                 "on_message")
+
+    def __init__(self, server: "NativeRpcServer", conn_id: int):
+        self._server = server
+        self.conn_id = conn_id
+        self._pending: dict[int, asyncio.Future] = {}
+        self._ids = itertools.count(1)
+        self._closed = False
+        self.on_message = None
+
+    @property
+    def peername(self):
+        return ("mux", self.conn_id)
+
+    def send_nowait(self, msg: dict):
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        st = self._server._mux_send(self.conn_id, frame_bytes(msg))
+        if st != 0:
+            # a conn we can no longer reply on is DEAD, not just muted:
+            # close the socket so the peer observes the disconnect instead
+            # of blocking forever on replies that silently stopped (-2 is
+            # a >256MB write backlog — a peer that far behind is gone)
+            self._closed = True
+            self._server._mux_close(self.conn_id)
+            raise ConnectionLost(f"mux send failed ({st})")
+
+    async def send(self, msg: dict):
+        self.send_nowait(msg)
+
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float | None = None):
+        i = next(self._ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[i] = fut
+        await self.send({"k": "c", "i": i, "m": method, "p": payload})
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def notify(self, method: str, payload: Any = None):
+        await self.send({"k": "n", "m": method, "p": payload})
+
+    async def respond(self, msg_id: int, value: Any = None,
+                      error: Exception | None = None):
+        await self.send({"k": "r", "i": msg_id, "v": value, "e": error})
+
+    async def respond_multi(self, items: list):
+        await self.send({"k": "R", "f": items})
+
+    call_scatter = _call_scatter
+
+    def _fail_pending(self, exc: Exception):
+        self._closed = True
+        exc = exc if isinstance(exc, ConnectionLost) else ConnectionLost(repr(exc))
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+
+    async def close(self):
+        if not self._closed:
+            self._closed = True
+            self._server._mux_close(self.conn_id)
+
+
+class NativeRpcServer(RpcServer):
+    """RpcServer over the native epoll mux (ref: grpc_server.h:88 — the
+    completion-queue-threads role). The C++ thread owns every socket and
+    frames every message; this loop wakes ONCE per burst via eventfd and
+    drains the whole batch in one callback — no per-connection reader
+    coroutine, no per-frame Task for the transport."""
+
+    _RECV_BUF0 = 1 << 20
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self._mux = None
+        self._efd = -1
+        self._muxconns: dict[int, MuxConnection] = {}
+        self._recvbuf = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+
+    async def start(self) -> tuple[str, int]:
+        import ctypes
+
+        from ray_tpu import _native
+
+        lib = _native.get_lib()
+        self._lib = lib
+        out_port = ctypes.c_uint16(0)
+        out_efd = ctypes.c_int(-1)
+        h = lib.rt_mux_create(self._host.encode(), self._port,
+                              ctypes.byref(out_port), ctypes.byref(out_efd))
+        if not h:
+            raise OSError(f"rt_mux_create failed on {self._host}:{self._port}")
+        self._mux = h
+        self._efd = out_efd.value
+        self._port = out_port.value
+        self._recvbuf = ctypes.create_string_buffer(self._RECV_BUF0)
+        self._loop = asyncio.get_running_loop()
+        self._loop.add_reader(self._efd, self._drain)
+        _LOCAL_SERVERS[(self._host, self._port)] = (self, self._loop)
+        return self._host, self._port
+
+    def _mux_send(self, conn_id: int, framed: bytes) -> int:
+        return self._lib.rt_mux_send(self._mux, conn_id, framed, len(framed))
+
+    def _mux_close(self, conn_id: int):
+        self._lib.rt_mux_close_conn(self._mux, conn_id)
+
+    def _drain(self):
+        import ctypes
+        import struct as _s
+
+        if self._mux is None:
+            return
+        n = self._lib.rt_mux_recv_batch(
+            self._mux,
+            ctypes.cast(self._recvbuf, ctypes.POINTER(ctypes.c_uint8)),
+            len(self._recvbuf))
+        if n < 0:  # one record larger than the buffer: grow and retry
+            self._recvbuf = ctypes.create_string_buffer(
+                max(-n, len(self._recvbuf) * 2))
+            return  # eventfd re-signaled; the loop calls us again
+        if n == 0:
+            return
+        buf = self._recvbuf.raw[:n]
+        off = 0
+        while off + 16 <= n:
+            conn_id, rtype, ln = _s.unpack_from("<QII", buf, off)
+            payload = buf[off + 16: off + 16 + ln]
+            off += 16 + ln
+            if rtype == 1:  # connected
+                conn = MuxConnection(self, conn_id)
+                self._muxconns[conn_id] = conn
+                self._conns.add(conn)
+                continue
+            conn = self._muxconns.get(conn_id)
+            if conn is None:
+                continue
+            if rtype == 2:  # disconnected
+                self._muxconns.pop(conn_id, None)
+                self._conns.discard(conn)
+                conn._fail_pending(ConnectionLost("peer disconnected"))
+                if self.on_disconnect is not None:
+                    try:
+                        self.on_disconnect(conn)
+                    except Exception:
+                        pass
+                self._lib.rt_mux_release(self._mux, conn_id)
+                continue
+            try:
+                msg = pickle.loads(payload)
+            except Exception:
+                continue  # garbage frame: drop it, keep the connection
+            kind = msg.get("k")
+            if kind in ("c", "n"):
+                self._spawn_dispatch(conn, msg)
+            elif kind == "r":
+                fut = conn._pending.pop(msg["i"], None)
+                if fut is not None and not fut.done():
+                    if msg.get("e") is not None:
+                        fut.set_exception(msg["e"])
+                    else:
+                        fut.set_result(msg.get("v"))
+            elif kind == "R":
+                _resolve_multi(conn._pending, msg["f"])
+
+    async def stop(self):
+        _LOCAL_SERVERS.pop((self._host, self._port), None)
+        if self._loop is not None and self._efd >= 0:
+            try:
+                self._loop.remove_reader(self._efd)
+            except Exception:
+                pass
+        for conn in list(self._conns):
+            if isinstance(conn, LoopbackConnection):
+                conn._closed = True
+                if conn.peer is not None:
+                    conn.peer._fail_pending(ConnectionLost("server stopped"))
+            elif isinstance(conn, MuxConnection):
+                conn._fail_pending(ConnectionLost("server stopped"))
+        self._conns.clear()
+        self._muxconns.clear()
+        for t in list(self._dispatch_tasks):
+            t.cancel()
+        if self._dispatch_tasks:
+            await asyncio.gather(*self._dispatch_tasks, return_exceptions=True)
+        if self._mux is not None:
+            # rt_mux_stop joins the epoll thread; cheap enough to inline
+            self._lib.rt_mux_stop(self._mux)
+            self._mux = None
+
+
+def make_server(host: str = "127.0.0.1", port: int = 0) -> RpcServer:
+    """Control-plane server factory: the native mux when enabled and
+    buildable, else the asyncio server (identical dispatch surface)."""
+    from ray_tpu.config import get_config
+
+    if get_config().native_mux_enabled:
+        try:
+            from ray_tpu import _native
+
+            _native.get_lib()  # force the build before committing to it
+            return NativeRpcServer(host, port)
+        except Exception:
+            pass
+    return RpcServer(host, port)

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 # (major, minor): bump MAJOR for incompatible changes (renamed/removed
 # methods, changed field meaning), MINOR for additions.
-PROTOCOL_VERSION = (1, 4)
+PROTOCOL_VERSION = (1, 5)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -82,6 +82,10 @@ CATALOG: dict[str, dict[str, dict]] = {
             "worker_id": "hex prefix — proxies a heap_profile RPC",
             "action": "start | snapshot | stop",
             "top": "snapshot: top-N allocation sites"}},
+        "cpu_profile_worker": {"since": (1, 5), "fields": {
+            "worker_id": "hex prefix — proxies a cpu_profile RPC",
+            "duration_s": "sampling window (capped 30s)",
+            "interval_s": "sample period"}},
         "dump_worker_stack": {"since": (1, 3), "fields": {
             "worker_id": "hex prefix — proxies a dump_stack RPC to the "
                          "matching worker (live stack profiling)"}},
@@ -162,6 +166,9 @@ CATALOG: dict[str, dict[str, dict]] = {
             "action": "start | snapshot | stop (tracemalloc control)",
             "top": "snapshot: top-N allocation sites",
             "nframes": "start: traceback depth"}},
+        "cpu_profile": {"since": (1, 5), "fields": {
+            "duration_s": "sampling window (capped 30s)",
+            "interval_s": "sample period — folded stacks returned"}},
     },
 }
 

@@ -19,6 +19,9 @@ completed step instead of recomputing.
     out = workflow.resume("exp1")   # completed steps replay from storage
 """
 from ray_tpu.workflow.api import (
+    EventListener,
+    KVEventListener,
+    TimerListener,
     WorkflowStep,
     get_output,
     get_status,
@@ -26,10 +29,15 @@ from ray_tpu.workflow.api import (
     resume,
     resume_all,
     run,
+    send_event,
     step,
+    wait_for_event,
 )
 
 __all__ = [
+    "EventListener",
+    "KVEventListener",
+    "TimerListener",
     "WorkflowStep",
     "get_output",
     "get_status",
@@ -37,5 +45,7 @@ __all__ = [
     "resume",
     "resume_all",
     "run",
+    "send_event",
     "step",
+    "wait_for_event",
 ]

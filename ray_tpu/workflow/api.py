@@ -298,3 +298,76 @@ def list_all() -> list[str]:
         )
     except FileNotFoundError:
         return []
+
+
+# ------------------------------------------------------------------- events
+class EventListener:
+    """Pluggable event source for wait_for_event (ref:
+    python/ray/workflow/event_listener.py EventListener.poll_for_event —
+    async there; a plain blocking poll here, since the wait runs inside
+    an ordinary worker task, not an event loop)."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires after a duration (ref: event_listener.py TimerListener)."""
+
+    def poll_for_event(self, duration_s: float):
+        time.sleep(duration_s)
+        return duration_s
+
+
+class KVEventListener(EventListener):
+    """Fires when ``send_event(key, payload)`` posts to the cluster KV —
+    the cross-process event channel (ref: the HTTP event provider role,
+    workflow/http_event_provider.py, over this framework's GCS KV
+    instead of an HTTP endpoint)."""
+
+    NS = "wf_events"
+
+    def poll_for_event(self, key: str, poll_interval_s: float = 0.2,
+                       timeout_s: float | None = None):
+        import ray_tpu
+        from ray_tpu.core import api as _core_api
+
+        core = _core_api.get_core()
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            blob = core._run_sync(core.gcs.call(
+                "kv_get", {"ns": self.NS, "key": key}))
+            if blob is not None:
+                return cloudpickle.loads(blob)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"no event {key!r} within {timeout_s}s")
+            time.sleep(poll_interval_s)
+
+
+def send_event(key: str, payload: Any = None) -> None:
+    """Deliver an event to any KVEventListener waiting on ``key``."""
+    from ray_tpu.core import api as _core_api
+
+    core = _core_api.get_core()
+    core._run_sync(core.gcs.call("kv_put", {
+        "ns": KVEventListener.NS, "key": key,
+        "value": cloudpickle.dumps(payload)}))
+
+
+def wait_for_event(listener_cls: type, *args, name: str | None = None,
+                   num_cpus: float = 0.1, **kwargs) -> StepNode:
+    """A workflow step that completes when the listener's event arrives
+    (ref: api.py wait_for_event:380). The delivered payload checkpoints
+    like any step result, so a resumed workflow does NOT re-wait for an
+    event it already consumed."""
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event takes an EventListener subclass")
+
+    def poll(*a, **k):
+        return listener_cls().poll_for_event(*a, **k)
+
+    wrapped = WorkflowStep(
+        poll, name=name or f"wait_{listener_cls.__name__}",
+        num_cpus=num_cpus)
+    return wrapped.bind(*args, **kwargs)

@@ -153,6 +153,14 @@ def test_dashboard_endpoints():
                     return r.read()
 
             assert b"ray_tpu dashboard" in get("/")
+            # SPA assets + views wiring (ref role: dashboard/client SPA)
+            assert b'href="#/nodes"' in get("/")
+            assert b"hash router" in get("/static/app.js") or b"views" in get("/static/app.js")
+            assert b"--accent" in get("/static/style.css")
+            summary = json.loads(get("/api/summary/tasks"))
+            assert isinstance(summary, dict)
+            assert json.loads(get("/api/objects")) is not None
+            assert json.loads(get("/api/placement_groups")) == []
             cluster = json.loads(get("/api/cluster"))
             assert len(cluster) == 1 and cluster[0]["alive"]
             tasks = json.loads(get("/api/tasks"))

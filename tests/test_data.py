@@ -374,3 +374,143 @@ def test_join_column_collision_and_empty_side(rt):
     assert lds.join(empty, on="k").take_all() == []
     assert sorted(r["k"] for r in lds.join(empty, on="k", how="left")
                   .take_all()) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------- optimizer
+def test_optimizer_map_fusion_and_explain(rt):
+    """Adjacent maps fuse into one physical op (ref:
+    logical/rules/operator_fusion.py); explain() shows both chains. A
+    leading limit blocks read-fusion, so the fused map stays visible."""
+    from ray_tpu import data
+
+    ds = (data.range(100, parallelism=4)
+          .limit(100)
+          .map(lambda r: {"id": r["id"] * 2})
+          .map(lambda r: {"id": r["id"] + 1}))
+    plan = ds.explain()
+    assert "map -> map" in plan.splitlines()[0]          # logical
+    assert "map->map" in plan.splitlines()[1]            # fused physical
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == sorted(i * 2 + 1 for i in range(100))
+
+
+def test_optimizer_read_map_fusion(rt):
+    """Leading maps fold into the read task itself — the whole chain runs
+    as ONE task per block (ref: fusing MapOperator into the Read)."""
+    from ray_tpu import data
+
+    ds = (data.range(80, parallelism=4)
+          .map(lambda r: {"id": r["id"] + 5})
+          .map(lambda r: {"id": r["id"] * 10}))
+    assert sorted(r["id"] for r in ds.take_all()) == [
+        i * 10 for i in range(5, 85)]
+    # both maps fused away into the read stage
+    assert ds.explain().splitlines()[1].strip() == "physical: read[4 tasks]"
+
+
+def test_optimizer_redundant_ops_and_limit_pushdown(rt):
+    from ray_tpu import data
+    from ray_tpu.data.optimizer import describe, optimize
+
+    ds = data.range(100, parallelism=4).limit(50).limit(10)
+    phys = describe(optimize(ds._plan))
+    assert phys.count("limit") == 1
+    assert len(ds.take_all()) == 10
+
+    # limit slides below the rows-preserving map
+    ds2 = data.range(100, parallelism=4).map(
+        lambda r: {"id": r["id"]}).limit(7)
+    phys2 = describe(optimize(ds2._plan))
+    assert phys2.index("limit") < phys2.index("map") or "map" not in phys2
+    assert len(ds2.take_all()) == 7
+
+
+def test_optimizer_projection_pushdown_parquet(rt, tmp_path):
+    """select_columns over parquet becomes a column-projected read (ref:
+    planner projection pushdown): the read task's column list narrows."""
+    import pandas as pd
+
+    from ray_tpu import data
+
+    pd.DataFrame({"a": range(10), "b": range(10), "c": range(10)}).to_parquet(
+        tmp_path / "p.parquet")
+    ds = data.read_parquet(str(tmp_path / "p.parquet")).select_columns(["a", "c"])
+    from ray_tpu.data.optimizer import optimize
+
+    phys = optimize(ds._plan)
+    assert phys.read_tasks[0].columns == ["a", "c"]
+    assert "select_columns" not in [op.name for op in phys.ops]
+    rows = ds.take_all()
+    assert set(rows[0].keys()) == {"a", "c"}
+    assert len(rows) == 10
+
+
+def test_hash_aggregate_parallel_and_multi_agg(rt):
+    """GroupedDataset.aggregate: several AggregateFns in one hash-sharded
+    pass; parity with pandas groupby."""
+    import pandas as pd
+
+    from ray_tpu import data
+    from ray_tpu.data import AggregateFn
+
+    rows = [{"g": i % 7, "v": float(i)} for i in range(200)]
+    ds = data.from_items(rows, parallelism=8)
+    out = ds.groupby("g").aggregate(
+        AggregateFn(lambda: 0, lambda s, r: s + 1, lambda a, b: a + b,
+                    name="n"),
+        AggregateFn(lambda: 0.0, lambda s, r: s + r["v"],
+                    lambda a, b: a + b, name="total"),
+    ).take_all()
+    want = pd.DataFrame(rows).groupby("g")["v"].agg(["count", "sum"])
+    got = {r["g"]: (r["n"], r["total"]) for r in out}
+    assert len(got) == 7
+    for g, (n, total) in got.items():
+        assert n == want.loc[g, "count"]
+        assert total == pytest.approx(want.loc[g, "sum"])
+
+
+def test_groupby_std(rt):
+    import pandas as pd
+
+    from ray_tpu import data
+
+    rows = [{"g": i % 3, "v": float(i * i % 17)} for i in range(60)]
+    out = data.from_items(rows, parallelism=6).groupby("g").std("v").take_all()
+    want = pd.DataFrame(rows).groupby("g")["v"].std()
+    got = {r["g"]: r["std(v)"] for r in out}
+    for g, s in got.items():
+        assert s == pytest.approx(want.loc[g], rel=1e-9)
+
+
+def test_projection_pushdown_missing_column_still_raises(rt, tmp_path):
+    """Optimization must not change observable behavior: selecting an
+    absent column fails the same way with and without pushdown."""
+    import pandas as pd
+
+    from ray_tpu import data
+
+    pd.DataFrame({"a": range(5)}).to_parquet(tmp_path / "p.parquet")
+    ds = (data.read_parquet(str(tmp_path / "p.parquet"), columns=["a"])
+          .select_columns(["a", "nope"]))
+    with pytest.raises(Exception, match="nope"):
+        ds.take_all()
+
+
+def test_groupby_output_globally_key_sorted(rt):
+    from ray_tpu import data
+
+    rows = [{"g": (i * 7) % 13, "v": i} for i in range(120)]
+    out = data.from_items(rows, parallelism=6).groupby("g").count().take_all()
+    keys = [r["g"] for r in out]
+    assert keys == sorted(keys, key=str), keys
+
+
+def test_sort_sort_keeps_stable_tiebreak(rt):
+    from ray_tpu import data
+
+    rows = [{"a": i % 4, "b": i % 2} for i in range(16)]
+    got = data.from_items(rows, parallelism=4).sort("a").sort("b").take_all()
+    # stable: within equal b, rows ordered by a
+    for b in (0, 1):
+        sub = [r["a"] for r in got if r["b"] == b]
+        assert sub == sorted(sub), got

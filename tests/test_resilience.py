@@ -236,8 +236,8 @@ def test_gcs_wal_survives_kill_between_mutations(tmp_path):
         gcs2 = GcsServer(persist_path=snap)
         io.run(gcs2.start())
         try:
-            assert gcs2.kv.get("t", {}).get("k1") == b"v1"
-            assert gcs2.kv.get("t", {}).get("k2") == b"v2", (
+            assert gcs2.kvstore.get("t", "k1") == b"v1"
+            assert gcs2.kvstore.get("t", "k2") == b"v2", (
                 "second mutation lost: WAL replay failed")
             assert aid in gcs2.actors, "actor registration lost"
             assert gcs2.named_actors.get("wal_actor") == aid

@@ -242,3 +242,40 @@ def test_heap_profile_live_worker(rt):
     assert snap and "current_bytes" in snap and isinstance(snap["top"], list)
     assert state.get_heap_profile(wid, action="stop") == {"tracing": False}
     assert ray_tpu.get(ref, timeout=120) == 40
+
+
+def test_cpu_profile_flamegraph(rt):
+    """Sampled CPU profile (the py-spy record role, in-process sampler):
+    folded stacks catch the busy function; speedscope render validates."""
+    import time as _t
+
+    @ray_tpu.remote
+    def spinner():
+        import time
+
+        end = time.monotonic() + 8.0
+        x = 0
+        while time.monotonic() < end:
+            x += 1
+        return x
+
+    ref = spinner.remote()
+    workers = []
+    deadline = _t.time() + 20
+    while _t.time() < deadline and not workers:
+        _t.sleep(0.5)
+        workers = [t for t in state.list_tasks()
+                   if t.get("name") == "spinner" and t.get("worker_id")
+                   and t.get("state") == "RUNNING"]
+    assert workers, state.list_tasks()
+    wid = workers[-1]["worker_id"]
+    prof = state.get_cpu_profile(wid, duration_s=1.0, interval_s=0.02)
+    assert prof and prof["samples"] > 10, prof
+    joined = "\n".join(prof["folded"])
+    assert "spinner" in joined, joined[:2000]
+    sps = state.get_cpu_profile(wid, duration_s=0.3, format="speedscope")
+    assert sps["profiles"][0]["type"] == "sampled"
+    assert sps["shared"]["frames"], sps
+    assert len(sps["profiles"][0]["samples"]) == \
+        len(sps["profiles"][0]["weights"])
+    assert ray_tpu.get(ref, timeout=120) > 0

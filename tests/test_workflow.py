@@ -139,3 +139,57 @@ def test_parallel_branches_actually_parallel(rt):
     assert out == [1, 2, 3]
     # 3 x 1s steps sequentially would be >= 3s; parallel ~1s + overhead
     assert elapsed < 2.8, f"branches did not run in parallel: {elapsed:.1f}s"
+
+
+# --------------------------------------------------------------------- events
+def test_wait_for_event_kv(rt):
+    """wait_for_event blocks a branch until send_event posts the payload
+    (ref: api.py wait_for_event:380 + the HTTP event provider role)."""
+    import threading
+    import time as _time
+
+    @workflow.step
+    def combine(ev, x):
+        return (ev, x)
+
+    @workflow.step
+    def fast(v):
+        return v * 2
+
+    dag = combine.bind(
+        workflow.wait_for_event(workflow.KVEventListener, "go-signal",
+                                poll_interval_s=0.05, timeout_s=30),
+        fast.bind(21))
+
+    def poke():
+        _time.sleep(1.0)
+        workflow.send_event("go-signal", {"msg": "launch"})
+
+    t = threading.Thread(target=poke, daemon=True)
+    t.start()
+    out = workflow.run(dag, workflow_id="ev1")
+    t.join()
+    assert out == ({"msg": "launch"}, 42)
+
+    # the consumed event is checkpointed: resume does NOT re-poll (the KV
+    # entry still exists, but even with no sender a re-run short-circuits)
+    assert workflow.resume("ev1") == ({"msg": "launch"}, 42)
+
+
+def test_wait_for_event_timer_and_timeout(rt):
+    @workflow.step
+    def done(v):
+        return v
+
+    out = workflow.run(
+        done.bind(workflow.wait_for_event(workflow.TimerListener, 0.2)),
+        workflow_id="ev-timer")
+    assert out == 0.2
+
+    with pytest.raises(Exception):  # TimeoutError surfaces as task error
+        workflow.run(
+            done.bind(workflow.wait_for_event(
+                workflow.KVEventListener, "never-sent",
+                poll_interval_s=0.05, timeout_s=0.5)),
+            workflow_id="ev-timeout")
+    assert workflow.get_status("ev-timeout") == "FAILED"
