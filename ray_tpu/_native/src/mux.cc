@@ -118,7 +118,9 @@ void parse_frames(Mux* m, Conn* c) {
   while (c->inbuf.size() - off >= 8) {
     uint64_t len;
     memcpy(&len, c->inbuf.data() + off, 8);
-    if (len > kMaxFrame) {  // protocol violation: hang up
+    if (len >= kMaxFrame) {  // protocol violation: hang up (>= : a frame
+                             // of exactly 2^32 would wrap the u32 batch
+                             // header length and desync the drain parser)
       drop_conn(m, c);
       return;
     }

@@ -91,6 +91,11 @@ class Config:
     #: streams (fan-in: N clients never serialize through per-connection
     #: reader coroutines); falls back to asyncio if the build is missing
     native_mux_enabled: bool = True
+    #: the mux only engages on hosts with at least this many cores: its
+    #: IO thread runs CONCURRENTLY with Python (the entire win), but on a
+    #: 1-2 core host that thread and its eventfd wakes just preempt the
+    #: interpreter — measured 25-35% slower there, faster with spare cores
+    native_mux_min_cpus: int = 4
 
     # --- tracing (ref: util/tracing/tracing_helper.py span injection) ---
     #: propagate span contexts through task specs and record spans into

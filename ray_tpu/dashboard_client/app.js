@@ -83,6 +83,7 @@ function wireTable(container, t) {
 
 /* ---- metric history for sparklines ----------------------------------- */
 const history = {};  // name|tag -> [values]
+let latestMetrics = {};  // last /api/metrics payload (fetched once per render)
 function pushHistory(name, tag, v) {
   const k = name + "|" + tag;
   (history[k] = history[k] || []).push(Number(v) || 0);
@@ -112,11 +113,11 @@ const views = {};
 let detail = null;  // {view, render: async () => html} overlay state
 
 views.overview = async () => {
-  const [nodes, summary, actors, objects, metrics] = await Promise.all([
+  const [nodes, summary, actors, objects] = await Promise.all([
     fetchJSON("/api/cluster"), fetchJSON("/api/summary/tasks"),
     fetchJSON("/api/actors"), fetchJSON("/api/objects"),
-    fetchJSON("/api/metrics"),
   ]);
+  const metrics = latestMetrics;  // render() preamble already fetched it
   const alive = nodes.filter((n) => n.alive).length;
   const actorsAlive = actors.filter((a) => a.state === "ALIVE").length;
   const st = byState(summary);
@@ -304,7 +305,7 @@ views.serve = async () => {
 };
 
 views.metrics = async () => {
-  const metrics = await fetchJSON("/api/metrics");
+  const metrics = latestMetrics;  // render() preamble already fetched it
   let h = `<h1>Metrics</h1>
     <div class="muted-note">sparklines accumulate client-side while this page is open ·
     <a class="inline" href="/metrics" target="_blank">prometheus endpoint</a></div>`;
@@ -395,10 +396,12 @@ async function render() {
   document.querySelectorAll("#nav a").forEach((a) =>
     a.classList.toggle("active", a.dataset.view === name));
   try {
-    // feed metric history every cycle regardless of view
+    // ONE metrics fetch per cycle: feeds the sparkline history AND the
+    // overview/metrics views (they read latestMetrics instead of
+    // re-fetching)
     try {
-      const metrics = await fetchJSON("/api/metrics");
-      for (const [k, m] of Object.entries(metrics))
+      latestMetrics = await fetchJSON("/api/metrics");
+      for (const [k, m] of Object.entries(latestMetrics))
         if (m.type !== "histogram")
           for (const [tag, v] of Object.entries(m.values || {})) pushHistory(k, tag, v);
     } catch (e) { /* metrics optional */ }

@@ -148,10 +148,14 @@ def read_map_fusion(plan: "ex.Plan") -> "ex.Plan":
                    plan.ops[1:])
 
 
+# projection BEFORE limit pushdown: limit_pushdown would otherwise swap a
+# trailing limit in front of a leading select_columns (it preserves rows),
+# after which select is no longer ops[0] and the parquet projection never
+# fires — reading every column the select exists to drop
 RULES: tuple = (
     eliminate_redundant,
-    limit_pushdown,
     projection_pushdown,
+    limit_pushdown,
     map_fusion,
     read_map_fusion,
 )

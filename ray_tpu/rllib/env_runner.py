@@ -77,10 +77,16 @@ class EnvRunner:
             mobs = self._module_obs(self.obs)
             action, logp, value = sample_action(self.params, mobs, key)
             action = np.asarray(action)
+            # the env gets the connector-processed (e.g. clipped) action,
+            # but the rollout stores the SAMPLED one — logp corresponds to
+            # the sample, and a clipped action under the sampled logp
+            # would bias PPO importance ratios (ref: RLlib trains on the
+            # unclipped action, sends the clipped one to the env)
+            env_action = action
             if self.module_to_env is not None:
-                action = np.asarray(
+                env_action = np.asarray(
                     self.module_to_env(action, self._m2e_ctx))
-            next_obs, reward, term, trunc, _ = self.envs.step(action)
+            next_obs, reward, term, trunc, _ = self.envs.step(env_action)
             done = np.logical_or(term, trunc)
             obs_l.append(mobs)
             act_l.append(action)

@@ -742,11 +742,18 @@ class NativeRpcServer(RpcServer):
 
 
 def make_server(host: str = "127.0.0.1", port: int = 0) -> RpcServer:
-    """Control-plane server factory: the native mux when enabled and
-    buildable, else the asyncio server (identical dispatch surface)."""
+    """Control-plane server factory: the native mux when enabled, the
+    host has cores to run its IO thread CONCURRENTLY with Python
+    (native_mux_min_cpus — on a 1-core host the thread only preempts the
+    interpreter), and the build succeeds; else the asyncio server
+    (identical dispatch surface). RT_NATIVE_MUX_MIN_CPUS=1 forces it on."""
+    import os as _os
+
     from ray_tpu.config import get_config
 
-    if get_config().native_mux_enabled:
+    cfg = get_config()
+    if (cfg.native_mux_enabled
+            and (_os.cpu_count() or 1) >= cfg.native_mux_min_cpus):
         try:
             from ray_tpu import _native
 

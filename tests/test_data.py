@@ -514,3 +514,21 @@ def test_sort_sort_keeps_stable_tiebreak(rt):
     for b in (0, 1):
         sub = [r["a"] for r in got if r["b"] == b]
         assert sub == sorted(sub), got
+
+
+def test_projection_pushdown_survives_trailing_limit(rt, tmp_path):
+    """limit_pushdown must not defeat projection_pushdown: with
+    select_columns().limit(), the parquet read still projects."""
+    import pandas as pd
+
+    from ray_tpu import data
+    from ray_tpu.data.optimizer import optimize
+
+    pd.DataFrame({"a": range(20), "b": range(20), "c": range(20)}).to_parquet(
+        tmp_path / "p.parquet")
+    ds = (data.read_parquet(str(tmp_path / "p.parquet"))
+          .select_columns(["a", "c"]).limit(5))
+    phys = optimize(ds._plan)
+    assert phys.read_tasks[0].columns == ["a", "c"], phys.read_tasks[0].columns
+    rows = ds.take_all()
+    assert len(rows) == 5 and set(rows[0]) == {"a", "c"}

@@ -1,0 +1,173 @@
+"""Native epoll RPC mux tests (_native/src/mux.cc via
+rpc.NativeRpcServer) — forced on regardless of core count so the native
+transport stays covered on 1-CPU CI hosts (ref role: grpc_server.h:88
+completion-queue threads)."""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.utils import rpc
+
+
+@pytest.fixture
+def loop_thread():
+    io = rpc.EventLoopThread("mux-test")
+    yield io
+    io.stop()
+
+
+def _start_server(io, handlers):
+    async def go():
+        server = rpc.NativeRpcServer("127.0.0.1", 0)
+        for name, fn in handlers.items():
+            server._handlers[name] = fn
+        host, port = await server.start()
+        rpc._LOCAL_SERVERS.pop((host, port), None)  # force the TCP path
+        return server, host, port
+
+    return io.run(go())
+
+
+def test_mux_calls_and_concurrency(loop_thread):
+    io = loop_thread
+    calls = []
+
+    async def echo(conn, p):
+        calls.append(p)
+        return {"echo": p}
+
+    async def boom(conn, p):
+        raise ValueError("kaboom")
+
+    server, host, port = _start_server(io, {"echo": echo, "boom": boom})
+    try:
+        async def client():
+            conn = await rpc.connect(host, port)
+            out = await asyncio.gather(
+                *[conn.call("echo", {"i": i}) for i in range(200)])
+            assert [o["echo"]["i"] for o in out] == list(range(200))
+            with pytest.raises(ValueError, match="kaboom"):
+                await conn.call("boom", {})
+            # big payload: exceeds the 1MB initial drain buffer
+            big = np.random.bytes(3 * 1024 * 1024)
+            assert (await conn.call("echo", {"blob": big}))["echo"]["blob"] == big
+            await conn.close()
+
+        io.run(client(), timeout=60)
+        assert len(calls) == 201
+    finally:
+        io.run(server.stop())
+
+
+def test_mux_many_clients_fan_in(loop_thread):
+    """N threads, each its own TCP connection + loop, hammering one mux
+    server — the fan-in shape the asyncio transport serialized."""
+    io = loop_thread
+    total = 0
+    lock = threading.Lock()
+
+    async def bump(conn, p):
+        nonlocal total
+        with lock:
+            total += p["n"]
+        return total
+
+    server, host, port = _start_server(io, {"bump": bump})
+    try:
+        def client_thread():
+            cio = rpc.EventLoopThread("mux-client")
+            try:
+                async def run():
+                    conn = await rpc.connect(host, port)
+                    for _ in range(50):
+                        await conn.call("bump", {"n": 1})
+                    await conn.close()
+
+                cio.run(run(), timeout=60)
+            finally:
+                cio.stop()
+
+        threads = [threading.Thread(target=client_thread) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert total == 6 * 50
+    finally:
+        io.run(server.stop())
+
+
+def test_mux_disconnect_and_server_push(loop_thread):
+    io = loop_thread
+    events = []
+    subs = []
+
+    async def subscribe(conn, p):
+        subs.append(conn)
+        return True
+
+    server, host, port = _start_server(io, {"subscribe": subscribe})
+    server.on_disconnect = lambda conn: events.append("gone")
+    try:
+        got = []
+
+        async def client():
+            conn = await rpc.connect(host, port)
+            conn.on_message = lambda msg: got.append(msg)
+            await conn.call("subscribe", {})
+            # server-initiated push on the accepted (mux) connection
+            await asyncio.sleep(0.1)
+            return conn
+
+        conn = io.run(client(), timeout=30)
+
+        async def push():
+            await subs[0].notify("tick", {"x": 1})
+
+        io.run(push(), timeout=30)
+
+        async def wait_push():
+            for _ in range(100):
+                if got:
+                    return
+                await asyncio.sleep(0.02)
+
+        io.run(wait_push(), timeout=30)
+        assert got and got[0]["m"] == "tick" and got[0]["p"] == {"x": 1}
+
+        io.run(conn.close(), timeout=30)
+
+        async def wait_gone():
+            for _ in range(200):
+                if events:
+                    return
+                await asyncio.sleep(0.02)
+
+        io.run(wait_gone(), timeout=30)
+        assert events == ["gone"]
+        # sends to the dead conn fail cleanly, no crash / wrong-socket write
+        async def dead_send():
+            with pytest.raises(rpc.ConnectionLost):
+                subs[0].send_nowait({"k": "n", "m": "tick", "p": None})
+
+        io.run(dead_send(), timeout=30)
+    finally:
+        io.run(server.stop())
+
+
+def test_make_server_core_gate(monkeypatch):
+    """On hosts below native_mux_min_cpus the factory returns the asyncio
+    server; forcing the floor to 1 yields the mux."""
+    from ray_tpu import config as config_mod
+
+    monkeypatch.setenv("RT_NATIVE_MUX_MIN_CPUS", "99")
+    config_mod.set_config(config_mod.Config.from_env())
+    assert type(rpc.make_server()) is rpc.RpcServer
+    monkeypatch.setenv("RT_NATIVE_MUX_MIN_CPUS", "1")
+    config_mod.set_config(config_mod.Config.from_env())
+    assert type(rpc.make_server()) is rpc.NativeRpcServer
+    monkeypatch.delenv("RT_NATIVE_MUX_MIN_CPUS")
+    config_mod.set_config(config_mod.Config.from_env())
