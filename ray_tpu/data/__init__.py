@@ -22,7 +22,9 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_csv,
     read_json,
     read_numpy,
+    read_images,
     read_parquet,
+    read_sql,
     read_text,
 )
 from ray_tpu.data.dataset import range as _range
@@ -46,6 +48,8 @@ __all__ = [
     "read_csv",
     "read_json",
     "read_numpy",
+    "read_images",
     "read_parquet",
+    "read_sql",
     "read_text",
 ]

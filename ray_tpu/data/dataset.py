@@ -244,6 +244,112 @@ class Dataset:
 
         return Dataset(Plan([], (InjectRefs("join", out_refs),)))
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two datasets with equal row counts (ref:
+        dataset.py zip; right-side name collisions take a ``_1`` suffix).
+        A barrier over REFS only: right blocks are sliced to the left's
+        block boundaries and each aligned pair zips in its own task —
+        the driver never materializes a row."""
+        from ray_tpu.data.executor import (InjectRefs, _count_rows,
+                                           _slice_block)
+
+        left_refs = list(self.iter_block_refs())
+        right_refs = list(other.iter_block_refs())
+        lcounts = ray_tpu.get([_count_rows.remote(r) for r in left_refs])
+        rcounts = ray_tpu.get([_count_rows.remote(r) for r in right_refs])
+        if sum(lcounts) != sum(rcounts):
+            raise ValueError(
+                f"zip requires equal row counts: "
+                f"{sum(lcounts)} vs {sum(rcounts)}")
+
+        @ray_tpu.remote
+        def zip_blocks(lblock, *rparts):
+            lacc = BlockAccessor.for_block(lblock)
+            racc = BlockAccessor.for_block(BlockAccessor.concat(list(rparts)))
+            out = []
+            for lr, rr in builtins.zip(lacc.rows(), racc.rows()):
+                row = dict(lr)
+                for k, v in rr.items():
+                    row[k + "_1" if k in row else k] = v
+                out.append(row)
+            return rows_to_columns(out) if out else []
+
+        # walk right blocks, carving each left block's row range
+        out_refs = []
+        ri = 0       # current right block
+        roff = 0     # rows of right block ri already consumed
+        for lref, need in builtins.zip(left_refs, lcounts):
+            parts = []
+            remaining = need
+            while remaining > 0:
+                avail = rcounts[ri] - roff
+                take = builtins.min(avail, remaining)
+                if take == rcounts[ri] and roff == 0:
+                    parts.append(right_refs[ri])
+                else:
+                    parts.append(_slice_block.remote(
+                        right_refs[ri], roff, roff + take))
+                roff += take
+                remaining -= take
+                if roff == rcounts[ri]:
+                    ri += 1
+                    roff = 0
+            out_refs.append(zip_blocks.remote(lref, *parts))
+        return Dataset(Plan([], (InjectRefs("zip", out_refs),)))
+
+    def unique(self, column: str) -> list:
+        """Distinct values of one column (ref: dataset.py unique) —
+        per-block set on the workers, one merge here."""
+        @ray_tpu.remote
+        def block_unique(block):
+            acc = BlockAccessor.for_block(block)
+            if acc.is_tabular():
+                return set(np.unique(acc.column(column)).tolist())
+            return {r[column] for r in acc.rows()}
+
+        sets = ray_tpu.get(
+            [block_unique.remote(r) for r in self.iter_block_refs()])
+        out: set = set()
+        for s in sets:
+            out |= s
+        return sorted(out, key=str)
+
+    def random_sample(self, fraction: float, *, seed: int | None = None
+                      ) -> "Dataset":
+        """Bernoulli row sample (ref: dataset.py random_sample)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError("fraction must be in [0, 1]")
+
+        def apply(block, index, _f=fraction, _s=seed):
+            acc = BlockAccessor.for_block(block)
+            n = acc.num_rows()
+            # per-block seed from the STREAM INDEX: deterministic under a
+            # fixed seed, and distinct across blocks even when their row
+            # counts are identical (equal-sized blocks would otherwise
+            # draw identical masks — a correlated, biased sample)
+            rs = np.random.RandomState(
+                None if _s is None else (_s * 7919 + index) % (2**31))
+            return acc.take(np.nonzero(rs.random_sample(n) < _f)[0])
+
+        return Dataset(self._plan.with_op(
+            MapBlocks("random_sample", apply, indexed=True)))
+
+    def columns(self) -> list[str] | None:
+        """Column names of the first non-empty block (ref: Dataset.columns);
+        None for non-record datasets (plain item lists)."""
+        for block in self.iter_blocks():
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows():
+                if acc.is_tabular():
+                    return list(acc.column_names())
+                first = next(iter(acc.rows()))
+                return list(first) if isinstance(first, dict) else None
+        return None
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
     def union(self, other: "Dataset") -> "Dataset":
         if self._plan.ops or other._plan.ops:
             # materialize both sides into read tasks
@@ -908,6 +1014,56 @@ def read_numpy(paths) -> Dataset:
         return lambda: {"data": np.load(path)}
 
     return Dataset(Plan([make(p) for p in files]))
+
+
+def read_images(paths, *, size: tuple[int, int] | None = None,
+                mode: str | None = None,
+                include_paths: bool = False) -> Dataset:
+    """One row per image: {"image": HxWxC uint8 array[, "path"]} (ref:
+    read_api.py read_images). ``size=(h, w)`` resizes; ``mode`` converts
+    (e.g. "RGB", "L")."""
+    files = _expand_paths(paths)
+
+    def make(path):
+        def read():
+            from PIL import Image
+
+            img = Image.open(path)
+            if mode:
+                img = img.convert(mode)
+            if size:
+                img = img.resize((size[1], size[0]))
+            row = {"image": np.asarray(img)}
+            if include_paths:
+                row["path"] = path
+            return [row]
+
+        return read
+
+    return Dataset(Plan([make(p) for p in files]))
+
+
+def read_sql(sql: str, connection_factory: Callable) -> Dataset:
+    """Rows from any DB-API connection (ref: read_api.py read_sql —
+    there over a connector zoo; here the caller supplies the
+    ``connection_factory`` so sqlite3/psycopg/etc. all work the same).
+    One read task executes the query on a worker (not the driver);
+    ``.repartition(n)`` afterwards for downstream parallelism."""
+    def read():
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(sql)
+            if cur.description is None:
+                raise ValueError(
+                    "read_sql requires a statement that returns rows "
+                    "(cursor.description is None — DDL/INSERT?)")
+            cols = [d[0] for d in cur.description]
+            return [dict(builtins.zip(cols, row)) for row in cur.fetchall()]
+        finally:
+            conn.close()
+
+    return Dataset(Plan([read]))
 
 
 def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:

@@ -41,6 +41,11 @@ def _apply_op(fn, block) -> Any:
 
 
 @ray_tpu.remote
+def _apply_op_indexed(fn, index, block) -> Any:
+    return normalize_block(fn(block, index))
+
+
+@ray_tpu.remote
 def _count_rows(block) -> int:
     return BlockAccessor.for_block(block).num_rows()
 
@@ -96,7 +101,7 @@ class MapBlocks(Operator):
     (ref: execution/operators/map_operator.py)."""
 
     def __init__(self, name: str, fn: Callable, max_in_flight: int | None = None,
-                 preserves_rows: bool = False):
+                 preserves_rows: bool = False, indexed: bool = False):
         self.name = name
         self.fn = fn
         self.max_in_flight = max_in_flight or DEFAULT_MAX_IN_FLIGHT
@@ -104,15 +109,20 @@ class MapBlocks(Operator):
         # emits exactly one output row per input row (map, add_column,
         # select_columns — NOT filter/flat_map/map_batches)
         self.preserves_rows = preserves_rows
+        # indexed ops receive (block, stream_index) — per-block seeds etc.
+        self.indexed = indexed
 
     def transform(self, refs, stats):
         inflight: collections.deque = collections.deque()
         t0 = time.perf_counter()
         try:
-            for ref in refs:
+            for i, ref in enumerate(refs):
                 while len(inflight) >= self.max_in_flight:
                     yield inflight.popleft()  # ordered: wait for the head
-                inflight.append(_apply_op.remote(self.fn, ref))
+                if self.indexed:
+                    inflight.append(_apply_op_indexed.remote(self.fn, i, ref))
+                else:
+                    inflight.append(_apply_op.remote(self.fn, ref))
                 stats.tasks += 1
             while inflight:
                 yield inflight.popleft()

@@ -30,7 +30,9 @@ from ray_tpu.data import executor as ex
 
 
 def _is_map(op) -> bool:
-    return type(op) is ex.MapBlocks
+    # indexed maps take (block, stream_index) — excluded from fusion,
+    # whose composed fns assume the plain (block) signature
+    return type(op) is ex.MapBlocks and not getattr(op, "indexed", False)
 
 
 def eliminate_redundant(plan: "ex.Plan") -> "ex.Plan":

@@ -1,0 +1,164 @@
+// Concurrency stress for the shm task rings (_native/src/ring.cc) —
+// the fast-path transport every steady-state submit/reply rides.
+// Run under plain / ThreadSanitizer / AddressSanitizer builds (ref:
+// .bazelrc tsan/asan configs role; see tests/test_store_tsan.py).
+//
+// Shape: two processes' roles in one binary — a driver thread pushing
+// framed records into SUB and popping REP, a worker thread popping SUB
+// batches and pushing replies into REP — both directions concurrently,
+// with a mid-run close phase to exercise shutdown-under-load. The
+// protocol is SPSC per direction; this harness honors that (one
+// producer + one consumer per ring) while TSAN checks the mutex/cond +
+// shared-header discipline and ASAN checks the copy windows.
+//
+// Usage: ring_stress <shm-name> <seconds>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_ring_pair_create(const char* name, uint64_t cap_each);
+void* rt_ring_pair_open(const char* name);
+int rt_ring_push(void* h, int which, const uint8_t* buf, uint64_t len,
+                 int64_t timeout_ms);
+int64_t rt_ring_pop_batch(void* h, int which, uint8_t* out, uint64_t outcap,
+                          int64_t timeout_ms);
+uint64_t rt_ring_pending(void* h, int which);
+void rt_ring_close(void* h, int which);
+int rt_ring_closed(void* h, int which);
+void rt_ring_pair_close(void* h);
+void rt_ring_pair_destroy(const char* name);
+}
+
+namespace {
+
+constexpr int SUB = 0, REP = 1;
+constexpr uint64_t kCap = 256 * 1024;
+constexpr size_t kPopBuf = 1 << 20;
+
+std::atomic<long> failures{0};
+std::atomic<bool> stop_flag{false};
+
+void fail(const char* what) {
+  fprintf(stderr, "FAIL: %s\n", what);
+  failures.fetch_add(1);
+}
+
+// parse [u32 len][payload][pad to 8] frames; return record payload sums
+void unframe_accumulate(const uint8_t* buf, int64_t n, uint64_t* count,
+                        uint64_t* bytes, uint64_t* checksum) {
+  int64_t off = 0;
+  while (off + 4 <= n) {
+    uint32_t len;
+    memcpy(&len, buf + off, 4);
+    if (off + 4 + len > n) {
+      fail("truncated record in pop buffer");
+      return;
+    }
+    (*count)++;
+    (*bytes) += len;
+    for (uint32_t i = 0; i < len; i++) (*checksum) += buf[off + 4 + i];
+    off += (4 + (int64_t)len + 7) & ~7ll;
+  }
+}
+
+struct Side {
+  uint64_t pushed = 0, push_bytes = 0, push_sum = 0;
+  uint64_t popped = 0, pop_bytes = 0, pop_sum = 0;
+};
+
+void producer(void* h, int which, Side* s, unsigned seed) {
+  std::vector<uint8_t> rec(2048);
+  while (!stop_flag.load(std::memory_order_relaxed)) {
+    uint64_t len = 1 + (seed = seed * 1103515245 + 12345) % 1500;
+    for (uint64_t i = 0; i < len; i++) rec[i] = (uint8_t)(seed + i);
+    int st = rt_ring_push(h, which, rec.data(), len, 50);
+    if (st == 0) {
+      s->pushed++;
+      s->push_bytes += len;
+      for (uint64_t i = 0; i < len; i++) s->push_sum += rec[i];
+    } else if (st == -7) {  // closed
+      return;
+    } else if (st != -4) {  // -4 = timeout (ok under contention)
+      fail("unexpected push status");
+      return;
+    }
+  }
+}
+
+void consumer(void* h, int which, Side* s) {
+  std::vector<uint8_t> buf(kPopBuf);
+  for (;;) {
+    int64_t n = rt_ring_pop_batch(h, which, buf.data(), buf.size(), 50);
+    if (n == -7) return;  // closed AND drained
+    if (n < 0) {          // kSys / kTooBig — genuine protocol errors
+      fail("unexpected pop status");
+      return;
+    }
+    if (n > 0) unframe_accumulate(buf.data(), n, &s->popped, &s->pop_bytes,
+                                  &s->pop_sum);
+    // n == 0: timeout — loop (drain continues until -7 after close)
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: ring_stress <shm-name> <seconds>\n");
+    return 2;
+  }
+  const char* name = argv[1];
+  double seconds = atof(argv[2]);
+  rt_ring_pair_destroy(name);
+
+  void* creator = rt_ring_pair_create(name, kCap);
+  void* opener = rt_ring_pair_open(name);
+  if (!creator || !opener) {
+    fail("create/open");
+    return 1;
+  }
+
+  Side sub, rep;
+  // driver: produces SUB on the creator mapping, consumes REP
+  // worker: consumes SUB on the opener mapping, produces REP
+  std::thread t_sub_prod(producer, creator, SUB, &sub, 1u);
+  std::thread t_sub_cons(consumer, opener, SUB, &sub);
+  std::thread t_rep_prod(producer, opener, REP, &rep, 99u);
+  std::thread t_rep_cons(consumer, creator, REP, &rep);
+
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((long)(seconds * 1000)));
+  stop_flag.store(true);
+  // close-under-load: producers stop, consumers must drain to -7
+  rt_ring_close(creator, SUB);
+  rt_ring_close(opener, REP);
+  t_sub_prod.join();
+  t_rep_prod.join();
+  t_sub_cons.join();
+  t_rep_cons.join();
+
+  if (sub.popped != sub.pushed || sub.pop_bytes != sub.push_bytes ||
+      sub.pop_sum != sub.push_sum)
+    fail("SUB count/bytes/checksum mismatch after drain");
+  if (rep.popped != rep.pushed || rep.pop_bytes != rep.push_bytes ||
+      rep.pop_sum != rep.push_sum)
+    fail("REP count/bytes/checksum mismatch after drain");
+  if (sub.pushed == 0 || rep.pushed == 0) fail("no traffic moved");
+
+  rt_ring_pair_close(opener);
+  rt_ring_pair_close(creator);
+  rt_ring_pair_destroy(name);
+
+  printf("sub=%llu rep=%llu failures=%ld\n",
+         (unsigned long long)sub.pushed, (unsigned long long)rep.pushed,
+         failures.load());
+  return failures.load() ? 1 : 0;
+}

@@ -532,3 +532,87 @@ def test_projection_pushdown_survives_trailing_limit(rt, tmp_path):
     assert phys.read_tasks[0].columns == ["a", "c"], phys.read_tasks[0].columns
     rows = ds.take_all()
     assert len(rows) == 5 and set(rows[0]) == {"a", "c"}
+
+
+def test_zip_unique_sample_columns(rt):
+    from ray_tpu import data
+
+    a = data.from_items([{"x": i} for i in range(10)], parallelism=2)
+    b = data.from_items([{"y": i * 10} for i in range(10)], parallelism=3)
+    z = a.zip(b).take_all()
+    assert [(r["x"], r["y"]) for r in z] == [(i, i * 10) for i in range(10)]
+    # collision takes the _1 suffix
+    c = data.from_items([{"x": -i} for i in range(10)], parallelism=2)
+    zz = a.zip(c).take_all()
+    assert zz[3]["x"] == 3 and zz[3]["x_1"] == -3
+    with pytest.raises(ValueError, match="equal row counts"):
+        a.zip(data.from_items([{"y": 1}]))
+
+    ds = data.from_items([{"g": i % 4, "v": i} for i in range(40)],
+                         parallelism=4)
+    assert ds.unique("g") == [0, 1, 2, 3]
+    assert ds.columns() == ["g", "v"]
+
+    sampled = data.range(2000).random_sample(0.25, seed=1)
+    n = sampled.count()
+    assert 350 < n < 650, n
+    # deterministic under a fixed seed
+    assert sampled.count() == n
+
+
+def test_read_images_and_sql(rt, tmp_path):
+    from PIL import Image
+
+    from ray_tpu import data
+
+    for i in range(3):
+        Image.fromarray(
+            (np.ones((8, 6, 3)) * i * 40).astype(np.uint8)).save(
+            tmp_path / f"im{i}.png")
+    ds = data.read_images(str(tmp_path / "*.png"), size=(4, 4), mode="L",
+                          include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 3
+    assert rows[0]["image"].shape == (4, 4)
+    assert rows[1]["path"].endswith("im1.png")
+
+    import sqlite3
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a INT, b TEXT)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, f"s{i}") for i in range(5)])
+    conn.commit()
+    conn.close()
+    out = data.read_sql("SELECT a, b FROM t ORDER BY a",
+                        lambda: sqlite3.connect(db)).take_all()
+    assert out == [{"a": i, "b": f"s{i}"} for i in range(5)]
+
+
+def test_random_sample_blocks_decorrelated(rt):
+    """Equal-sized blocks must not draw identical masks (per-block seed
+    comes from the stream index, not the row count)."""
+    from ray_tpu import data
+
+    from ray_tpu.data import BlockAccessor
+
+    ds = data.range(400, parallelism=4).random_sample(0.5, seed=3)
+    sets = []
+    for b in ds.iter_blocks():
+        ids = np.asarray(BlockAccessor.for_block(b).column("id"))
+        sets.append(set((ids % 100).tolist()))  # in-block positions
+    assert len(sets) == 4
+    assert any(sets[0] != s for s in sets[1:]), "identical masks across blocks"
+
+
+def test_read_sql_non_query_raises(rt, tmp_path):
+    import sqlite3
+
+    from ray_tpu import data
+
+    db = str(tmp_path / "x.db")
+    sqlite3.connect(db).close()
+    with pytest.raises(Exception, match="returns rows"):
+        data.read_sql("CREATE TABLE t (a INT)",
+                      lambda: sqlite3.connect(db)).take_all()

@@ -163,11 +163,15 @@ def test_make_server_core_gate(monkeypatch):
     server; forcing the floor to 1 yields the mux."""
     from ray_tpu import config as config_mod
 
-    monkeypatch.setenv("RT_NATIVE_MUX_MIN_CPUS", "99")
-    config_mod.set_config(config_mod.Config.from_env())
-    assert type(rpc.make_server()) is rpc.RpcServer
-    monkeypatch.setenv("RT_NATIVE_MUX_MIN_CPUS", "1")
-    config_mod.set_config(config_mod.Config.from_env())
-    assert type(rpc.make_server()) is rpc.NativeRpcServer
-    monkeypatch.delenv("RT_NATIVE_MUX_MIN_CPUS")
-    config_mod.set_config(config_mod.Config.from_env())
+    try:
+        monkeypatch.setenv("RT_NATIVE_MUX_MIN_CPUS", "99")
+        config_mod.set_config(config_mod.Config.from_env())
+        assert type(rpc.make_server()) is rpc.RpcServer
+        monkeypatch.setenv("RT_NATIVE_MUX_MIN_CPUS", "1")
+        config_mod.set_config(config_mod.Config.from_env())
+        assert type(rpc.make_server()) is rpc.NativeRpcServer
+    finally:
+        # restore the process-global config even when an assert fails —
+        # a leaked min_cpus would flip the transport for every later test
+        monkeypatch.delenv("RT_NATIVE_MUX_MIN_CPUS", raising=False)
+        config_mod.set_config(config_mod.Config.from_env())

@@ -48,3 +48,65 @@ def test_store_stress_tsan():
     assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr[:4000]
     assert out.returncode == 0, (out.stdout, out.stderr[:4000])
     assert "failures=0" in out.stdout
+
+
+def test_store_stress_asan():
+    """AddressSanitizer + LeakSanitizer over the same stress harness (ref:
+    .bazelrc asan configs role): heap/stack/global overflows and leaks in
+    the store's native paths fail the test."""
+    binary, err = _build(["-fsanitize=address"], "store_stress_asan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=address: {err[-200:]}")
+    out = subprocess.run([binary, f"rt_asan_{os.getpid()}", "1.5"],
+                         capture_output=True, text=True, timeout=300)
+    assert "ERROR: AddressSanitizer" not in out.stderr, out.stderr[:4000]
+    assert "ERROR: LeakSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
+
+
+def _build_ring(flags, out_name):
+    os.makedirs(BUILD, exist_ok=True)
+    out = os.path.join(BUILD, out_name)
+    cmd = ["g++", "-std=c++17", "-O1", "-g", *flags,
+           "-o", out,
+           os.path.join(HERE, "cpp", "ring_stress.cc"),
+           os.path.join(SRC, "ring.cc"),
+           "-lpthread", "-lrt"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        return None, proc.stderr
+    return out, None
+
+
+def test_ring_stress_plain():
+    """SPSC ring pairs under bidirectional load + close-under-load drain:
+    counts, bytes, and checksums must balance exactly."""
+    binary, err = _build_ring([], "ring_stress_plain")
+    assert binary, err
+    out = subprocess.run([binary, f"/rt_ringst_{os.getpid()}", "2.0"],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "failures=0" in out.stdout
+
+
+def test_ring_stress_tsan():
+    binary, err = _build_ring(["-fsanitize=thread"], "ring_stress_tsan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=thread: {err[-200:]}")
+    out = subprocess.run([binary, f"/rt_ringts_{os.getpid()}", "2.0"],
+                         capture_output=True, text=True, timeout=300)
+    assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
+
+
+def test_ring_stress_asan():
+    binary, err = _build_ring(["-fsanitize=address"], "ring_stress_asan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=address: {err[-200:]}")
+    out = subprocess.run([binary, f"/rt_ringas_{os.getpid()}", "1.5"],
+                         capture_output=True, text=True, timeout=300)
+    assert "ERROR: AddressSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
