@@ -119,6 +119,10 @@ def test_wait(rt):
         time.sleep(2.0)
         return "slow"
 
+    # warm TWO workers first: a cold spawn costs ~3s on a loaded 1-CPU
+    # box, which can otherwise hand `slow` a live worker while `fast`
+    # waits to be forked — inverting the readiness order this asserts
+    rt.get([fast.remote(), fast.remote()], timeout=60)
     f, s = fast.remote(), slow.remote()
     ready, pending = rt.wait([f, s], num_returns=1, timeout=10)
     assert ready == [f]
