@@ -33,8 +33,23 @@ def _shm_bytes():
     return ray_tpu.get_core().store.bytes_in_use
 
 
+def _settled_base():
+    """bytes_in_use once deferred frees from EARLIER tests stop landing:
+    a base sampled mid-drain makes `>= base + N` race a concurrent drop."""
+    gc.collect()
+    last = _shm_bytes()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        time.sleep(0.3)
+        cur = _shm_bytes()
+        if cur == last:
+            return cur
+        last = cur
+    return last
+
+
 def test_put_shm_freed_on_last_ref_drop(rt):
-    base = _shm_bytes()
+    base = _settled_base()
     ref = ray_tpu.put(np.zeros(2 * MB, dtype=np.uint8))
     assert _shm_bytes() >= base + 2 * MB
     del ref
@@ -47,7 +62,7 @@ def test_task_return_shm_freed(rt):
     def big():
         return np.ones(2 * MB, dtype=np.uint8)
 
-    base = _shm_bytes()
+    base = _settled_base()
     ref = big.remote()
     val = ray_tpu.get(ref, timeout=60)
     assert val.nbytes == 2 * MB
