@@ -390,34 +390,47 @@ def run_llm_engine(quick: bool) -> dict:
     prompts = [list(rng.integers(1, cfg.vocab_size, prompt_len))
                for _ in range(n_req)]
 
-    async def go():
+    async def go(kv_dtype, mb, reqs):
         eng = ContinuousBatchingEngine(
-            params, cfg, max_batch=max_batch, page_size=page_size,
-            n_pages=n_pages, max_seq_len=max_seq, max_waiting=512)
+            params, cfg, max_batch=mb, page_size=page_size,
+            n_pages=n_pages, max_seq_len=max_seq, max_waiting=1024,
+            kv_dtype=kv_dtype)
         await eng.start()
         # warm run: compiles prefill buckets + every decode block bucket
         # the measured run will use (first-compile is ~20s/program here)
         await asyncio.gather(
-            *[eng.generate(p, max_tokens=max_tokens) for p in prompts])
+            *[eng.generate(p, max_tokens=max_tokens) for p in reqs])
         best = 0.0
         for _ in range(2):
             tokens0 = eng.tokens_out
             t0 = time.perf_counter()
             await asyncio.gather(
-                *[eng.generate(p, max_tokens=max_tokens) for p in prompts])
+                *[eng.generate(p, max_tokens=max_tokens) for p in reqs])
             dt = time.perf_counter() - t0
             best = max(best, (eng.tokens_out - tokens0) / dt)
         await eng.stop()
         return best
 
-    rate = asyncio.run(go())
-    return {
+    rate = asyncio.run(go(None, max_batch, prompts))
+    out = {
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
         "concurrent_requests": n_req,
         "max_batch": max_batch,
         "decode_tokens_per_s": rate,
     }
+    if on_tpu and not quick:
+        # int8 KV halves the page-table gather bytes — the bottleneck
+        # that capped bf16 at batch 64 — so its knee sits at 128 slots
+        # (r5 sweep: int8 64→10.5k, 128→18.4k, 256→14.3k tok/s vs bf16
+        # 64→5.8k, 128→9.7k same-session)
+        prompts2 = [list(rng.integers(1, cfg.vocab_size, prompt_len))
+                    for _ in range(2 * n_req)]
+        out["decode_tokens_per_s_int8kv"] = asyncio.run(
+            go("int8", 128, prompts2))
+        out["int8kv_max_batch"] = 128
+        out["int8kv_concurrent_requests"] = len(prompts2)
+    return out
 
 
 def write_benchvs(micro: dict, model: dict | None,
@@ -525,6 +538,17 @@ def write_benchvs(micro: dict, model: dict | None,
             "(The reference delegates this engine to vLLM; no comparable "
             "number is checked into its repo.)",
             "",
+            ] + ([
+            f"With the int8 KV cache (`kv_dtype=\"int8\"`, per-token "
+            f"per-kv-head symmetric scales) at its batch-128 knee "
+            f"({llm.get('int8kv_concurrent_requests', '2x')} concurrent "
+            f"requests): "
+            f"**{llm['decode_tokens_per_s_int8kv']:,.0f} tokens/s** — "
+            "the quantized cache halves the page-table gather bytes "
+            "that cap the bf16 cache at batch 64 (~97% greedy-token "
+            "agreement with bf16 on the parity model).",
+            "",
+            ] if "decode_tokens_per_s_int8kv" in llm else []) + [
             "Roofline note: the bench model is ~200M params bf16 "
             "(~0.4 GB). Decode is weight-bandwidth-bound, so tokens/step "
             "scale with batch until the page-table attention gather "

@@ -51,15 +51,50 @@ def _lora_delta(h, loras, name, aid):
 from ray_tpu.llm.generation import _ffn, _gqa_attn  # noqa: E402
 
 
+def _kv_shape(pool):
+    return (pool["q"] if isinstance(pool, dict) else pool).shape
+
+
+def _kv_write(pool, i, row, off, val):
+    """Store new K/V rows; int8 pools ({"q": int8, "s": f32 scales})
+    quantize symmetrically per (token, kv-head) — one scale per hd
+    vector, the granularity that keeps dequant a fused broadcast-mul.
+
+    val: [..., KV, hd] float; row/off index [L, P, PS] positions."""
+    if not isinstance(pool, dict):
+        return pool.at[i, row, off].set(val)
+    s = jnp.max(jnp.abs(val), axis=-1) / 127.0           # [..., KV]
+    # clip BEFORE the int8 cast: low-precision (bf16) scale rounding can
+    # put the max element's quotient at 128, and float->int overflow is
+    # implementation-defined in XLA (saturates here, wraps elsewhere)
+    q = jnp.clip(jnp.round(val / jnp.maximum(s, 1e-8)[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return {"q": pool["q"].at[i, row, off].set(q),
+            "s": pool["s"].at[i, row, off].set(s.astype(jnp.float32))}
+
+
+def _kv_read(pool, i, page_tables, B, MAXP, PS, KV, hd, dtype):
+    """Gather the decode attention window. int8 pools move HALF the HBM
+    bytes of bf16 through the page-table gather (the decode bottleneck
+    past ~64 slots); the scale gather is hd-times smaller — noise."""
+    if not isinstance(pool, dict):
+        return pool[i][page_tables].reshape(B, MAXP * PS, KV, hd)
+    q = pool["q"][i][page_tables].reshape(B, MAXP * PS, KV, hd)
+    s = pool["s"][i][page_tables].reshape(B, MAXP * PS, KV, 1)
+    return q.astype(dtype) * s.astype(dtype)
+
+
 def _decode_body(params, loras, aids, tokens, pos, page_tables,
                  kpool, vpool, active, temps, key, cfg: LlamaConfig):
     """One decode step for every slot (masked where inactive).
 
     tokens: [B] current input token; pos: [B] tokens already cached (the
     new token lands at that position); page_tables: [B, MAXP]; aids: [B]
-    adapter ids; temps: [B]. Returns (next_tok [B], kpool, vpool)."""
+    adapter ids; temps: [B]. Returns (next_tok [B], kpool, vpool).
+    Pools are either plain [L, P, PS, KV, hd] arrays (cfg dtype) or int8
+    quantized dicts (see _kv_write) — the engine's kv_dtype option."""
     B = tokens.shape[0]
-    L, P, PS, KV, hd = kpool.shape
+    L, P, PS, KV, hd = _kv_shape(kpool)
     MAXP = page_tables.shape[1]
     cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = pos[:, None]
@@ -89,10 +124,10 @@ def _decode_body(params, loras, aids, tokens, pos, page_tables,
              ).reshape(B, 1, KV, hd)
         q = rope(q, cos, sin, positions)
         k = rope(k, cos, sin, positions)
-        kpool = kpool.at[i, row, off].set(k[:, 0])
-        vpool = vpool.at[i, row, off].set(v[:, 0])
-        kb = kpool[i][page_tables].reshape(B, MAXP * PS, KV, hd)
-        vb = vpool[i][page_tables].reshape(B, MAXP * PS, KV, hd)
+        kpool = _kv_write(kpool, i, row, off, k[:, 0])
+        vpool = _kv_write(vpool, i, row, off, v[:, 0])
+        kb = _kv_read(kpool, i, page_tables, B, MAXP, PS, KV, hd, k.dtype)
+        vb = _kv_read(vpool, i, page_tables, B, MAXP, PS, KV, hd, v.dtype)
         att = _gqa_attn(q, kb, vb, mask)
         x = x + att.reshape(B, 1, -1) @ layer["wo"]["kernel"]
         hf = rms_norm(x, layer["ffn_norm"]["scale"])
@@ -159,7 +194,7 @@ def paged_prefill_batch(params, loras, aids, tokens, pages, kpool, vpool,
     because small-batch steps are per-op-overhead bound; one fat forward
     amortizes it across the whole wave."""
     N, Tp = tokens.shape
-    L, P, PS, KV, hd = kpool.shape
+    L, P, PS, KV, hd = _kv_shape(kpool)
     cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
     positions = jnp.arange(Tp)[None, :]
     idx = jnp.arange(Tp)
@@ -177,9 +212,10 @@ def paged_prefill_batch(params, loras, aids, tokens, pages, kpool, vpool,
              ).reshape(N, Tp, KV, hd)
         q = rope(q, cos, sin, positions)
         k = rope(k, cos, sin, positions)
-        kpool = kpool.at[i, rows, offs].set(k)
-        vpool = vpool.at[i, rows, offs].set(v)
-        att = _gqa_attn(q, k, v, mask)
+        kpool = _kv_write(kpool, i, rows, offs, k)
+        vpool = _kv_write(vpool, i, rows, offs, v)
+        att = _gqa_attn(q, k, v, mask)  # prefill attends the FRESH k/v:
+        # quantization only affects what later decode steps read back
         x = x + att.reshape(N, Tp, -1) @ layer["wo"]["kernel"]
         x = _ffn(layer, x)
     x = rms_norm(x, params["norm"]["scale"])
@@ -248,7 +284,8 @@ class ContinuousBatchingEngine:
                  max_seq_len: int = 512, eos_id: int | None = None,
                  lora_adapters: dict[str, dict] | None = None,
                  lora_rank: int = 8, max_waiting: int = 256,
-                 block_buckets: tuple[int, ...] = (4, 8, 16, 32, 64)):
+                 block_buckets: tuple[int, ...] = (4, 8, 16, 32, 64),
+                 kv_dtype: str | None = None):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
@@ -262,10 +299,28 @@ class ContinuousBatchingEngine:
         # long generations amortize dispatch 64x
         self.block_buckets = tuple(sorted(block_buckets))
         dtype = jnp.dtype(cfg.dtype)
-        self.kpool = jnp.zeros(
-            (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.head_dim),
-            dtype)
-        self.vpool = jnp.zeros_like(self.kpool)
+        pool_shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
+                      cfg.head_dim)
+        if kv_dtype == "int8":
+            # quantized cache: half the HBM bytes through the decode
+            # page-table gather (the bottleneck past ~64 slots) at the
+            # cost of per-(token, kv-head) symmetric int8 rounding
+            def make_pool():
+                return {"q": jnp.zeros(pool_shape, jnp.int8),
+                        "s": jnp.zeros(pool_shape[:-1], jnp.float32)}
+
+            self.kpool = make_pool()
+            self.vpool = make_pool()
+        elif kv_dtype in (None, "native"):
+            self.kpool = jnp.zeros(pool_shape, dtype)
+            self.vpool = jnp.zeros_like(self.kpool)
+        elif kv_dtype == "bf16":
+            # explicit half-precision cache, regardless of cfg.dtype
+            self.kpool = jnp.zeros(pool_shape, jnp.bfloat16)
+            self.vpool = jnp.zeros_like(self.kpool)
+        else:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        self.kv_dtype = kv_dtype or "native"
         self.n_pages = n_pages
         self.free_pages = list(range(1, n_pages))  # page 0 = junk page
         self.loras = None
