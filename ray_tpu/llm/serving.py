@@ -90,7 +90,7 @@ class LLMEngineServer:
                  max_batch: int = 8, page_size: int = 16, n_pages: int = 512,
                  max_seq_len: int = 512, eos_id: int | None = None,
                  lora_adapters: dict | None = None, lora_rank: int = 8,
-                 default_max_tokens: int = 32):
+                 default_max_tokens: int = 32, kv_dtype: str | None = None):
         from ray_tpu.utils.device import configure_jax
 
         configure_jax()
@@ -107,7 +107,8 @@ class LLMEngineServer:
         self.engine = ContinuousBatchingEngine(
             params, model_config, max_batch=max_batch, page_size=page_size,
             n_pages=n_pages, max_seq_len=max_seq_len, eos_id=eos_id,
-            lora_adapters=lora_adapters, lora_rank=lora_rank)
+            lora_adapters=lora_adapters, lora_rank=lora_rank,
+            kv_dtype=kv_dtype)
         self.default_max_tokens = default_max_tokens
 
     async def _ensure_started(self):
