@@ -132,13 +132,19 @@ def test_parallel_branches_actually_parallel(rt):
     # CPU-serialized (~3s each) and would swamp the timing being asserted
     workflow.run(join.bind(slow.bind(0), slow.bind(0), slow.bind(0)),
                  workflow_id="warm")
-    t0 = time.monotonic()
-    out = workflow.run(join.bind(slow.bind(1), slow.bind(2), slow.bind(3)),
-                       workflow_id="par")
-    elapsed = time.monotonic() - t0
-    assert out == [1, 2, 3]
-    # 3 x 1s steps sequentially would be >= 3s; parallel ~1s + overhead
-    assert elapsed < 2.8, f"branches did not run in parallel: {elapsed:.1f}s"
+    # best-of-3: a single neighbor-load spike can stretch any one run past
+    # the bound; sequential execution would fail ALL of them (>= 3s each)
+    best = float("inf")
+    for attempt in range(3):
+        t0 = time.monotonic()
+        out = workflow.run(
+            join.bind(slow.bind(1), slow.bind(2), slow.bind(3)),
+            workflow_id=f"par{attempt}")
+        best = min(best, time.monotonic() - t0)
+        assert out == [1, 2, 3]
+        if best < 2.8:
+            break
+    assert best < 2.8, f"branches did not run in parallel: {best:.1f}s"
 
 
 # --------------------------------------------------------------------- events
