@@ -74,6 +74,21 @@ def timeit(fn, *, window: float, multiplier: int = 1, trials: int = 2) -> float:
     return best
 
 
+def lint_findings() -> int | None:
+    """Unsuppressed raylint findings over ray_tpu/ (the test_lint.py
+    self-check gate, surfaced in bench artifacts); None if the linter
+    itself fails so a lint crash can't sink the perf numbers."""
+    try:
+        from ray_tpu.devtools.lint import lint_paths
+
+        pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "ray_tpu")
+        return len(lint_paths([pkg]))
+    except Exception as e:
+        print(f"raylint gate failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def run_micro(window: float) -> dict[str, float]:
     import numpy as np
 
@@ -434,13 +449,23 @@ def run_llm_engine(quick: bool) -> dict:
 
 
 def write_benchvs(micro: dict, model: dict | None,
-                  llm: dict | None = None) -> None:
+                  llm: dict | None = None,
+                  findings: int | None = None) -> None:
     lines = [
         "# BENCHVS — ours vs reference (BASELINE.md, Ray 2.46.0 release metrics)",
         "",
         "Reference hardware: single 64-vCPU m5.16xlarge node. Ours: this machine "
         f"({os.cpu_count()} cpus). Produced by `python bench.py`.",
         "",
+    ]
+    if findings is not None:
+        lines += [
+            f"`lint_findings={findings}` — raylint static-analysis gate "
+            "(`python -m ray_tpu lint ray_tpu/`, see README § Static "
+            "analysis); 0 is the tier-1 requirement.",
+            "",
+        ]
+    lines += [
         "| Metric | Ours | Reference | Ratio |",
         "|---|---:|---:|---:|",
     ]
@@ -603,18 +628,30 @@ def main():
     # partial runs (--micro / --model) keep the other sections from the
     # previous results file rather than clobbering them with null
     raw = {"micro": micro, "model": model, "llm_engine": llm}
+    # static-analysis gate, surfaced alongside the perf numbers: nonzero
+    # means tests/test_lint.py::test_self_check is failing too
+    findings = lint_findings()
+    stored_findings = findings
     try:
         with open(out_path) as f:
             prev = json.load(f)
         for key in raw:
             if not raw[key]:
                 raw[key] = prev.get(key)
+        if stored_findings is None:  # lint crash: keep last known gate state
+            stored_findings = prev.get("lint_findings")
     except (OSError, json.JSONDecodeError):
         pass
+    raw["lint_findings"] = stored_findings
     with open(out_path, "w") as f:
         json.dump(raw, f, indent=2)
+
+    if findings is not None:
+        print(f"lint_findings={findings}")
+
     if raw["micro"]:
-        write_benchvs(raw["micro"], raw["model"], raw["llm_engine"])
+        write_benchvs(raw["micro"], raw["model"], raw["llm_engine"],
+                      findings=findings)
 
     value = micro.get(HEADLINE)
     if value is not None:

@@ -364,8 +364,10 @@ class Dataset:
         return stream
 
     def iter_blocks(self) -> Iterable:
+        # streaming by design: one materialised block in memory at a time;
+        # batching the gets would buffer the whole dataset
         for ref in self.iter_block_refs():
-            yield ray_tpu.get(ref)
+            yield ray_tpu.get(ref)  # raylint: disable=RT002
 
     def materialize(self) -> "Dataset":
         """Execute now; the result holds its blocks (ref: MaterializedDataset)."""

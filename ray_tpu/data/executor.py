@@ -232,7 +232,9 @@ class LimitOp(Operator):
             for ref in refs:
                 if remaining <= 0:
                     return
-                count = ray_tpu.get(_count_rows.remote(ref))
+                # limit stays lazy: count blocks one at a time and stop at
+                # the cut instead of forcing the whole upstream stream
+                count = ray_tpu.get(_count_rows.remote(ref))  # raylint: disable=RT002
                 if count <= remaining:
                     remaining -= count
                     yield ref

@@ -1,0 +1,7 @@
+"""Developer tooling for ray_tpu: static analysis, correctness gates.
+
+Counterpart of the reference repo's ci/lint stack (ref: ci/lint/*,
+.bazelrc sanitizer configs): the native side is covered by the sanitizer
+matrix in tests/test_store_tsan.py, the Python API layer by
+`ray_tpu.devtools.lint` (raylint).
+"""

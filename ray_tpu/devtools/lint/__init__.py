@@ -1,0 +1,29 @@
+"""raylint — framework-aware static analysis for ray_tpu programs.
+
+AST-based: resolves names through each module's import table so rules fire
+on real ray_tpu API usage (`get`/`put`/`wait`/`.remote()`/collectives),
+not on look-alike identifiers. Run it as `python -m ray_tpu lint <paths>`.
+
+Rules (see `ray_tpu lint --rules` for rationale):
+  RT001 blocking get() inside a remote function/actor method
+  RT002 get() in a loop instead of one batched get(refs)
+  RT003 .remote() result discarded
+  RT004 large np/jnp array passed inline instead of put()
+  RT005 mutable default argument on a remote function/actor method
+  RT006 collective call order diverging across branches
+  RT007 bare except swallowing errors around get()/wait()
+  RT008 time.sleep in a remote task without max_retries
+
+Suppress a deliberate finding with `# raylint: disable=RT003  -- reason`
+on the offending line, or file-wide with `# raylint: disable-file=RT003`.
+"""
+from ray_tpu.devtools.lint.engine import (  # noqa: F401
+    Finding,
+    Rule,
+    lint_paths,
+    lint_source,
+    register,
+    rule_table,
+    to_json,
+)
+from ray_tpu.devtools.lint.cli import main  # noqa: F401

@@ -1,0 +1,80 @@
+"""`ray_tpu lint` CLI: human/JSON output, rule table, exit codes.
+
+Exit codes: 0 no unsuppressed findings, 1 findings reported, 2 bad usage.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ray_tpu.devtools.lint import engine
+
+# default target: the installed ray_tpu package itself, not a cwd-relative
+# "ray_tpu" that only resolves from the repo root
+_PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def add_lint_parser(sub):
+    """Mount the `lint` subcommand on the top-level ray_tpu CLI."""
+    p = sub.add_parser("lint",
+                       help="framework-aware static analysis (raylint)")
+    p.add_argument("paths", nargs="*", default=[_PACKAGE_ROOT],
+                   help="files or directories to lint "
+                        "(default: the installed ray_tpu package)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--select", default=None, metavar="RT001,RT002",
+                   help="run only these rules")
+    p.add_argument("--ignore", default=None, metavar="RT003",
+                   help="skip these rules")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule table and exit")
+    p.set_defaults(fn=cmd_lint)
+    return p
+
+
+def _split(csv: str | None) -> list[str] | None:
+    return [tok.strip() for tok in csv.split(",") if tok.strip()] if csv else None
+
+
+def cmd_lint(args) -> int:
+    import ray_tpu.devtools.lint.rules  # noqa: F401  (populate registry)
+
+    if args.rules:
+        if args.format == "json":
+            import json
+
+            print(json.dumps(engine.rule_table(), indent=2))
+        else:
+            for row in engine.rule_table():
+                print(f"{row['id']}  {row['summary']}")
+                print(f"       {row['rationale']}")
+        return 0
+    try:
+        findings = engine.lint_paths(args.paths,
+                                     select=_split(args.select),
+                                     ignore=_split(args.ignore))
+    except (ValueError, OSError) as e:
+        print(f"raylint: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(engine.to_json(findings))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"raylint: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="raylint")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    add_lint_parser(sub)
+    args = parser.parse_args(sys.argv[1:] if argv is None else argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main(["lint", *sys.argv[1:]]))

@@ -1,0 +1,251 @@
+"""raylint rules RT001-RT008: ray_tpu-semantic anti-patterns.
+
+Each rule is a Rule subclass registered with @register; hooks receive
+(node, ctx) from the engine's single AST walk. See engine.rule_table()
+for the ID/summary/rationale table rendered by `ray_tpu lint --rules`.
+"""
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.devtools.lint.engine import (
+    Context,
+    Rule,
+    literal_array_size,
+    register,
+)
+
+# RT004: below this many elements an inline argument is cheap enough that
+# copying it into the task spec beats a store round-trip
+LARGE_ARRAY_ELEMENTS = 16384
+
+
+@register
+class BlockingGetInRemote(Rule):
+    id = "RT001"
+    summary = "blocking get() inside a remote function or actor method"
+    rationale = ("a task that blocks on get() holds its worker slot while "
+                 "waiting on other tasks; under load this deadlocks the "
+                 "scheduler (all slots waiting, none running)")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if ctx.in_remote and ctx.framework_op(node.func) == "get":
+            ctx.report(self, node,
+                       "ray_tpu.get() blocks inside a remote "
+                       f"{ctx.in_remote.kind.replace('_', ' ')}; pass the "
+                       "ObjectRef through instead (it resolves on arrival) "
+                       "or restructure into a DAG")
+
+
+@register
+class GetInLoop(Rule):
+    id = "RT002"
+    summary = "get() called once per iteration instead of batched"
+    rationale = ("get() in a loop serialises the cluster: each call waits "
+                 "for one ref while the rest sit ready; one batched "
+                 "get(refs) overlaps all transfers")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        # fires only when the argument references a for-loop/comprehension
+        # target: a while-based poll loop, or wait()-then-get-one
+        # streaming, is not a loop over refs and stays clean
+        if (ctx.framework_op(node.func) == "get"
+                and any(ctx.loops_over(arg)
+                        for arg in [*node.args,
+                                    *[kw.value for kw in node.keywords]])):
+            ctx.report(self, node,
+                       "get() once per ref inside a loop; collect the refs "
+                       "and call get(refs) once (or use wait() for "
+                       "streaming)")
+
+
+@register
+class DiscardedRemoteCall(Rule):
+    id = "RT003"
+    summary = ".remote() result discarded"
+    rationale = ("a dropped ObjectRef can never be get() or wait()ed, so "
+                 "task errors vanish and backpressure is impossible")
+
+    def on_expr(self, node: ast.Expr, ctx: Context):
+        call = node.value
+        if (ctx.uses_framework
+                and isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "remote"):
+            ctx.report(self, node,
+                       ".remote() result discarded; keep the ObjectRef "
+                       "(even fire-and-forget tasks need their errors "
+                       "surfaced via wait())")
+
+
+@register
+class LargeArrayArgument(Rule):
+    id = "RT004"
+    summary = "large np/jnp array passed inline to .remote() instead of put()"
+    rationale = ("inline arguments are copied into every task spec; a "
+                 "put() ref is written to the object store once and "
+                 "shared zero-copy by every consumer")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if not (ctx.uses_framework
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "remote"):
+            return
+        for arg in [*node.args, *[kw.value for kw in node.keywords]]:
+            size = literal_array_size(arg, ctx)
+            if size is None and isinstance(arg, ast.Name):
+                size = ctx.array_bindings.get(arg.id)
+            if size is not None and size >= LARGE_ARRAY_ELEMENTS:
+                ctx.report(self, arg,
+                           f"array of {size} elements passed inline to "
+                           ".remote(); put() it once and pass the ref")
+
+
+@register
+class MutableDefaultOnRemote(Rule):
+    id = "RT005"
+    summary = "mutable default argument on a remote function/actor method"
+    rationale = ("the default is evaluated once per worker process and "
+                 "shared across invocations, so state leaks between tasks "
+                 "on the same worker but not across workers — "
+                 "nondeterminism that only appears at scale")
+
+    _MUTABLE_CTORS = {"list", "dict", "set", "bytearray"}
+
+    def _is_mutable(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in self._MUTABLE_CTORS)
+
+    def _check(self, node, ctx: Context):
+        for default in [*node.args.defaults,
+                        *[d for d in node.args.kw_defaults if d is not None]]:
+            if self._is_mutable(default):
+                ctx.report(self, default,
+                           f"mutable default on remote {node.name}(); use "
+                           "None and construct inside the body")
+
+    def on_functiondef(self, node: ast.FunctionDef, ctx: Context):
+        if (ctx.remote_decorator(node) is not None
+                or getattr(node, "_rt_actor_method", False)):
+            self._check(node, ctx)
+
+    on_asyncfunctiondef = on_functiondef
+
+
+@register
+class DivergentCollectiveOrder(Rule):
+    id = "RT006"
+    summary = "collective call order diverges across branches"
+    rationale = ("collectives are rendezvous points: if one replica takes "
+                 "the if-branch and another the else, they post different "
+                 "op sequences and every participant hangs forever")
+
+    def on_if(self, node: ast.If, ctx: Context):
+        if not ctx.in_remote or getattr(node, "_rt006_covered", False):
+            return
+        body_ops = self._collective_seq(node.body, ctx)
+        else_ops = self._collective_seq(node.orelse, ctx)
+        if body_ops != else_ops:
+            ctx.report(self, node,
+                       f"collective sequence diverges across branches "
+                       f"({body_ops or 'none'} vs {else_ops or 'none'}); "
+                       "hoist the collectives out of the branch or make "
+                       "the condition replica-uniform")
+            # one finding per divergent chain: the nested ifs (including
+            # elifs, which parse as orelse=[If]) lie on the already-
+            # reported divergent paths, so their own reports would be
+            # duplicates of this one
+            for branch in (node.body, node.orelse):
+                for stmt in branch:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.If):
+                            sub._rt006_covered = True
+
+    def _collective_seq(self, stmts, ctx: Context) -> list[str]:
+        ops: list[str] = []
+        for stmt in stmts:
+            self._collect(stmt, ctx, ops)
+        return ops
+
+    def _collect(self, node: ast.AST, ctx: Context, ops: list[str]):
+        if isinstance(node, ast.If):
+            # the test executes on every path that reaches this if, so
+            # collectives in it belong to the enclosing sequence; the
+            # branches are their own rendezvous check (on_if visits the
+            # nested if too): when they agree the sequence counts once,
+            # when they diverge the nested if reports and cascading the
+            # outer comparison would only duplicate the finding
+            self._collect(node.test, ctx, ops)
+            ops.extend(self._collective_seq(node.body, ctx))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # a nested def's body doesn't execute at this point
+        if isinstance(node, ast.Call):
+            op = ctx.collective_op(node.func)
+            if op:
+                ops.append(op)
+        for child in ast.iter_child_nodes(node):
+            self._collect(child, ctx, ops)
+
+
+@register
+class BareExceptAroundGet(Rule):
+    id = "RT007"
+    summary = "bare except swallowing errors around get()/wait()"
+    rationale = ("get() re-raises remote task exceptions; a bare except "
+                 "that doesn't re-raise turns a worker crash into silent "
+                 "data loss")
+
+    def on_try(self, node, ctx: Context):
+        if not self._calls_get_or_wait(node.body, ctx):
+            return
+        for handler in node.handlers:
+            if self._is_catch_all(handler) and not self._reraises(handler):
+                ctx.report(self, handler,
+                           "bare except around get()/wait(); catch specific "
+                           "exceptions or re-raise so remote failures "
+                           "propagate")
+
+    on_trystar = on_try
+
+    def _calls_get_or_wait(self, stmts, ctx: Context) -> bool:
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if (isinstance(sub, ast.Call)
+                        and ctx.framework_op(sub.func) in ("get", "wait")):
+                    return True
+        return False
+
+    def _is_catch_all(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        return isinstance(t, ast.Name) and t.id == "BaseException"
+
+    def _reraises(self, handler: ast.ExceptHandler) -> bool:
+        return any(isinstance(sub, ast.Raise)
+                   for stmt in handler.body for sub in ast.walk(stmt))
+
+
+@register
+class SleepInRemoteWithoutRetry(Rule):
+    id = "RT008"
+    summary = "time.sleep in a remote function without max_retries"
+    rationale = ("a sleeping task pins its worker slot; without "
+                 "max_retries a node failure during the sleep loses the "
+                 "task silently instead of rescheduling it")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        frame = ctx.in_remote
+        if (frame is not None and frame.kind == "task"
+                and "max_retries" not in frame.decorator_kwargs
+                and ctx.is_time_sleep(node.func)):
+            ctx.report(self, node,
+                       "time.sleep() in a remote task declared without "
+                       "max_retries; add @remote(max_retries=...) or poll "
+                       "via wait(timeout=...)")

@@ -235,7 +235,9 @@ def submit_job(entrypoint: str, runtime_env: dict | None = None,
     sup = ray_tpu.remote(JobSupervisor).options(
         name=f"_job_supervisor_{job_id}", num_cpus=0
     ).remote(job_id, entrypoint, desc, _gcs_address_str())
-    sup.run.remote()  # fire-and-forget; status lands in the KV
+    # fire-and-forget by design: the supervisor reports terminal status
+    # (and any error) into the GCS KV, which job_status() surfaces
+    sup.run.remote()  # raylint: disable=RT003
     return job_id
 
 

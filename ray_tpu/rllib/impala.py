@@ -217,10 +217,12 @@ class IMPALA:
             self._consumed += 1
             if self._consumed % c.broadcast_interval == 0:
                 # fire-and-forget broadcast: staleness is by design
-                runner.set_weights.remote(self.params)
+                # (IMPALA corrects off-policy drift with V-trace), so a
+                # lost update is repaired by the next broadcast
+                runner.set_weights.remote(self.params)  # raylint: disable=RT003
                 for other in self.runners:
                     if other is not runner:
-                        other.set_weights.remote(self.params)
+                        other.set_weights.remote(self.params)  # raylint: disable=RT003
         metrics_list = ray_tpu.get(
             [r.episode_metrics.remote() for r in self.runners], timeout=120)
         means = [m["episode_return_mean"] for m in metrics_list

@@ -400,6 +400,13 @@ def main(argv=None):
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_serve)
 
+    from ray_tpu.devtools.lint.cli import add_lint_parser, cmd_lint
+
+    lp = add_lint_parser(sub)
+    # cmd_lint returns an exit code rather than printing-and-returning;
+    # adapt it to the `args.fn(args)` convention the other commands use
+    lp.set_defaults(fn=lambda args: sys.exit(cmd_lint(args)))
+
     p = sub.add_parser("_autoscaler_monitor")
     p.add_argument("--address", required=True)
     p.add_argument("--min-nodes", type=int, default=1)

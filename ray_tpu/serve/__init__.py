@@ -119,15 +119,23 @@ def run(app: Application, *, name: str = "default", timeout_s: float = 120.0,
     ]
     ray_tpu.get(refs, timeout=30)
     if _blocking:
-        for dep_name in specs:
-            ok = ray_tpu.get(
-                controller.wait_ready.remote(name, dep_name, timeout_s),
-                timeout=timeout_s + 10,
-            )
+        # all deployments come up concurrently: one batched get over the
+        # wait_ready refs instead of waiting out each deployment in turn.
+        # Each wait gets the CUMULATIVE budget the old sequential loop
+        # allowed (windows started after the previous deployment was
+        # ready), so replicas that place one at a time on a constrained
+        # cluster still pass; the get returns as soon as all are ready.
+        budget_s = timeout_s * max(1, len(specs))
+        ready = ray_tpu.get(
+            [controller.wait_ready.remote(name, dep_name, budget_s)
+             for dep_name in specs],
+            timeout=budget_s + 10,
+        )
+        for dep_name, ok in zip(specs, ready):
             if not ok:
                 raise RayServeException(
                     f"deployment {name}/{dep_name} failed to become ready "
-                    f"within {timeout_s}s"
+                    f"within {budget_s}s"
                 )
     return DeploymentHandle(ingress, app_name=name)
 

@@ -199,7 +199,9 @@ class _Router:
                 # report unplaceable demand: the scale-from-zero signal
                 try:
                     controller = await self._controller()
-                    controller.report_handle_queued.remote(
+                    # best-effort telemetry: the autoscaler treats a lost
+                    # sample as stale demand, never as an error
+                    controller.report_handle_queued.remote(  # raylint: disable=RT003
                         self.app_name, self.deployment_name,
                         self._router_id, self._waiting,
                     )
@@ -217,7 +219,9 @@ class _Router:
             if self._waiting == 0:
                 try:
                     controller = await self._controller()
-                    controller.report_handle_queued.remote(
+                    # best-effort: clearing the queued-demand gauge may race
+                    # with shutdown; the controller expires stale reports
+                    controller.report_handle_queued.remote(  # raylint: disable=RT003
                         self.app_name, self.deployment_name, self._router_id, 0
                     )
                 except Exception:

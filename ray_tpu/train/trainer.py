@@ -206,8 +206,7 @@ class JaxTrainer:
             # surface early run() failures (submission/unpickling errors)
             # instead of polling a worker that never started
             done_now, _ = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.01)
-            for ref in done_now:
-                r = ray_tpu.get(ref)
+            for r in ray_tpu.get(done_now):
                 if not r.get("ok"):
                     raise TrainingFailedError(r.get("error", "unknown"))
             polls = ray_tpu.get([w.poll.remote() for w in workers], timeout=60)

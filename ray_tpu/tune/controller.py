@@ -231,10 +231,14 @@ class TuneController:
                 pass
 
     def _poll_running(self, running: list[Trial]):
+        # submit every poll before retrieving any so trials answer
+        # concurrently; retrieval stays per-ref because one dead actor
+        # must not sink the whole batch
+        refs = [t.actor.poll.remote() for t in running]
         polls = []
-        for t in running:
+        for ref in refs:
             try:
-                polls.append(ray_tpu.get(t.actor.poll.remote(), timeout=30))
+                polls.append(ray_tpu.get(ref, timeout=30))  # raylint: disable=RT002
             except Exception:
                 polls.append(None)  # actor died
         for trial, poll in zip(running, polls):

@@ -10,6 +10,8 @@ multiprocessing code scales past one machine).
 from __future__ import annotations
 
 import os
+from collections import deque
+from itertools import islice
 from typing import Callable, Iterable
 
 import ray_tpu
@@ -101,10 +103,13 @@ class Pool:
         if self._closed:
             raise ValueError("Pool not running")
 
+    def _auto_chunksize(self, n: int) -> int:
+        return max(1, n // (self._processes * 4) or 1)
+
     def _chunks(self, iterable: Iterable, chunksize: int | None):
         items = list(iterable)
         if chunksize is None:
-            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+            chunksize = self._auto_chunksize(len(items))
         return [items[i:i + chunksize] for i in
                 range(0, len(items), chunksize)] or [[]]
 
@@ -152,9 +157,45 @@ class Pool:
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: int | None = None):
+        # validate eagerly (stdlib parity: errors surface at the call
+        # site, not at first iteration), then hand off to the generator
         self._check_open()
-        for chunk in self._chunks(iterable, chunksize):
-            for v in ray_tpu.get(self._run.remote(fn, chunk)):
+        if chunksize is None:
+            try:
+                chunksize = self._auto_chunksize(len(iterable))  # type: ignore[arg-type]
+            except TypeError:
+                chunksize = 16  # lazy iterable: no len() to size against
+        elif chunksize < 1:
+            raise ValueError(f"Chunksize must be 1+, not {chunksize}")
+        return self._imap_gen(fn, iter(iterable), chunksize)
+
+    def _imap_gen(self, fn: Callable, it, chunksize: int):
+        # bounded submission window: a few chunks stay in flight ahead of
+        # the consumer (workers pipeline) without ever materializing the
+        # iterable, so unbounded generators stream; the per-ref get is
+        # the ordered yield imap's contract requires
+        depth = max(2, self._processes * 2)
+        window: deque = deque()
+
+        def submit_next() -> bool:
+            chunk = list(islice(it, chunksize))
+            if not chunk:
+                return False
+            window.append(self._run.remote(fn, chunk))
+            return True
+
+        for _ in range(depth):
+            if not submit_next():
+                break
+        # if the consumer abandons the generator mid-stream, the <= depth
+        # in-flight chunks finish in the background and their results and
+        # errors are discarded — same contract as stdlib Pool.imap, and
+        # deliberately non-blocking (draining here would stall a `break`
+        # for up to a full chunk's runtime)
+        while window:
+            ref = window.popleft()
+            submit_next()
+            for v in ray_tpu.get(ref):
                 yield v
 
     def imap_unordered(self, fn: Callable, iterable: Iterable,

@@ -207,8 +207,11 @@ def _execute(dag: StepNode, storage: _Storage) -> Any:
         return ref
 
     out = submit(dag)
+    # per-step get is load-bearing for durability: each step checkpoints
+    # the moment it completes, so a crash mid-workflow resumes from the
+    # last saved step; one batched get would checkpoint all-or-nothing
     for node, ref in order:  # topological: deps checkpoint before dependents
-        storage.save_step(node.step_id, ray_tpu.get(ref))
+        storage.save_step(node.step_id, ray_tpu.get(ref))  # raylint: disable=RT002
     if isinstance(out, ObjectRef):
         return ray_tpu.get(out)
     return out

@@ -1,0 +1,41 @@
+"""RT001 fixture: blocking get() inside a remote function/actor method."""
+import ray_tpu
+from ray_tpu import get as rt_get
+
+
+@ray_tpu.remote
+def bad_task(ref):
+    return ray_tpu.get(ref)  # expect: RT001
+
+
+@ray_tpu.remote(num_cpus=2)
+def bad_task_with_options(ref):
+    return rt_get(ref)  # expect: RT001
+
+
+@ray_tpu.remote
+class BadActor:
+    def method(self, ref):
+        return ray_tpu.get(ref)  # expect: RT001
+
+
+@ray_tpu.remote
+def suppressed_task(ref):
+    # scheduler reserves a slot for this task's dependency chain
+    return ray_tpu.get(ref)  # raylint: disable=RT001
+
+
+def driver(ref):
+    # get() at the driver is the normal blocking call site: no finding
+    return ray_tpu.get(ref)
+
+
+class PlainClass:
+    def method(self, ref):
+        # not an actor: no finding
+        return ray_tpu.get(ref)
+
+
+def lookalike(cache, key):
+    # dict.get resolves to nothing framework-side: no finding
+    return cache.get(key)

@@ -1,0 +1,44 @@
+"""RT002 fixture: get() once per ref in a loop instead of batched."""
+import ray_tpu
+
+
+def bad_for_loop(refs):
+    out = []
+    for ref in refs:
+        out.append(ray_tpu.get(ref))  # expect: RT002
+    return out
+
+
+def bad_comprehension(refs):
+    return [ray_tpu.get(r) for r in refs]  # expect: RT002
+
+
+def bad_nested_expression(pairs):
+    out = []
+    for name, ref in pairs:
+        out.append((name, ray_tpu.get([ref])[0]))  # expect: RT002
+    return out
+
+
+def suppressed_streaming(refs):
+    for ref in refs:
+        yield ray_tpu.get(ref)  # raylint: disable=RT002
+
+
+def good_batched(refs):
+    return ray_tpu.get(list(refs))
+
+
+def good_wait_streaming(pending):
+    # wait()-then-get-one is the streaming idiom, not a loop over refs
+    while pending:
+        done, pending = ray_tpu.wait(pending, num_returns=1)
+        yield ray_tpu.get(done[0])
+
+
+def good_poll_loop(ref):
+    import time
+
+    # a while-based poll loop re-gets the same ref: not a loop over refs
+    while not ray_tpu.get(ref):
+        time.sleep(0.1)

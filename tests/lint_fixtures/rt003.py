@@ -1,0 +1,34 @@
+"""RT003 fixture: .remote() result discarded."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def task(x):
+    return x
+
+
+def bad_discard():
+    task.remote(1)  # expect: RT003
+
+
+def bad_discard_actor_method(actor):
+    actor.step.remote()  # expect: RT003
+
+
+def suppressed_fire_and_forget(actor):
+    # telemetry push; errors surface via the actor's health check
+    actor.report.remote()  # raylint: disable=RT003
+
+
+def good_kept():
+    ref = task.remote(1)
+    return ray_tpu.get(ref)
+
+
+def good_collected(xs):
+    return ray_tpu.get([task.remote(x) for x in xs])
+
+
+def good_unrelated_remote_name(client):
+    # a statement call not named .remote() is fine
+    client.push(1)

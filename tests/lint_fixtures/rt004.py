@@ -1,0 +1,65 @@
+"""RT004 fixture: large np/jnp array passed inline to .remote()."""
+import jax.numpy as jnp
+import numpy as np
+import ray_tpu
+
+
+@ray_tpu.remote
+def consume(arr):
+    return arr.sum()
+
+
+def bad_inline_literal():
+    return consume.remote(np.zeros((4096, 4096)))  # expect: RT004
+
+
+def bad_inline_jnp():
+    return consume.remote(jnp.ones((512, 512)))  # expect: RT004
+
+
+def bad_closure_capture():
+    weights = np.zeros((1024, 1024))
+    return consume.remote(weights)  # expect: RT004
+
+
+def bad_kwarg():
+    return consume.options(num_cpus=2).remote(arr=np.full((300, 300), 7.0))  # expect: RT004
+
+
+def suppressed_single_consumer():
+    # single consumer, single use: the spec copy is the cheapest path
+    return consume.remote(np.zeros((4096, 4096)))  # raylint: disable=RT004
+
+
+def good_small_array():
+    return consume.remote(np.zeros((8, 8)))
+
+
+def good_put_ref():
+    big = ray_tpu.put(np.zeros((4096, 4096)))
+    return consume.remote(big)
+
+
+def good_rebound_small():
+    # rebinding kills the large-array tracking for this name
+    weights = np.zeros((1024, 1024))
+    weights = weights.sum()
+    return consume.remote(weights)
+
+
+def good_dynamic_shape(n):
+    # size not statically known: stay silent rather than guess
+    return consume.remote(np.zeros((n, n)))
+
+
+def bad_arange():
+    return consume.remote(np.arange(100_000))  # expect: RT004
+
+
+def good_strided_arange():
+    # 10_000 elements, not 100_000: start/stop/step all count
+    return consume.remote(np.arange(0, 100_000, 10))
+
+
+def good_offset_arange():
+    return consume.remote(np.arange(90_000, 100_000))

@@ -1,0 +1,39 @@
+"""RT005 fixture: mutable default argument on a remote function/actor method."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def bad_list_default(x, acc=[]):  # expect: RT005
+    acc.append(x)
+    return acc
+
+
+@ray_tpu.remote
+def bad_dict_kwonly(x, *, cache={}):  # expect: RT005
+    return cache.setdefault(x, x)
+
+
+@ray_tpu.remote
+class Counter:
+    def bad_method(self, samples=list()):  # expect: RT005
+        samples.append(1)
+        return samples
+
+    def good_method(self, samples=None):
+        return samples or []
+
+
+@ray_tpu.remote
+def suppressed(x, acc=[]):  # raylint: disable=RT005
+    return acc
+
+
+@ray_tpu.remote
+def good_immutable(x, scale=1.0, name="w", dims=(8, 8)):
+    return x
+
+
+def plain_function(x, acc=[]):
+    # not remote: worker-process sharing doesn't apply, stay silent
+    acc.append(x)
+    return acc

@@ -1,0 +1,81 @@
+"""RT006 fixture: collective call order diverging across branches."""
+import ray_tpu
+from ray_tpu import collective as col
+
+
+@ray_tpu.remote
+class Worker:
+    def bad_one_sided(self, grads, is_leader):
+        if is_leader:  # expect: RT006
+            col.allreduce(grads, group_name="g")
+        return grads
+
+    def bad_different_ops(self, grads, phase):
+        if phase == "sync":  # expect: RT006
+            col.allreduce(grads, group_name="g")
+        else:
+            col.barrier(group_name="g")
+        return grads
+
+    def suppressed_rank_guard(self, grads, rank):
+        # every replica computes the same rank predicate: branch is uniform
+        if rank == 0:  # raylint: disable=RT006
+            col.broadcast(grads, src_rank=0, group_name="g")
+        return grads
+
+    def good_same_sequence(self, grads, use_fp32):
+        if use_fp32:
+            grads = grads.astype("float32")
+            col.allreduce(grads, group_name="g")
+        else:
+            col.allreduce(grads, group_name="g")
+        return grads
+
+    def good_no_collectives(self, x, flag):
+        if flag:
+            return x + 1
+        return x - 1
+
+    def good_nested_uniform(self, grads, outer, inner):
+        # every replica path posts exactly one allreduce; the nested if
+        # must count once, not once per branch
+        if outer:
+            if inner:
+                col.allreduce(grads, group_name="g")
+            else:
+                col.allreduce(grads, group_name="g")
+        else:
+            col.allreduce(grads, group_name="g")
+        return grads
+
+    def bad_nested_divergent(self, grads, outer, inner):
+        if outer:
+            if inner:  # expect: RT006
+                col.allreduce(grads, group_name="g")
+        else:
+            col.allreduce(grads, group_name="g")
+        return grads
+
+    def bad_collective_in_nested_condition(self, grads, outer):
+        # the barrier runs only on outer-true replicas: the nested if's
+        # TEST belongs to the outer branch's sequence
+        if outer:  # expect: RT006
+            if col.barrier(group_name="g"):
+                grads = grads + 1
+        return grads
+
+    def bad_elif_reports_once(self, grads, x):
+        # one divergent chain, one finding: the elif (orelse=[If]) must
+        # not produce a second cascaded report
+        if x > 0:  # expect: RT006
+            col.allreduce(grads, group_name="g")
+        elif x < 0:
+            col.barrier(group_name="g")
+        return grads
+
+
+def driver_branching(grads, flag):
+    # not a remote context: driver-side branching can't desync replicas
+    if flag:
+        col.allreduce(grads, group_name="g")
+    return grads

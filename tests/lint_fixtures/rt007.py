@@ -1,0 +1,49 @@
+"""RT007 fixture: bare except swallowing errors around get()/wait()."""
+import ray_tpu
+
+
+def bad_bare_except(ref):
+    try:
+        return ray_tpu.get(ref)
+    except:  # expect: RT007
+        return None
+
+
+def bad_base_exception_wait(refs):
+    try:
+        return ray_tpu.wait(refs, num_returns=1)
+    except BaseException:  # expect: RT007
+        return [], refs
+
+
+def suppressed_shutdown_path(ref):
+    try:
+        return ray_tpu.get(ref, timeout=1)
+    except:  # raylint: disable=RT007
+        return None  # best-effort drain during shutdown
+
+
+def good_specific_exception(ref):
+    try:
+        return ray_tpu.get(ref)
+    except TimeoutError:
+        return None
+
+
+def good_reraise(ref):
+    try:
+        return ray_tpu.get(ref)
+    except:
+        cleanup()
+        raise
+
+
+def good_no_get_inside(path):
+    try:
+        return open(path).read()
+    except:
+        return ""
+
+
+def cleanup():
+    pass

@@ -1,0 +1,40 @@
+"""RT008 fixture: time.sleep in a remote task without max_retries."""
+import time
+from time import sleep
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def bad_sleep(ref):
+    time.sleep(5.0)  # expect: RT008
+    return ref
+
+
+@ray_tpu.remote(num_cpus=2)
+def bad_sleep_from_import():
+    sleep(1.0)  # expect: RT008
+
+
+@ray_tpu.remote
+def suppressed_backoff(url):
+    # external rate limit: retrying elsewhere would hammer the endpoint
+    time.sleep(0.5)  # raylint: disable=RT008
+    return url
+
+
+@ray_tpu.remote(max_retries=3)
+def good_with_retries(ref):
+    time.sleep(5.0)
+    return ref
+
+
+@ray_tpu.remote
+def good_no_sleep(refs):
+    ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=5.0)
+    return ready
+
+
+def good_driver_sleep():
+    # sleeping at the driver holds no worker slot
+    time.sleep(0.1)

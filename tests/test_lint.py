@@ -1,0 +1,237 @@
+"""raylint test suite: per-rule fixtures, JSON stability, CLI, and the
+self-check that gates ray_tpu/ itself (the linter as permanent CI
+infrastructure, ref: the reference repo's ci/lint stack).
+
+Fixture convention: every line in tests/lint_fixtures/rtNNN.py expected to
+fire carries a trailing `# expect: RTNNN` marker; the test asserts the
+finding set matches the marker set exactly, so both false negatives AND
+false positives fail."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu.devtools.lint import engine, lint_paths, lint_source
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+PACKAGE = os.path.join(REPO, "ray_tpu")
+
+ALL_RULES = ["RT001", "RT002", "RT003", "RT004",
+             "RT005", "RT006", "RT007", "RT008"]
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+)")
+
+
+def _expected_markers(path: str) -> set:
+    expected = set()
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                for rid in m.group(1).split(","):
+                    expected.add((lineno, rid.strip()))
+    return expected
+
+
+# ------------------------------------------------------------ rule fixtures
+@pytest.mark.parametrize("rule_id", ALL_RULES)
+def test_rule_fixture(rule_id):
+    """Each rule fires on exactly its fixture's marked lines: positives
+    found, negatives silent, suppressed lines dropped."""
+    path = os.path.join(FIXTURES, f"{rule_id.lower()}.py")
+    expected = _expected_markers(path)
+    assert expected, f"fixture {path} has no # expect markers"
+    with open(path) as f:
+        findings = lint_source(f.read(), path, select=[rule_id])
+    actual = {(f.line, f.rule_id) for f in findings}
+    assert actual == expected
+
+
+def test_fixtures_cover_every_registered_rule():
+    import ray_tpu.devtools.lint.rules  # noqa: F401
+
+    assert sorted(engine.RULES) == ALL_RULES
+
+
+def test_registry_rejects_duplicate_ids():
+    with pytest.raises(ValueError, match="duplicate"):
+        @engine.register
+        class Dup(engine.Rule):
+            id = "RT001"
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_source("x = 1", select=["RT999"])
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint_source("x = 1", ignore=["RT999"])
+
+
+def test_empty_effective_rule_set_rejected():
+    """--select X --ignore X must error, not lint with zero rules."""
+    with pytest.raises(ValueError, match="no rules enabled"):
+        lint_source("x = 1", select=["RT001"], ignore=["RT001"])
+
+
+def test_nonexistent_path_raises():
+    with pytest.raises(FileNotFoundError, match="no such file"):
+        lint_paths(["tests/does_not_exist_anywhere"])
+
+
+def test_directory_with_no_python_files_raises(tmp_path):
+    """An existing-but-empty (or renamed) package must error, not report
+    a green '0 findings' over zero linted files."""
+    with pytest.raises(FileNotFoundError, match="no python files"):
+        lint_paths([str(tmp_path)])
+
+
+def test_arange_size_uses_start_stop_step():
+    src = ("import numpy as np\n"
+           "import ray_tpu\n"
+           "@ray_tpu.remote\n"
+           "def f(a):\n"
+           "    return a\n"
+           "r1 = f.remote(np.arange(0, 100000, 10))\n"   # 10k elems: clean
+           "r2 = f.remote(np.arange(90000, 100000))\n"   # 10k elems: clean
+           "r3 = f.remote(np.arange(20000))\n")          # 20k elems: fires
+    findings = lint_source(src, select=["RT004"])
+    assert [(f.line, f.rule_id) for f in findings] == [(8, "RT004")]
+
+
+# ------------------------------------------------------------- suppression
+def test_file_wide_suppression():
+    src = ("# raylint: disable-file=RT003\n"
+           "import ray_tpu\n"
+           "def f(actor):\n"
+           "    actor.step.remote()\n")
+    assert lint_source(src) == []
+
+
+def test_directive_in_docstring_is_not_a_suppression():
+    """Documentation that quotes the syntax (docstrings, strings) must not
+    become a live suppression — only real comment tokens count."""
+    src = ('"""Suppress with `# raylint: disable-file=RT003` anywhere."""\n'
+           "import ray_tpu\n"
+           "def f(actor):\n"
+           "    actor.step.remote()\n")
+    assert [f.rule_id for f in lint_source(src)] == ["RT003"]
+
+
+def test_lambda_body_is_deferred_scope():
+    """A get() inside a lambda built in a loop runs later, not
+    per-iteration — RT002 must stay silent."""
+    src = ("import ray_tpu\n"
+           "def f(refs):\n"
+           "    return [lambda r=r: ray_tpu.get(r) for r in refs]\n")
+    assert lint_source(src) == []
+
+
+def test_remote_attr_without_framework_import_is_clean():
+    """`.remote()` on an unrelated library's object in a module that never
+    imports ray_tpu must not fire the attribute-shape rules."""
+    src = ("import fabric\n"
+           "def deploy(conn):\n"
+           "    conn.remote()\n")
+    assert lint_source(src) == []
+
+
+def test_disable_all_on_line():
+    src = ("import ray_tpu\n"
+           "@ray_tpu.remote\n"
+           "def f(ref, acc=[]):  # raylint: disable=all\n"
+           "    return acc\n")
+    assert lint_source(src) == []
+
+
+def test_suppression_is_rule_specific():
+    src = ("import ray_tpu\n"
+           "@ray_tpu.remote\n"
+           "def f(ref, acc=[]):  # raylint: disable=RT001\n"
+           "    return acc\n")
+    assert [f.rule_id for f in lint_source(src)] == ["RT005"]
+
+
+def test_syntax_error_reported_as_rt000():
+    findings = lint_source("def broken(:\n", "bad.py")
+    assert [f.rule_id for f in findings] == [engine.PARSE_RULE_ID]
+
+
+# ------------------------------------------------------------- JSON output
+def test_json_output_stability():
+    """Two runs over the same tree produce byte-identical JSON, sorted by
+    (path, line, col, rule), with a fixed key order per finding."""
+    first = engine.to_json(lint_paths([FIXTURES]))
+    second = engine.to_json(lint_paths([FIXTURES]))
+    assert first == second
+    rows = json.loads(first)
+    assert rows, "fixtures must produce findings"
+    for row in rows:
+        assert list(row) == ["rule", "path", "line", "col", "message"]
+    keys = [(r["path"], r["line"], r["col"], r["rule"]) for r in rows]
+    assert keys == sorted(keys)
+
+
+def test_rule_table_shape():
+    table = engine.rule_table()
+    assert [row["id"] for row in table] == ALL_RULES
+    assert all(row["summary"] and row["rationale"] for row in table)
+
+
+# -------------------------------------------------------------------- CLI
+def _run_cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "lint", *argv],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_findings_exit_one_and_json():
+    proc = _run_cli(os.path.join(FIXTURES, "rt001.py"),
+                    "--select", "RT001", "--format", "json")
+    assert proc.returncode == 1, proc.stderr
+    rows = json.loads(proc.stdout)
+    assert {r["rule"] for r in rows} == {"RT001"}
+
+
+def test_cli_clean_file_exits_zero(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("import ray_tpu\n\nref = None\n")
+    proc = _run_cli(str(clean))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout
+
+
+def test_cli_rules_table():
+    proc = _run_cli("--rules")
+    assert proc.returncode == 0
+    for rid in ALL_RULES:
+        assert rid in proc.stdout
+
+
+def test_cli_unknown_rule_exits_two():
+    proc = _run_cli("--select", "RT999", FIXTURES)
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_nonexistent_path_exits_two():
+    """A typo'd path must error loudly, never report a green '0 findings'."""
+    proc = _run_cli("no_such_dir_typo")
+    assert proc.returncode == 2
+    assert "no such file" in proc.stderr
+
+
+# -------------------------------------------------------------- self-check
+def test_self_check():
+    """ray_tpu/ lints clean: every violation fixed or explicitly
+    suppressed. This is the permanent CI gate — a new anti-pattern
+    anywhere in the package fails this test."""
+    findings = lint_paths([PACKAGE])
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)

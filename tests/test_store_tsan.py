@@ -1,10 +1,13 @@
-"""Race detection for the C++ shm store (ref: .bazelrc build:tsan
-configs, .bazelrc:113-125 — the reference runs its C++ core under
-ThreadSanitizer; here the store is the concurrency-bearing native code).
+"""Sanitizer matrix for the C++ shm store and SPSC rings (ref: .bazelrc
+build:tsan/asan configs, .bazelrc:113-125 — the reference runs its C++
+core under sanitizers; here the store and rings are the
+concurrency-bearing native code).
 
-Builds tests/cpp/store_stress.cc twice (plain, -fsanitize=thread) and runs
-both: the plain build checks API invariants under contention, the TSAN
-build fails the test on any data-race report."""
+Builds tests/cpp/store_stress.cc and ring_stress.cc four ways each —
+plain, -fsanitize=thread, -fsanitize=address, -fsanitize=undefined — and
+runs all of them: the plain build checks API invariants under contention,
+each sanitizer build fails the test on any report. Sanitizer builds skip
+gracefully when the toolchain lacks that runtime."""
 
 import os
 import subprocess
@@ -65,6 +68,23 @@ def test_store_stress_asan():
     assert "failures=0" in out.stdout
 
 
+def test_store_stress_ubsan():
+    """UndefinedBehaviorSanitizer over the same harness: signed overflow,
+    misaligned access, and bad shifts in the store's offset arithmetic
+    print `runtime error:` and fail the test (-fno-sanitize-recover makes
+    the first report fatal, so the exit code catches it too)."""
+    binary, err = _build(
+        ["-fsanitize=undefined", "-fno-sanitize-recover=undefined"],
+        "store_stress_ubsan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=undefined: {err[-200:]}")
+    out = subprocess.run([binary, f"rt_ubsan_{os.getpid()}", "1.5"],
+                         capture_output=True, text=True, timeout=300)
+    assert "runtime error:" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
+
+
 def _build_ring(flags, out_name):
     os.makedirs(BUILD, exist_ok=True)
     out = os.path.join(BUILD, out_name)
@@ -108,5 +128,18 @@ def test_ring_stress_asan():
     out = subprocess.run([binary, f"/rt_ringas_{os.getpid()}", "1.5"],
                          capture_output=True, text=True, timeout=300)
     assert "ERROR: AddressSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
+
+
+def test_ring_stress_ubsan():
+    binary, err = _build_ring(
+        ["-fsanitize=undefined", "-fno-sanitize-recover=undefined"],
+        "ring_stress_ubsan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=undefined: {err[-200:]}")
+    out = subprocess.run([binary, f"/rt_ringub_{os.getpid()}", "1.5"],
+                         capture_output=True, text=True, timeout=300)
+    assert "runtime error:" not in out.stderr, out.stderr[:4000]
     assert out.returncode == 0, (out.stdout, out.stderr[:4000])
     assert "failures=0" in out.stdout

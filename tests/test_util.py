@@ -90,6 +90,13 @@ def test_multiprocessing_pool(rt):
         assert sorted(p.imap_unordered(_double, range(6))) == \
             [2 * i for i in range(6)]
         assert list(p.imap(_double, range(5))) == [2 * i for i in range(5)]
+        # imap streams: an unbounded generator must yield without being
+        # materialized (bounded submission window, not submit-everything)
+        from itertools import count, islice
+        assert list(islice(p.imap(_double, count(), chunksize=2), 7)) == \
+            [2 * i for i in range(7)]
+        with pytest.raises(ValueError):
+            next(p.imap(_double, [1, 2, 3], chunksize=0))
     with pytest.raises(ValueError):
         p.map(_double, [1])  # closed
 
