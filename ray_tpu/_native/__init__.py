@@ -160,6 +160,9 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_ring_push_raw.restype = ctypes.c_int
             lib.rt_ring_push_raw.argtypes = [
                 ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, u64, i64]
+            lib.rt_ring_push_batch.restype = i64
+            lib.rt_ring_push_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, u64, i64]
             lib.rt_ring_pop_batch.restype = i64
             lib.rt_ring_pop_batch.argtypes = [
                 ctypes.c_void_p, ctypes.c_int, u8p, u64, i64]

@@ -262,7 +262,19 @@ void* rt_mux_create(const char* host, uint16_t port, uint16_t* out_port,
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(port);
-  addr.sin_addr.s_addr = host && host[0] ? inet_addr(host) : INADDR_ANY;
+  // inet_addr does NO hostname resolution: a name like "localhost" yields
+  // INADDR_NONE, which as a bind address means 255.255.255.255 — reject
+  // it here so the caller falls back (python resolves names first)
+  in_addr_t ip = INADDR_ANY;
+  if (host && host[0]) {
+    ip = inet_addr(host);
+    if (ip == INADDR_NONE) {
+      close(m->listen_fd);
+      delete m;
+      return nullptr;
+    }
+  }
+  addr.sin_addr.s_addr = ip;
   if (bind(m->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
       listen(m->listen_fd, 512) != 0) {
     close(m->listen_fd);
@@ -275,6 +287,16 @@ void* rt_mux_create(const char* host, uint16_t port, uint16_t* out_port,
   m->epfd = epoll_create1(0);
   m->ready_efd = eventfd(0, EFD_NONBLOCK);
   m->wake_efd = eventfd(0, EFD_NONBLOCK);
+  if (m->epfd < 0 || m->ready_efd < 0 || m->wake_efd < 0) {
+    // fd exhaustion etc.: fail the create instead of epoll_ctl'ing -1
+    // handles and leaving the caller with a mux that can never signal
+    if (m->epfd >= 0) close(m->epfd);
+    if (m->ready_efd >= 0) close(m->ready_efd);
+    if (m->wake_efd >= 0) close(m->wake_efd);
+    close(m->listen_fd);
+    delete m;
+    return nullptr;
+  }
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.u64 = UINT64_MAX;  // listen marker

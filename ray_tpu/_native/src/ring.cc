@@ -301,6 +301,55 @@ int rt_ring_push_raw(void* hp, int which, const uint8_t* buf, uint64_t len,
   return kOK;
 }
 
+// Push as many whole framed records from buf[0..len) as currently fit,
+// waiting up to timeout_ms for space for the FIRST record only. buf holds
+// N records in rt_ring_push_raw framing ([u32 len][payload], 8-aligned).
+// Returns bytes consumed (0 on timeout — nothing was pushed), or a
+// negative RingError. The coalesced-flush path uses this to drain a
+// driver-side submit buffer in ONE lock round + at most one consumer
+// wake per call, and to push partial prefixes instead of blocking the
+// submitting thread when the ring is nearly full.
+int64_t rt_ring_push_batch(void* hp, int which, const uint8_t* buf,
+                           uint64_t len, int64_t timeout_ms) {
+  auto* h = (RingHandle*)hp;
+  Ring* r = ring_of(h, which);
+  if (len < 4) return 0;
+  uint32_t len32;
+  memcpy(&len32, buf, 4);
+  uint64_t first = align_up(4 + (uint64_t)len32, 8);
+  if (first > r->capacity) return kTooBig;
+  uint8_t* data = h->base + r->data_off;
+  if (lock(&r->mu) != 0) return kSys;
+  while (true) {
+    if (r->closed) {
+      pthread_mutex_unlock(&r->mu);
+      return kClosed;
+    }
+    if (r->capacity - (r->head - r->tail) >= first) break;
+    int rc = timed_wait(r, timeout_ms);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&r->mu);
+      return 0;
+    }
+    if (rc != 0) {
+      pthread_mutex_unlock(&r->mu);
+      return kSys;
+    }
+  }
+  uint64_t avail = r->capacity - (r->head - r->tail);
+  uint64_t take = 0;
+  while (take + 4 <= len) {
+    memcpy(&len32, buf + take, 4);
+    uint64_t rec = align_up(4 + (uint64_t)len32, 8);
+    if (take + rec > len || take + rec > avail) break;
+    take += rec;
+  }
+  copy_in(data, r->capacity, r->head, buf, take);
+  __atomic_store_n(&r->head, r->head + take, __ATOMIC_RELEASE);
+  unlock_and_wake(r);
+  return (int64_t)take;
+}
+
 // Pop as many whole records as fit into out[outcap]; blocks until at least
 // one record is available (or timeout/closed). Returns total bytes written
 // to out (still [u32 len][payload] framed, 8-aligned), 0 on timeout, or a

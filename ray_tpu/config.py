@@ -84,6 +84,17 @@ class Config:
     fastpath_record_max: int = 256 * 1024
     #: max unreplied fast-path tasks per worker before spilling to RPC
     fastpath_inflight_max: int = 4096
+    #: coalesced ring flush: during a submit burst, records buffer until
+    #: this many are pending (or fastpath_flush_max_bytes), then push in
+    #: ONE native batch — one ring lock round + one consumer wake per
+    #: batch instead of per record. 1 disables buffering entirely.
+    fastpath_flush_max_records: int = 16
+    #: byte cap for one coalesced flush batch
+    fastpath_flush_max_bytes: int = 64 * 1024
+    #: background flusher linger: how long a buffered burst tail may sit
+    #: before the flusher thread pushes it (bounds worst-case added
+    #: latency for fire-and-forget submits; get()/prepass flush sooner)
+    fastpath_flush_linger_us: int = 300
 
     # --- native RPC mux (ref: grpc_server.h:88 completion-queue threads;
     # _native/src/mux.cc) ---

@@ -285,19 +285,62 @@ def wait(
 
 
 # ------------------------------------------------------------------- tasks
+class SubmitTemplate:
+    """Frozen per-handle submission state (ref: the SchedulingKey /
+    lease-cache pairing in normal_task_submitter.h — the reference
+    resolves a task's scheduling identity once and reuses it for every
+    steady-state push).
+
+    Everything a ``.remote()`` call used to re-derive per call — the
+    resources dict, the normalized scheduling strategy, the placement
+    target, the registered function id and the ring scheduling key — is
+    resolved ONCE here, at the first ``.remote()`` of a handle.
+
+    Invalidation story (each falls back to the slow RPC path, which stays
+    the source of truth):
+      * ``.options()`` fork → a NEW RemoteFunction → its own template;
+      * runtime_env / core change → ``env_token``/``core`` mismatch on the
+        next call rebuilds the template;
+      * worker death mid-flight → the fast lane breaks and in-flight ring
+        records replay over RPC (core_client._fast_break_lane); the
+        template itself stays valid.
+    """
+
+    __slots__ = ("core", "env_token", "func_id", "resources", "sched_key",
+                 "num_returns", "max_retries", "placement_group",
+                 "bundle_index", "scheduling_node", "scheduling_strategy",
+                 "name", "runtime_env", "fast_ok")
+
+
 class RemoteFunction:
     """Handle produced by @remote on a function (ref: remote_function.py:41)."""
 
     def __init__(self, fn, **default_opts):
         self._fn = fn
         self._opts = default_opts
+        self._tmpl: SubmitTemplate | None = None
         functools.update_wrapper(self, fn)
+
+    def __getstate__(self):
+        # the template pins the driver's CoreClient: never ship it with a
+        # handle that travels to a worker (it rebuilds there on first use)
+        state = self.__dict__.copy()
+        state["_tmpl"] = None
+        return state
 
     def options(self, **opts) -> "RemoteFunction":
         merged = {**self._opts, **opts}
         return RemoteFunction(self._fn, **merged)
 
     def remote(self, *args, **kwargs):
+        core = get_core()
+        tmpl = self._tmpl
+        if (tmpl is None or tmpl.core is not core
+                or tmpl.env_token is not core.default_runtime_env):
+            tmpl = self._tmpl = self._build_template(core)
+        return core.submit_template(tmpl, self._fn, args, kwargs)
+
+    def _build_template(self, core) -> SubmitTemplate:
         o = self._opts
         resources = dict(o.get("resources") or {})
         resources["CPU"] = float(o.get("num_cpus", 1.0))
@@ -307,25 +350,40 @@ class RemoteFunction:
 
         pg = o.get("placement_group")
         strategy = o.get("scheduling_strategy")
+        bundle_index = o.get("placement_group_bundle_index", -1)
         if isinstance(strategy, scheduling_strategies.
                       PlacementGroupSchedulingStrategy):
             pg = strategy.placement_group
-            o = {**o, "placement_group_bundle_index":
-                 strategy.placement_group_bundle_index}
-        return get_core().submit_task(
-            self._fn,
-            args,
-            kwargs,
-            num_returns=o.get("num_returns", 1),
-            resources=resources,
-            max_retries=o.get("max_retries"),
-            placement_group=pg.id if isinstance(pg, PlacementGroup) else pg,
-            bundle_index=o.get("placement_group_bundle_index", -1),
-            scheduling_node=o.get("_scheduling_node"),
-            scheduling_strategy=scheduling_strategies.normalize(strategy),
-            name=o.get("name"),
-            runtime_env=o.get("runtime_env"),
-        )
+            bundle_index = strategy.placement_group_bundle_index
+        t = SubmitTemplate()
+        t.core = core
+        t.env_token = core.default_runtime_env
+        t.resources = resources
+        t.num_returns = o.get("num_returns", 1)
+        t.max_retries = o.get("max_retries")
+        t.placement_group = pg.id if isinstance(pg, PlacementGroup) else pg
+        t.bundle_index = bundle_index
+        t.scheduling_node = o.get("_scheduling_node")
+        t.scheduling_strategy = scheduling_strategies.normalize(strategy)
+        t.name = o.get("name")
+        t.runtime_env = o.get("runtime_env")
+        t.func_id = None
+        t.sched_key = None
+        t.fast_ok = (
+            t.num_returns == 1 and t.placement_group is None
+            and t.scheduling_node is None and t.runtime_env is None
+            and t.scheduling_strategy is None and t.name is None
+            and t.max_retries is None)
+        if t.fast_ok:
+            # register now (once per template) so steady-state calls skip
+            # the per-call registration probe entirely
+            t.func_id = core._register_function(self._fn)
+            t.fast_ok = bool(getattr(self._fn, "__rt_fast_ok__", False))
+            if t.fast_ok:
+                t.sched_key = (t.func_id,
+                               tuple(sorted(resources.items())),
+                               None, -1, None, None)
+        return t
 
     def __call__(self, *a, **k):
         raise TypeError(

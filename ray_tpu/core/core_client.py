@@ -269,6 +269,14 @@ class CoreClient:
         self._fast_last_submit = 0.0  # burst detector (see _try_fast_submit)
         self._fast_demand_kick = 0.0  # rate-limits backlog->pump kicks
         self._fast_actor_lanes: dict[ActorID, object] = {}
+        # Coalesced ring flush (see FastLane.txbuf): the flusher thread is
+        # the backstop that pushes a burst's buffered tail when no
+        # get()/threshold flush does; started lazily on first deferral.
+        self._fast_flush_cv = _threading.Condition()
+        self._fast_flush_dirty = False
+        self._fast_flusher_thread: _threading.Thread | None = None
+        self._fast_tx_flushes = 0   # batch pushes (stats: bench.py)
+        self._fast_tx_records = 0   # records those pushes carried
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -961,13 +969,23 @@ class CoreClient:
     def _try_fast_submit(self, fn, args, kwargs, resources):
         """User-thread fast submit. Returns an ObjectRef, or None to take
         the RPC path. Must never raise."""
-        from ray_tpu.core import fastpath
-
         func_id = getattr(fn, "__rt_func_id__", None)
         if (func_id is None
                 or not getattr(fn, "__rt_fast_ok__", False)
-                or func_id not in self._registered_funcs
-                or func_id in self._fast_ineligible_funcs):
+                or func_id not in self._registered_funcs):
+            return None
+        key = (func_id, tuple(sorted(resources.items())), None, -1, None,
+               None)
+        return self._fast_submit_keyed(fn, func_id, key, resources,
+                                       args, kwargs)
+
+    def _fast_submit_keyed(self, fn, func_id, key, resources, args, kwargs):
+        """Shared fast-submit tail: the template path enters here directly
+        with its precomputed scheduling key (skipping the per-call getattr
+        probes and resources sort that _try_fast_submit re-derives)."""
+        from ray_tpu.core import fastpath
+
+        if func_id in self._fast_ineligible_funcs:
             return None
         for a in args:
             if isinstance(a, ObjectRef):
@@ -976,8 +994,6 @@ class CoreClient:
             for a in kwargs.values():
                 if isinstance(a, ObjectRef):
                     return None
-        key = (func_id, tuple(sorted(resources.items())), None, -1, None,
-               None)
         state = self.sched_keys.get(key)
         if state is None:
             return None
@@ -988,9 +1004,14 @@ class CoreClient:
         # The ring wins by amortizing thread wakes over a pipelined burst;
         # a lone submit-then-block roundtrip is faster on the RPC path
         # (the loop threads are already hot). Burst = tasks in flight, or
-        # back-to-back submits from the caller.
+        # back-to-back submits from the caller. The coalescing window
+        # (defer) is wider: even a slow-moving burst (per-call cost
+        # inflated by neighbor load) should buffer — deferral is safe
+        # because it additionally requires in-ring work the worker is
+        # already chewing on (see _fast_register_and_push).
         now = time.perf_counter()
-        burst = (now - self._fast_last_submit) < 0.0002
+        gap = now - self._fast_last_submit
+        burst = gap < 0.0002
         self._fast_last_submit = now
         if not burst and not any(ln.inflight for ln in lanes):
             return None
@@ -1018,7 +1039,8 @@ class CoreClient:
                           fastpath.POP_BUF_BYTES - 64):
             return None  # big args belong in the object store
         ref = self._fast_register_and_push(lane, task_id, rec,
-                                           (fn, args, kwargs, resources))
+                                           (fn, args, kwargs, resources),
+                                           defer=gap < 0.002)
         if ref is None:
             return None
         lane.worker.idle_since = time.monotonic()  # keep the lease warm
@@ -1039,12 +1061,20 @@ class CoreClient:
         return ref
 
     def _fast_register_and_push(self, lane, task_id: TaskID, rec: bytes,
-                                light) -> ObjectRef | None:
+                                light, defer: bool = False
+                                ) -> ObjectRef | None:
         """Shared submit tail for task and actor lanes: register the
         in-flight entry under the cv, create the pending memory-store
-        entry, push; on failure undo — unless a concurrent break-lane
-        already snapshotted our entry and resubmitted it over RPC, in
-        which case the ref is handed out as-is (no duplicate call)."""
+        entry, then push — coalesced: the framed record lands in the
+        lane's txbuf and rides one native batch push per burst instead of
+        one ring lock + consumer wake per record. The record pushes
+        immediately unless ``defer`` (burst detected) AND the worker
+        already has in-ring work to chew on; a deferred tail is flushed
+        by the threshold caps, the next blocking get() (fast_prepass), or
+        the flusher thread's linger timer. On a closed ring undo — unless
+        a concurrent break-lane already snapshotted our entry and
+        resubmitted it over RPC, in which case the ref is handed out
+        as-is (no duplicate call)."""
         from ray_tpu.core import fastpath
 
         oid = ObjectID.for_task_return(task_id, 0)
@@ -1054,8 +1084,37 @@ class CoreClient:
             lane.inflight[task_id] = light
             self._fast_oid_lane[oid] = lane
         self.memory_store[oid] = _MemEntry()
-        status = lane.ring.push(fastpath.SUB, rec, timeout_ms=0)
-        if status != 0:  # full or closed: undo, use the RPC path
+        cfg = self.cfg
+        kick = False
+        undo = False
+        framed = fastpath.frame_one(rec)
+        with lane.txlock:
+            lane.txbuf.append(framed)
+            lane.txbytes += len(framed)
+            if (defer and cfg.fastpath_flush_max_records > 1
+                    and len(lane.inflight) > len(lane.txbuf)
+                    and len(lane.txbuf) < cfg.fastpath_flush_max_records
+                    and lane.txbytes < cfg.fastpath_flush_max_bytes):
+                status = 0
+                kick = len(lane.txbuf) == 1  # arm the linger backstop
+            else:
+                status = self._fast_flush_locked(lane, timeout_ms=0)
+                if (status == 0 and lane.txbuf
+                        and lane.txbuf[-1] is framed):
+                    # ring full and OUR record didn't make it in: keep the
+                    # pre-coalescing spill semantics — undo and route this
+                    # task over RPC (other workers stay usable) instead of
+                    # parking it behind one saturated lane. Earlier
+                    # deferred leftovers stay for the flusher.
+                    lane.txbuf.pop()
+                    lane.txbytes -= len(framed)
+                    undo = True
+                kick = bool(lane.txbuf)  # leftovers: flusher finishes
+        if kick:
+            self._fast_flush_kick()
+        if status < 0 or undo:  # closed/unusable/full: undo, use RPC path
+            if status < 0 and status != fastpath._ST_CLOSED:
+                self._fast_break_lane(lane)  # kTooBig/sys: nobody else will
             with self._fast_cv:
                 owned = lane.inflight.pop(task_id, None) is not None
                 self._fast_oid_lane.pop(oid, None)
@@ -1064,6 +1123,106 @@ class CoreClient:
             self.memory_store.pop(oid, None)
             return None
         return self._new_owned_ref(oid)
+
+    def _fast_flush_locked(self, lane, timeout_ms: int = 0) -> int:
+        """Push the lane's buffered records (caller holds lane.txlock) in
+        ONE native batch. Returns 0 when the buffer advanced or the
+        remainder may stay buffered (ring momentarily full — the flusher
+        retries); a negative ring status when the ring is closed/unusable
+        (buffer dropped: every buffered task is registered in
+        lane.inflight, and the break-lane path owns their recovery)."""
+        from ray_tpu.core import fastpath
+
+        if not lane.txbuf:
+            return 0
+        framed = (lane.txbuf[0] if len(lane.txbuf) == 1
+                  else b"".join(lane.txbuf))
+        pushed = lane.ring.push_batch(fastpath.SUB, framed, timeout_ms)
+        if pushed < 0:
+            lane.txbuf.clear()
+            lane.txbytes = 0
+            return pushed
+        if pushed >= len(framed):
+            self._fast_tx_flushes += 1
+            self._fast_tx_records += len(lane.txbuf)
+            lane.txbuf.clear()
+            lane.txbytes = 0
+            return 0
+        if pushed:
+            off = consumed = 0
+            for fr in lane.txbuf:
+                off += len(fr)
+                if off > pushed:
+                    break
+                consumed += 1
+            self._fast_tx_flushes += 1
+            self._fast_tx_records += consumed
+            del lane.txbuf[:consumed]
+            lane.txbytes -= pushed
+        return 0
+
+    def _fast_flush_lane(self, lane, timeout_ms: int = 0) -> int:
+        with lane.txlock:
+            status = self._fast_flush_locked(lane, timeout_ms)
+            leftover = bool(lane.txbuf)
+        if status < 0:
+            from ray_tpu.core import fastpath
+
+            if status != fastpath._ST_CLOSED:
+                self._fast_break_lane(lane)
+        elif leftover:
+            self._fast_flush_kick()  # ring full: the flusher retries
+        return status
+
+    def _fast_flush_kick(self):
+        if self._fast_flusher_thread is None:
+            self._ensure_fast_flusher()
+        with self._fast_flush_cv:
+            self._fast_flush_dirty = True
+            self._fast_flush_cv.notify()
+
+    def _ensure_fast_flusher(self):
+        with self._fast_flush_cv:
+            if self._fast_flusher_thread is not None:
+                return
+            t = _threading.Thread(target=self._fast_flusher,
+                                  name="rt-fastflush", daemon=True)
+            self._fast_flusher_thread = t
+        t.start()
+
+    def _fast_flusher(self):
+        """Backstop flusher: bounds how long a burst's buffered tail can
+        sit when no threshold or blocking get() flushes it (wait(), pure
+        fire-and-forget). One wake per buffering episode, not per record."""
+        linger = max(0.0, self.cfg.fastpath_flush_linger_us / 1e6)
+        while not self._closed:
+            with self._fast_flush_cv:
+                while not self._fast_flush_dirty and not self._closed:
+                    self._fast_flush_cv.wait(0.5)
+                self._fast_flush_dirty = False
+            if self._closed:
+                return
+            if linger:
+                time.sleep(linger)  # let the burst tail accumulate
+            again = False
+            for lane in list(self._fast_lanes):
+                if lane.txbytes and not lane.broken:
+                    self._fast_flush_lane(lane, timeout_ms=20)
+                    if lane.txbytes:
+                        again = True
+            if again:
+                with self._fast_flush_cv:
+                    self._fast_flush_dirty = True
+
+    def fast_flush_stats(self) -> dict:
+        """Coalescing counters for bench.py: batch pushes and the records
+        they carried (avg_batch == 1.0 means no coalescing happened)."""
+        flushes, records = self._fast_tx_flushes, self._fast_tx_records
+        return {
+            "flushes": flushes,
+            "records": records,
+            "avg_batch": (records / flushes) if flushes else 0.0,
+        }
 
     async def _fast_actor_attach(self, actor_id: ActorID, conn):
         """Ring lane to a same-node actor's worker: actor calls then skip
@@ -1145,8 +1304,12 @@ class CoreClient:
                           fastpath.POP_BUF_BYTES - 64):
             self._fast_retire_actor_lane(lane)
             return None
+        now = time.perf_counter()
+        gap = now - self._fast_last_submit
+        self._fast_last_submit = now
         ref = self._fast_register_and_push(
-            lane, task_id, rec, ("actor", actor_id, method, args, kwargs))
+            lane, task_id, rec, ("actor", actor_id, method, args, kwargs),
+            defer=gap < 0.002)
         if ref is not None:
             metrics.actor_calls.inc()
         return ref
@@ -1401,6 +1564,11 @@ class CoreClient:
                     self._fast_oid_lane.pop(
                         ObjectID.for_task_return(task_id, 0), None)
             self._fast_cv.notify_all()
+        with lane.txlock:
+            # buffered records were in the inflight snapshot above (or in
+            # an earlier break's): the RPC resubmission owns them now
+            lane.txbuf.clear()
+            lane.txbytes = 0
         if lane.worker is not None and lane.worker.fast_lane is lane:
             lane.worker.fast_lane = None
         if lane.key and lane.key[0] == "actor":
@@ -1445,6 +1613,11 @@ class CoreClient:
             return {}
         from ray_tpu.core import fastpath
 
+        # about to block on results: push any coalesced submit tail now
+        # rather than waiting out the flusher's linger
+        for lane in list(self._fast_lanes):
+            if lane.txbytes and not lane.broken:
+                self._fast_flush_lane(lane, timeout_ms=20)
         deadline = None if timeout is None else time.monotonic() + timeout
         resolved: dict = {}
         while True:
@@ -1544,15 +1717,47 @@ class CoreClient:
             pass
         return func_id
 
+    def submit_template(self, tmpl, fn, args, kwargs):
+        """Flat steady-state submit: everything a .remote() call used to
+        re-derive per call (resources dict, normalized strategy, placement
+        target, scheduling key, function registration) comes precomputed
+        in the frozen SubmitTemplate (core/api.py). Fast-eligible calls go
+        straight into the ring with the template's key; everything else —
+        and every fast miss — falls through to submit_task, which stays
+        the single source of truth for slow-path semantics and builds a
+        spec byte-identical to a direct submit_task call."""
+        if (tmpl.fast_ok and not self.cfg.tracing_enabled):
+            ref = self._fast_submit_keyed(fn, tmpl.func_id, tmpl.sched_key,
+                                          tmpl.resources, args, kwargs)
+            if ref is not None:
+                return ref
+        return self.submit_task(
+            fn, args, kwargs,
+            num_returns=tmpl.num_returns,
+            resources=dict(tmpl.resources),
+            max_retries=tmpl.max_retries,
+            placement_group=tmpl.placement_group,
+            bundle_index=tmpl.bundle_index,
+            scheduling_node=tmpl.scheduling_node,
+            scheduling_strategy=tmpl.scheduling_strategy,
+            name=tmpl.name,
+            runtime_env=tmpl.runtime_env,
+            _fast_tried=True,
+        )
+
     def submit_task(self, fn, args, kwargs, *, num_returns=1, resources=None,
                     max_retries=None, placement_group=None, bundle_index=-1,
                     scheduling_node=None, scheduling_strategy=None, name=None,
-                    runtime_env=None) -> list[ObjectRef] | ObjectRef:
+                    runtime_env=None,
+                    _fast_tried=False) -> list[ObjectRef] | ObjectRef:
         """Synchronous entry (driver thread) or loop-thread entry (nested).
 
         ``fn`` is a Python callable, or ("cpp", func_name) for cross-language
         submission to a C++ worker (ref: cpp/ worker API; function resolved
-        from the binary's RT_REMOTE registry by name)."""
+        from the binary's RT_REMOTE registry by name). ``_fast_tried``
+        (internal, set by submit_template) records that the ring fast path
+        was already attempted this call, so the burst detector isn't
+        double-counted; it never affects the built task spec."""
         language = "python"
         func_name = None
         if isinstance(fn, tuple) and len(fn) == 2 and fn[0] == "cpp":
@@ -1561,7 +1766,8 @@ class CoreClient:
                 raise TypeError("C++ tasks take positional arguments only")
             func_id = b"cpp:" + func_name.encode()
         else:
-            if (num_returns == 1 and placement_group is None
+            if (not _fast_tried and num_returns == 1
+                    and placement_group is None
                     and scheduling_node is None and runtime_env is None
                     and scheduling_strategy is None
                     and not self.cfg.tracing_enabled
@@ -2848,6 +3054,8 @@ class CoreClient:
     async def close(self):
         await self.task_events.flush()
         self._closed = True
+        with self._fast_flush_cv:  # release the flusher backstop thread
+            self._fast_flush_cv.notify_all()
         for lane in list(self._fast_lanes):
             # wake pump+sweeper (the sweeper owns the unmap); unlink the
             # name NOW so daemon threads killed at exit can't leak /dev/shm

@@ -123,6 +123,20 @@ class RingPair:
         finally:
             self._exit()
 
+    def push_batch(self, which: int, framed: bytes, timeout_ms: int = 0) -> int:
+        """Push as many whole records of a pre-framed buffer as currently
+        fit (waiting up to timeout_ms for the first): returns bytes
+        consumed (>= 0) or a negative _ST_* status. One lock round and at
+        most one consumer wake for the whole batch — the native half of
+        the coalesced flush."""
+        if not self._enter():
+            return _ST_CLOSED
+        try:
+            return self._lib.rt_ring_push_batch(
+                self._h, which, framed, len(framed), timeout_ms)
+        finally:
+            self._exit()
+
     def pop_batch(self, which: int, timeout_ms: int) -> list[bytes] | None:
         """None once closed AND drained; [] on timeout."""
         if not self._enter():
@@ -199,6 +213,12 @@ def frame(records: list[bytes]) -> bytes:
     return b"".join(parts)
 
 
+def frame_one(rec: bytes) -> bytes:
+    """frame([rec]) without the list round-trip (submit hot path)."""
+    pad = (-(4 + len(rec))) % 8
+    return struct.pack("<I", len(rec)) + rec + b"\x00" * pad
+
+
 def unframe(buf: bytes) -> list[bytes]:
     out = []
     off = 0
@@ -266,7 +286,7 @@ class FastLane:
 
     __slots__ = ("ring", "worker", "key", "inflight", "broken", "reader",
                  "return_armed", "rx_lock", "user_wants", "resume_evt",
-                 "retired")
+                 "retired", "txbuf", "txbytes", "txlock")
 
     def __init__(self, ring: RingPair, worker, key):
         self.ring = ring
@@ -276,6 +296,14 @@ class FastLane:
         self.broken = False
         self.reader: threading.Thread | None = None
         self.return_armed = False  # one idle lease-return watcher at a time
+        # Coalesced submit flush: framed records buffered here during a
+        # burst ride ONE rt_ring_push_batch (one ring lock round + at most
+        # one futex wake) instead of a push per record. Every buffered
+        # record is already registered in ``inflight``, so break-lane
+        # recovery treats buffered and in-ring records identically.
+        self.txbuf: list = []
+        self.txbytes = 0
+        self.txlock = threading.Lock()
         # actor lanes: permanently downgraded to the RPC path (the first
         # ineligible call would otherwise race ring traffic and break the
         # per-caller FIFO contract); in-flight records still drain

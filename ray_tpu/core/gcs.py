@@ -641,13 +641,30 @@ class GcsServer:
 
         if not self.persist_path:
             return
-        if (not self.kvstore.had_snapshot
-                and self.kvstore.wal_records == 0):
-            # truly empty native state: this is either a fresh cluster or
-            # the first start after the engine swap — check for (and
-            # migrate) the pre-native persistence format. Once migration
-            # journals anything, wal_records > 0 on the next start, so an
-            # old legacy snapshot can never clobber newer native state.
+        recovered_ops = []
+        legacy_migrated = False
+        for rec in self.kvstore.recovered_aux_records():
+            try:
+                op = _p.loads(rec)
+            except Exception:
+                continue  # CRC passed but unpicklable (version skew): skip
+            if op[0] == "legacy_migrated":
+                legacy_migrated = True
+            recovered_ops.append(op)
+        if not self.kvstore.had_snapshot and not legacy_migrated:
+            # No native snapshot and no positive migration-complete
+            # sentinel: either a fresh cluster, the first start after the
+            # engine swap, or a crash MID-migration (some legacy ops
+            # journaled, sentinel absent). Re-run the migration — its puts
+            # are idempotent (overwrite=False defers to already-migrated
+            # native state), so a partial previous pass can never be
+            # silently dropped nor clobber what it already wrote.
+            # Known narrow edge: a migration completed by a PRE-sentinel
+            # build also lands here (records, no sentinel) and re-puts
+            # legacy keys that native kvdels since removed — absent delete
+            # tombstones the two states are indistinguishable. The window
+            # is ~1s: migration marks dirty and the persist loop writes a
+            # native snapshot (had_snapshot → skip) on its next tick.
             self._restore_legacy()
         aux = self.kvstore.recovered_snapshot_aux()
         if aux:
@@ -659,11 +676,7 @@ class GcsServer:
                 self.pgs = snap.get("pgs", {})
             except Exception:
                 pass  # unreadable table blob: KV still recovered
-        for rec in self.kvstore.recovered_aux_records():
-            try:
-                op = _p.loads(rec)
-            except Exception:
-                continue  # CRC passed but unpicklable (version skew): skip
+        for op in recovered_ops:
             kind = op[0]
             if kind == "job":
                 self.job_counter = max(self.job_counter, op[1])
@@ -678,16 +691,34 @@ class GcsServer:
         self._restored_at = time.monotonic()
 
     def _restore_legacy(self):
-        """One-way migration from the pre-native persistence format (a
-        whole-state pickle snapshot + [u32 len][pickle(op)] WAL). The
-        native engine rejects the old magic and sidelines an unparseable
-        WAL as .wal.legacy; this reads both and re-journals EVERY loaded
-        op into the native WAL, so acknowledged old-format writes are
-        durable immediately — not only after the first snapshot tick."""
+        """Migration from the pre-native persistence format (a whole-state
+        pickle snapshot + [u32 len][pickle(op)] WAL). The native engine
+        rejects the old magic and sidelines an unparseable WAL as
+        .wal.legacy; this reads both and re-journals EVERY loaded op into
+        the native WAL, so acknowledged old-format writes are durable
+        immediately — not only after the first snapshot tick.
+
+        Crash-safe: a ("legacy_migrated",) sentinel aux record journals
+        once BOTH legacy sources migrated fully — and before the legacy
+        WAL file is deleted — and _restore re-runs this whole pass while
+        the sentinel is absent. Re-runs are idempotent: the first write of
+        each key this pass uses overwrite=False (native state — what an
+        interrupted earlier pass already migrated — wins), while later
+        legacy ops on a key this pass already wrote use overwrite=True so
+        the legacy log's own ordering is preserved."""
         import pickle as _p
         import struct as _s
 
         state_loaded = False
+        snap_ok = False   # snapshot portion fully migrated (or absent)
+        wal_ok = False    # WAL portion fully migrated (or absent)
+        touched: set[tuple[str, str]] = set()  # (ns, key) written this pass
+
+        def kv_migrate(ns: str, k: str, v) -> None:
+            self.kvstore.put(ns, k, v, overwrite=(ns, k) in touched,
+                             journal=True)
+            touched.add((ns, k))
+
         try:
             if os.path.exists(self.persist_path):
                 with open(self.persist_path, "rb") as f:
@@ -699,7 +730,7 @@ class GcsServer:
                         if ns == "metrics":
                             continue
                         for k, v in table.items():
-                            self.kvstore.put(ns, k, v, journal=True)
+                            kv_migrate(ns, k, v)
                     self.job_counter = snap.get("job_counter", 0)
                     self.actors = snap.get("actors", {})
                     self.named_actors = snap.get("named_actors", {})
@@ -714,11 +745,14 @@ class GcsServer:
                     for pg in self.pgs.values():
                         self.kvstore.journal_aux(_p.dumps(("pg", pg)))
                     state_loaded = True
+            snap_ok = True  # absent, non-legacy, or fully journaled
         except Exception:
             pass
         legacy_wal = self.persist_path + ".wal.legacy"
         try:
-            if os.path.exists(legacy_wal):
+            if not os.path.exists(legacy_wal):
+                wal_ok = True
+            else:
                 with open(legacy_wal, "rb") as f:
                     buf = f.read()
                 off = 0
@@ -733,9 +767,10 @@ class GcsServer:
                     off += 4 + ln
                     kind = op[0]
                     if kind == "kvput":
-                        self.kvstore.put(op[1], op[2], op[3], journal=True)
+                        kv_migrate(op[1], op[2], op[3])
                     elif kind == "kvdel":
                         self.kvstore.delete(op[1], op[2], journal=True)
+                        touched.add((op[1], op[2]))
                     elif kind == "job":
                         self.job_counter = max(self.job_counter, op[1])
                         self.kvstore.journal_aux(_p.dumps(op))
@@ -752,11 +787,24 @@ class GcsServer:
                         self.pgs[op[1].pg_id] = op[1]
                         self.kvstore.journal_aux(_p.dumps(op))
                     state_loaded = True
-                # every op above is now in the native WAL (flushed per
-                # append): the legacy copy is redundant
-                os.remove(legacy_wal)
+                wal_ok = True
         except Exception:
             pass
+        if snap_ok and wal_ok:
+            try:
+                # Migration-complete sentinel: journaled only when BOTH
+                # legacy sources migrated fully, and BEFORE the legacy WAL
+                # is deleted — a crash anywhere earlier leaves the sentinel
+                # absent (next start re-runs the idempotent migration with
+                # every source still on disk); a crash between sentinel
+                # and remove only leaks an already-migrated file.
+                self.kvstore.journal_aux(_p.dumps(("legacy_migrated",)))
+                if os.path.exists(legacy_wal):
+                    # every replayed op is in the native WAL (flushed per
+                    # append): the legacy copy is redundant
+                    os.remove(legacy_wal)
+            except Exception:
+                pass
         if state_loaded:
             self.mark_dirty()  # next snapshot converts to native format
 

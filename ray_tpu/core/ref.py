@@ -215,7 +215,13 @@ class ActorHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         num_returns = self._options.get("method_num_returns", {}).get(name)
-        return ActorMethod(self, name, num_returns=num_returns)
+        m = ActorMethod(self, name, num_returns=num_returns)
+        # cache on the instance: the next ``handle.method`` hits plain
+        # attribute lookup and skips both __getattr__ and the ActorMethod
+        # rebuild — the actor-call analogue of the submit template. NOT
+        # serialized (__reduce__ rebuilds from ids alone).
+        self.__dict__[name] = m
+        return m
 
     def __reduce__(self):
         return (_rebuild_actor_handle, (self._actor_id, self._method_names, self._options))
@@ -240,9 +246,13 @@ class ActorMethod:
             concurrency_group or self._concurrency_group)
 
     def remote(self, *args, **kwargs) -> Any:
-        from ray_tpu.core import api
+        core = self._handle._core
+        if core is None:
+            from ray_tpu.core import api
 
-        core = self._handle._core or api.get_core()
+            # backfill a deserialized handle once: later calls (and later
+            # methods of the same handle) skip the lookup
+            core = self._handle._core = api.get_core()
         return core.submit_actor_task(
             self._handle, self._name, args, kwargs,
             num_returns=self._num_returns or 1,

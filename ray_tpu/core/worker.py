@@ -513,44 +513,71 @@ class Worker:
                     continue
                 replies = []
                 bad_record = False
-                for rec in recs:
-                    try:
-                        tid, func_id, args, kwargs = fastpath.unpack_task(rec)
-                    except Exception:
-                        # undecodable record: without its task id there is
-                        # nothing to reply to. Flush the replies of the
-                        # batch-mates that ALREADY executed, then close the
-                        # ring so the driver recovers only the rest —
-                        # otherwise completed side effects would re-run.
-                        bad_record = True
+                closed = False
+                contended = False
+                while True:
+                    for rec in recs:
+                        try:
+                            tid, func_id, args, kwargs = (
+                                fastpath.unpack_task(rec))
+                        except Exception:
+                            # undecodable record: without its task id there
+                            # is nothing to reply to. Flush the replies of
+                            # the batch-mates that ALREADY executed, then
+                            # close the ring so the driver recovers only
+                            # the rest — otherwise completed side effects
+                            # would re-run.
+                            bad_record = True
+                            break
+                        fn = load(func_id)
+                        if not fn:
+                            replies.append(fastpath.pack_reply(
+                                tid, fastpath.NEED_SLOW, b""))
+                            continue
+                        # _exec_mutex: an RPC-path normal task may be on the
+                        # executor thread right now (the driver's quiet-lane
+                        # preference is not an exclusion). Bounded acquire,
+                        # NOT a blocking one: the RPC task may itself be
+                        # waiting on THIS ring record (nested get on a ref
+                        # buried in a container arg) — on contention reply
+                        # NEED_SLOW so the driver reroutes to a free worker
+                        # instead of deadlocking the lease.
+                        if not self._exec_mutex.acquire(timeout=0.05):
+                            contended = True
+                            replies.append(fastpath.pack_reply(
+                                tid, fastpath.NEED_SLOW, b""))
+                            continue
+                        try:
+                            ok, val = True, fn(*args, **kwargs)
+                        except BaseException as e:  # noqa: BLE001 — reply on
+                            ok, val = False, e
+                        finally:
+                            self._exec_mutex.release()
+                        replies.append(
+                            self._fast_pack_result(tid, ok, val, inline_max))
+                    # Reply-drain coalescing: records that arrived while
+                    # this batch executed join the SAME reply frame — a
+                    # pipelined burst costs the driver one reply wake per
+                    # merged batch, not per pop. Bounded so the first
+                    # caller's results are never held hostage to a
+                    # never-empty ring; and NEVER merged past a mutex-
+                    # contention NEED_SLOW — the occupant may be blocked
+                    # on the driver rerouting exactly these records, and
+                    # each further merged record would burn another 50ms
+                    # acquire timeout before the reroute signal ships.
+                    if bad_record or contended or len(replies) >= 64:
                         break
-                    fn = load(func_id)
-                    if not fn:
-                        replies.append(
-                            fastpath.pack_reply(tid, fastpath.NEED_SLOW, b""))
-                        continue
-                    # _exec_mutex: an RPC-path normal task may be on the
-                    # executor thread right now (the driver's quiet-lane
-                    # preference is not an exclusion). Bounded acquire,
-                    # NOT a blocking one: the RPC task may itself be
-                    # waiting on THIS ring record (nested get on a ref
-                    # buried in a container arg) — on contention reply
-                    # NEED_SLOW so the driver reroutes to a free worker
-                    # instead of deadlocking the lease.
-                    if not self._exec_mutex.acquire(timeout=0.05):
-                        replies.append(
-                            fastpath.pack_reply(tid, fastpath.NEED_SLOW, b""))
-                        continue
-                    try:
-                        ok, val = True, fn(*args, **kwargs)
-                    except BaseException as e:  # noqa: BLE001 — reply on
-                        ok, val = False, e
-                    finally:
-                        self._exec_mutex.release()
-                    replies.append(
-                        self._fast_pack_result(tid, ok, val, inline_max))
+                    if not ring.pending(fastpath.SUB):
+                        break
+                    more = ring.pop_batch(fastpath.SUB, timeout_ms=0)
+                    if more is None:
+                        closed = True  # still flush what already executed
+                        break
+                    if not more:
+                        break
+                    recs = more
                 status = self._fast_push_replies(ring, replies)
-                if bad_record or status != 0:
+                if bad_record or closed or status != 0:
                     break  # ring closed/undecodable: driver recovers
         finally:
             # on ANY exit — clean close or unexpected error — close the

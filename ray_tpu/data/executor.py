@@ -173,9 +173,9 @@ class ActorPoolMapBlocks(Operator):
 
     def transform(self, refs, stats):
         t0 = time.perf_counter()
-        WorkerCls = ray_tpu.remote(_MapWorker)
+        WorkerCls = ray_tpu.remote(_MapWorker).options(num_cpus=self.num_cpus)
         actors = [
-            WorkerCls.options(num_cpus=self.num_cpus).remote(
+            WorkerCls.remote(
                 self.fn_or_cls, self.fn_constructor_args,
                 self.fn_constructor_kwargs)
             for _ in range(self.size)
@@ -395,8 +395,9 @@ class ShuffleOp(Operator):
                     stats.tasks += 1
             round_splits.clear()
 
+        split_task = _shuffle_split.options(num_returns=n_parts)
         for r in in_refs:
-            split = _shuffle_split.options(num_returns=n_parts).remote(
+            split = split_task.remote(
                 r, int(rng.randint(0, 2**31 - 1)), n_parts)
             stats.tasks += 1
             round_splits.append(split if isinstance(split, list) else [split])

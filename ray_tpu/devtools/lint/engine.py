@@ -146,6 +146,10 @@ class Context:
     # name -> element count, for np/jnp arrays bound in the current scope
     # (simple forward-flow map used by RT004's closure-capture check)
     array_bindings: dict[str, int] = field(default_factory=dict)
+    # nesting depth of enclosing loop BODIES (for/while/comprehension)
+    # within the current function scope; unlike for_targets this also
+    # counts while-loops — RT009 fires on any per-iteration re-derivation
+    loop_depth: int = 0
 
     # -- reporting ----------------------------------------------------------
     def report(self, rule: Rule, node: ast.AST, message: str):
@@ -286,10 +290,13 @@ class Walker:
             ctx.remote_stack.append(frame)
         saved_arrays = dict(ctx.array_bindings)
         saved_targets = ctx.for_targets
+        saved_depth = ctx.loop_depth
         ctx.for_targets = []  # a nested def body doesn't run per-iteration
+        ctx.loop_depth = 0
         for stmt in node.body:
             self.walk(stmt)
         ctx.for_targets = saved_targets
+        ctx.loop_depth = saved_depth
         ctx.array_bindings = saved_arrays
         if frame is not None:
             ctx.remote_stack.pop()
@@ -302,9 +309,12 @@ class Walker:
             if default is not None:
                 self.walk(default)
         saved_targets = ctx.for_targets
+        saved_depth = ctx.loop_depth
         ctx.for_targets = []
+        ctx.loop_depth = 0
         self.walk(node.body)
         ctx.for_targets = saved_targets
+        ctx.loop_depth = saved_depth
 
     def _walk_class(self, node: ast.ClassDef):
         is_actor = self.ctx.remote_decorator(node) is not None
@@ -322,13 +332,17 @@ class Walker:
             self.walk(node.iter)  # evaluated once, outside the loop
             self.walk(node.target)
             ctx.for_targets.append(_target_names(node.target))
+            ctx.loop_depth += 1
             for stmt in node.body:
                 self.walk(stmt)
+            ctx.loop_depth -= 1
             ctx.for_targets.pop()
-        else:  # While: no bound targets, nothing to track
+        else:  # While: no bound targets, but still a per-iteration body
             self.walk(node.test)
+            ctx.loop_depth += 1
             for stmt in node.body:
                 self.walk(stmt)
+            ctx.loop_depth -= 1
         for stmt in node.orelse:
             self.walk(stmt)
 
@@ -338,6 +352,7 @@ class Walker:
         self.walk(gens[0].iter)  # first iterable evaluates once
         ctx.for_targets.append(
             set().union(*[_target_names(g.target) for g in gens]))
+        ctx.loop_depth += 1
         for gen in gens:
             self.walk(gen.target)
             if gen is not gens[0]:
@@ -349,6 +364,7 @@ class Walker:
             self.walk(node.value)
         else:
             self.walk(node.elt)
+        ctx.loop_depth -= 1
         ctx.for_targets.pop()
 
     # -- RT004 dataflow -----------------------------------------------------

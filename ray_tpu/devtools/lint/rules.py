@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT008: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT009: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -249,3 +249,30 @@ class SleepInRemoteWithoutRetry(Rule):
                        "time.sleep() in a remote task declared without "
                        "max_retries; add @remote(max_retries=...) or poll "
                        "via wait(timeout=...)")
+
+
+@register
+class OptionsRemoteInLoop(Rule):
+    id = "RT009"
+    summary = ".options(...).remote(...) inside a loop body"
+    rationale = ("each .options() call forks a fresh handle and re-derives "
+                 "its submission template (resources, normalized scheduling "
+                 "strategy, placement target) per iteration, defeating the "
+                 "per-handle template cache; hoist the .options() handle "
+                 "out of the loop and call .remote() on it")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if not ctx.uses_framework or not ctx.loop_depth:
+            return
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "remote"):
+            return
+        inner = f.value
+        if (isinstance(inner, ast.Call)
+                and isinstance(inner.func, ast.Attribute)
+                and inner.func.attr == "options"):
+            ctx.report(self, node,
+                       ".options(...).remote(...) in a loop re-derives a "
+                       "submission template every iteration; hoist "
+                       "`h = fn.options(...)` above the loop and call "
+                       "h.remote() inside it")

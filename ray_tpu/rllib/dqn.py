@@ -209,9 +209,9 @@ class DQN:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         self.config = config
-        RunnerCls = ray_tpu.remote(DQNEnvRunner)
+        RunnerCls = ray_tpu.remote(DQNEnvRunner).options(num_cpus=0.5)
         self.runners = [
-            RunnerCls.options(num_cpus=0.5).remote(
+            RunnerCls.remote(
                 config.env_name, config.num_envs_per_runner,
                 seed=config.seed + 1000 * i, env_config=config.env_config,
             )

@@ -108,11 +108,11 @@ class PPO:
                 return factory_or_pipe
             return factory_or_pipe()
 
-        RunnerCls = ray_tpu.remote(EnvRunner)
+        RunnerCls = ray_tpu.remote(EnvRunner).options(num_cpus=0.5)
         e2m = config.env_to_module_connector
         m2e = config.module_to_env_connector
         self.runners = [
-            RunnerCls.options(num_cpus=0.5).remote(
+            RunnerCls.remote(
                 config.env_name, config.num_envs_per_runner,
                 seed=config.seed + 1000 * i, env_config=config.env_config,
                 env_to_module=build_pipe(e2m),
@@ -142,10 +142,11 @@ class PPO:
             "collective_backend": config.collective_backend,
             "learner_connector": config.learner_connector,
         }
-        LearnerCls = ray_tpu.remote(Learner)
+        LearnerCls = ray_tpu.remote(Learner).options(
+            num_cpus=1.0, max_concurrency=2)
         group = f"rl_learners_{id(self)}"
         self.learners = [
-            LearnerCls.options(num_cpus=1.0, max_concurrency=2).remote(
+            LearnerCls.remote(
                 rank, config.num_learners, learner_cfg, group
             )
             for rank in range(config.num_learners)

@@ -264,7 +264,9 @@ class ServeController:
                     opts["scheduling_strategy"] = (
                         NodeAffinitySchedulingStrategy(target_node, soft=True))
             handle = (
-                ray_tpu.remote(Replica)
+                # per-replica name + placement: the options legitimately
+                # differ every iteration, no handle to hoist
+                ray_tpu.remote(Replica)  # raylint: disable=RT009
                 .options(
                     name=actor_name,
                     max_concurrency=max(8, cfg.max_ongoing_requests + 2),

@@ -164,7 +164,8 @@ class JaxTrainer:
         pg.ready(timeout=60)
         WorkerCls = ray_tpu.remote(TrainWorker)
         workers = [
-            WorkerCls.options(
+            # per-worker bundle_index: options differ every iteration
+            WorkerCls.options(  # raylint: disable=RT009
                 num_cpus=scaling.worker_resources().get("CPU", 1.0),
                 resources={k: v for k, v in scaling.worker_resources().items()
                            if k != "CPU"},

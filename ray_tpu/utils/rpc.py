@@ -631,17 +631,36 @@ class NativeRpcServer(RpcServer):
 
     async def start(self) -> tuple[str, int]:
         import ctypes
+        import socket
 
-        from ray_tpu import _native
+        try:
+            from ray_tpu import _native
 
-        lib = _native.get_lib()
+            lib = _native.get_lib()
+            # mux.cc binds with inet_addr (numeric only): resolve names
+            # here — 'localhost' would otherwise parse as INADDR_NONE and
+            # bind to 255.255.255.255
+            host = self._host
+            try:
+                host = socket.gethostbyname(host)
+            except OSError:
+                pass  # let the native bind reject it -> asyncio fallback
+            out_port = ctypes.c_uint16(0)
+            out_efd = ctypes.c_int(-1)
+            h = lib.rt_mux_create(host.encode(), self._port,
+                                  ctypes.byref(out_port),
+                                  ctypes.byref(out_efd))
+            if not h:
+                raise OSError(
+                    f"rt_mux_create failed on {host}:{self._port}")
+        except Exception:
+            # degrade to the asyncio transport (identical dispatch
+            # surface) instead of aborting GCS/raylet startup — a host
+            # string or environment that worked under start_server must
+            # keep working when the native mux can't come up
+            return await super().start()
         self._lib = lib
-        out_port = ctypes.c_uint16(0)
-        out_efd = ctypes.c_int(-1)
-        h = lib.rt_mux_create(self._host.encode(), self._port,
-                              ctypes.byref(out_port), ctypes.byref(out_efd))
-        if not h:
-            raise OSError(f"rt_mux_create failed on {self._host}:{self._port}")
+        self._host = host
         self._mux = h
         self._efd = out_efd.value
         self._port = out_port.value
@@ -716,6 +735,11 @@ class NativeRpcServer(RpcServer):
                 _resolve_multi(conn._pending, msg["f"])
 
     async def stop(self):
+        if self._mux is None and self._server is not None:
+            # start() degraded to the asyncio transport: its stop path
+            # owns the listener socket and stream connections
+            await super().stop()
+            return
         _LOCAL_SERVERS.pop((self._host, self._port), None)
         if self._loop is not None and self._efd >= 0:
             try:

@@ -287,6 +287,11 @@ def pack_into(meta: bytes, buffers: list, dest: memoryview) -> int:
 def pack(obj: Any) -> bytes:
     """One-shot serialize to a contiguous blob (inline/small-object path)."""
     meta, buffers = dumps_with_buffers(obj)
+    if not buffers:
+        # submission hot path: small task args/results carry no
+        # out-of-band buffers — skip the bytearray + pack_into round trip
+        # (byte-identical wire layout: [u32 meta_len][meta])
+        return struct.pack("<I", len(meta)) + meta
     out = bytearray(total_size(meta, buffers))
     pack_into(meta, buffers, memoryview(out))
     return bytes(out)

@@ -127,6 +127,7 @@ class _Storage:
     <root>/<workflow_id>/{dag.pkl, status.json, steps/<step_id>.pkl}"""
 
     def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
         self.dir = _wf_dir(workflow_id)
 
     def _ensure_dirs(self):
@@ -195,6 +196,11 @@ def _execute(dag: StepNode, storage: _Storage) -> Any:
         args = [submit(a) if isinstance(a, StepNode) else a for a in node.args]
         kwargs = {k: submit(v) if isinstance(v, StepNode) else v
                   for k, v in node.kwargs.items()}
+        if getattr(node.step, "_rt_event_listener", None) is not None:
+            # event waits poll keys scoped to THIS workflow first (see
+            # KVEventListener.event_keys) so runs can't consume each
+            # other's payloads
+            kwargs["_wf_event_scope"] = storage.workflow_id
         remote_fn = ray_tpu.remote(node.step.fn)
         ref = remote_fn.options(
             num_cpus=node.step.num_cpus,
@@ -212,9 +218,57 @@ def _execute(dag: StepNode, storage: _Storage) -> Any:
     # last saved step; one batched get would checkpoint all-or-nothing
     for node, ref in order:  # topological: deps checkpoint before dependents
         storage.save_step(node.step_id, ray_tpu.get(ref))  # raylint: disable=RT002
+        listener = getattr(node.step, "_rt_event_listener", None)
+        if listener is not None:
+            # the payload is checkpointed now — delete the consumed KV
+            # entry so a later run's wait can't short-circuit on it and
+            # event blobs stop accumulating in the GCS WAL/snapshot
+            _cleanup_event_keys(listener, storage.workflow_id, node)
     if isinstance(out, ObjectRef):
         return ray_tpu.get(out)
     return out
+
+
+def _cleanup_event_keys(listener_cls, workflow_id: str, node: StepNode) -> None:
+    """Best-effort delete of the CONSUMED event's KV entry, AFTER the
+    waiting step checkpointed its result — a crash before the checkpoint
+    must keep the payload for the re-wait.
+
+    Only what the wait ACTUALLY consumed is deleted: the poll records the
+    consumed key under a marker entry (see consumed_marker), so a sibling
+    payload under the other candidate key — e.g. a shared-key event
+    addressed to a different workflow, or a freshly posted scoped event
+    for this workflow's NEXT wait — is never collaterally destroyed."""
+    keys_fn = getattr(listener_cls, "event_keys", None)
+    if keys_fn is None or not node.args:
+        return
+    try:
+        from ray_tpu.core import api as _core_api
+
+        core = _core_api.get_core()
+        targets = []
+        marker = None
+        marker_fn = getattr(listener_cls, "consumed_marker", None)
+        if marker_fn is not None:
+            marker = marker_fn(workflow_id, node.args[0])
+            consumed = core._run_sync(core.gcs.call(
+                "kv_get", {"ns": listener_cls.NS, "key": marker}))
+            if consumed is not None:
+                targets = [consumed.decode()]
+        if not targets:
+            # no marker (replayed-from-checkpoint node, legacy DAG): the
+            # conservative fallback deletes only the scoped key, which is
+            # addressed to this workflow by construction
+            candidates = keys_fn(workflow_id, node.args[0])
+            targets = candidates[:1] if len(candidates) > 1 else candidates
+        for k in targets:
+            core._run_sync(core.gcs.call(
+                "kv_del", {"ns": listener_cls.NS, "key": k}))
+        if marker is not None:
+            core._run_sync(core.gcs.call(
+                "kv_del", {"ns": listener_cls.NS, "key": marker}))
+    except Exception:
+        pass  # a failed delete only leaves a stale blob behind
 
 
 def _run_to_completion(storage: _Storage, dag: StepNode) -> Any:
@@ -326,9 +380,36 @@ class KVEventListener(EventListener):
     """Fires when ``send_event(key, payload)`` posts to the cluster KV —
     the cross-process event channel (ref: the HTTP event provider role,
     workflow/http_event_provider.py, over this framework's GCS KV
-    instead of an HTTP endpoint)."""
+    instead of an HTTP endpoint).
+
+    Event lifecycle: a wait step polls the key scoped to its own workflow
+    id first (``send_event(key, payload, workflow_id=...)``), then the
+    shared plain key; once the waiting step's result is checkpointed the
+    consumed entries are deleted (see _cleanup_event_keys), so a stale
+    payload from a previous run can never short-circuit a later wait and
+    blobs don't accumulate in the GCS WAL. Consequence: a shared-key
+    event is consumed by ONE workflow — to address several concurrent
+    workflows, send each a workflow_id-scoped event (or distinct keys);
+    a shared key is not a broadcast channel."""
 
     NS = "wf_events"
+    workflow_id: str | None = None  # injected by the wait_for_event step
+
+    @classmethod
+    def event_keys(cls, workflow_id: str | None, key: str) -> list[str]:
+        """KV keys consulted for ``key``, most specific first."""
+        keys = []
+        if workflow_id:
+            keys.append(f"wf:{workflow_id}:{key}")
+        keys.append(key)
+        return keys
+
+    @classmethod
+    def consumed_marker(cls, workflow_id: str, key: str) -> str:
+        """KV key recording WHICH entry a workflow's wait consumed, so
+        the post-checkpoint cleanup deletes exactly that entry — never a
+        sibling payload addressed to someone else."""
+        return f"wf-consumed::{workflow_id}::{key}"
 
     def poll_for_event(self, key: str, poll_interval_s: float = 0.2,
                        timeout_s: float | None = None):
@@ -336,21 +417,37 @@ class KVEventListener(EventListener):
         from ray_tpu.core import api as _core_api
 
         core = _core_api.get_core()
+        candidates = self.event_keys(self.workflow_id, key)
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         while True:
-            blob = core._run_sync(core.gcs.call(
-                "kv_get", {"ns": self.NS, "key": key}))
-            if blob is not None:
-                return cloudpickle.loads(blob)
+            for k in candidates:
+                blob = core._run_sync(core.gcs.call(
+                    "kv_get", {"ns": self.NS, "key": k}))
+                if blob is not None:
+                    if self.workflow_id:
+                        # record the consumed key BEFORE returning: the
+                        # driver-side cleanup reads it after the step
+                        # checkpoints (a crash-retry simply overwrites it)
+                        core._run_sync(core.gcs.call("kv_put", {
+                            "ns": self.NS,
+                            "key": self.consumed_marker(self.workflow_id,
+                                                        key),
+                            "value": k.encode()}))
+                    return cloudpickle.loads(blob)
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(f"no event {key!r} within {timeout_s}s")
             time.sleep(poll_interval_s)
 
 
-def send_event(key: str, payload: Any = None) -> None:
-    """Deliver an event to any KVEventListener waiting on ``key``."""
+def send_event(key: str, payload: Any = None,
+               workflow_id: str | None = None) -> None:
+    """Deliver an event to any KVEventListener waiting on ``key``; with
+    ``workflow_id`` the payload is addressed to that workflow's waits
+    only (other workflows sharing the key name never observe it)."""
     from ray_tpu.core import api as _core_api
 
+    if workflow_id:
+        key = f"wf:{workflow_id}:{key}"
     core = _core_api.get_core()
     core._run_sync(core.gcs.call("kv_put", {
         "ns": KVEventListener.NS, "key": key,
@@ -367,10 +464,14 @@ def wait_for_event(listener_cls: type, *args, name: str | None = None,
             and issubclass(listener_cls, EventListener)):
         raise TypeError("wait_for_event takes an EventListener subclass")
 
-    def poll(*a, **k):
-        return listener_cls().poll_for_event(*a, **k)
+    def poll(*a, _wf_event_scope=None, **k):
+        listener = listener_cls()
+        listener.workflow_id = _wf_event_scope
+        return listener.poll_for_event(*a, **k)
 
     wrapped = WorkflowStep(
         poll, name=name or f"wait_{listener_cls.__name__}",
         num_cpus=num_cpus)
+    # marks the step for scope injection + post-checkpoint KV cleanup
+    wrapped._rt_event_listener = listener_cls
     return wrapped.bind(*args, **kwargs)

@@ -28,6 +28,8 @@ void* rt_ring_pair_create(const char* name, uint64_t cap_each);
 void* rt_ring_pair_open(const char* name);
 int rt_ring_push(void* h, int which, const uint8_t* buf, uint64_t len,
                  int64_t timeout_ms);
+int64_t rt_ring_push_batch(void* h, int which, const uint8_t* buf,
+                           uint64_t len, int64_t timeout_ms);
 int64_t rt_ring_pop_batch(void* h, int which, uint8_t* out, uint64_t outcap,
                           int64_t timeout_ms);
 uint64_t rt_ring_pending(void* h, int which);
@@ -75,21 +77,63 @@ struct Side {
 };
 
 void producer(void* h, int which, Side* s, unsigned seed) {
+  // alternates the per-record entry point with the coalesced batch one
+  // (rt_ring_push_batch — the driver's flush path), so both stay under
+  // the sanitizer matrix; batch pushes may land a PREFIX of the frames
+  // (partial push when the ring is nearly full), which the accounting
+  // below mirrors.
   std::vector<uint8_t> rec(2048);
+  std::vector<uint8_t> framed;
   while (!stop_flag.load(std::memory_order_relaxed)) {
-    uint64_t len = 1 + (seed = seed * 1103515245 + 12345) % 1500;
-    for (uint64_t i = 0; i < len; i++) rec[i] = (uint8_t)(seed + i);
-    int st = rt_ring_push(h, which, rec.data(), len, 50);
-    if (st == 0) {
-      s->pushed++;
-      s->push_bytes += len;
-      for (uint64_t i = 0; i < len; i++) s->push_sum += rec[i];
-    } else if (st == -7) {  // closed
-      return;
-    } else if (st != -4) {  // -4 = timeout (ok under contention)
-      fail("unexpected push status");
+    bool batch = ((seed = seed * 1103515245 + 12345) >> 16) & 1;
+    if (!batch) {
+      uint64_t len = 1 + seed % 1500;
+      for (uint64_t i = 0; i < len; i++) rec[i] = (uint8_t)(seed + i);
+      int st = rt_ring_push(h, which, rec.data(), len, 50);
+      if (st == 0) {
+        s->pushed++;
+        s->push_bytes += len;
+        for (uint64_t i = 0; i < len; i++) s->push_sum += rec[i];
+      } else if (st == -7) {  // closed
+        return;
+      } else if (st != -4) {  // -4 = timeout (ok under contention)
+        fail("unexpected push status");
+        return;
+      }
+      continue;
+    }
+    // build 2-5 framed records, push in one batch call
+    framed.clear();
+    int nrec = 2 + seed % 4;
+    std::vector<uint64_t> lens;
+    for (int r = 0; r < nrec; r++) {
+      uint64_t len = 1 + (seed = seed * 1103515245 + 12345) % 700;
+      lens.push_back(len);
+      uint32_t len32 = (uint32_t)len;
+      size_t base = framed.size();
+      framed.resize((base + 4 + len + 7) & ~7ull, 0);
+      memcpy(framed.data() + base, &len32, 4);
+      for (uint64_t i = 0; i < len; i++)
+        framed[base + 4 + i] = (uint8_t)(seed + i);
+    }
+    int64_t took = rt_ring_push_batch(h, which, framed.data(),
+                                      framed.size(), 50);
+    if (took == -7) return;  // closed
+    if (took < 0) {
+      fail("unexpected push_batch status");
       return;
     }
+    // credit exactly the consumed prefix (whole records by contract)
+    int64_t off = 0;
+    for (int r = 0; r < nrec && off < took; r++) {
+      uint64_t len = lens[r];
+      s->pushed++;
+      s->push_bytes += len;
+      for (uint64_t i = 0; i < len; i++)
+        s->push_sum += framed[off + 4 + i];
+      off += (int64_t)((4 + len + 7) & ~7ull);
+    }
+    if (off > took) fail("push_batch consumed a partial record");
   }
 }
 

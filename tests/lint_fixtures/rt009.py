@@ -1,0 +1,54 @@
+"""RT009 fixture: .options(...).remote(...) inside a loop body."""
+import ray_tpu
+
+
+@ray_tpu.remote
+def f(x):
+    return x
+
+
+def per_iteration_options(items):
+    refs = []
+    for x in items:
+        refs.append(f.options(num_cpus=2).remote(x))  # expect: RT009
+    return ray_tpu.get(refs)
+
+
+def while_loop_options(actor):
+    n = 0
+    refs = []
+    while n < 10:
+        refs.append(actor.step.options(num_returns=1).remote())  # expect: RT009
+        n += 1
+    return refs
+
+
+def comprehension_options(items):
+    return [f.options(name="t").remote(x) for x in items]  # expect: RT009
+
+
+def hoisted_is_clean(items):
+    h = f.options(num_cpus=2)  # options derived once: template cached
+    refs = [h.remote(x) for x in items]
+    return ray_tpu.get(refs)
+
+
+def plain_remote_in_loop_is_clean(items):
+    refs = [f.remote(x) for x in items]
+    return ray_tpu.get(refs)
+
+
+def options_outside_loop_is_clean(x):
+    return f.options(num_cpus=2).remote(x)
+
+
+def deferred_body_is_clean(items):
+    # the lambda body runs later, not per iteration of this loop
+    return [lambda x=x: f.options(num_cpus=2).remote(x) for x in items]
+
+
+def loop_in_nested_def_is_clean(items):
+    def inner(x):
+        return f.options(num_cpus=2).remote(x)
+
+    return [inner for _ in range(3)]

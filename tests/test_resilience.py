@@ -249,6 +249,58 @@ def test_gcs_wal_survives_kill_between_mutations(tmp_path):
         io.stop()
 
 
+def test_legacy_migration_survives_crash_midway(tmp_path):
+    """ADVICE r5 (gcs.py:645): a crash mid legacy-format migration must
+    not drop the unmigrated remainder. A partial pass leaves
+    wal_records > 0 but NO ("legacy_migrated",) sentinel — the next start
+    re-runs the (idempotent) migration instead of skipping it."""
+    import pickle
+
+    from ray_tpu.core.gcs import GcsServer
+    from ray_tpu.core.gcs_store import NativeGcsStore
+    from ray_tpu.utils import rpc as _rpc
+
+    snap = str(tmp_path / "gcs.snap")
+    # a legacy-format (pre-native) whole-state pickle snapshot
+    with open(snap, "wb") as f:
+        pickle.dump({
+            "kv": {"app": {"k1": b"v1", "k2": b"v2", "k3": b"v3"}},
+            "job_counter": 3, "actors": {}, "named_actors": {}, "pgs": {},
+        }, f)
+    # simulate the interrupted first pass: one key migrated (natively
+    # journaled), then death — before k2/k3 and before the sentinel
+    partial = NativeGcsStore(snap)
+    assert not partial.had_snapshot  # legacy magic rejected by the engine
+    partial.put("app", "k1", b"v1", journal=True)
+    partial.close()
+
+    io = _rpc.EventLoopThread()
+    gcs = GcsServer(persist_path=snap)
+    io.run(gcs.start())
+    try:
+        assert gcs.kvstore.wal_records > 0  # the old skip condition
+        for k, v in (("k1", b"v1"), ("k2", b"v2"), ("k3", b"v3")):
+            assert gcs.kvstore.get("app", k) == v, (
+                f"legacy key {k} dropped by the interrupted migration")
+        assert gcs.job_counter == 3
+    finally:
+        io.run(gcs.stop())
+
+    # completed migration journals the sentinel: a restart (still no
+    # native snapshot tick needed) must NOT re-clobber newer native state
+    store = NativeGcsStore(snap)
+    store.put("app", "k2", b"v2-updated", journal=True)
+    store.close()
+    gcs2 = GcsServer(persist_path=snap)
+    io.run(gcs2.start())
+    try:
+        assert gcs2.kvstore.get("app", "k2") == b"v2-updated", (
+            "sentinel ignored: migration re-ran over newer native state")
+    finally:
+        io.run(gcs2.stop())
+        io.stop()
+
+
 # --------------------------------------------------------------- chaos harness
 def test_chaos_interval_killer_workload_completes():
     """VERDICT r4 task 7 (ref: _private/test_utils.py:1419

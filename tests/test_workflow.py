@@ -182,6 +182,99 @@ def test_wait_for_event_kv(rt):
     assert workflow.resume("ev1") == ({"msg": "launch"}, 42)
 
 
+def test_stale_event_not_reused_across_runs(rt):
+    """ADVICE r5 (workflow/api.py:347): consumed events are deleted once
+    the waiting step checkpoints, so a LATER workflow waiting on the same
+    key can't short-circuit on the stale payload."""
+    import threading
+    import time as _time
+
+    @workflow.step
+    def ident(v):
+        return v
+
+    def poke():
+        _time.sleep(0.8)
+        workflow.send_event("reused-key", "first")
+
+    t = threading.Thread(target=poke, daemon=True)
+    t.start()
+    out = workflow.run(
+        ident.bind(workflow.wait_for_event(
+            workflow.KVEventListener, "reused-key",
+            poll_interval_s=0.05, timeout_s=30)),
+        workflow_id="ev-stale-1")
+    t.join()
+    assert out == "first"
+    # resume still short-circuits from the CHECKPOINT (not the KV entry)
+    assert workflow.resume("ev-stale-1") == "first"
+    # ...but a NEW workflow on the same key must wait (and here, time
+    # out) instead of consuming the previous run's payload
+    with pytest.raises(Exception):
+        workflow.run(
+            ident.bind(workflow.wait_for_event(
+                workflow.KVEventListener, "reused-key",
+                poll_interval_s=0.05, timeout_s=0.6)),
+            workflow_id="ev-stale-2")
+    assert workflow.get_status("ev-stale-2") == "FAILED"
+
+
+def test_workflow_scoped_event_delivery(rt):
+    """send_event(..., workflow_id=...) addresses one workflow's wait;
+    the scoped key wins over (and never leaks into) the shared key."""
+    import threading
+    import time as _time
+
+    @workflow.step
+    def ident(v):
+        return v
+
+    def poke():
+        _time.sleep(0.8)
+        workflow.send_event("scoped-key", "mine", workflow_id="ev-scope-1")
+
+    t = threading.Thread(target=poke, daemon=True)
+    t.start()
+    out = workflow.run(
+        ident.bind(workflow.wait_for_event(
+            workflow.KVEventListener, "scoped-key",
+            poll_interval_s=0.05, timeout_s=30)),
+        workflow_id="ev-scope-1")
+    t.join()
+    assert out == "mine"
+
+
+def test_scoped_consumption_leaves_shared_event(rt):
+    """A wait satisfied by its scoped key must NOT collaterally delete a
+    shared-key payload another workflow is still polling for."""
+    import threading
+    import time as _time
+
+    @workflow.step
+    def ident(v):
+        return v
+
+    del threading, _time  # both payloads pre-posted: timing-independent
+    # a shared-key payload addressed to some OTHER workflow, plus the
+    # scoped payload for THIS one — scoped-first polling must consume
+    # the scoped entry and leave the shared one alone
+    workflow.send_event("dual-key", "for-someone-else")
+    workflow.send_event("dual-key", "mine", workflow_id="ev-dual-1")
+    out = workflow.run(
+        ident.bind(workflow.wait_for_event(
+            workflow.KVEventListener, "dual-key",
+            poll_interval_s=0.05, timeout_s=30)),
+        workflow_id="ev-dual-1")
+    assert out == "mine"
+    from ray_tpu.core import api as _core_api
+
+    core = _core_api.get_core()
+    assert core._run_sync(core.gcs.call(
+        "kv_exists", {"ns": workflow.KVEventListener.NS,
+                      "key": "dual-key"})), (
+        "shared-key payload collaterally deleted by a scoped consume")
+
+
 def test_wait_for_event_timer_and_timeout(rt):
     @workflow.step
     def done(v):
