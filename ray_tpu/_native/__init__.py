@@ -204,6 +204,10 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_gcs_journal_aux.argtypes = [ctypes.c_void_p, cp, u64]
             lib.rt_gcs_wal_ok.restype = ctypes.c_int
             lib.rt_gcs_wal_ok.argtypes = [ctypes.c_void_p]
+            lib.rt_gcs_set_fsync.restype = None
+            lib.rt_gcs_set_fsync.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_gcs_wal_sync.restype = ctypes.c_int
+            lib.rt_gcs_wal_sync.argtypes = [ctypes.c_void_p]
             lib.rt_gcs_snapshot_aux.restype = ctypes.c_int
             lib.rt_gcs_snapshot_aux.argtypes = [ctypes.c_void_p, u8p, u64, p64]
             lib.rt_gcs_aux_count.restype = u64

@@ -33,6 +33,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include <fcntl.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -70,6 +71,17 @@ struct GcsStore {
   std::string snapshot_aux;  // aux blob from the snapshot file
   bool had_snapshot = false;
   uint64_t wal_records = 0;  // records applied during open()'s replay
+  // opt-in machine-crash durability (rt_gcs_set_fsync): appends mark the
+  // WAL dirty and rt_gcs_wal_sync group-commits them with one fdatasync;
+  // snapshots fsync the tmp file before the rename and the directory
+  // after it. Off (default) = fflush-only: survives a process kill (the
+  // bytes are in the OS page cache) but not a machine crash.
+  bool do_fsync = false;
+  bool wal_dirty = false;  // appended since the last fdatasync
+  // a record was dropped by the append-failure rewind: the in-memory
+  // table is ahead of the WAL, so durability is broken until the next
+  // snapshot captures the table (wal_sync reports -1 meanwhile)
+  bool wal_lost = false;
 };
 
 void put_u16(std::string& out, uint16_t v) { out.append((const char*)&v, 2); }
@@ -116,12 +128,15 @@ void wal_append(GcsStore* s, const std::string& payload) {
       fflush(s->wal) != 0) {
     if (pos >= 0 && ftruncate(fileno(s->wal), pos) == 0) {
       fseek(s->wal, pos, SEEK_SET);
+      s->wal_lost = true;  // record dropped: not durable until snapshot
     } else {
       fclose(s->wal);
       s->wal = nullptr;
       s->wal_broken = true;
     }
+    return;
   }
+  s->wal_dirty = true;  // group commit: rt_gcs_wal_sync makes it durable
 }
 
 bool load_snapshot(GcsStore* s) {
@@ -361,6 +376,43 @@ int rt_gcs_wal_ok(void* h) {
   return (!s->path.empty() && !s->wal_broken) ? 1 : 0;
 }
 
+// ---- opt-in durability (group-committed fdatasync) ---------------------
+void rt_gcs_set_fsync(void* h, int on) {
+  auto* s = (GcsStore*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->do_fsync = on != 0;
+}
+
+// fdatasync the WAL iff records were appended since the last sync. The
+// caller (Python group-commit barrier) batches: N writes acked in one
+// event-loop tick share ONE disk sync. Returns 0 synced/clean, -1 error —
+// including a broken WAL or a record dropped by the append-failure
+// rewind: writes that never reached the WAL must surface as not-durable,
+// not be silently acked (the next snapshot repairs/truncates the WAL and
+// restores the guarantee). The fdatasync runs on a dup'd fd OUTSIDE the
+// store mutex: a multi-millisecond disk sync under s->mu would block
+// every concurrent kv operation (and the GCS event loop behind them).
+int rt_gcs_wal_sync(void* h) {
+  auto* s = (GcsStore*)h;
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    if (s->wal_broken || s->wal_lost) return -1;
+    if (!s->wal_dirty || !s->wal) return 0;
+    fd = dup(fileno(s->wal));  // survives a concurrent snapshot's fclose
+    if (fd < 0) return -1;
+    s->wal_dirty = false;
+  }
+  int rc = fdatasync(fd);
+  close(fd);
+  if (rc != 0) {
+    std::lock_guard<std::mutex> lk(s->mu);
+    s->wal_dirty = true;  // restore: the records are still unsynced
+    return -1;
+  }
+  return 0;
+}
+
 // ---- recovery accessors ----------------------------------------------
 int rt_gcs_snapshot_aux(void* h, uint8_t* buf, uint64_t buflen,
                         uint64_t* out_len) {
@@ -414,6 +466,10 @@ int rt_gcs_snapshot(void* h, const char* aux, uint64_t auxlen,
     }
   }
   ok = (fflush(f) == 0) && ok;
+  // machine-crash safety (opt-in): the tmp file's bytes must be on disk
+  // BEFORE the rename makes it the live snapshot, or a crash could leave
+  // a correctly-named file with garbage contents
+  if (ok && s->do_fsync && fsync(fileno(f)) != 0) ok = false;
   fclose(f);
   if (!ok) {
     remove(tmp.c_str());
@@ -423,6 +479,17 @@ int rt_gcs_snapshot(void* h, const char* aux, uint64_t auxlen,
     remove(tmp.c_str());
     return -4;
   }
+  if (s->do_fsync) {
+    // persist the rename itself: fsync the containing directory
+    size_t slash = s->path.find_last_of('/');
+    std::string dir = slash == std::string::npos ? "." : s->path.substr(0, slash);
+    if (dir.empty()) dir = "/";
+    int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+      fsync(dfd);  // best effort: the data fsync above is the hard gate
+      close(dfd);
+    }
+  }
   // state up to now is in the snapshot: the journal restarts empty
   if (s->wal) {
     fclose(s->wal);
@@ -430,6 +497,8 @@ int rt_gcs_snapshot(void* h, const char* aux, uint64_t auxlen,
   }
   remove(s->wal_path.c_str());
   s->wal_broken = false;
+  s->wal_dirty = false;
+  s->wal_lost = false;  // table state is in the snapshot: durable again
   s->recovered_aux.clear();
   s->snapshot_aux.assign(aux, auxlen);
   s->had_snapshot = true;

@@ -137,11 +137,18 @@ Ring* ring_of(RingHandle* h, int which) {
 // lands within microseconds, and a shared-futex sleep/wake round measured
 // 60-90us per side here (vs ~1us for a yield). sched_yield (rather than a
 // pause loop) matters on single-core hosts: it hands the core to the peer
-// instead of burning the timeslice it needs. Returns true if the
+// instead of burning the timeslice it needs — and while the consumer
+// spins its `waiters` stays 0, so the producer skips ITS wake syscall
+// too: a fast round trip (the completion lane's submit->execute->reply
+// ping-pong) can close with zero futex calls on either side. 64
+// iterations spans the peer's turnaround for a small task (each yield
+// hands it a scheduler slice); an idle ring still reaches the futex
+// sleep after ~65 yields, which return immediately when nothing else is
+// runnable, so parked consumers stay cheap. Returns true if the
 // condition became true without sleeping.
 template <typename F>
 bool spin_for(F cond) {
-  for (int i = 0; i < 8; i++) {
+  for (int i = 0; i < 64; i++) {
     if (cond()) return true;
     sched_yield();
   }
@@ -414,7 +421,10 @@ void rt_ring_close(void* hp, int which) {
   auto* h = (RingHandle*)hp;
   Ring* r = ring_of(h, which);
   if (lock(&r->mu) == 0) {
-    r->closed = 1;
+    // atomic store: rt_ring_pop_batch's pre-lock spin and rt_ring_closed
+    // read `closed` without the mutex, so the write must be atomic too
+    // (mixed plain/atomic access to one location is UB and a TSAN race)
+    __atomic_store_n(&r->closed, 1, __ATOMIC_RELEASE);
     pthread_cond_broadcast(&r->cv);
     pthread_mutex_unlock(&r->mu);
   }
@@ -422,7 +432,7 @@ void rt_ring_close(void* hp, int which) {
 
 int rt_ring_closed(void* hp, int which) {
   auto* h = (RingHandle*)hp;
-  return (int)ring_of(h, which)->closed;
+  return (int)__atomic_load_n(&ring_of(h, which)->closed, __ATOMIC_ACQUIRE);
 }
 
 void rt_ring_pair_close(void* hp) {

@@ -95,6 +95,16 @@ class Config:
     #: before the flusher thread pushes it (bounds worst-case added
     #: latency for fire-and-forget submits; get()/prepass flush sooner)
     fastpath_flush_linger_us: int = 300
+    #: completion fast lane: results at or below this many bytes travel
+    #: inside the ring completion record itself (no object-store put, no
+    #: location registration); larger results are sealed into the node's
+    #: shm arena and the record carries (size) so the driver's location
+    #: cache is primed at completion time
+    fastpath_inline_result_max: int = 8 * 1024
+    #: how long the worker pump keeps retrying a partial reply-ring push
+    #: before spilling the undelivered completion records to the driver
+    #: over RPC (driver stalled / result ring full)
+    fastpath_reply_spill_ms: int = 200
 
     # --- native RPC mux (ref: grpc_server.h:88 completion-queue threads;
     # _native/src/mux.cc) ---
@@ -118,6 +128,16 @@ class Config:
     #: <= 0 disables the monitor
     memory_usage_threshold: float = 0.95
     memory_monitor_refresh_s: float = 1.0
+
+    # --- GCS durability (ref: ray_config_def.h GCS storage knobs) ---
+    #: opt-in machine-crash durability for the GCS WAL: every journaled
+    #: table write is fdatasync'd (group-committed — concurrent writes in
+    #: one loop tick share a single sync) before its RPC is acked, and
+    #: snapshots fsync the tmp file before the rename plus the directory
+    #: after it. Default off: the WAL is flushed to the OS page cache on
+    #: every append, which survives a GCS process kill but not a machine
+    #: crash/power loss.
+    gcs_fsync: bool = False
 
     # --- timeouts / health (ref: gcs_health_check_manager.h:59) ---
     health_check_period_s: float = 1.0

@@ -241,6 +241,12 @@ def get(refs, timeout: float | None = None):
     # fast-path refs resolve straight off the shm reply rings, in this
     # thread, without a loop round-trip (see core/fastpath.py)
     fast = core.fast_prepass(ref_list, timeout)
+    # completion fast lane: anything already local (ready memory-store
+    # entries, sealed local shm results) resolves on this thread too —
+    # the loop round-trip is only paid for genuinely remote/pending refs
+    if len(fast) < len(ref_list):
+        fast.update(core.get_local_prepass(
+            [r for r in ref_list if r.id not in fast]))
     slow_refs = ([r for r in ref_list if r.id not in fast]
                  if fast else ref_list)
     slow_values = []
@@ -259,6 +265,8 @@ def get(refs, timeout: float | None = None):
             values.append(next(it))
         elif hit[0] == "v":
             values.append(serialization.unpack(hit[1]))
+        elif hit[0] == "V":
+            values.append(hit[1])
         else:
             raise hit[1]
     return values[0] if single else values
@@ -281,6 +289,13 @@ def wait(
     refs = list(refs)
     if num_returns > len(refs):
         raise ValueError("num_returns > len(refs)")
+    # completion fast lane: ready refs are counted on this thread, and a
+    # shortfall made up purely of fast-lane in-flight refs waits on the
+    # reply-stream condition variable (ring completions wake it) — the
+    # loop path is only for refs it alone can resolve (borrowed, RPC)
+    res = core.fast_wait_prepass(refs, num_returns, timeout)
+    if res is not None:
+        return res
     return core._run_sync(core.wait_async(refs, num_returns, timeout, fetch_local))
 
 

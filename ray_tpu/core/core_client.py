@@ -235,6 +235,14 @@ class CoreClient:
         self._shipped_at: dict[ObjectID, float] = {}
         self._owner_conns: dict[tuple, rpc.Connection] = {}
         self._owner_conn_locks: dict[tuple, asyncio.Lock] = {}
+        # Completion-time location cache (ref: SURVEY §1 L0/L2 —
+        # owner-resident object metadata): oid -> set of holder node ids,
+        # primed by completion records / location registrations so
+        # steady-state get() never consults the GCS object directory.
+        # Invalidated on holder death via the "nodes" pubsub channel; the
+        # directory stays the source of truth (pull falls back to it on a
+        # stale hint).
+        self._obj_locations: dict[ObjectID, set] = {}
         # lineage for reconstruction (ref: task_manager.h:182 lineage pinning)
         self._lineage: dict[TaskID, dict] = {}
         self._lineage_live: dict[TaskID, set] = {}  # return oids still live
@@ -277,6 +285,7 @@ class CoreClient:
         self._fast_flusher_thread: _threading.Thread | None = None
         self._fast_tx_flushes = 0   # batch pushes (stats: bench.py)
         self._fast_tx_records = 0   # records those pushes carried
+        self._fast_spilled_results = 0  # completions that arrived via RPC spill
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -301,6 +310,13 @@ class CoreClient:
                 # raylet's chunked transfer RPCs instead of mapped.
                 self.store = None
         self.job_id = await self.gcs.call("register_job", {})
+        # holder-death signal for the location cache (dedicated channel:
+        # "nodes" also carries per-heartbeat resource gossip this client
+        # has no use for)
+        try:
+            await self.gcs.call("subscribe", {"channel": "node_removed"})
+        except Exception:
+            pass  # cache misses fall back to the directory anyway
         self._bg.spawn(self.task_events._flush_loop(), self.loop)
         if self.cfg.fastpath_enabled and self.store is not None:
             self._bg.spawn(self._fast_health_loop(), self.loop)
@@ -314,6 +330,17 @@ class CoreClient:
         if channel.startswith("actor:"):
             actor_id = ActorID.from_hex(channel.split(":", 1)[1])
             self._actor_info[actor_id] = message
+        elif channel == "node_removed" and isinstance(message, dict):
+            # holder died: drop it from every cached location so the next
+            # get falls back to the GCS directory (source of truth)
+            node_id = message.get("node_id")
+            nb = node_id.binary() if hasattr(node_id, "binary") else node_id
+            for oid in [o for o, holders in self._obj_locations.items()
+                        if nb in holders]:
+                holders = self._obj_locations[oid]
+                holders.discard(nb)
+                if not holders:
+                    del self._obj_locations[oid]
 
     # ----------------------------------------------------------- ownership
     # Distributed reference counting (ref: reference_count.h:72): the owner
@@ -390,6 +417,7 @@ class CoreClient:
             return
         self._shipped_at.pop(oid, None)
         self._borrowers.pop(oid, None)
+        self._obj_locations.pop(oid, None)
         entry = self.memory_store.pop(oid, None)
         # lineage pins its task's arg refs only while some return is live
         self._release_lineage_for(oid)
@@ -549,17 +577,96 @@ class CoreClient:
 
     async def _register_location(self, oid: ObjectID):
         holders = {self.node_id.binary()}
+        self._obj_locations.setdefault(oid, set()).add(self.node_id.binary())
         await self.gcs.call(
             "kv_put", {"ns": "obj_loc", "key": oid.hex(), "value": pickle.dumps(holders)}
         )
 
+    async def _pull_via_raylet(self, oid: ObjectID) -> bool:
+        """pull_object through the local raylet, passing the cached holder
+        set as a hint so the steady-state pull skips the GCS directory
+        lookup; a failed hinted pull drops the (stale) cache entry — the
+        raylet already fell back to the directory inside the call."""
+        payload = {"object_id": oid.binary()}
+        hint = self._obj_locations.get(oid)
+        if hint:
+            payload["holders_hint"] = sorted(hint)
+        ok = await self.raylet.call("pull_object", payload)
+        if hint:
+            if ok:
+                # the one holder we now KNOW is our own node (the pull
+                # landed locally); stale entries — e.g. a dead node the
+                # raylet fell back past — drop in the same move
+                self._obj_locations[oid] = {self.node_id.binary()}
+            else:
+                self._obj_locations.pop(oid, None)
+        return ok
+
     # ----------------------------------------------------------------- get
     async def get_async(self, refs: list[ObjectRef], timeout: float | None = None):
+        refs = list(refs)
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = []
-        for ref in refs:
-            out.append(await self._get_one(ref, deadline))
+        if len(refs) <= 1:
+            return [await self._get_one(ref, deadline) for ref in refs]
+        # inline sweep: ready non-shm entries resolve without spawning a
+        # task per ref (the common get([...]) over completed results)
+        out: list = [None] * len(refs)
+        pending: list[int] = []
+        for i, ref in enumerate(refs):
+            entry = self.memory_store.get(ref.id)
+            if (entry is not None and entry.ready.is_set()
+                    and entry.error is None and not entry.in_shm):
+                if entry.packed is not None:
+                    out[i] = serialization.unpack(entry.packed)
+                else:
+                    out[i] = entry.value
+            else:
+                pending.append(i)
+        if not pending:
+            return out
+        # batched location priming: one kv_multi_get covers every shm ref
+        # whose holder set is unknown, instead of one directory RPC per
+        # ref inside the pulls below
+        await self._prime_locations([refs[i] for i in pending])
+        results = await asyncio.gather(
+            *(self._get_one(refs[i], deadline) for i in pending),
+            return_exceptions=True)
+        for i, r in zip(pending, results):
+            if isinstance(r, BaseException):
+                raise r  # first error in ref order, like the serial path
+            out[i] = r
         return out
+
+    async def _prime_locations(self, refs: list[ObjectRef]):
+        """Coalesce location misses for ready shm-resident refs into ONE
+        GCS kv_multi_get (ref: owner-resident metadata — the slow path
+        paid one obj_loc kv_get per ref)."""
+        need = []
+        seen = set()
+        for ref in refs:
+            oid = ref.id
+            if oid in seen or oid in self._obj_locations:
+                continue
+            entry = self.memory_store.get(oid)
+            if (entry is not None and entry.ready.is_set() and entry.in_shm
+                    and (self.store is None or not self.store.contains(oid))):
+                seen.add(oid)
+                need.append(oid)
+        if len(need) < 2:
+            return
+        try:
+            blobs = await self.gcs.call(
+                "kv_multi_get", {"ns": "obj_loc",
+                                 "keys": [o.hex() for o in need]})
+        except Exception:
+            return  # per-ref pulls fall back to the directory themselves
+        for oid in need:
+            blob = (blobs or {}).get(oid.hex())
+            if blob:
+                try:
+                    self._obj_locations[oid] = set(pickle.loads(blob))
+                except Exception:
+                    pass
 
     async def _get_one(self, ref: ObjectRef, deadline: float | None):
         oid = ref.id
@@ -636,7 +743,7 @@ class CoreClient:
                     # contains() and get(): re-pull from another holder (the
                     # raylet consults the GCS directory); no holder → lost,
                     # unless lineage can re-execute the producing task.
-                    ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+                    ok = await self._pull_via_raylet(oid)
                     if expired:
                         raise GetTimeoutError(f"get timed out on {ref}") from None
                     if not ok:
@@ -648,7 +755,7 @@ class CoreClient:
                     continue
             if entry is not None:
                 if entry.ready.is_set():  # owned, in_shm, not local: pull it
-                    ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+                    ok = await self._pull_via_raylet(oid)
                     if expired:
                         # pull issued (or refused) but the value is still not
                         # local and the deadline passed: raise rather than
@@ -690,7 +797,7 @@ class CoreClient:
             if reply.get("inline") is not None:
                 return serialization.unpack(reply["inline"])
             # large object: pull into local shm through our raylet
-            ok = await self.raylet.call("pull_object", {"object_id": oid.binary()})
+            ok = await self._pull_via_raylet(oid)
             if not ok:
                 pull_fails += 1
                 if pull_fails in (5, 15, 30):  # escalate: owner re-executes
@@ -709,7 +816,7 @@ class CoreClient:
         with the chunked transfer RPCs — the remote-driver read path)."""
         obj = {"object_id": oid.binary()}
         try:
-            ok = await self.raylet.call("pull_object", obj)
+            ok = await self._pull_via_raylet(oid)
             if not ok:
                 return None
             meta = await self.raylet.call("fetch_object_meta", obj)
@@ -817,11 +924,7 @@ class CoreClient:
                         # start moving the payload to this node (ref:
                         # ray.wait fetch_local semantics)
                         self._bg.spawn(
-                            self.raylet.call(
-                                "pull_object", {"object_id": ref.id.binary()}
-                            ),
-                            self.loop,
-                        )
+                            self._pull_via_raylet(ref.id), self.loop)
                     return True
                 if not r.get("known"):
                     await asyncio.sleep(0.2)  # not created yet (or freed)
@@ -951,8 +1054,9 @@ class CoreClient:
         except Exception:
             return
         try:
-            ok = await w.conn.call("attach_fast_ring", {"name": name},
-                                   timeout=10)
+            ok = await w.conn.call(
+                "attach_fast_ring",
+                {"name": name, "owner": list(self.address)}, timeout=10)
         except Exception:
             ok = False
         if not ok or w not in state.workers:
@@ -1001,23 +1105,35 @@ class CoreClient:
                  if w.fast_lane is not None and not w.fast_lane.broken]
         if not lanes:
             return None
-        # The ring wins by amortizing thread wakes over a pipelined burst;
-        # a lone submit-then-block roundtrip is faster on the RPC path
-        # (the loop threads are already hot). Burst = tasks in flight, or
-        # back-to-back submits from the caller. The coalescing window
-        # (defer) is wider: even a slow-moving burst (per-call cost
-        # inflated by neighbor load) should buffer — deferral is safe
-        # because it additionally requires in-ring work the worker is
-        # already chewing on (see _fast_register_and_push).
+        # Burst traffic (tasks in flight, or back-to-back submits) rides
+        # any lane: the ring amortizes thread wakes over the pipeline.
+        # The coalescing window (defer) is wider: even a slow-moving
+        # burst (per-call cost inflated by neighbor load) should buffer —
+        # deferral is safe because it additionally requires in-ring work
+        # the worker is already chewing on (see _fast_register_and_push).
         now = time.perf_counter()
         gap = now - self._fast_last_submit
         burst = gap < 0.0002
         self._fast_last_submit = now
+        lone = False
         if not burst and not any(ln.inflight for ln in lanes):
-            return None
+            # Completion fast lane: a lone submit-then-block call rides
+            # the ring too — the blocking get() steals the reply-ring
+            # consumer (fast_prepass), so the round trip is two futex
+            # wakes instead of an RPC frame + event-loop hop on each
+            # side. Only onto a worker with no RPC batch committed: if
+            # every leased worker is mid-batch, the RPC path's
+            # free-worker routing wins.
+            lanes = [ln for ln in lanes if not ln.worker.busy]
+            if not lanes:
+                return None
+            lone = True
         cap = self.cfg.fastpath_inflight_max
         n = len(lanes)
-        start = self._task_counter % n
+        # lone submit/get loops stick to one lane: its worker pump stays
+        # hot (spin-paired, no futex sleep) and the blocking get's steal
+        # loop stays single-lane; round-robin is for pipelined bursts
+        start = 0 if lone else self._task_counter % n
         lane = None
         for i in range(n):
             cand = lanes[(start + i) % n]
@@ -1250,8 +1366,10 @@ class CoreClient:
         except Exception:
             return
         try:
-            ok = await conn.call("attach_fast_ring",
-                                 {"name": name, "kind": "actor"}, timeout=10)
+            ok = await conn.call(
+                "attach_fast_ring",
+                {"name": name, "kind": "actor",
+                 "owner": list(self.address)}, timeout=10)
         except Exception:
             ok = False
         if not ok or self._actor_conns.get(actor_id) is not conn:
@@ -1371,6 +1489,15 @@ class CoreClient:
                 task_id = TaskID(tid_b)
                 light = lane.inflight.pop(task_id, None)
                 oid = ObjectID.for_task_return(task_id, 0)
+                if light is None:
+                    # untracked completion: a duplicate delivery (the
+                    # spill RPC's timeout path may re-send records whose
+                    # first copy DID land) or a task the break-lane /
+                    # cancel recovery already owns — both are no-ops here
+                    # (at-least-once delivery, exactly-once application)
+                    entry = self.memory_store.get(oid)
+                    if entry is None or entry.ready.is_set():
+                        continue
                 self._fast_oid_lane.pop(oid, None)
                 if status != fastpath.NEED_SLOW:
                     self._fast_done[oid] = (status, payload)
@@ -1385,6 +1512,27 @@ class CoreClient:
                 self.loop.call_soon_threadsafe(self._drain_fast_migrations)
             except RuntimeError:
                 pass  # loop gone (shutdown)
+
+    async def rpc_fast_result(self, conn, p):
+        """Result-ring spill receiver: completion records the worker could
+        not push into a full result ring arrive here over RPC (the slow
+        road backs the fast lane in both directions). Records whose task
+        is no longer tracked on a lane (break-lane recovery or cancel got
+        there first) are dropped — the RPC resubmission owns them."""
+        from ray_tpu.core import fastpath
+
+        by_lane: dict[int, tuple] = {}
+        with self._fast_cv:
+            for rec in p["records"]:
+                tid_b, status, payload = fastpath.unpack_reply(rec)
+                oid = ObjectID.for_task_return(TaskID(tid_b), 0)
+                lane = self._fast_oid_lane.get(oid)
+                if lane is not None:
+                    by_lane.setdefault(id(lane), (lane, []))[1].append(rec)
+        for lane, recs in by_lane.values():
+            self._fast_spilled_results += len(recs)
+            self._fast_process_replies(lane, recs)
+        return True
 
     def _drain_fast_migrations(self):
         """Loop-side completion: fill memory-store entries, emit events,
@@ -1406,6 +1554,7 @@ class CoreClient:
             # between timer-linger (blocking-call traffic) and disarm
             # (burst traffic) — see below
         lanes_to_check = set()
+        result_bytes: dict = {}
         for task_id, oid, status, payload, light in batch:
             if status == fastpath.NEED_SLOW:
                 if light is not None:
@@ -1423,6 +1572,11 @@ class CoreClient:
             entry = self.memory_store.get(oid)
             if light is None:
                 name = "task"
+                if entry is None or entry.ready.is_set():
+                    # duplicate delivery that slipped past the intake
+                    # guard (first copy drained in between): the value,
+                    # events and metrics were all applied already
+                    continue
             elif light[0] == "actor":
                 name = light[2]
             else:
@@ -1432,6 +1586,13 @@ class CoreClient:
                     entry.packed = payload
                 elif status == fastpath.OK_SHM:
                     entry.in_shm = True
+                    # fast lanes are same-node: the completion record IS
+                    # the location registration for the cache (the GCS
+                    # directory write below stays the source of truth);
+                    # its size payload feeds the task event below
+                    result_bytes[oid] = fastpath.unpack_shm_size(payload)
+                    self._obj_locations.setdefault(oid, set()).add(
+                        self.node_id.binary())
                     if light is not None and light[0] != "actor":
                         # shm results can be evicted: keep real lineage
                         # (actor calls have no reconstruction, as in the
@@ -1449,9 +1610,13 @@ class CoreClient:
             self._cancelled_tasks.discard(task_id)
             outcome = "failed" if status == fastpath.ERR else "ok"
             metrics.tasks_finished.inc(tags={"outcome": outcome})
-            self.task_events.emit(
-                task_id=task_id.hex(), name=name,
-                state="FAILED" if status == fastpath.ERR else "FINISHED")
+            ev = dict(task_id=task_id.hex(), name=name,
+                      state="FAILED" if status == fastpath.ERR
+                      else "FINISHED")
+            size = result_bytes.get(oid)
+            if size:
+                ev["result_bytes"] = size  # shm-sealed result size
+            self.task_events.emit(**ev)
             with self._fast_cv:
                 self._fast_done.pop(oid, None)
         # a RETIRED actor lane whose in-flight records have all drained is
@@ -1683,8 +1848,109 @@ class CoreClient:
                     out[oid] = ("e", pickle.loads(payload))
                 except Exception as e:
                     out[oid] = ("e", TaskError(f"task failed: {e!r}"))
-            # OK_SHM: leave for the normal path (arena read after migration)
+            elif status == fastpath.OK_SHM and self.store is not None:
+                # the worker sealed the result into the local arena before
+                # replying: read it zero-copy right here on the caller
+                # thread instead of waiting out the loop migration
+                hit = self.store.try_get(oid)
+                if hit is not None:
+                    out[oid] = ("V", hit[0])
+                # else evicted/racing: the normal path pulls/rebuilds
         return out
+
+    def get_local_prepass(self, refs) -> dict:
+        """Caller-thread get: resolve refs whose values are already local —
+        ready memory-store entries unpack in place, sealed local shm
+        objects read zero-copy through the arena mapping — WITHOUT the
+        event-loop round trip the async path pays per call. Never blocks;
+        anything unresolved (pending, remote, evicted) is left for
+        get_async, which stays the source of truth. Returns
+        {oid: ("V", value) | ("e", exc)}."""
+        out: dict = {}
+        store = self.store
+        for ref in refs:
+            oid = ref.id
+            if oid in out:
+                continue
+            entry = self.memory_store.get(oid)
+            if entry is None or not entry.ready.is_set():
+                continue
+            if entry.error is not None:
+                out[oid] = ("e", entry.error)
+                continue
+            if not entry.in_shm:
+                try:
+                    if entry.packed is not None:
+                        out[oid] = ("V", serialization.unpack(entry.packed))
+                    else:
+                        out[oid] = ("V", entry.value)
+                except Exception:
+                    continue  # let the slow path surface the failure
+                continue
+            if store is not None:
+                hit = store.try_get(oid)
+                if hit is not None:
+                    out[oid] = ("V", hit[0])
+                # absent/pending/evicted: the async pull path owns it
+        return out
+
+    def fast_wait_prepass(self, refs, num_returns: int,
+                          timeout: float | None):
+        """Caller-thread wait. Ready refs (memory-store entries, local shm
+        objects, fast-lane completions) are counted without touching the
+        event loop; when the shortfall consists ENTIRELY of fast-lane
+        in-flight refs, block on the reply-stream condition variable —
+        completions wake it directly — instead of parking watcher tasks on
+        the loop. Returns (ready, pending) in ref order, or None when some
+        pending ref needs the loop path (borrowed refs, RPC-path tasks:
+        wait_async owns those blocking semantics)."""
+        if _in_loop(self.loop):
+            return None  # loop thread: _run_sync's guard owns the error
+        refs = list(refs)
+        # wait never runs the get prepass: push any coalesced submit tail
+        # now rather than waiting out the flusher's linger
+        for lane in list(self._fast_lanes):
+            if lane.txbytes and not lane.broken:
+                self._fast_flush_lane(lane, timeout_ms=20)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready_idx: set[int] = set()
+            shortfall_fast = True
+            for i, ref in enumerate(refs):
+                if len(ready_idx) >= num_returns:
+                    break
+                entry = self.memory_store.get(ref.id)
+                if entry is not None and entry.ready.is_set():
+                    ready_idx.add(i)
+                elif entry is None and self.store is not None \
+                        and self.store.contains(ref.id):
+                    ready_idx.add(i)
+                # lock-free membership probes (GIL-atomic): taking
+                # _fast_cv per ref would cost O(n) lock round-trips per
+                # scan against the reply threads; a racy miss just makes
+                # this round conservative — the next round (or the loop
+                # path) resolves it
+                elif ref.id in self._fast_done:
+                    ready_idx.add(i)
+                elif ref.id not in self._fast_oid_lane:
+                    shortfall_fast = False
+            if len(ready_idx) >= num_returns:
+                ready = [r for i, r in enumerate(refs) if i in ready_idx]
+                pending = [r for i, r in enumerate(refs)
+                           if i not in ready_idx]
+                return ready, pending
+            if not shortfall_fast:
+                return None  # loop path owns the blocking wait
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                ready = [r for i, r in enumerate(refs) if i in ready_idx]
+                pending = [r for i, r in enumerate(refs)
+                           if i not in ready_idx]
+                return ready, pending
+            with self._fast_cv:
+                self._fast_cv.wait(
+                    0.05 if remaining is None else min(0.05, remaining))
 
     # ------------------------------------------------------ task submission
     def _register_function(self, fn) -> bytes:
@@ -2383,6 +2649,12 @@ class CoreClient:
                 entry.packed = result["inline"]
             else:
                 entry.in_shm = True
+                # completion-time location priming: the reply names the
+                # sealing node, so get() goes straight to the pull with a
+                # holder hint — zero directory round-trips in steady state
+                node = result.get("node")
+                if node is not None:
+                    self._obj_locations.setdefault(oid, set()).add(node)
             entry.ready.set()
 
     def _complete_task_error(self, spec, error):
@@ -2422,6 +2694,9 @@ class CoreClient:
                 entry.packed = item["inline"]
             else:
                 entry.in_shm = True
+                node = item.get("node")
+                if node is not None:
+                    self._obj_locations.setdefault(oid, set()).add(node)
             entry.ready.set()
             self.memory_store[oid] = entry
             state.items.append(self._new_owned_ref(oid))

@@ -18,6 +18,18 @@ into the reply ring; a driver reader thread completes blocking ``get()``s
 directly and trickles the results onto the event loop for everything else
 (memory-store entries, task events, wait()).
 
+The reply lane is the COMPLETION fast lane, mirroring the submit lane's
+semantics in the opposite direction: results at or below
+``fastpath_inline_result_max`` ride inside the completion record (no
+object-store put, no location registration); larger ones seal into the
+node's shm arena and the record carries the size, priming the owner's
+location cache at completion time. The worker pump merges records that
+arrive mid-batch into one reply frame and pushes with partial-push
+semantics — whole records land as they fit, and once the ring has stayed
+full past ``fastpath_reply_spill_ms`` the remainder spills to the driver
+over RPC (``rpc_fast_result``), so a stalled driver can never wedge task
+execution.
+
 Anything that doesn't fit — object-ref args, generators, actors with
 options, worker death mid-flight — falls back to the ordinary RPC path,
 which stays the single source of truth for scheduling semantics.
@@ -42,7 +54,9 @@ POP_BUF_BYTES = 1 << 20
 
 # reply status codes
 OK = 0        # payload = packed inline value
-OK_SHM = 1    # result stored in the node's shm arena under the return oid
+OK_SHM = 1    # result sealed into the node's shm arena under the return
+#               oid; payload = <Q size (primes the owner's location cache
+#               at completion time; empty payload = size unknown)
 ERR = 2       # payload = pickled TaskError
 NEED_SLOW = 3  # func not executable on the fast path: resubmit via RPC
 
@@ -272,6 +286,17 @@ def pack_reply(task_id: bytes, status: int, payload: bytes) -> bytes:
 def unpack_reply(rec: bytes):
     task_id, status = struct.unpack_from("<16sI", rec)
     return task_id, status, rec[20:]
+
+
+def pack_shm_size(size: int) -> bytes:
+    """OK_SHM payload: the sealed result's byte size."""
+    return struct.pack("<Q", size)
+
+
+def unpack_shm_size(payload: bytes) -> int | None:
+    if len(payload) >= 8:
+        return struct.unpack_from("<Q", payload)[0]
+    return None
 
 
 class FastLane:

@@ -120,6 +120,17 @@ class GcsServer:
         from ray_tpu.core.gcs_store import NativeGcsStore
 
         self.kvstore = NativeGcsStore(persist_path)
+        # opt-in machine-crash durability (cfg.gcs_fsync): journaled KV
+        # writes are acked only after their WAL record is fdatasync'd,
+        # group-committed so every write landing in the same event-loop
+        # tick shares ONE disk sync; snapshots fsync before their rename.
+        # Default (off) remains process-kill-safe: appends are fflushed to
+        # the OS page cache, which survives a GCS crash but not the box.
+        self._fsync = bool(getattr(self.cfg, "gcs_fsync", False)) \
+            and persist_path is not None
+        if self._fsync:
+            self.kvstore.set_fsync(True)
+        self._sync_fut: asyncio.Future | None = None
         self.nodes: dict[NodeID, NodeInfo] = {}
         self.actors: dict[ActorID, ActorInfo] = {}
         self.named_actors: dict[str, ActorID] = {}
@@ -170,6 +181,8 @@ class GcsServer:
                               overwrite=p.get("overwrite", True),
                               journal=journal)
         self.mark_dirty()
+        if journal:
+            await self._commit_barrier()
         return ok
 
     async def rpc_kv_get(self, conn, p):
@@ -181,7 +194,64 @@ class GcsServer:
     async def rpc_kv_del(self, conn, p):
         ok = self.kvstore.delete(p.get("ns", ""), p["key"])
         self.mark_dirty()
+        await self._commit_barrier()
         return ok
+
+    async def _commit_barrier(self):
+        """Group commit (cfg.gcs_fsync off = no-op): hold this journaled
+        write's ack until its WAL record is on disk. One syncer future per
+        event-loop tick — concurrent writers all await the same fdatasync
+        (the classic group-commit amortization), which runs in an executor
+        with the GIL released. A FAILED sync raises: the caller's RPC
+        errors out instead of acking a write that is not durable (the
+        whole point of the opt-in mode)."""
+        if not self._fsync:
+            return
+        loop = asyncio.get_running_loop()
+        fut = self._sync_fut
+        if fut is None:
+            fut = loop.create_future()
+            self._sync_fut = fut
+
+            async def sync(fut=fut):
+                ok = False
+                try:
+                    await asyncio.sleep(0)  # let batch-mates append first
+                    self._sync_fut = None
+                    ok = await loop.run_in_executor(
+                        None, self.kvstore.wal_sync)
+                finally:
+                    # cancellation-safe (stop() cancels _bg tasks while
+                    # writers may be parked on fut): ALWAYS resolve the
+                    # barrier and clear the slot, or those writers — and
+                    # every later one finding the dead future — hang
+                    if self._sync_fut is fut:
+                        self._sync_fut = None
+                    if not fut.done():
+                        fut.set_result(ok)
+
+            if self._bg.spawn(sync()) is None and not fut.done():
+                # shutting down: sync inline rather than faking success
+                # (stop()'s final snapshot has not happened yet). Clear
+                # the slot — sync() never ran, and leaving a completed
+                # future here would ack every later write without a sync.
+                self._sync_fut = None
+                fut.set_result(self.kvstore.wal_sync())
+        if not await fut:
+            raise RuntimeError(
+                "GCS WAL fdatasync failed: write is NOT durable "
+                "(gcs_fsync mode refuses to ack it)")
+
+    def _kick_sync(self):
+        """Fire-and-forget group sync for table-op journal records (actor
+        transitions, job counters): the records reach disk promptly via
+        the shared syncer, without withholding the mutation's reply."""
+        if not self._fsync:
+            return
+        try:
+            self._bg.spawn(self._commit_barrier())
+        except RuntimeError:
+            pass  # no running loop (restore path): snapshot covers it
 
     async def rpc_kv_exists(self, conn, p):
         return self.kvstore.exists(p.get("ns", ""), p["key"])
@@ -258,6 +328,11 @@ class GcsServer:
             return
         info.alive = False
         await self.publish("nodes", {"event": "removed", "node_id": node_id, "cause": cause})
+        # dedicated low-traffic channel for location-cache invalidation:
+        # every CoreClient subscribes to THIS, not "nodes" — the "nodes"
+        # channel also carries per-heartbeat resource gossip that every
+        # driver and worker would otherwise receive and discard
+        await self.publish("node_removed", {"node_id": node_id})
         # fail actors living on that node (ref: gcs_actor_manager.cc OnNodeDead)
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING):
@@ -820,6 +895,7 @@ class GcsServer:
         except Exception:
             pass  # snapshot loop still covers the mutation
         self.mark_dirty()
+        self._kick_sync()
 
     def mark_dirty(self):
         self._dirty = True

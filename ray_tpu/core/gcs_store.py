@@ -120,6 +120,17 @@ class NativeGcsStore:
     def wal_ok(self) -> bool:
         return bool(self._lib.rt_gcs_wal_ok(self._h))
 
+    def set_fsync(self, on: bool) -> None:
+        """Opt-in machine-crash durability: snapshot writes fsync before
+        the rename (+ directory fsync after), and wal_sync() becomes the
+        group-commit gate for journaled table writes."""
+        self._lib.rt_gcs_set_fsync(self._h, 1 if on else 0)
+
+    def wal_sync(self) -> bool:
+        """fdatasync records appended since the last sync (no-op when the
+        WAL is clean). Releases the GIL for the disk sync."""
+        return self._lib.rt_gcs_wal_sync(self._h) == 0
+
     @property
     def had_snapshot(self) -> bool:
         return bool(self._lib.rt_gcs_had_snapshot(self._h))

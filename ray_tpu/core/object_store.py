@@ -215,6 +215,16 @@ class SharedObjectStore:
             guard.release_now()
         return value
 
+    def try_get(self, object_id: ObjectID):
+        """Non-blocking zero-copy read for the completion fast lane's
+        caller-thread get: returns ``(value,)`` when the object is sealed
+        locally, None when it is absent/pending/evicted — one native call,
+        no contains()-then-get() race window."""
+        try:
+            return (self.get(object_id, timeout_ms=0),)
+        except ObjectStoreError:
+            return None
+
     # -- mutable channels (compiled-graph substrate) -------------------------
 
     def channel_create(self, object_id: ObjectID, size: int, num_readers: int) -> None:

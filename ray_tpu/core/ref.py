@@ -133,6 +133,16 @@ class ObjectRef:
         from ray_tpu.core import api
 
         async def _get():
+            # completion fast lane: an already-resolved ref (ready
+            # memory-store entry, sealed local shm object) returns
+            # without entering the async get machinery at all
+            core = self._core or api._core
+            if core is not None:
+                hit = core.get_local_prepass([self]).get(self.id)
+                if hit is not None:
+                    if hit[0] == "e":
+                        raise hit[1]
+                    return hit[1]
             return await api._async_get(self)
 
         return _get().__await__()

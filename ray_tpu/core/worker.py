@@ -74,6 +74,8 @@ class Worker:
         self._group_sems: dict = {}
         # fast-path rings attached by drivers (see core/fastpath.py)
         self._fast_rings: list = []
+        # cached connections to drivers for result-ring spill (rpc_fast_result)
+        self._spill_conns: dict[tuple, object] = {}
         # one-task-per-worker guard for NORMAL tasks: ring-pump inline
         # execution and RPC-path executor runs must never run two tasks
         # at once on this one-CPU lease (the driver's quiet-lane worker
@@ -240,7 +242,11 @@ class Worker:
                 results.append({"inline": _pack_bytes(meta, buffers, size)})
             else:
                 await self._store_shm_object(oid, meta, buffers)
-                results.append({"shm": True})
+                # (node, size) primes the owner's location cache at
+                # completion time: steady-state get() skips the GCS
+                # object-directory lookup entirely
+                results.append({"shm": True, "size": size,
+                                "node": self.node_id.binary()})
         return results
 
     async def rpc_cancel_if_current(self, conn, p):
@@ -274,6 +280,9 @@ class Worker:
             # check in the dispatch path) by refusing the attach outright.
             return False
         ring = fastpath.RingPair.open(p["name"])
+        # the driver's server address: spill target for completion records
+        # the result ring cannot absorb (see _fast_spill_replies)
+        ring._owner_addr = tuple(p["owner"]) if p.get("owner") else None
         self._fast_rings.append(ring)
         loop = asyncio.get_running_loop()
         if p.get("kind") == "actor":
@@ -301,25 +310,99 @@ class Worker:
         return True
 
     def _fast_push_replies(self, ring, replies) -> int:
-        """Chunked reply push: one frame per ~512KB so a big batch of
-        mid-size results can never exceed the reply ring's capacity
-        (kTooBig) or the driver's fixed pop buffer."""
+        """Deliver completion records with the submit lane's partial-push /
+        RPC-spill semantics, mirrored in the opposite direction: push as
+        many whole records as currently fit in one native batch call,
+        retry the remainder briefly, and once the result ring has stayed
+        full past the spill deadline hand the undelivered records to the
+        driver over RPC (rpc_fast_result) — a stalled driver must not
+        wedge the pump (and with it task execution) behind a full ring.
+        Chunked at ~512KB so one frame can never exceed the ring capacity
+        or the driver's fixed pop buffer. Returns 0 once every record is
+        delivered (ring or spill), or a negative ring status when the
+        ring is closed/unusable (the driver's break-lane recovery owns
+        whatever did not land)."""
         from ray_tpu.core import fastpath
 
-        status = 0
+        spill_s = max(1, self.cfg.fastpath_reply_spill_ms) / 1000.0
+        idx = 0
+        n = len(replies)
+        while idx < n:
+            chunk_end = idx
+            chunk_bytes = 0
+            while chunk_end < n and (chunk_end == idx
+                                     or chunk_bytes + len(replies[chunk_end])
+                                     <= 512 * 1024):
+                chunk_bytes += len(replies[chunk_end])
+                chunk_end += 1
+            framed = fastpath.frame(replies[idx:chunk_end])
+            off = 0
+            deadline = time.monotonic() + spill_s
+            while off < len(framed):
+                took = ring.push_batch(
+                    fastpath.REP, framed[off:] if off else framed,
+                    timeout_ms=20)
+                if took < 0:
+                    return took
+                off += took
+                if off < len(framed) and time.monotonic() >= deadline:
+                    # whole records already in the ring stay there; spill
+                    # everything after the consumed prefix
+                    consumed = idx
+                    acc = 0
+                    for r in replies[idx:chunk_end]:
+                        acc += (4 + len(r) + 7) & ~7
+                        if acc > off:
+                            break
+                        consumed += 1
+                    return self._fast_spill_replies(ring, replies[consumed:])
+            idx = chunk_end
+        return 0
+
+    def _fast_spill_replies(self, ring, recs) -> int:
+        """Result-ring-full spill: ship undelivered completion records to
+        the driver over the RPC path (the slow road stays the backstop in
+        BOTH directions). Falls back to a blocking ring push when no
+        spill address is known or the driver is unreachable — in the
+        latter case the driver is gone and its break-lane recovery (or
+        teardown) owns the records."""
+        from ray_tpu.core import fastpath
+
+        owner = getattr(ring, "_owner_addr", None)
+        if owner is not None:
+            try:
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._send_spilled_results(owner, list(recs)),
+                    self.core.loop)
+                fut.result(30)
+                return 0
+            except Exception:
+                # ambiguous failure (e.g. timeout with the RPC still in
+                # flight): the ring re-push below may duplicate records —
+                # safe, the driver applies completions exactly once
+                pass
+        # blocking fallback, chunked so one frame can never exceed the
+        # ring capacity (kTooBig would tear down the whole lane)
         chunk: list = []
         chunk_bytes = 0
-        for reply in replies:
-            if chunk and chunk_bytes + len(reply) > 512 * 1024:
+        for rec in recs:
+            if chunk and chunk_bytes + len(rec) > 512 * 1024:
                 status = ring.push_raw(fastpath.REP, fastpath.frame(chunk))
                 if status != 0:
                     return status
                 chunk, chunk_bytes = [], 0
-            chunk.append(reply)
-            chunk_bytes += len(reply)
+            chunk.append(rec)
+            chunk_bytes += len(rec)
         if chunk:
-            status = ring.push_raw(fastpath.REP, fastpath.frame(chunk))
-        return status
+            return ring.push_raw(fastpath.REP, fastpath.frame(chunk))
+        return 0
+
+    async def _send_spilled_results(self, owner: tuple, recs: list):
+        conn = self._spill_conns.get(owner)
+        if conn is None or conn._closed:
+            conn = await rpc.connect(*owner, timeout=10)
+            self._spill_conns[owner] = conn
+        await conn.call("fast_result", {"records": recs}, timeout=20)
 
     # hot-mode tuning: 5ms pop slices, ~20 empty slices (~100ms) to park
     _PUMP_HOT_POP_MS = 5
@@ -381,7 +464,7 @@ class Worker:
         """Execute one batch of ring records inline; False = ring done."""
         from ray_tpu.core import fastpath
 
-        inline_max = self.cfg.max_inline_object_size
+        inline_max = self.cfg.fastpath_inline_result_max
         inst = self.actor_instance
         replies = []
         for rec in recs:
@@ -482,7 +565,11 @@ class Worker:
         this one pump."""
         from ray_tpu.core import fastpath
 
-        inline_max = self.cfg.max_inline_object_size
+        # completion records inline results up to the fast-lane threshold
+        # (not max_inline_object_size): above it the value is sealed into
+        # shm ONCE and every read is zero-copy, instead of being copied
+        # through the ring and unpacked from a bytes round-trip
+        inline_max = self.cfg.fastpath_inline_result_max
         fast_funcs: dict[bytes, object] = {}
 
         def load(func_id):
@@ -612,7 +699,10 @@ class Worker:
             payload = _pack_bytes(meta, buffers, size)
             if not self.core.store.contains(oid):  # retry may have stored it
                 self.core.store.put_raw(oid, payload)
-            return fastpath.pack_reply(tid, fastpath.OK_SHM, b"")
+            # size rides in the record: the owner's location cache is
+            # primed at completion time, no directory round-trip on get
+            return fastpath.pack_reply(tid, fastpath.OK_SHM,
+                                       fastpath.pack_shm_size(size))
         except Exception as e:
             return fastpath.pack_reply(tid, fastpath.ERR,
                                        self._fast_pack_error(e))
@@ -1132,7 +1222,7 @@ class Worker:
             return {"inline": _pack_bytes(meta, buffers, size)}
         oid = ObjectID.for_task_return(task_id, index)
         await self._store_shm_object(oid, meta, buffers)
-        return {"shm": True}
+        return {"shm": True, "size": size, "node": self.node_id.binary()}
 
     async def _store_shm_object(self, oid, meta, buffers):
         """Seal a large value into local shm and register this node as a

@@ -150,6 +150,10 @@ class Context:
     # within the current function scope; unlike for_targets this also
     # counts while-loops — RT009 fires on any per-iteration re-derivation
     loop_depth: int = 0
+    # True while walking the body of an `async def` (reset inside nested
+    # sync defs and lambdas: their bodies run on whatever thread calls
+    # them, not necessarily the event loop) — RT010's blocking-call scope
+    in_async: bool = False
 
     # -- reporting ----------------------------------------------------------
     def report(self, rule: Rule, node: ast.AST, message: str):
@@ -291,12 +295,15 @@ class Walker:
         saved_arrays = dict(ctx.array_bindings)
         saved_targets = ctx.for_targets
         saved_depth = ctx.loop_depth
+        saved_async = ctx.in_async
         ctx.for_targets = []  # a nested def body doesn't run per-iteration
         ctx.loop_depth = 0
+        ctx.in_async = isinstance(node, ast.AsyncFunctionDef)
         for stmt in node.body:
             self.walk(stmt)
         ctx.for_targets = saved_targets
         ctx.loop_depth = saved_depth
+        ctx.in_async = saved_async
         ctx.array_bindings = saved_arrays
         if frame is not None:
             ctx.remote_stack.pop()
@@ -310,11 +317,14 @@ class Walker:
                 self.walk(default)
         saved_targets = ctx.for_targets
         saved_depth = ctx.loop_depth
+        saved_async = ctx.in_async
         ctx.for_targets = []
         ctx.loop_depth = 0
+        ctx.in_async = False  # deferred body: caller's thread, not the loop
         self.walk(node.body)
         ctx.for_targets = saved_targets
         ctx.loop_depth = saved_depth
+        ctx.in_async = saved_async
 
     def _walk_class(self, node: ast.ClassDef):
         is_actor = self.ctx.remote_decorator(node) is not None

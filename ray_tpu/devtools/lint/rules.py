@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT009: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT010: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -276,3 +276,21 @@ class OptionsRemoteInLoop(Rule):
                        "submission template every iteration; hoist "
                        "`h = fn.options(...)` above the loop and call "
                        "h.remote() inside it")
+
+
+@register
+class BlockingGetInAsync(Rule):
+    id = "RT010"
+    summary = "blocking get() inside an async def body"
+    rationale = ("ray_tpu.get() blocks its thread until the result lands; "
+                 "inside a coroutine that thread IS the event loop, so "
+                 "every other coroutine — including the completion "
+                 "machinery that would resolve the ref — stalls behind it "
+                 "(an async get path exists: await the ref)")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if ctx.in_async and ctx.framework_op(node.func) == "get":
+            ctx.report(self, node,
+                       "blocking ray_tpu.get() inside an async def stalls "
+                       "the event loop; await the ObjectRef(s) directly "
+                       "(or asyncio.gather them) instead")

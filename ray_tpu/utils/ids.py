@@ -104,7 +104,6 @@ class TaskID(BaseID):
     # kind tag (never _ACTOR_MARK for normal tasks).
     _gen_prefix: bytes = b""
     _gen_counter = None
-    _gen_pid: int = -1
     _gen_lock = _threading.Lock()
 
     @classmethod
@@ -113,12 +112,15 @@ class TaskID(BaseID):
 
     @classmethod
     def generate(cls):
-        if cls._gen_pid != os.getpid():  # fresh process or fork
+        # fork safety WITHOUT a per-call os.getpid(): that's a real
+        # syscall (~30us under syscall-intercepting sandboxes) on the
+        # submission hot path. _reset_task_prefix below invalidates the
+        # prefix in fork children; fresh processes start invalidated.
+        if cls._gen_counter is None:
             with cls._gen_lock:
-                if cls._gen_pid != os.getpid():
+                if cls._gen_counter is None:
                     cls._gen_prefix = os.urandom(cls.SIZE - 8)
                     cls._gen_counter = itertools.count()
-                    cls._gen_pid = os.getpid()
         n = next(cls._gen_counter) % (1 << 56)
         tail = n.to_bytes(7, "little") + b"\x00"
         return cls(cls._gen_prefix + tail)
@@ -131,6 +133,16 @@ class TaskID(BaseID):
 
     def is_actor_task(self) -> bool:
         return self._bytes[-1] == self._ACTOR_MARK
+
+    @classmethod
+    def _reset_prefix(cls) -> None:
+        with cls._gen_lock:
+            cls._gen_prefix = b""
+            cls._gen_counter = None
+
+
+if hasattr(os, "register_at_fork"):  # a fork child must mint fresh ids
+    os.register_at_fork(after_in_child=TaskID._reset_prefix)
 
 
 class ObjectID(BaseID):

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 # (major, minor): bump MAJOR for incompatible changes (renamed/removed
 # methods, changed field meaning), MINOR for additions.
-PROTOCOL_VERSION = (1, 5)
+PROTOCOL_VERSION = (1, 6)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -101,7 +101,10 @@ CATALOG: dict[str, dict[str, dict]] = {
         "return_bundle": {"since": (1, 0), "fields": {
             "pg_id": "PGID", "bundle_index": "int"}},
         "pull_object": {"since": (1, 0), "fields": {
-            "object_id": "bytes", "owner_address": "(host, port)"}},
+            "object_id": "bytes", "owner_address": "(host, port)",
+            "holders_hint": "[node_id bytes] optional (since (1, 6)): "
+                            "location-cache hint tried before the GCS "
+                            "directory; stale hints fall back in-call"}},
         "fetch_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
         "fetch_object_meta": {"since": (1, 0), "fields": {"object_id": "bytes"}},
         "fetch_object_chunk": {"since": (1, 0), "fields": {
@@ -135,6 +138,10 @@ CATALOG: dict[str, dict[str, dict]] = {
         "unborrow_object": {"since": (1, 0), "fields": {
             "object_id": "bytes", "borrower": "hex"}},
         "recover_object": {"since": (1, 0), "fields": {"object_id": "bytes"}},
+        "fast_result": {"since": (1, 6), "fields": {
+            "records": "[reply record bytes] — completion records the "
+                       "worker spilled over RPC when the result ring "
+                       "stayed full (see core/fastpath.py)"}},
         "generator_item": {"since": (1, 0), "fields": {
             "task_id": "TaskID", "index": "int", "item": "packed | None",
             "done": "bool"}},
@@ -160,7 +167,9 @@ CATALOG: dict[str, dict[str, dict]] = {
         "attach_fast_ring": {"since": (1, 3), "fields": {
             "name": "str — shm name of the task RingPair this worker "
                     "should pump (see core/fastpath.py)",
-            "kind": "'actor' for actor-call rings (since 1.3)"}},
+            "kind": "'actor' for actor-call rings (since 1.3)",
+            "owner": "(host, port) optional (since (1, 6)): driver server "
+                     "address — the result-ring spill target"}},
         "dump_stack": {"since": (1, 3), "fields": {}},
         "heap_profile": {"since": (1, 4), "fields": {
             "action": "start | snapshot | stop (tracemalloc control)",

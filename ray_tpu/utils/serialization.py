@@ -316,6 +316,28 @@ class _GuardedBuffer:
         return memoryview(self._mv)
 
 
+# PEP 688 (__buffer__ on a plain class) only exists on 3.12+; earlier
+# interpreters get a ctypes view, which exports the buffer protocol
+# natively, pins the source buffer (from_buffer holds it), and carries the
+# guard as an attribute — same zero-copy aliasing, same lifetime tie.
+_HAVE_PEP688 = sys.version_info >= (3, 12)
+
+
+def _guarded_slice(sl: memoryview, guard):
+    if _HAVE_PEP688:
+        return _GuardedBuffer(sl, guard)
+    import ctypes
+
+    try:
+        view = (ctypes.c_char * sl.nbytes).from_buffer(sl)
+    except (TypeError, ValueError):
+        # read-only source: copy (no aliasing view to tie, but the guard
+        # still rides along so the caller's release logic stays uniform)
+        view = (ctypes.c_char * sl.nbytes).from_buffer_copy(sl)
+    view._guard = guard
+    return view
+
+
 def unpack(src, guard=None) -> Any:
     """Deserialize a packed blob; array buffers alias ``src`` (zero-copy).
 
@@ -332,7 +354,7 @@ def unpack(src, guard=None) -> Any:
     for size in sizes:
         start = _align(off)
         sl = src[start : start + size]
-        slices.append(sl if guard is None else _GuardedBuffer(sl, guard))
+        slices.append(sl if guard is None else _guarded_slice(sl, guard))
         off = start + size
     return pickle.loads(header, buffers=slices)
 

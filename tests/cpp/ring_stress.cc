@@ -11,6 +11,15 @@
 // producer + one consumer per ring) while TSAN checks the mutex/cond +
 // shared-header discipline and ASAN checks the copy windows.
 //
+// Phase 2 (echo) drives the COMPLETION fast lane's shape: the worker
+// pops submit records and answers each with a correlated completion
+// record on the result lane via partial batch pushes (remainder retried
+// from the consumed-prefix boundary — the worker pump's
+// _fast_push_replies loop), while the driver consumer stalls
+// periodically to force the partial-push interleavings and verifies the
+// completions arrive exactly once, in submit order, with matching
+// checksums.
+//
 // Usage: ring_stress <shm-name> <seconds>
 
 #include <atomic>
@@ -152,6 +161,195 @@ void consumer(void* h, int which, Side* s) {
   }
 }
 
+// ---- phase 2: completion-lane echo (submit -> correlated result) -------
+
+uint64_t frame_len(uint64_t payload) { return (4 + payload + 7) & ~7ull; }
+
+// driver submit side: [u64 seq][random payload]; records per-seq checksums
+// implicitly via a running sum the consumer re-derives from the echoes.
+void echo_driver_submit(void* h, std::atomic<uint64_t>* submitted,
+                        std::atomic<uint64_t>* submit_sum, unsigned seed) {
+  std::vector<uint8_t> framed;
+  uint64_t seq = 0;
+  while (!stop_flag.load(std::memory_order_relaxed)) {
+    // build 1-4 framed submit records, push via the coalesced batch path
+    framed.clear();
+    int nrec = 1 + ((seed = seed * 1103515245 + 12345) >> 16) % 4;
+    std::vector<uint64_t> sums;
+    for (int r = 0; r < nrec; r++) {
+      uint64_t len = 8 + (seed = seed * 1103515245 + 12345) % 600;
+      uint32_t len32 = (uint32_t)len;
+      size_t base = framed.size();
+      framed.resize(base + frame_len(len), 0);
+      memcpy(framed.data() + base, &len32, 4);
+      uint64_t s = seq + (uint64_t)r;
+      memcpy(framed.data() + base + 4, &s, 8);
+      uint64_t sum = 0;
+      for (uint64_t i = 8; i < len; i++) {
+        uint8_t b = (uint8_t)(seed + i);
+        framed[base + 4 + i] = b;
+        sum += b;
+      }
+      sums.push_back(sum);
+    }
+    // push the WHOLE batch, resuming remainders from the consumed-prefix
+    // record boundary: once any prefix entered the ring the batch is
+    // committed (its seqs will be echoed), so it must all go in — even
+    // past the stop flag — for the exactly-once accounting to balance
+    uint64_t off = 0;
+    while (off < framed.size()) {
+      int64_t took = rt_ring_push_batch(h, SUB, framed.data() + off,
+                                        framed.size() - off, 20);
+      if (took == -7) return;
+      if (took < 0) {
+        fail("echo submit push_batch status");
+        return;
+      }
+      off += (uint64_t)took;
+    }
+    for (int r = 0; r < nrec; r++) {
+      submit_sum->fetch_add(sums[r]);
+    }
+    submitted->fetch_add(nrec);
+    seq += nrec;
+  }
+}
+
+// worker echo side: pop submit batches, reply [u64 seq][u64 checksum] per
+// record through partial batch pushes — the worker pump's reply loop.
+void echo_worker(void* h, std::atomic<uint64_t>* echoed) {
+  std::vector<uint8_t> in(kPopBuf);
+  std::vector<uint8_t> out;
+  for (;;) {
+    int64_t n = rt_ring_pop_batch(h, SUB, in.data(), in.size(), 50);
+    if (n == -7) return;
+    if (n < 0) {
+      fail("echo worker pop status");
+      return;
+    }
+    if (n == 0) continue;
+    out.clear();
+    int64_t off = 0;
+    uint64_t replies = 0;
+    while (off + 4 <= n) {
+      uint32_t len;
+      memcpy(&len, in.data() + off, 4);
+      if (off + 4 + (int64_t)len > n) {
+        fail("echo worker truncated record");
+        return;
+      }
+      uint64_t seq;
+      memcpy(&seq, in.data() + off + 4, 8);
+      uint64_t sum = 0;
+      for (uint64_t i = 8; i < len; i++) sum += in[off + 4 + i];
+      uint32_t rlen = 16;
+      size_t base = out.size();
+      out.resize(base + frame_len(rlen), 0);
+      memcpy(out.data() + base, &rlen, 4);
+      memcpy(out.data() + base + 4, &seq, 8);
+      memcpy(out.data() + base + 12, &sum, 8);
+      replies++;
+      off += (int64_t)frame_len(len);
+    }
+    // partial-push reply loop: remainder resumes at the consumed prefix
+    uint64_t roff = 0;
+    while (roff < out.size()) {
+      int64_t took = rt_ring_push_batch(h, REP, out.data() + roff,
+                                        out.size() - roff, 5);
+      if (took == -7) return;  // driver closed mid-drain
+      if (took < 0) {
+        fail("echo reply push_batch status");
+        return;
+      }
+      roff += (uint64_t)took;  // 0 = timeout: stalled consumer, retry
+    }
+    echoed->fetch_add(replies);
+  }
+}
+
+// driver result side: completions must arrive exactly once, in order,
+// with checksums summing to what was submitted. Periodic stalls force
+// the worker into the partial-push retry path.
+void echo_driver_results(void* h, std::atomic<uint64_t>* received,
+                         std::atomic<uint64_t>* recv_sum) {
+  std::vector<uint8_t> buf(kPopBuf);
+  uint64_t expect_seq = 0;
+  int batches = 0;
+  for (;;) {
+    int64_t n = rt_ring_pop_batch(h, REP, buf.data(), buf.size(), 50);
+    if (n == -7) return;
+    if (n < 0) {
+      fail("echo result pop status");
+      return;
+    }
+    if (n == 0) continue;
+    int64_t off = 0;
+    while (off + 4 <= n) {
+      uint32_t len;
+      memcpy(&len, buf.data() + off, 4);
+      if (len != 16 || off + 4 + (int64_t)len > n) {
+        fail("echo result bad record");
+        return;
+      }
+      uint64_t seq, sum;
+      memcpy(&seq, buf.data() + off + 4, 8);
+      memcpy(&sum, buf.data() + off + 12, 8);
+      if (seq != expect_seq) {
+        fail("echo result out of order / duplicated");
+        return;
+      }
+      expect_seq++;
+      received->fetch_add(1);
+      recv_sum->fetch_add(sum);
+      off += (int64_t)frame_len(len);
+    }
+    if (++batches % 7 == 0 && !stop_flag.load(std::memory_order_relaxed)) {
+      // stall: let REP fill so the worker exercises partial pushes
+      std::this_thread::sleep_for(std::chrono::milliseconds(3));
+    }
+  }
+}
+
+int run_echo_phase(const char* name, double seconds) {
+  std::string echo_name = std::string(name) + "_echo";
+  rt_ring_pair_destroy(echo_name.c_str());
+  // small REP ring: reply batches overrun it regularly, so the worker's
+  // partial-push remainder loop is ON the tested path
+  void* creator = rt_ring_pair_create(echo_name.c_str(), 16 * 1024);
+  void* opener = rt_ring_pair_open(echo_name.c_str());
+  if (!creator || !opener) {
+    fail("echo create/open");
+    return 1;
+  }
+  stop_flag.store(false);
+  std::atomic<uint64_t> submitted{0}, submit_sum{0}, echoed{0},
+      received{0}, recv_sum{0};
+  std::thread t_sub(echo_driver_submit, creator, &submitted, &submit_sum, 7u);
+  std::thread t_worker(echo_worker, opener, &echoed);
+  std::thread t_res(echo_driver_results, creator, &received, &recv_sum);
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((long)(seconds * 1000)));
+  stop_flag.store(true);
+  t_sub.join();          // submit side quiesces first (no new work)
+  rt_ring_close(opener, SUB);   // worker drains SUB to -7, then exits
+  t_worker.join();
+  rt_ring_close(creator, REP);  // results drain to -7
+  t_res.join();
+
+  if (received.load() != submitted.load() || echoed.load() != submitted.load())
+    fail("echo completion count mismatch (lost or duplicated results)");
+  if (recv_sum.load() != submit_sum.load())
+    fail("echo completion checksum mismatch");
+  if (submitted.load() == 0) fail("echo moved no traffic");
+
+  rt_ring_pair_close(opener);
+  rt_ring_pair_close(creator);
+  rt_ring_pair_destroy(echo_name.c_str());
+  printf("echo=%llu failures=%ld\n", (unsigned long long)submitted.load(),
+         failures.load());
+  return failures.load() ? 1 : 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -204,5 +402,8 @@ int main(int argc, char** argv) {
   printf("sub=%llu rep=%llu failures=%ld\n",
          (unsigned long long)sub.pushed, (unsigned long long)rep.pushed,
          failures.load());
-  return failures.load() ? 1 : 0;
+  if (failures.load()) return 1;
+
+  // phase 2: completion-lane echo (result ring under partial-push load)
+  return run_echo_phase(name, seconds);
 }

@@ -1,0 +1,52 @@
+"""RT010 fixture: blocking ray_tpu.get() inside an async def body."""
+import asyncio
+
+import ray_tpu
+import ray_tpu as rt
+
+
+@ray_tpu.remote
+def f(x):
+    return x
+
+
+async def blocking_in_coroutine(ref):
+    return ray_tpu.get(ref)  # expect: RT010
+
+
+async def aliased_import_form(refs):
+    vals = rt.get(refs)  # expect: RT010
+    return sum(vals)
+
+
+@ray_tpu.remote
+class Act:
+    async def method(self, ref):
+        return ray_tpu.get(ref)  # expect: RT010
+
+    def sync_method(self, ref):
+        return ray_tpu.get(ref)  # RT001's concern, not RT010's
+
+
+async def awaiting_ref_is_clean(ref):
+    return await ref
+
+
+async def gather_refs_is_clean(refs):
+    return await asyncio.gather(*refs)
+
+
+def sync_def_is_clean(ref):
+    return ray_tpu.get(ref)
+
+
+async def nested_sync_def_is_clean(refs):
+    def resolve():
+        # runs on whatever thread calls it (e.g. an executor), not the loop
+        return ray_tpu.get(refs)
+
+    return await asyncio.get_running_loop().run_in_executor(None, resolve)
+
+
+async def unrelated_get_is_clean(cache, key):
+    return cache.get(key)
