@@ -96,6 +96,182 @@ def lint_findings() -> int | None:
         return None
 
 
+def _stage_latency_results() -> dict[str, float]:
+    """Per-stage fast-lane percentiles via state.list_task_latency()
+    (published on the ~1s flush timer: poll briefly for the freshest
+    window). Flat keys so they ride the BENCHVS table."""
+    from ray_tpu import state
+
+    out: dict[str, float] = {}
+    lat: dict = {}
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        try:
+            lat = state.list_task_latency()
+        except Exception:
+            lat = {}
+        if lat.get("total", {}).get("count", 0) > 0:
+            break
+        time.sleep(0.3)
+    for stage in ("ring_sub", "deserialize", "exec", "ring_reply", "total"):
+        row = lat.get(stage)
+        if row:
+            out[f"stage_{stage}_p50_us"] = row["p50_us"]
+            out[f"stage_{stage}_p99_us"] = row["p99_us"]
+    return out
+
+
+def _recorder_direct_overhead_us() -> float:
+    """Direct on-vs-off measurement of the exact per-task recorder
+    operations, run against the real modules: the ON arm executes the
+    driver's reply-apply additions (submit stamp, t0 registration and
+    pop, one raw stats-ring store) plus the worker pump's additions (two
+    exec-boundary clock reads, the 16-byte stage stamp, the 1-in-16
+    W_TASK slot); the OFF arm executes the residual disabled-gate
+    checks. This is the only estimator with sub-µs resolution on a
+    shared host — end-to-end wall/CPU per task swings ±30-200µs between
+    runs, ~two orders of magnitude above the 1µs budget under test
+    (the subprocess A/B arms below bracket that end-to-end noise)."""
+    import time as _t
+
+    from ray_tpu.core import fastpath as _fp
+    from ray_tpu.utils import recorder as _rec
+
+    N = 50_000
+    tid = b"x" * 16
+    rec = _rec.Recorder(4096, None)
+    st = _rec.StageStats(4096)
+    stamp = _fp.pack_stamp(100, 200, 300)
+    clock = _t.perf_counter_ns
+    stamp_pack = _fp._STAMP.pack  # the pump's bound fast path
+    t0ns: dict = {}
+    now_ns = _t.perf_counter_ns()
+
+    lane = object()  # stand-in for the routing value both arms store
+    # process_replies inlines the stats store with ring/cap hoisted
+    sring, scap = st.ring, st.cap
+
+    def task(i, on):
+        # ONE function, recorder work behind the same gated branches the
+        # real code uses — the on-vs-off delta is exactly the recorder's
+        # marginal, not harness-structure noise. Baseline ops BOTH arms
+        # pay: the oid-lane routing dict store + pop.
+        t0 = now_ns if on else 0  # driver submit stamp (the ns clock
+        #                           read already exists for burst
+        #                           detection; the stamp reuses it)
+        t0ns[i] = (lane, t0)
+        ent = t0ns.pop(i)
+        if ent[1]:  # driver reply-apply: one raw stats-ring store
+            sring[st.n % scap] = (ent[1], 1234567890, tid, stamp)
+            st.n += 1
+        if on:  # worker pump: exec-boundary clocks + stamp + W_TASK/16
+            t_x0 = clock()
+            t_x1 = clock()
+            try:
+                s = stamp_pack(t_x0 - 1000, 500, t_x1 - t_x0)
+            except Exception:
+                s = stamp
+            # i advances once per task, exactly like the pump's wt_n
+            if not (i & 15):
+                rec.record_wtask(tid, t_x1, 100, 500, t_x1 - t_x0)
+        else:
+            s = b""
+        return s
+
+    def one_round(on) -> float:
+        t0 = clock()
+        for i in range(N):
+            task(i, on)
+        return (clock() - t0) / N
+
+    one_round(True)
+    one_round(False)  # warm both code paths
+    on_t, off_t = [], []
+    for _ in range(7):  # alternating rounds; min-per-arm (the timeit
+        on_t.append(one_round(True))        # doctrine: interference is
+        off_t.append(one_round(False))      # additive-positive, so the
+    return max(0.0, (min(on_t) - min(off_t)) / 1e3)  # minima are the
+    # least-interfered estimates of each arm's deterministic cost
+
+
+# Recorder end-to-end A/B child: a fresh cluster per arm (the recorder
+# switch propagates to workers through the serialized config), async
+# batches because they have the lowest per-task cost and therefore the
+# most sensitive denominator.
+_AB_CHILD = r"""
+import json, sys, time
+import ray_tpu
+batches, per_batch = int(sys.argv[1]), int(sys.argv[2])
+ray_tpu.init(num_cpus=16)
+
+@ray_tpu.remote
+def _n():
+    return b"ok"
+
+ray_tpu.get([_n.remote() for _ in range(per_batch)])  # warm lanes
+best = None
+for _ in range(batches):
+    t0 = time.perf_counter()
+    ray_tpu.get([_n.remote() for _ in range(per_batch)])
+    us = (time.perf_counter() - t0) / per_batch * 1e6
+    best = us if best is None else min(best, us)
+ray_tpu.shutdown()
+print(json.dumps({"wall_us": best}))
+"""
+
+
+def run_recorder_ab(quick: bool) -> dict[str, float]:
+    """recorder_overhead_us: the flight recorder forced off vs on.
+    The headline number is the DIRECT per-task operation delta
+    (_recorder_direct_overhead_us — sub-µs resolution); the subprocess
+    wall A/B arms (recorder_ab_wall_*_us, best-of per arm across
+    alternating-order rounds) bracket the end-to-end effect, whose
+    between-run noise on this shared 1-vCPU host (±30-200µs/task)
+    swamps any µs-scale delta."""
+    import subprocess
+
+    # the direct measurement runs in a FRESH subprocess: after the full
+    # micro suite this process's heap makes every allocation's gc
+    # amortization ~50% more expensive, which would bill the recorder
+    # for the bench harness's garbage
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import bench, json; "
+         "print(json.dumps(bench._recorder_direct_overhead_us()))"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=300)
+    out = {}
+    if proc.returncode == 0:
+        out["recorder_overhead_us"] = json.loads(
+            proc.stdout.strip().splitlines()[-1])
+    else:
+        print(f"recorder direct measure failed:\n{proc.stderr[-1000:]}",
+              file=sys.stderr)
+        out["recorder_overhead_us"] = _recorder_direct_overhead_us()
+    rounds = 2 if quick else 3
+    batches, per_batch = (4, 250) if quick else (8, 500)
+    env_base = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    arms: dict[str, list[float]] = {"off": [], "on": []}
+    order = [("off", "0"), ("on", "1")]
+    for r in range(rounds):
+        for arm, flag in (order if r % 2 == 0 else order[::-1]):
+            env = {**env_base, "RT_RECORDER_ENABLED": flag}
+            proc = subprocess.run(
+                [sys.executable, "-c", _AB_CHILD, str(batches),
+                 str(per_batch)],
+                env=env, capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                print(f"recorder A/B arm {arm} failed:\n{proc.stderr[-2000:]}",
+                      file=sys.stderr)
+                return out
+            val = json.loads(proc.stdout.strip().splitlines()[-1])
+            arms[arm].append(val["wall_us"])
+    out["recorder_ab_wall_off_us"] = min(arms["off"])
+    out["recorder_ab_wall_on_us"] = min(arms["on"])
+    return out
+
+
 def run_micro(window: float) -> dict[str, float]:
     import numpy as np
 
@@ -154,6 +330,15 @@ def run_micro(window: float) -> dict[str, float]:
         results["single_client_tasks_sync"] = timeit(
             lambda: ray_tpu.get(small_value.remote()), window=window
         )
+
+        # flight-recorder per-stage breakdown of the sync round trips
+        # just measured (submit-ring hop / deserialize / exec / reply
+        # hop / total — read HERE so the window holds lone round trips,
+        # not the 1000-deep pipelined burst below whose queueing delay
+        # would swamp every stage), read back through the state API it
+        # ships on — proving recorder -> GCS -> list_task_latency end
+        # to end
+        results.update(_stage_latency_results())
 
         def batch_tasks(n=1000):
             ray_tpu.get([small_value.remote() for _ in range(n)])
@@ -537,7 +722,7 @@ def write_benchvs(micro: dict, model: dict | None,
             unit = "GB/s (host-load marker: physical ceiling ~20)"
         elif "gigabytes" in name:
             unit = "GB/s"
-        elif name.endswith("_us_per_call"):
+        elif name.endswith("_us_per_call") or name.endswith("_us"):
             unit = "µs"  # lower is better; no reference counterpart
         elif name.endswith("_avg_batch"):
             unit = "recs/flush"
@@ -624,6 +809,33 @@ def write_benchvs(micro: dict, model: dict | None,
         "refs resolve on the calling thread — no event-loop round trip. "
         "wait_1k: caller-thread ready-count + reply-stream cv instead of "
         "a loop hop with watcher tasks.",
+        "",
+        "## Flight recorder (README § Observability)",
+        "",
+        "`stage_<name>_p50_us`/`_p99_us` are the always-on flight "
+        "recorder's per-stage breakdown of the fast-lane tasks the bench "
+        "just ran, read back through `state.list_task_latency()`: "
+        "ring_sub (submit pack → worker pop, the submit-ring hop, "
+        "includes coalescing defer), deserialize (pop → user-function "
+        "entry), exec (the user function), ring_reply (exec end → "
+        "driver apply, the completion-ring hop) and total. "
+        "`recorder_overhead_us` is the recorder-off-vs-on delta of the "
+        "exact per-task recorder operations (driver: submit stamp + "
+        "one raw stats store at reply-apply; worker: two exec-boundary "
+        "clock reads + 16-byte stage stamp + 1-in-16 W_TASK shm slot), "
+        "measured directly against the real modules behind the same "
+        "gated branches the runtime uses (min-per-arm over alternating "
+        "rounds, the timeit doctrine) — the only estimator with sub-µs "
+        "resolution here, since end-to-end per-task wall/CPU between "
+        "runs on this shared 1-vCPU box swings ±30-200µs, two orders "
+        "of magnitude above the < 1.0µs/task budget under test. The "
+        "number swings ~±0.15µs with host phase; note this VM's clock "
+        "read alone costs 120-155ns (vs ~25ns on reference-class "
+        "hardware), so the two exec-boundary reads are ~0.3µs of it "
+        "here and ~0.05µs there. recorder_ab_wall_*_us bracket the "
+        "end-to-end effect (RT_RECORDER_ENABLED off vs on, fresh "
+        "subprocess cluster per arm, alternating order, best-of per "
+        "arm): their delta sits inside host noise.",
     ]
     if model:
         lines += [
@@ -706,6 +918,11 @@ def main():
 
     window = 0.5 if args.quick else 2.0
     micro = run_micro(window) if do_micro else {}
+    if do_micro:
+        try:
+            micro.update(run_recorder_ab(args.quick))
+        except Exception as e:  # the A/B must not sink the micro numbers
+            print(f"recorder A/B failed: {e!r}", file=sys.stderr)
     model = None
     if do_model:
         for attempt in range(2):  # the axon tunnel's remote_compile can flake

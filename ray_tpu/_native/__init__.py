@@ -168,6 +168,11 @@ def get_lib() -> ctypes.CDLL:
                 ctypes.c_void_p, ctypes.c_int, u8p, u64, i64]
             lib.rt_ring_pending.restype = u64
             lib.rt_ring_pending.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.rt_ring_stats.restype = ctypes.c_int
+            lib.rt_ring_stats.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, p64, ctypes.c_int]
+            lib.rt_store_stats.restype = ctypes.c_int
+            lib.rt_store_stats.argtypes = [ctypes.c_void_p, p64, ctypes.c_int]
             lib.rt_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.rt_ring_closed.restype = ctypes.c_int
             lib.rt_ring_closed.argtypes = [ctypes.c_void_p, ctypes.c_int]

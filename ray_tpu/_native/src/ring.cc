@@ -30,7 +30,7 @@
 
 namespace {
 
-constexpr uint64_t kRingMagic = 0x52545249'4e473144ull;  // "RTRING1D"
+constexpr uint64_t kRingMagic = 0x52545249'4e473145ull;  // "RTRING1E"
 
 enum RingError : int {
   kOK = 0,
@@ -39,6 +39,26 @@ enum RingError : int {
   kTooBig = -9,
   kSys = -6,
 };
+
+// Per-direction counters, IN the shared segment so both sides read the
+// same numbers (the Python layer surfaces them as rt_ring_* gauges —
+// the metric_defs.cc stats-family role for the ring transport). Updated
+// under the ring mutex: plain adds, no extra atomics on the hot path.
+struct RingStats {
+  uint64_t push_ops;        // native push calls that moved >= 1 byte
+  uint64_t push_bytes;
+  uint64_t push_records;    // framed records pushed (where the call can tell)
+  uint64_t pop_ops;         // pop calls that returned >= 1 record
+  uint64_t pop_bytes;
+  uint64_t pop_records;
+  uint64_t producer_waits;  // futex sleeps while full (the "full" events)
+  uint64_t consumer_waits;  // futex sleeps while empty
+  uint64_t wake_signals;    // broadcasts actually issued (waiters != 0)
+  uint64_t spin_hits;       // consumer spin found data without sleeping
+  uint64_t partial_pushes;  // push_batch couldn't take the whole buffer
+  uint64_t peak_used;       // max observed occupancy (bytes)
+};
+constexpr int kRingStatsFields = sizeof(RingStats) / sizeof(uint64_t);
 
 struct Ring {
   pthread_mutex_t mu;
@@ -49,6 +69,7 @@ struct Ring {
   uint32_t closed;
   uint32_t waiters;       // threads inside cond_wait (under mu)
   uint64_t data_off;      // data area offset from segment base
+  RingStats stats;
 };
 
 struct PairHeader {
@@ -99,8 +120,19 @@ void init_sync(pthread_mutex_t* mu, pthread_cond_t* cv) {
 // entirely.
 void unlock_and_wake(Ring* r) {
   uint32_t waiters = r->waiters;
+  if (waiters != 0) r->stats.wake_signals++;  // still under mu
   pthread_mutex_unlock(&r->mu);
   if (waiters != 0) pthread_cond_broadcast(&r->cv);
+}
+
+// Producer-side occupancy bookkeeping, called under mu after advancing head.
+void note_push(Ring* r, uint64_t bytes, uint64_t records) {
+  RingStats* st = &r->stats;
+  st->push_ops++;
+  st->push_bytes += bytes;
+  st->push_records += records;
+  uint64_t used = r->head - r->tail;
+  if (used > st->peak_used) st->peak_used = used;
 }
 
 int timed_wait(Ring* r, int64_t timeout_ms) {
@@ -257,6 +289,7 @@ int rt_ring_push(void* hp, int which, const uint8_t* buf, uint64_t len,
       return kClosed;
     }
     if (r->capacity - (r->head - r->tail) >= need) break;
+    r->stats.producer_waits++;
     int rc = timed_wait(r, timeout_ms);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&r->mu);
@@ -271,6 +304,7 @@ int rt_ring_push(void* hp, int which, const uint8_t* buf, uint64_t len,
   copy_in(data, r->capacity, r->head, (const uint8_t*)&len32, 4);
   copy_in(data, r->capacity, r->head + 4, buf, len);
   __atomic_store_n(&r->head, r->head + need, __ATOMIC_RELEASE);
+  note_push(r, need, 1);
   unlock_and_wake(r);
   return kOK;
 }
@@ -292,6 +326,7 @@ int rt_ring_push_raw(void* hp, int which, const uint8_t* buf, uint64_t len,
       return kClosed;
     }
     if (r->capacity - (r->head - r->tail) >= need) break;
+    r->stats.producer_waits++;
     int rc = timed_wait(r, timeout_ms);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&r->mu);
@@ -304,6 +339,7 @@ int rt_ring_push_raw(void* hp, int which, const uint8_t* buf, uint64_t len,
   }
   copy_in(data, r->capacity, r->head, buf, len);
   __atomic_store_n(&r->head, r->head + need, __ATOMIC_RELEASE);
+  note_push(r, need, 0);  // caller-framed: record count unknown here
   unlock_and_wake(r);
   return kOK;
 }
@@ -333,6 +369,7 @@ int64_t rt_ring_push_batch(void* hp, int which, const uint8_t* buf,
       return kClosed;
     }
     if (r->capacity - (r->head - r->tail) >= first) break;
+    r->stats.producer_waits++;
     int rc = timed_wait(r, timeout_ms);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&r->mu);
@@ -345,14 +382,18 @@ int64_t rt_ring_push_batch(void* hp, int which, const uint8_t* buf,
   }
   uint64_t avail = r->capacity - (r->head - r->tail);
   uint64_t take = 0;
+  uint64_t nrecs = 0;
   while (take + 4 <= len) {
     memcpy(&len32, buf + take, 4);
     uint64_t rec = align_up(4 + (uint64_t)len32, 8);
     if (take + rec > len || take + rec > avail) break;
     take += rec;
+    nrecs++;
   }
   copy_in(data, r->capacity, r->head, buf, take);
   __atomic_store_n(&r->head, r->head + take, __ATOMIC_RELEASE);
+  if (take) note_push(r, take, nrecs);
+  if (take < len) r->stats.partial_pushes++;
   unlock_and_wake(r);
   return (int64_t)take;
 }
@@ -366,17 +407,19 @@ int64_t rt_ring_pop_batch(void* hp, int which, uint8_t* out, uint64_t outcap,
   auto* h = (RingHandle*)hp;
   Ring* r = ring_of(h, which);
   uint8_t* data = h->base + r->data_off;
-  spin_for([r] {
+  bool spun = spin_for([r] {
     return __atomic_load_n(&r->head, __ATOMIC_ACQUIRE) !=
                __atomic_load_n(&r->tail, __ATOMIC_RELAXED) ||
            __atomic_load_n(&r->closed, __ATOMIC_RELAXED);
   });
   if (lock(&r->mu) != 0) return kSys;
+  if (spun && r->head != r->tail) r->stats.spin_hits++;
   while (r->head == r->tail) {
     if (r->closed) {
       pthread_mutex_unlock(&r->mu);
       return kClosed;
     }
+    r->stats.consumer_waits++;
     int rc = timed_wait(r, timeout_ms);
     if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&r->mu);
@@ -388,6 +431,7 @@ int64_t rt_ring_pop_batch(void* hp, int which, uint8_t* out, uint64_t outcap,
     }
   }
   uint64_t written = 0;
+  uint64_t nrecs = 0;
   while (r->head != r->tail) {
     uint32_t len32;
     copy_out(data, r->capacity, r->tail, (uint8_t*)&len32, 4);
@@ -405,9 +449,32 @@ int64_t rt_ring_pop_batch(void* hp, int which, uint8_t* out, uint64_t outcap,
     copy_out(data, r->capacity, r->tail, out + written, rec);
     __atomic_store_n(&r->tail, r->tail + rec, __ATOMIC_RELEASE);
     written += rec;
+    nrecs++;
   }
+  RingStats* st = &r->stats;
+  st->pop_ops++;
+  st->pop_bytes += written;
+  st->pop_records += nrecs;
   unlock_and_wake(r);
   return (int64_t)written;
+}
+
+// Copy one direction's stats block into out[0..n): field order matches
+// RingStats (push_ops, push_bytes, push_records, pop_ops, pop_bytes,
+// pop_records, producer_waits, consumer_waits, wake_signals, spin_hits,
+// partial_pushes, peak_used). Returns the number of fields written.
+// Takes the ring mutex: the caller is a ~1Hz metrics flush, and a
+// locked copy keeps the counters race-free (TSAN matrix) without
+// putting any atomics on the push/pop hot path.
+int rt_ring_stats(void* hp, int which, uint64_t* out, int n) {
+  auto* h = (RingHandle*)hp;
+  Ring* r = ring_of(h, which);
+  if (lock(&r->mu) != 0) return 0;
+  const uint64_t* src = (const uint64_t*)&r->stats;
+  int count = n < kRingStatsFields ? n : kRingStatsFields;
+  for (int i = 0; i < count; i++) out[i] = src[i];
+  pthread_mutex_unlock(&r->mu);
+  return count;
 }
 
 // Bytes currently queued in one direction (approximate: unlocked read).

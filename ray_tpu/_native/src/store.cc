@@ -32,7 +32,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545354'4f524531ull;  // "RTSTORE1"
+constexpr uint64_t kMagic = 0x52545354'4f524532ull;  // "RTSTORE2"
 constexpr int kIdSize = 20;
 constexpr uint64_t kAlign = 64;
 
@@ -89,6 +89,24 @@ struct ChannelHeader {  // lives at the start of a channel's data block
   uint32_t pad;
 };
 
+// Arena-wide counters in the shared header, updated under the store
+// mutex (plain adds) and read out via rt_store_stats for the metrics
+// flush — the metric_defs.cc objects-family role for the local arena.
+struct StoreStats {
+  uint64_t creates;        // successful object creations
+  uint64_t create_bytes;
+  uint64_t seals;
+  uint64_t gets;           // successful (sealed) reads
+  uint64_t get_waits;      // futex sleeps inside blocking gets
+  uint64_t get_lost;       // gets that hit an eviction tombstone
+  uint64_t releases;
+  uint64_t deletes;
+  uint64_t evictions;      // LRU victims freed under pressure
+  uint64_t evicted_bytes;
+  uint64_t peak_bytes;     // max observed bytes_in_use
+};
+constexpr int kStoreStatsFields = sizeof(StoreStats) / sizeof(uint64_t);
+
 struct StoreHeader {
   uint64_t magic;
   uint64_t capacity;     // total file size
@@ -102,6 +120,7 @@ struct StoreHeader {
   uint64_t bytes_in_use;
   uint32_t closed;
   uint32_t pad;
+  StoreStats stats;
 };
 
 struct Handle {
@@ -347,6 +366,8 @@ int evict_locked(Handle* h, uint64_t need) {
     }
   }
   if (!victim) return 0;
+  h->hdr->stats.evictions++;
+  h->hdr->stats.evicted_bytes += victim->size;
   free_locked(h, victim->offset);
   // Leave a tombstone instead of erasing: a live ObjectRef (or a stale GCS
   // location entry) may still point here, and a blocking get must see "lost",
@@ -497,6 +518,21 @@ uint64_t rt_store_bytes_in_use(void* hv) {
   return static_cast<Handle*>(hv)->hdr->bytes_in_use;
 }
 
+// Copy the arena stats block into out[0..n): field order matches
+// StoreStats (creates, create_bytes, seals, gets, get_waits, get_lost,
+// releases, deletes, evictions, evicted_bytes, peak_bytes). Locked copy
+// (the caller is a ~1Hz metrics flush); returns fields written.
+int rt_store_stats(void* hv, uint64_t* out, int n) {
+  auto* h = static_cast<Handle*>(hv);
+  StoreHeader* s = h->hdr;
+  if (lock(&s->mu) != 0) return 0;
+  const uint64_t* src = reinterpret_cast<const uint64_t*>(&s->stats);
+  int count = n < kStoreStatsFields ? n : kStoreStatsFields;
+  for (int i = 0; i < count; i++) out[i] = src[i];
+  pthread_mutex_unlock(&s->mu);
+  return count;
+}
+
 // Enumerate spill candidates: sealed, unreferenced objects, LRU-first.
 // Writes up to `max` ids (kIdSize bytes each) + sizes; returns the count.
 // The raylet uses this to pick what to move to disk under arena pressure
@@ -569,6 +605,10 @@ int rt_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* offset_out) 
   e->size = size;
   e->refcnt = 1;  // creator holds a ref until seal+release
   e->lru_seq = ++s->lru_clock;
+  s->stats.creates++;
+  s->stats.create_bytes += size;
+  if (s->bytes_in_use > s->stats.peak_bytes)
+    s->stats.peak_bytes = s->bytes_in_use;
   *offset_out = off;
   pthread_mutex_unlock(&s->mu);
   return kOK;
@@ -590,6 +630,7 @@ int rt_seal(void* hv, const uint8_t* id) {
   e->state = kSealed;
   e->refcnt -= 1;  // drop creator ref
   e->lru_seq = ++s->lru_clock;
+  s->stats.seals++;
   pthread_cond_broadcast(&s->cv);
   pthread_mutex_unlock(&s->mu);
   return kOK;
@@ -608,16 +649,19 @@ int rt_get(void* hv, const uint8_t* id, int64_t timeout_ms, uint64_t* offset_out
     if (e && e->state == kSealed) {
       e->refcnt += 1;
       e->lru_seq = ++s->lru_clock;
+      s->stats.gets++;
       *offset_out = e->offset;
       *size_out = e->size;
       pthread_mutex_unlock(&s->mu);
       return kOK;
     }
     if (e && e->state == kEvicted) {
+      s->stats.get_lost++;
       pthread_mutex_unlock(&s->mu);
       return kLost;  // fail fast: caller raises ObjectLostError / reconstructs
     }
     int rc;
+    s->stats.get_waits++;
     if (timeout_ms >= 0) {
       rc = cond_timedwait(&s->cv, &s->mu, &deadline);
       if (rc == ETIMEDOUT) {
@@ -653,6 +697,7 @@ int rt_release(void* hv, const uint8_t* id) {
     return kNotFound;
   }
   if (e->refcnt > 0) e->refcnt -= 1;
+  h->hdr->stats.releases++;
   pthread_cond_broadcast(&h->hdr->cv);
   pthread_mutex_unlock(&h->hdr->mu);
   return kOK;
@@ -678,6 +723,7 @@ int rt_delete(void* hv, const uint8_t* id) {
     // keep data alive for readers; demote lru so eviction reclaims it next
     e->lru_seq = 0;
   }
+  h->hdr->stats.deletes++;
   pthread_cond_broadcast(&h->hdr->cv);
   pthread_mutex_unlock(&h->hdr->mu);
   return kOK;

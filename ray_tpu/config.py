@@ -154,6 +154,13 @@ class Config:
 
     # --- observability ---
     task_events_report_interval_s: float = 1.0
+    #: hot-path flight recorder (utils/recorder.py): always-on ring of
+    #: ns-stamped stage events per process, < 1µs/task budget (bench.py
+    #: recorder_overhead_us). Off switch for A/B and paranoia.
+    recorder_enabled: bool = True
+    #: slots per process recorder ring (also the driver's retained
+    #: latency-sample window); fixed-size, drop-oldest
+    recorder_events_cap: int = 4096
     log_dir: str = ""
     temp_dir: str = "/tmp/ray_tpu"
 

@@ -51,7 +51,7 @@ from ray_tpu.core.ref import (
     TaskError,
     WorkerCrashedError,
 )
-from ray_tpu.utils import aio, metrics, rpc, serialization
+from ray_tpu.utils import aio, metrics, recorder, rpc, serialization
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 
 _NCPU = max(1, os.cpu_count() or 1)
@@ -154,6 +154,12 @@ class _TaskEventBuffer:
             if self.events:
                 batch, self.events = self.events, []
                 await self.core.gcs.notify("report_task_events", {"events": batch})
+            # flight-recorder drain rides the same timer: native ring/
+            # store gauges + sampled stage histograms are folded into the
+            # metrics snapshot below, the latency window is published
+            # beside it (all the expensive work happens HERE, 1/s — the
+            # task hot path only ever appends to the recorder ring)
+            self.core._publish_recorder_metrics()
             # metrics publish is independent of task activity (a put-only
             # process still reports its counters)
             await self.core.gcs.call(
@@ -161,6 +167,16 @@ class _TaskEventBuffer:
                 {"ns": "metrics", "key": self.core.worker_id.hex(),
                  "value": pickle.dumps(metrics.registry().snapshot())},
             )
+            lat = self.core._latency_snapshot()
+            if lat is not None:
+                await self.core.gcs.call(
+                    "kv_put",
+                    {"ns": "latency", "key": self.core.worker_id.hex(),
+                     "value": pickle.dumps(lat)},
+                )
+                # only after the put landed: a transient GCS error must
+                # not permanently skip republishing this window
+                self.core._lat_published = lat["count"]
         except Exception:
             pass
 
@@ -274,7 +290,7 @@ class CoreClient:
         self._fast_migrate_armed = False
         self._fast_ineligible_funcs: set[bytes] = set()
         self._fast_ring_seq = 0
-        self._fast_last_submit = 0.0  # burst detector (see _try_fast_submit)
+        self._fast_last_submit = 0  # burst detector, perf_counter_ns
         self._fast_demand_kick = 0.0  # rate-limits backlog->pump kicks
         self._fast_actor_lanes: dict[ActorID, object] = {}
         # Coalesced ring flush (see FastLane.txbuf): the flusher thread is
@@ -286,6 +302,12 @@ class CoreClient:
         self._fast_tx_flushes = 0   # batch pushes (stats: bench.py)
         self._fast_tx_records = 0   # records those pushes carried
         self._fast_spilled_results = 0  # completions that arrived via RPC spill
+        # flight recorder (utils/recorder.py): the hot paths read this
+        # cached flag (an attribute load) instead of calling
+        # recorder.enabled() per task; the flush timer refreshes it
+        self._rec_enabled = recorder.enabled()
+        self._rec_published = -1  # stats.n at the last metrics publish
+        self._lat_published = -1  # stats.n at the last latency kv_put
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -1111,10 +1133,12 @@ class CoreClient:
         # burst (per-call cost inflated by neighbor load) should buffer —
         # deferral is safe because it additionally requires in-ring work
         # the worker is already chewing on (see _fast_register_and_push).
-        now = time.perf_counter()
-        gap = now - self._fast_last_submit
-        burst = gap < 0.0002
-        self._fast_last_submit = now
+        # ns clock: the SAME read serves burst detection AND the flight
+        # recorder's submit stamp (no float math, no second clock call)
+        now_ns = time.perf_counter_ns()
+        gap_ns = now_ns - self._fast_last_submit
+        burst = gap_ns < 200_000
+        self._fast_last_submit = now_ns
         lone = False
         if not burst and not any(ln.inflight for ln in lanes):
             # Completion fast lane: a lone submit-then-block call rides
@@ -1145,8 +1169,12 @@ class CoreClient:
         self._task_counter += 1
         task_id = TaskID.generate()
         tid = task_id.binary()
+        # flight-recorder stamp: perf_counter_ns is the same
+        # CLOCK_MONOTONIC the worker pops against, so pop - t0 IS the
+        # submit-ring hop
+        t0 = now_ns if self._rec_enabled else 0
         try:
-            rec = fastpath.pack_task(tid, func_id, args, kwargs)
+            rec = fastpath.pack_task(tid, func_id, args, kwargs, t0)
         except Exception:
             return None  # plain pickle can't carry it: cloudpickle path
         # cap also guards the pop buffer: a record the consumer can never
@@ -1156,7 +1184,7 @@ class CoreClient:
             return None  # big args belong in the object store
         ref = self._fast_register_and_push(lane, task_id, rec,
                                            (fn, args, kwargs, resources),
-                                           defer=gap < 0.002)
+                                           defer=gap_ns < 2_000_000, t0=t0)
         if ref is None:
             return None
         lane.worker.idle_since = time.monotonic()  # keep the lease warm
@@ -1164,20 +1192,23 @@ class CoreClient:
         # Demand signaling: tasks queued beyond one-per-worker must still
         # surface as lease demand (raylet _lease_waiters feeds the
         # autoscaler and spillback) even though they ride the rings — but
-        # only once the backlog PERSISTS (see fast_backlog_since).
+        # only once the backlog PERSISTS (see fast_backlog_since, kept in
+        # seconds: _maybe_spawn_lease/_report_demand compare it against
+        # time.monotonic(), the same clock as perf_counter on Linux).
         if len(lane.inflight) > 1:
+            now_s = now_ns * 1e-9
             if state.fast_backlog_since == 0.0:
-                state.fast_backlog_since = now
-            elif (now - state.fast_backlog_since > 0.5
-                    and now - self._fast_demand_kick > 0.25):
-                self._fast_demand_kick = now
+                state.fast_backlog_since = now_s
+            elif (now_s - state.fast_backlog_since > 0.5
+                    and now_s - self._fast_demand_kick > 0.25):
+                self._fast_demand_kick = now_s
                 self._call_on_loop(self._pump(key, state))
         else:
             state.fast_backlog_since = 0.0
         return ref
 
     def _fast_register_and_push(self, lane, task_id: TaskID, rec: bytes,
-                                light, defer: bool = False
+                                light, defer: bool = False, t0: int = 0
                                 ) -> ObjectRef | None:
         """Shared submit tail for task and actor lanes: register the
         in-flight entry under the cv, create the pending memory-store
@@ -1198,7 +1229,10 @@ class CoreClient:
             if lane.broken or lane.retired:
                 return None  # lost the race with a lane retire/break
             lane.inflight[task_id] = light
-            self._fast_oid_lane[oid] = lane
+            # the oid entry carries the recorder's submit stamp too: one
+            # dict op serves routing AND telemetry (t0 is 0 when the
+            # recorder is off)
+            self._fast_oid_lane[oid] = (lane, t0)
         self.memory_store[oid] = _MemEntry()
         cfg = self.cfg
         kick = False
@@ -1261,6 +1295,10 @@ class CoreClient:
         if pushed >= len(framed):
             self._fast_tx_flushes += 1
             self._fast_tx_records += len(lane.txbuf)
+            rec_r = recorder.get_recorder() if self._rec_enabled else None
+            if rec_r is not None:  # one event per FLUSH, not per task
+                rec_r.record(b"", recorder.RING_PUSH,
+                             a0=len(lane.txbuf), a1=pushed)
             lane.txbuf.clear()
             lane.txbytes = 0
             return 0
@@ -1273,6 +1311,10 @@ class CoreClient:
                 consumed += 1
             self._fast_tx_flushes += 1
             self._fast_tx_records += consumed
+            rec_r = recorder.get_recorder() if self._rec_enabled else None
+            if rec_r is not None:
+                rec_r.record(b"", recorder.RING_PUSH,
+                             a0=consumed, a1=pushed)
             del lane.txbuf[:consumed]
             lane.txbytes -= pushed
         return 0
@@ -1339,6 +1381,104 @@ class CoreClient:
             "records": records,
             "avg_batch": (records / flushes) if flushes else 0.0,
         }
+
+    def native_stats(self) -> dict:
+        """Zero-copy view of the native transport counters: per-direction
+        ring stats summed over live lanes (both sides of each ring share
+        one shm stats block, so this covers the workers' halves too) and
+        the local arena's store stats."""
+        out: dict = {"ring": {}, "store": None}
+        for which, label in ((0, "sub"), (1, "rep")):
+            agg: dict[str, int] = {}
+            for lane in list(self._fast_lanes):
+                st = lane.ring.stats(which)
+                if st:
+                    for k, v in st.items():
+                        if k == "peak_used":
+                            # a SUM of per-lane peaks is an occupancy
+                            # that never existed; the ring-sizing signal
+                            # is the worst single lane
+                            agg[k] = max(agg.get(k, 0), v)
+                        else:
+                            agg[k] = agg.get(k, 0) + v
+            out["ring"][label] = agg
+        if self.store is not None and not self.client_mode:
+            try:
+                out["store"] = self.store.stats()
+            except Exception:
+                pass
+        return out
+
+    def _publish_recorder_metrics(self) -> None:
+        """Flush-timer hook: fold the flight recorder's window and the
+        native shm counters into the metrics registry (gauges + sampled
+        stage histograms). Runs 1/s off the hot path; every aggregation
+        here is bounded (capped windows, bulk bisect feed) so the flush
+        can never grow past ~1ms and tax the A/B's CPU counter."""
+        self._rec_enabled = recorder.enabled()  # refresh the hot-path gate
+        # native ring/store gauges first, UNGATED: the shm counters move
+        # with puts/gets/ring traffic even when no new task sample landed
+        ns = self.native_stats()
+        for label, agg in ns["ring"].items():
+            for k, v in agg.items():
+                metrics.fastpath_ring.set(v, tags={"which": label, "stat": k})
+        if ns["store"]:
+            for k, v in ns["store"].items():
+                metrics.object_store_stat.set(v, tags={"stat": k})
+        stats = recorder.get_stats() if self._rec_enabled else None
+        if stats is None or stats.n == 0 or stats.n == self._rec_published:
+            return  # recorder off / idle: stage aggregation has no new work
+        # write the drained tasks' SAMPLE slots into the recorder ring
+        # now (bounded to the newest 64 per flush): the hot path only
+        # stored raw tuples, and timeline/event expansion reads these
+        rec_r = recorder.get_recorder()
+        prev = max(self._rec_published, 0)
+        if rec_r is not None and stats.n > prev:
+            for raw in stats.raw_window(min(stats.n - prev, 64)):
+                ring_ns, deser_ns, exec_ns, reply_ns, total = \
+                    recorder.decode_sample(raw)
+                rec_r.record_sample(raw[2], raw[1], ring_ns, deser_ns,
+                                    exec_ns, reply_ns, total)
+        self._rec_published = stats.n
+        metrics.recorder_samples.set(stats.n)
+        # histogram feed is bounded per flush (newest samples win): under
+        # full load this is deliberate sampling, not a per-task tax
+        fresh = stats.new_since_flush()
+        if fresh:
+            for i, name in enumerate(recorder.LATENCY_STAGES):
+                metrics.task_stage_seconds.observe_many(
+                    [s[i] / 1e9 for s in fresh], tags={"stage": name})
+        win = stats.window(512)
+        for i, name in enumerate(recorder.LATENCY_STAGES):
+            vals = sorted(s[i] for s in win)
+            for q, qn in ((0.5, "p50"), (0.99, "p99")):
+                metrics.task_stage_us.set(
+                    recorder.percentile(vals, q) / 1e3,
+                    tags={"stage": name, "q": qn})
+
+    def _latency_snapshot(self) -> dict | None:
+        """Publishable per-stage latency window (GCS ns="latency"):
+        stage duration lists for list_task_latency percentiles plus the
+        newest raw samples (wall-anchored) for timeline enrichment.
+        Skipped while idle — the flush marks ``_lat_published`` after a
+        successful kv_put, so an idle driver doesn't decode/pickle/ship
+        a byte-identical ~40KB window every second forever."""
+        stats = recorder.get_stats() if recorder.enabled() else None
+        rec_r = recorder.get_recorder() if stats is not None else None
+        if rec_r is None or stats.n == self._lat_published:
+            return None
+        snap = stats.snapshot(rec_r.anchor_wall, rec_r.anchor_perf)
+        if snap is None:
+            return None
+        samples = []
+        for raw in stats.raw_window(256):
+            ring_ns, deser_ns, exec_ns, reply_ns, _total = \
+                recorder.decode_sample(raw)
+            samples.append((raw[2].hex(), rec_r.wall_ns(raw[1]), ring_ns,
+                            deser_ns, exec_ns, reply_ns))
+        snap["samples"] = samples
+        snap["worker_id"] = self.worker_id.hex()
+        return snap
 
     async def _fast_actor_attach(self, actor_id: ActorID, conn):
         """Ring lane to a same-node actor's worker: actor calls then skip
@@ -1412,9 +1552,11 @@ class CoreClient:
                     return None
         task_id = TaskID.generate_actor()
         tid = task_id.binary()
+        now_ns = time.perf_counter_ns()
+        t0 = now_ns if self._rec_enabled else 0
         try:
             rec = fastpath.pack_task(tid, b"am:" + method.encode(), args,
-                                     kwargs)
+                                     kwargs, t0)
         except Exception:
             self._fast_retire_actor_lane(lane)
             return None
@@ -1422,12 +1564,11 @@ class CoreClient:
                           fastpath.POP_BUF_BYTES - 64):
             self._fast_retire_actor_lane(lane)
             return None
-        now = time.perf_counter()
-        gap = now - self._fast_last_submit
-        self._fast_last_submit = now
+        gap_ns = now_ns - self._fast_last_submit
+        self._fast_last_submit = now_ns
         ref = self._fast_register_and_push(
             lane, task_id, rec, ("actor", actor_id, method, args, kwargs),
-            defer=gap < 0.002)
+            defer=gap_ns < 2_000_000, t0=t0)
         if ref is not None:
             metrics.actor_calls.inc()
         return ref
@@ -1479,16 +1620,28 @@ class CoreClient:
 
     def _fast_process_replies(self, lane, recs):
         """Record a batch of reply records (any thread): resolve blocking
-        gets via the cv, queue loop-side bookkeeping."""
+        gets via the cv, queue loop-side bookkeeping. This is the
+        DRIVER_APPLY point of the flight recorder: a stamped reply plus
+        the submit-time t0 yields the full per-task stage sample (both
+        ring hops, deserialize, exec) at the cost of one ring store and
+        one recorder slot per task."""
         from ray_tpu.core import fastpath
 
+        t_rx = time.perf_counter_ns()
+        stats = recorder.get_stats() if self._rec_enabled else None
+        # StageStats.add inlined below (ring/cap hoisted per batch): the
+        # method-call frame alone is ~8% of the recorder's whole per-task
+        # budget on slow interpreters (bench.py recorder_overhead_us)
+        if stats is not None:
+            sring, scap = stats.ring, stats.cap
         batch = []
         with self._fast_cv:
             for rec in recs:
-                tid_b, status, payload = fastpath.unpack_reply(rec)
+                tid_b, status, payload, stamp = fastpath.unpack_reply(rec)
                 task_id = TaskID(tid_b)
                 light = lane.inflight.pop(task_id, None)
                 oid = ObjectID.for_task_return(task_id, 0)
+                ent = self._fast_oid_lane.pop(oid, None)
                 if light is None:
                     # untracked completion: a duplicate delivery (the
                     # spill RPC's timeout path may re-send records whose
@@ -1498,7 +1651,14 @@ class CoreClient:
                     entry = self.memory_store.get(oid)
                     if entry is None or entry.ready.is_set():
                         continue
-                self._fast_oid_lane.pop(oid, None)
+                if (stamp is not None and ent is not None and ent[1]
+                        and stats is not None
+                        and status != fastpath.NEED_SLOW):
+                    # ONE raw tuple store per task — stamp decoding,
+                    # percentile math and shm SAMPLE slots all happen on
+                    # the flush timer over bounded windows, never here
+                    sring[stats.n % scap] = (ent[1], t_rx, tid_b, stamp)
+                    stats.n += 1
                 if status != fastpath.NEED_SLOW:
                     self._fast_done[oid] = (status, payload)
                 batch.append((task_id, oid, status, payload, light))
@@ -1524,10 +1684,11 @@ class CoreClient:
         by_lane: dict[int, tuple] = {}
         with self._fast_cv:
             for rec in p["records"]:
-                tid_b, status, payload = fastpath.unpack_reply(rec)
+                tid_b = fastpath.unpack_reply(rec)[0]
                 oid = ObjectID.for_task_return(TaskID(tid_b), 0)
-                lane = self._fast_oid_lane.get(oid)
-                if lane is not None:
+                ent = self._fast_oid_lane.get(oid)
+                if ent is not None:
+                    lane = ent[0]
                     by_lane.setdefault(id(lane), (lane, []))[1].append(rec)
         for lane, recs in by_lane.values():
             self._fast_spilled_results += len(recs)
@@ -1798,14 +1959,14 @@ class CoreClient:
                     if hit is not None:
                         resolved[oid] = hit
                         continue
-                    lane = self._fast_oid_lane.get(oid)
-                    if lane is None:
+                    ent = self._fast_oid_lane.get(oid)
+                    if ent is None:
                         continue  # migrated/broken/cancelled: loop path owns it
                     entry = self.memory_store.get(oid)
                     if entry is not None and entry.ready.is_set():
                         continue  # completed via the loop
                     pending.add(oid)
-                    lanes.add(lane)
+                    lanes.add(ent[0])
                 if not pending:
                     break
                 if len(lanes) == 1:

@@ -65,6 +65,13 @@ _ST_TIMEOUT = -4
 _ST_CLOSED = -7
 _ST_TOOBIG = -9
 
+# rt_ring_stats field order (ring.cc RingStats)
+RING_STAT_FIELDS = (
+    "push_ops", "push_bytes", "push_records", "pop_ops", "pop_bytes",
+    "pop_records", "producer_waits", "consumer_waits", "wake_signals",
+    "spin_hits", "partial_pushes", "peak_used",
+)
+
 
 class RingClosed(Exception):
     pass
@@ -179,6 +186,23 @@ class RingPair:
         finally:
             self._exit()
 
+    def stats(self, which: int) -> dict[str, int] | None:
+        """One direction's shared-memory stats block (ring.cc RingStats),
+        read straight out of the mapped segment — both sides of the ring
+        see identical numbers, so the driver's metrics flush covers the
+        worker's half too. None once the pair is dead."""
+        if not self._enter():
+            return None
+        try:
+            out = (ctypes.c_uint64 * len(RING_STAT_FIELDS))()
+            n = self._lib.rt_ring_stats(
+                self._h, which,
+                ctypes.cast(out, ctypes.POINTER(ctypes.c_uint64)), len(out))
+        finally:
+            self._exit()
+        return {name: int(out[i])
+                for i, name in enumerate(RING_STAT_FIELDS[:n])}
+
     def close(self, which: int) -> None:
         if not self._enter():
             return
@@ -259,33 +283,87 @@ def _simple(x, depth: int = 2) -> bool:
     return False
 
 
-def pack_task(task_id: bytes, func_id: bytes, args, kwargs) -> bytes:
+def pack_task(task_id: bytes, func_id: bytes, args, kwargs,
+              t_ns: int = 0) -> bytes:
     """Two-tier arg encoding. Simple immutables take the C pickler (the
     submission hot path — a Python-level reducer hook here measured ~2x on
     the whole bench); anything else goes through serialization.pack, whose
     rules match the RPC path: functions/classes from __main__ or test
     modules ship by value, jax arrays devolve to numpy, nested ObjectRefs
     run the borrow protocol. Plain pickle would encode those by reference
-    and silently mean something else on the worker."""
+    and silently mean something else on the worker.
+
+    ``t_ns`` (protocol 1.7, flight recorder) is the driver's
+    ``perf_counter_ns`` at submit: CLOCK_MONOTONIC is system-wide on
+    Linux and fast lanes are same-node, so the worker's pop-time minus
+    this stamp IS the submit-ring hop. Stamped records use the "Q"/"R"
+    prefixes; un-stamped "P"/"S" stay decodable (recorder off)."""
     if _simple(args) and (not kwargs or _simple(kwargs)):
-        return b"P" + pickle.dumps(
-            (task_id, func_id, args, kwargs), protocol=5)
-    return b"S" + serialization.pack((task_id, func_id, args, kwargs))
+        body = pickle.dumps((task_id, func_id, args, kwargs), protocol=5)
+        if t_ns:
+            return b"Q" + struct.pack("<Q", t_ns) + body
+        return b"P" + body
+    body = serialization.pack((task_id, func_id, args, kwargs))
+    if t_ns:
+        return b"R" + struct.pack("<Q", t_ns) + body
+    return b"S" + body
 
 
 def unpack_task(rec: bytes):
-    if rec[:1] == b"P":
-        return pickle.loads(rec[1:])
-    return serialization.unpack(rec[1:])
+    """-> (task_id, func_id, args, kwargs, t_submit_ns) — 0 when the
+    record carries no recorder stamp."""
+    kind = rec[:1]
+    if kind == b"P":
+        return (*pickle.loads(rec[1:]), 0)
+    if kind == b"S":
+        return (*serialization.unpack(rec[1:]), 0)
+    (t_ns,) = struct.unpack_from("<Q", rec, 1)
+    if kind == b"Q":
+        return (*pickle.loads(rec[9:]), t_ns)
+    return (*serialization.unpack(rec[9:]), t_ns)
 
 
-def pack_reply(task_id: bytes, status: int, payload: bytes) -> bytes:
+# reply-status flag bit: a 16-byte stage stamp follows the header
+# (protocol 1.7; kept ≤ 16 bytes so inline results stay under the
+# fastpath_inline_result_max threshold budget)
+STAMPED = 0x100
+_STAMP = struct.Struct("<IIQ")  # ring_ns (sat), deser_ns (sat), exec_ns
+_U32_MAX = 0xFFFFFFFF
+
+
+def pack_stamp(ring_ns: int, deser_ns: int, exec_ns: int) -> bytes:
+    """Worker-side stage stamp: submit-ring hop (pop - t_submit),
+    deserialize (pop -> user-function entry, includes function load and
+    exec-mutex acquire), and exec (the user function). The reply hop is
+    derived driver-side as total - (ring + deser + exec). Fast path
+    packs unclamped (every per-task nanosecond counts — see the bench's
+    recorder_overhead_us budget); only out-of-range values (a >4.3s
+    ring stall, a clock anomaly) pay the clamping retry."""
+    try:
+        return _STAMP.pack(ring_ns, deser_ns, exec_ns)
+    except struct.error:
+        return _STAMP.pack(min(max(ring_ns, 0), _U32_MAX),
+                           min(max(deser_ns, 0), _U32_MAX),
+                           max(exec_ns, 0))
+
+
+def unpack_stamp(stamp: bytes) -> tuple[int, int, int]:
+    return _STAMP.unpack(stamp)
+
+
+def pack_reply(task_id: bytes, status: int, payload: bytes,
+               stamp: bytes = b"") -> bytes:
+    if stamp:
+        return struct.pack("<16sI", task_id, status | STAMPED) + stamp + payload
     return struct.pack("<16sI", task_id, status) + payload
 
 
 def unpack_reply(rec: bytes):
+    """-> (task_id, status, payload, stamp | None)."""
     task_id, status = struct.unpack_from("<16sI", rec)
-    return task_id, status, rec[20:]
+    if status & STAMPED:
+        return task_id, status & ~STAMPED, rec[36:], rec[20:36]
+    return task_id, status, rec[20:], None
 
 
 def pack_shm_size(size: int) -> bytes:

@@ -162,6 +162,25 @@ class SharedObjectStore:
     def bytes_in_use(self) -> int:
         return self._lib.rt_store_bytes_in_use(self._handle)
 
+    # rt_store_stats field order (store.cc StoreStats)
+    STAT_FIELDS = (
+        "creates", "create_bytes", "seals", "gets", "get_waits", "get_lost",
+        "releases", "deletes", "evictions", "evicted_bytes", "peak_bytes",
+    )
+
+    def stats(self) -> dict[str, int]:
+        """Arena-wide counters from the shared header (store.cc
+        StoreStats): every process mapping the arena reads the same
+        numbers, so one metrics flush per node covers all clients."""
+        out = (ctypes.c_uint64 * len(self.STAT_FIELDS))()
+        n = self._lib.rt_store_stats(
+            self._handle,
+            ctypes.cast(out, ctypes.POINTER(ctypes.c_uint64)), len(out))
+        d = {name: int(out[i]) for i, name in enumerate(self.STAT_FIELDS[:n])}
+        d["bytes_in_use"] = int(self.bytes_in_use)
+        d["capacity"] = int(self.capacity)
+        return d
+
     def list_spillable(self, max_count: int = 64) -> list[tuple[ObjectID, int]]:
         """Sealed, unreferenced objects in LRU order (spill candidates for
         the raylet's spill manager, ref: local_object_manager.h:42)."""

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import os
+import pickle
 import subprocess
 import sys
 import threading
@@ -403,6 +404,17 @@ class Raylet:
                     w.proc.terminate()
                     self.all_workers.pop(w.worker_id, None)
                     self._release_cgroup_after_exit(w)
+                    # trimmed workers never run their clean-exit recorder
+                    # unlink and skip the death-report path: drop the file
+                    # here or it leaks 256KB per trim for the session
+                    from ray_tpu.utils import recorder as _recorder
+
+                    try:
+                        os.unlink(_recorder.worker_recorder_path(
+                            self.cfg.temp_dir, self.session,
+                            w.worker_id.hex()))
+                    except OSError:
+                        pass
                 else:
                     keep.append(w)
                     kept_by_lang[w.language] = kept_by_lang.get(w.language, 0) + 1
@@ -431,6 +443,7 @@ class Raylet:
             lease = self.leases.pop(w.lease_id)
             self._free_lease_resources(lease)
             self._grant_waiters()
+        await self._report_worker_death(w)
         if w.actor_id is not None:
             try:
                 await self.gcs.call(
@@ -439,6 +452,43 @@ class Raylet:
                 )
             except Exception:
                 pass
+
+    async def _report_worker_death(self, w: WorkerHandle):
+        """Postmortem: the victim's flight-recorder ring lives in a shm
+        file under the session tree (utils/recorder.py), so it survives
+        a SIGKILL — dump the last-N stage events plus exit context into
+        the GCS death-report table (state.list_worker_deaths). A clean
+        exit_worker unlinks its recorder first, so only real deaths
+        carry events."""
+        from ray_tpu.utils import recorder as _recorder
+
+        rec_path = _recorder.worker_recorder_path(
+            self.cfg.temp_dir, self.session, w.worker_id.hex())
+        events = _recorder.read_events(rec_path, last=64)
+        try:
+            os.unlink(rec_path)
+        except OSError:
+            pass
+        returncode = w.proc.poll()
+        report = {
+            "worker_id": w.worker_id.hex(),
+            "node_id": self.node_id.hex(),
+            "pid": w.proc.pid,
+            "ts": time.time(),
+            "returncode": returncode,
+            # negative returncode = killed by that signal (SIGKILL -> -9)
+            "signal": -returncode if returncode and returncode < 0 else None,
+            "actor_id": w.actor_id.hex()
+                        if hasattr(w.actor_id, "hex") else w.actor_id,
+            "leased": w.lease_id is not None,
+            "recorder_events": events,
+        }
+        try:
+            await self.gcs.call("kv_put", {
+                "ns": "worker_deaths", "key": w.worker_id.hex(),
+                "value": pickle.dumps(report)})
+        except Exception:
+            pass  # GCS unreachable: the death still frees the lease above
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self, language: str = "python") -> WorkerHandle:
@@ -1449,6 +1499,23 @@ class Raylet:
                 w.proc.terminate()
             except Exception:
                 pass
+        # terminated workers never run their clean-exit recorder unlink:
+        # drop OUR workers' recorder files (256KB each) — only ours, the
+        # session rec/ dir is shared by every node of an in-process
+        # cluster and other raylets' workers may still be alive
+        from ray_tpu.utils import recorder as _recorder
+
+        for w in self.all_workers.values():
+            try:
+                os.unlink(_recorder.worker_recorder_path(
+                    self.cfg.temp_dir, self.session, w.worker_id.hex()))
+            except OSError:
+                pass
+        try:  # removes the dir only once the LAST node emptied it
+            os.rmdir(os.path.join(
+                self.cfg.temp_dir, f"session_{self.session}", "rec"))
+        except OSError:
+            pass
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()

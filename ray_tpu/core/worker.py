@@ -84,6 +84,8 @@ class Worker:
         import threading as _threading
 
         self._exec_mutex = _threading.Lock()
+        # actor-lane W_TASK sampling counter (see _fast_actor_exec_batch)
+        self._rec_wt_n = 0
 
     async def start(self):
         # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
@@ -94,6 +96,18 @@ class Worker:
         from ray_tpu.utils.device import configure_jax
 
         configure_jax()
+        # Flight recorder: shm-file-backed under the session tree so the
+        # raylet can dump our last-N stage events into the death report
+        # after a SIGKILL (no RT_SESSION -> manually spawned: stay
+        # anonymous/in-memory).
+        from ray_tpu.utils import recorder as _recorder
+
+        if self.cfg.recorder_enabled:
+            session = os.environ.get("RT_SESSION")
+            _recorder.init_process_recorder(
+                _recorder.worker_recorder_path(
+                    self.cfg.temp_dir, session, self.worker_id.hex())
+                if session else None)
         # register on the CANONICAL module: under `python -m` this file
         # also exists as `__main__`, and runtime_context imports
         # ray_tpu.core.worker — the two must agree
@@ -463,12 +477,17 @@ class Worker:
     def _fast_actor_exec_batch(self, ring, state: dict, recs) -> bool:
         """Execute one batch of ring records inline; False = ring done."""
         from ray_tpu.core import fastpath
+        from ray_tpu.utils import recorder as _rec
 
         inline_max = self.cfg.fastpath_inline_result_max
         inst = self.actor_instance
+        rec_r = _rec.get_recorder()
+        t_prev = t_pop = time.perf_counter_ns()
+        if rec_r is not None:
+            rec_r.record(b"", _rec.WORKER_POP, t_pop, a0=len(recs))
         replies = []
         for rec in recs:
-            tid, mkey, args, kwargs = fastpath.unpack_task(rec)
+            tid, mkey, args, kwargs, t_sub = fastpath.unpack_task(rec)
             mname = mkey[3:].decode()  # b"am:<method>"
             m = getattr(inst, mname, None)
             if (state["downgraded"]
@@ -482,14 +501,35 @@ class Worker:
                 state["downgraded"] = True
                 replies.append(fastpath.pack_reply(
                     tid, fastpath.NEED_SLOW, b""))
+                t_prev = time.perf_counter_ns()  # skipped record: don't
+                # bill its handling to the next record's deserialize
                 continue
+            t_x0 = time.perf_counter_ns()
             try:
                 ok, val = True, m(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — reply on
                 ok, val = False, e
-            replies.append(
-                self._fast_pack_result(tid, ok, val, inline_max))
-        return self._fast_push_replies(ring, replies) == 0
+            t_x1 = time.perf_counter_ns()
+            ring_ns = t_pop - t_sub if t_sub else 0
+            deser_ns = t_x0 - t_prev
+            exec_ns = t_x1 - t_x0
+            t_prev = t_x1
+            replies.append(self._fast_pack_result(
+                tid, ok, val, inline_max,
+                fastpath.pack_stamp(ring_ns, deser_ns, exec_ns)
+                if t_sub else b""))
+            if rec_r is not None:
+                # same 1-in-16 W_TASK sampling as the normal pump (the
+                # counter lives on self: batches don't reset it)
+                self._rec_wt_n += 1
+                if not (self._rec_wt_n & 15):
+                    rec_r.record_wtask(
+                        tid, t_x1, min(max(ring_ns, 0), 0xFFFFFFFF),
+                        min(deser_ns, 0xFFFFFFFF), exec_ns)
+        ok_push = self._fast_push_replies(ring, replies) == 0
+        if rec_r is not None:
+            rec_r.record(b"", _rec.COMPLETION_PUSH, a0=len(replies))
+        return ok_push
 
     def _fast_actor_pump_cycle(self, ring, state: dict):
         """ONE pump cycle, ON the actor's single executor thread: pop a
@@ -571,6 +611,19 @@ class Worker:
         # through the ring and unpacked from a bytes round-trip
         inline_max = self.cfg.fastpath_inline_result_max
         fast_funcs: dict[bytes, object] = {}
+        from ray_tpu.utils import recorder as _rec
+
+        rec_r = _rec.get_recorder()  # None when the recorder is disabled
+        # hot-path locals: per-record attribute walks add up at ring rate
+        import struct as _struct
+
+        clock = time.perf_counter_ns
+        stamp_pack = fastpath._STAMP.pack  # raw; clamp fallback below
+        pack_stamp = fastpath.pack_stamp
+        wt_n = 0  # W_TASK shm slots are taken every 16th task (Dapper
+        #           sampling: the per-batch POP/PUSH events plus sampled
+        #           task slots keep postmortems representative at a
+        #           sixteenth of the write cost)
 
         def load(func_id):
             fn = fast_funcs.get(func_id)
@@ -602,10 +655,15 @@ class Worker:
                 bad_record = False
                 closed = False
                 contended = False
+                # per-pop batch timestamps: t_prev advances past each
+                # record so deser_i never charges a batch-mate's exec
+                t_pop = t_prev = clock()
+                if rec_r is not None:
+                    rec_r.record(b"", _rec.WORKER_POP, t_pop, a0=len(recs))
                 while True:
                     for rec in recs:
                         try:
-                            tid, func_id, args, kwargs = (
+                            tid, func_id, args, kwargs, t_sub = (
                                 fastpath.unpack_task(rec))
                         except Exception:
                             # undecodable record: without its task id there
@@ -620,6 +678,9 @@ class Worker:
                         if not fn:
                             replies.append(fastpath.pack_reply(
                                 tid, fastpath.NEED_SLOW, b""))
+                            t_prev = clock()  # don't bill the (possibly
+                            # 15s) function fetch to the next record's
+                            # deserialize stage
                             continue
                         # _exec_mutex: an RPC-path normal task may be on the
                         # executor thread right now (the driver's quiet-lane
@@ -633,15 +694,40 @@ class Worker:
                             contended = True
                             replies.append(fastpath.pack_reply(
                                 tid, fastpath.NEED_SLOW, b""))
+                            t_prev = clock()  # the 50ms acquire timeout
+                            # must not surface as a phantom deserialize
+                            # spike on the next record's stamp
                             continue
+                        t_x0 = clock()
                         try:
                             ok, val = True, fn(*args, **kwargs)
                         except BaseException as e:  # noqa: BLE001 — reply on
                             ok, val = False, e
                         finally:
                             self._exec_mutex.release()
-                        replies.append(
-                            self._fast_pack_result(tid, ok, val, inline_max))
+                        t_x1 = clock()
+                        ring_ns = t_pop - t_sub if t_sub else 0
+                        deser_ns = t_x0 - t_prev
+                        exec_ns = t_x1 - t_x0
+                        t_prev = t_x1
+                        if t_sub:
+                            try:  # zero-cost try; clamp only on anomaly
+                                stamp = stamp_pack(ring_ns, deser_ns,
+                                                   exec_ns)
+                            except _struct.error:
+                                stamp = pack_stamp(ring_ns, deser_ns,
+                                                   exec_ns)
+                        else:
+                            stamp = b""
+                        replies.append(self._fast_pack_result(
+                            tid, ok, val, inline_max, stamp))
+                        if rec_r is not None:
+                            wt_n += 1
+                            if not (wt_n & 15):
+                                rec_r.record_wtask(
+                                    tid, t_x1,
+                                    min(max(ring_ns, 0), 0xFFFFFFFF),
+                                    min(deser_ns, 0xFFFFFFFF), exec_ns)
                     # Reply-drain coalescing: records that arrived while
                     # this batch executed join the SAME reply frame — a
                     # pipelined burst costs the driver one reply wake per
@@ -663,7 +749,14 @@ class Worker:
                     if not more:
                         break
                     recs = more
+                    t_pop = t_prev = time.perf_counter_ns()
+                    if rec_r is not None:
+                        rec_r.record(b"", _rec.WORKER_POP, t_pop,
+                                     a0=len(recs))
                 status = self._fast_push_replies(ring, replies)
+                if rec_r is not None:
+                    rec_r.record(b"", _rec.COMPLETION_PUSH,
+                                 a0=len(replies))
                 if bad_record or closed or status != 0:
                     break  # ring closed/undecodable: driver recovers
         finally:
@@ -680,18 +773,19 @@ class Worker:
     # oversized record would wedge the ring (pop can never drain it)
     _FAST_ERR_MAX = 256 * 1024
 
-    def _fast_pack_result(self, tid: bytes, ok: bool, val, inline_max: int):
+    def _fast_pack_result(self, tid: bytes, ok: bool, val, inline_max: int,
+                          stamp: bytes = b""):
         from ray_tpu.core import fastpath
 
         if not ok:
             return fastpath.pack_reply(tid, fastpath.ERR,
-                                       self._fast_pack_error(val))
+                                       self._fast_pack_error(val), stamp)
         try:
             meta, buffers = serialization.dumps_with_buffers(val)
             size = serialization.total_size(meta, buffers)
             if size <= inline_max:
                 return fastpath.pack_reply(
-                    tid, fastpath.OK, _pack_bytes(meta, buffers, size))
+                    tid, fastpath.OK, _pack_bytes(meta, buffers, size), stamp)
             # big result: place it in the node's arena under the return oid
             # (same-node owner reads it directly; location registration is
             # the owner's migration step)
@@ -702,10 +796,10 @@ class Worker:
             # size rides in the record: the owner's location cache is
             # primed at completion time, no directory round-trip on get
             return fastpath.pack_reply(tid, fastpath.OK_SHM,
-                                       fastpath.pack_shm_size(size))
+                                       fastpath.pack_shm_size(size), stamp)
         except Exception as e:
             return fastpath.pack_reply(tid, fastpath.ERR,
-                                       self._fast_pack_error(e))
+                                       self._fast_pack_error(e), stamp)
 
     def _fast_pack_error(self, exc) -> bytes:
         payload = cloudpickle.dumps(_as_task_error(exc))
@@ -1506,6 +1600,11 @@ class Worker:
 
     async def rpc_exit_worker(self, conn, p):
         self._exit_requested = True
+        from ray_tpu.utils import recorder as _recorder
+
+        rec = _recorder.get_recorder() if self.cfg.recorder_enabled else None
+        if rec is not None:
+            rec.unlink()  # clean exit: no postmortem, don't leak the file
         if _profiler is not None:  # RT_WORKER_PROFILE_DIR diagnosis mode
             _profiler.disable()
             _profiler.dump_stats(os.path.join(

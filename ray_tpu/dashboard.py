@@ -17,6 +17,8 @@ hash-routed JS app from ``dashboard_client/``, no build step):
     GET /api/serve             serve applications/deployments status
     GET /api/metrics           aggregated cluster metrics
     GET /api/timeline          chrome-trace events (load into perfetto)
+    GET /api/latency           flight-recorder per-stage task latency
+    GET /api/worker_deaths     worker postmortems (recorder event dumps)
     GET /api/workers/{id}/stack  live stack dump (py-spy role)
     GET /api/workers/{id}/heap   tracemalloc heap profile
 """
@@ -62,8 +64,10 @@ async function refresh(){
   h += '</table><h2>metrics</h2><table><tr><th>metric</th><th>value</th></tr>';
   for (const [k,m] of Object.entries(metrics)){
     if (m.type !== 'histogram')
-      for (const [tag,v] of Object.entries(m.values))
-        h += `<tr><td>${esc(k)}${tag==='()'?'':' '+esc(tag)}</td><td>${esc(v)}</td></tr>`;
+      for (const s of (m.samples || [])){
+        const tag = Object.entries(s.tags || {}).map(([tk,tv])=>`${tk}=${tv}`).join(',');
+        h += `<tr><td>${esc(k)}${tag?' {'+esc(tag)+'}':''}</td><td>${esc(s.value)}</td></tr>`;
+      }
   }
   h += '</table>';
   document.getElementById('out').innerHTML = h;
@@ -113,6 +117,13 @@ def build_app():
 
     app.router.add_get("/metrics", prometheus)
     app.router.add_get("/api/timeline", _json(lambda: state.timeline()))
+    # flight-recorder surfaces: per-stage latency percentiles and worker
+    # postmortems (see utils/recorder.py, state.list_task_latency)
+    app.router.add_get(
+        "/api/latency", _json(lambda: _plain(state.list_task_latency())))
+    app.router.add_get(
+        "/api/worker_deaths",
+        _json(lambda: _plain(state.list_worker_deaths())))
     app.router.add_get(
         "/api/objects", _json(lambda: _plain(state.list_objects())))
     app.router.add_get(

@@ -84,6 +84,11 @@ function wireTable(container, t) {
 /* ---- metric history for sparklines ----------------------------------- */
 const history = {};  // name|tag -> [values]
 let latestMetrics = {};  // last /api/metrics payload (fetched once per render)
+// structured samples ({tags:{...}, value|counts/sum}) -> [label, sample]
+function metricSamples(m) {
+  return (m.samples || []).map((s) => [
+    Object.entries(s.tags || {}).map(([k, v]) => `${k}=${v}`).join(","), s]);
+}
 function pushHistory(name, tag, v) {
   const k = name + "|" + tag;
   (history[k] = history[k] || []).push(Number(v) || 0);
@@ -153,8 +158,8 @@ views.overview = async () => {
   if (rates.length) {
     h += `<h2>Metrics</h2>`;
     for (const [k, m] of rates)
-      for (const [tag, v] of Object.entries(m.values || {}))
-        h += `<div><span class="dim" style="display:inline-block;width:340px">${esc(k)}${tag === "()" ? "" : " " + esc(tag)}</span> ${esc(v)} ${spark(history[k + "|" + tag])}</div>`;
+      for (const [tag, s] of metricSamples(m))
+        h += `<div><span class="dim" style="display:inline-block;width:340px">${esc(k)}${tag ? " " + esc(tag) : ""}</span> ${esc(s.value)} ${spark(history[k + "|" + tag])}</div>`;
   }
   return h;
 };
@@ -312,13 +317,15 @@ views.metrics = async () => {
   for (const [name, m] of Object.entries(metrics)) {
     if (m.type === "histogram") {
       h += `<h2>${esc(name)} <span class="dim">(histogram)</span></h2>`;
-      for (const [tag, hist] of Object.entries(m.values || {})) {
-        h += `<div class="dim">${tag === "()" ? "" : esc(tag) + " "}count=${hist.count ?? ""} sum=${hist.sum ?? ""}</div>`;
+      for (const [tag, hist] of metricSamples(m)) {
+        const count = (hist.counts || []).reduce((a, b) => a + b, 0);
+        h += `<div class="dim">${tag ? esc(tag) + " " : ""}count=${count} sum=${hist.sum ?? ""}</div>`;
       }
       continue;
     }
-    for (const [tag, v] of Object.entries(m.values || {})) {
-      h += `<div><span class="dim" style="display:inline-block;width:360px">${esc(name)}${tag === "()" ? "" : " " + esc(tag)}</span>
+    for (const [tag, s] of metricSamples(m)) {
+      const v = s.value;
+      h += `<div><span class="dim" style="display:inline-block;width:360px">${esc(name)}${tag ? " " + esc(tag) : ""}</span>
         <span style="display:inline-block;width:120px">${esc(typeof v === "number" ? +v.toFixed(3) : v)}</span>
         ${spark(history[name + "|" + tag])}</div>`;
     }
@@ -403,7 +410,7 @@ async function render() {
       latestMetrics = await fetchJSON("/api/metrics");
       for (const [k, m] of Object.entries(latestMetrics))
         if (m.type !== "histogram")
-          for (const [tag, v] of Object.entries(m.values || {})) pushHistory(k, tag, v);
+          for (const [tag, s] of metricSamples(m)) pushHistory(k, tag, s.value);
     } catch (e) { /* metrics optional */ }
     const out = await views[name]();
     const html = typeof out === "string" ? out : out.html;

@@ -154,6 +154,10 @@ class Context:
     # sync defs and lambdas: their bodies run on whatever thread calls
     # them, not necessarily the event loop) — RT010's blocking-call scope
     in_async: bool = False
+    # nesting depth of enclosing function/lambda BODIES; unlike
+    # loop_depth this survives into nested defs — RT011 fires on any
+    # construction that re-runs per call rather than once at import
+    func_depth: int = 0
 
     # -- reporting ----------------------------------------------------------
     def report(self, rule: Rule, node: ast.AST, message: str):
@@ -299,8 +303,10 @@ class Walker:
         ctx.for_targets = []  # a nested def body doesn't run per-iteration
         ctx.loop_depth = 0
         ctx.in_async = isinstance(node, ast.AsyncFunctionDef)
+        ctx.func_depth += 1
         for stmt in node.body:
             self.walk(stmt)
+        ctx.func_depth -= 1
         ctx.for_targets = saved_targets
         ctx.loop_depth = saved_depth
         ctx.in_async = saved_async
@@ -321,7 +327,9 @@ class Walker:
         ctx.for_targets = []
         ctx.loop_depth = 0
         ctx.in_async = False  # deferred body: caller's thread, not the loop
+        ctx.func_depth += 1
         self.walk(node.body)
+        ctx.func_depth -= 1
         ctx.for_targets = saved_targets
         ctx.loop_depth = saved_depth
         ctx.in_async = saved_async
@@ -497,7 +505,7 @@ def _instantiate(select: Iterable[str] | None = None,
 def lint_source(source: str, path: str = "<string>", *,
                 select=None, ignore=None) -> list[Finding]:
     """Lint one source string; returns unsuppressed findings, sorted."""
-    import ray_tpu.devtools.lint.rules  # noqa: F401  (registers RT001-RT008)
+    import ray_tpu.devtools.lint.rules  # noqa: F401  (registers RT001-RT011)
 
     try:
         tree = ast.parse(source, filename=path)

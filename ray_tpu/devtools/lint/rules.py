@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT010: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT011: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -294,3 +294,32 @@ class BlockingGetInAsync(Rule):
                        "blocking ray_tpu.get() inside an async def stalls "
                        "the event loop; await the ObjectRef(s) directly "
                        "(or asyncio.gather them) instead")
+
+
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+
+
+@register
+class MetricConstructedPerCall(Rule):
+    id = "RT011"
+    summary = "Counter/Gauge/Histogram constructed inside a function or loop body"
+    rationale = ("every metric construction registers in the process-wide "
+                 "registry under its name: per-call construction churns "
+                 "the registry (the old object with its accumulated "
+                 "values is silently replaced and its history lost) and "
+                 "leaks a dict entry per unique name; metrics are "
+                 "module-level singletons by design")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if not ctx.func_depth and not ctx.loop_depth:
+            return
+        origin = ctx.imports.resolve(node.func)
+        if (origin and origin[0] == "ray_tpu"
+                and origin[-1] in _METRIC_CTORS
+                and "metrics" in origin[:-1]):
+            where = "loop" if ctx.loop_depth else "function"
+            ctx.report(self, node,
+                       f"{origin[-1]}(...) constructed in a {where} body "
+                       "re-registers in the global metrics registry every "
+                       "call (accumulated values silently reset); hoist "
+                       "the metric to module level")

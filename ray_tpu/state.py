@@ -250,31 +250,65 @@ def summary_tasks() -> dict:
 
 
 # ------------------------------------------------------------------- metrics
+def _snapshot_samples(m: dict) -> list[dict]:
+    """A metric's samples as [{tags, ...}], accepting both the structured
+    1.7 snapshot format ("samples") and the pre-1.7 one ("values" keyed
+    by str(tuple(sorted(tags.items()))) — readable during rollover so a
+    mixed-version cluster still aggregates)."""
+    if "samples" in m:
+        return m["samples"]
+    import ast
+
+    out = []
+    for tag_key, v in m.get("values", {}).items():
+        try:
+            tags = dict(ast.literal_eval(tag_key) or ())
+        except (ValueError, SyntaxError):
+            tags = {}
+        if isinstance(v, dict):  # old-format histogram cell
+            out.append({"tags": tags, **v})
+        else:
+            out.append({"tags": tags, "value": v})
+    return out
+
+
 def cluster_metrics() -> dict[str, Any]:
-    """Aggregate the per-process metric snapshots pushed to the GCS KV."""
+    """Aggregate the per-process metric snapshots pushed to the GCS KV.
+
+    Returns ``{name: {"type": ..., ["boundaries": ...,] "samples":
+    [{"tags": {...}, "value": v} | {"tags": {...}, "counts": [...],
+    "sum": s}]}}`` — tags stay structured end to end."""
     keys = _call("kv_keys", {"ns": "metrics", "prefix": ""})
     blobs = _call("kv_multi_get", {"ns": "metrics", "keys": keys})
     agg: dict[str, Any] = {}
+    merged: dict[str, dict] = {}  # name -> tag-tuple -> cell
     for k in keys:
         blob = blobs.get(k)
         if not blob:
             continue
         snap = pickle.loads(blob)
         for name, m in snap.get("metrics", {}).items():
-            slot = agg.setdefault(name, {"type": m["type"], "values": {}})
+            slot = agg.setdefault(name, {"type": m["type"]})
             if "boundaries" in m:
                 slot.setdefault("boundaries", m["boundaries"])
-            for tag_key, v in m.get("values", {}).items():
+            cells = merged.setdefault(name, {})
+            for s in _snapshot_samples(m):
+                tkey = tuple(sorted(s.get("tags", {}).items()))
                 if m["type"] == "counter":
-                    slot["values"][tag_key] = slot["values"].get(tag_key, 0.0) + v
+                    cell = cells.setdefault(tkey, {"value": 0.0})
+                    cell["value"] += s.get("value", 0.0)
                 elif m["type"] == "gauge":
-                    slot["values"][tag_key] = v  # last writer wins
+                    cells[tkey] = {"value": s.get("value", 0.0)}
                 else:  # histogram: merge counts and sums
-                    cur = slot["values"].setdefault(
-                        tag_key, {"counts": [0] * len(v["counts"]), "sum": 0.0}
-                    )
-                    cur["counts"] = [a + b for a, b in zip(cur["counts"], v["counts"])]
-                    cur["sum"] += v["sum"]
+                    counts = s.get("counts", [])
+                    cell = cells.setdefault(
+                        tkey, {"counts": [0] * len(counts), "sum": 0.0})
+                    cell["counts"] = [a + b for a, b in
+                                      zip(cell["counts"], counts)]
+                    cell["sum"] += s.get("sum", 0.0)
+    for name, cells in merged.items():
+        agg[name]["samples"] = [{"tags": dict(tkey), **cell}
+                                for tkey, cell in cells.items()]
     return agg
 
 
@@ -282,8 +316,8 @@ def prometheus_metrics() -> str:
     """Render the aggregated cluster metrics in the Prometheus text
     exposition format (ref: dashboard/modules/metrics — there a sidecar
     agent exposes OpenCensus metrics to a Prometheus scraper; here the
-    dashboard's /metrics endpoint serves the same role directly)."""
-    import ast
+    dashboard's /metrics endpoint serves the same role directly).
+    Labels come straight from the structured sample tags."""
 
     def esc(v) -> str:
         # exposition-format escaping: one bad label value must not make
@@ -291,15 +325,11 @@ def prometheus_metrics() -> str:
         return (str(v).replace("\\", "\\\\").replace('"', '\\"')
                 .replace("\n", "\\n"))
 
-    def labels(tag_key: str) -> str:
-        try:
-            pairs = ast.literal_eval(tag_key)
-        except (ValueError, SyntaxError):
-            return ""
-        if not pairs:
-            return ""
-        inner = ",".join(f'{k}="{esc(v)}"' for k, v in pairs)
-        return "{" + inner + "}"
+    def labels(tags: dict, extra: str = "") -> str:
+        inner = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(tags.items()))
+        if extra:
+            inner = f"{inner},{extra}" if inner else extra
+        return "{" + inner + "}" if inner else ""
 
     lines: list[str] = []
     for name, m in sorted(cluster_metrics().items()):
@@ -309,23 +339,76 @@ def prometheus_metrics() -> str:
         kind = m["type"]
         lines.append(f"# TYPE {pname} {kind}")
         if kind in ("counter", "gauge"):
-            for tag_key, v in m["values"].items():
-                lines.append(f"{pname}{labels(tag_key)} {v}")
+            for s in m.get("samples", []):
+                lines.append(f"{pname}{labels(s['tags'])} {s['value']}")
             continue
         bounds = list(m.get("boundaries") or [])
-        for tag_key, v in m["values"].items():
-            lab = labels(tag_key)
-            base = lab[1:-1] if lab else ""
+        for s in m.get("samples", []):
             cum = 0
-            for i, count in enumerate(v["counts"]):
+            for i, count in enumerate(s.get("counts", [])):
                 cum += count
                 le = bounds[i] if i < len(bounds) else "+Inf"
-                parts = ([base] if base else []) + [f'le="{le}"']
+                extra = 'le="%s"' % le
                 lines.append(
-                    f"{pname}_bucket{{{','.join(parts)}}} {cum}")
-            lines.append(f"{pname}_sum{lab} {v['sum']}")
-            lines.append(f"{pname}_count{lab} {cum}")
+                    f"{pname}_bucket{labels(s['tags'], extra)} {cum}")
+            lines.append(f"{pname}_sum{labels(s['tags'])} {s['sum']}")
+            lines.append(f"{pname}_count{labels(s['tags'])} {cum}")
     return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------- flight recorder
+def list_task_latency() -> dict[str, dict]:
+    """Per-stage fast-lane latency percentiles from the flight-recorder
+    windows every process publishes on the task-event flush timer
+    (utils/recorder.py). Stages: ring_sub (submit pack -> worker pop,
+    the submit-ring hop), deserialize, exec (worker-side user function),
+    ring_reply (exec end -> driver apply, the completion-ring hop) and
+    total. Returns ``{stage: {count, p50_us, p99_us, mean_us, max_us}}``
+    plus a ``"tasks_total"`` lifetime counter; empty dict when no
+    fast-lane task has completed (recorder off / RPC-only workload)."""
+    from ray_tpu.utils import recorder as _rec
+
+    keys = _call("kv_keys", {"ns": "latency", "prefix": ""})
+    blobs = _call("kv_multi_get", {"ns": "latency", "keys": keys})
+    stages: dict[str, list] = {}
+    total_count = 0
+    for k in keys:
+        blob = blobs.get(k)
+        if not blob:
+            continue
+        snap = pickle.loads(blob)
+        total_count += snap.get("count", 0)
+        for name, vals in snap.get("stages", {}).items():
+            stages.setdefault(name, []).extend(vals)
+    out: dict[str, dict] = {}
+    for name, vals in stages.items():
+        vals.sort()
+        out[name] = {
+            "count": len(vals),
+            "p50_us": _rec.percentile(vals, 0.5) / 1e3,
+            "p99_us": _rec.percentile(vals, 0.99) / 1e3,
+            "mean_us": (sum(vals) / len(vals)) / 1e3 if vals else 0.0,
+            "max_us": vals[-1] / 1e3 if vals else 0.0,
+        }
+    if out:
+        out["tasks_total"] = total_count
+    return out
+
+
+def list_worker_deaths(limit: int = 100) -> list[dict]:
+    """Postmortem reports the raylet writes when a worker process dies:
+    pid, exit code/signal, lease/actor context, and the victim's last-N
+    flight-recorder events (read from its shm recorder ring AFTER death
+    — survives SIGKILL)."""
+    keys = _call("kv_keys", {"ns": "worker_deaths", "prefix": ""})[:limit]
+    blobs = _call("kv_multi_get", {"ns": "worker_deaths", "keys": keys})
+    out = []
+    for k in keys:
+        blob = blobs.get(k)
+        if blob:
+            out.append(pickle.loads(blob))
+    out.sort(key=lambda r: r.get("ts", 0), reverse=True)
+    return out
 
 
 # ------------------------------------------------------------------ timeline
@@ -377,7 +460,47 @@ def timeline(filename: str | None = None) -> list[dict]:
             "pid": (s.get("node_id") or "node")[:8], "tid": s.get("pid"),
             "args": {"task_id": tid, "state": "RUNNING"},
         })
+    trace.extend(_fastlane_timeline())
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def _fastlane_timeline() -> list[dict]:
+    """Fast-lane stage slices from the flight-recorder latency samples:
+    tasks that ride the shm rings never touch the RPC task-event RUNNING/
+    FINISHED pair, so without these the timeline shows nothing between
+    .remote() and reply-apply. Each published sample (wall-anchored)
+    expands into one slice per stage on a per-owner 'fastlane' row."""
+    try:
+        keys = _call("kv_keys", {"ns": "latency", "prefix": ""})
+        blobs = _call("kv_multi_get", {"ns": "latency", "keys": keys})
+    except Exception:
+        return []
+    out: list[dict] = []
+    for k in keys:
+        blob = blobs.get(k)
+        if not blob:
+            continue
+        try:
+            snap = pickle.loads(blob)
+        except Exception:
+            continue
+        row = f"fastlane-{snap.get('worker_id', k)[:8]}"
+        for tid, wall_apply, ring, deser, exec_ns, reply in \
+                snap.get("samples", []):
+            t0 = wall_apply - reply - exec_ns - deser - ring
+            for stage, start, dur in (
+                    ("ring_sub", t0, ring),
+                    ("deserialize", t0 + ring, deser),
+                    ("exec", t0 + ring + deser, exec_ns),
+                    ("ring_reply", t0 + ring + deser + exec_ns, reply)):
+                out.append({
+                    "name": stage, "cat": "fastlane", "ph": "X",
+                    "ts": start / 1e3,  # ns -> µs (chrome-trace unit)
+                    "dur": max(dur, 1) / 1e3,
+                    "pid": row, "tid": 0,
+                    "args": {"task_id": tid},
+                })
+    return out

@@ -7,6 +7,14 @@ component code records locally (lock-free dict bumps on the hot path) and
 the core client piggybacks periodic snapshots to the GCS KV
 (ns="metrics", key=worker hex) on the task-event flush timer, where the
 state API aggregates them cluster-wide.
+
+Snapshot format: each metric exports structured ``samples`` —
+``{"tags": {...}, "value": v}`` (counters/gauges) or
+``{"tags": {...}, "counts": [...], "sum": s}`` (histograms) — so
+``state.prometheus_metrics()`` can emit real labels without reparsing
+stringified tag tuples. ``state.cluster_metrics`` still reads the
+pre-1.7 ``values`` format (keys were ``str(tuple(sorted(tags)))``)
+during rollover.
 """
 from __future__ import annotations
 
@@ -38,7 +46,9 @@ class Counter(Metric):
         self._values[k] = self._values.get(k, 0.0) + value
 
     def snapshot(self):
-        return {"type": "counter", "values": {str(k): v for k, v in self._values.items()}}
+        return {"type": "counter",
+                "samples": [{"tags": dict(k), "value": v}
+                            for k, v in self._values.items()]}
 
 
 class Gauge(Metric):
@@ -50,7 +60,9 @@ class Gauge(Metric):
         self._values[self._key(tags)] = value
 
     def snapshot(self):
-        return {"type": "gauge", "values": {str(k): v for k, v in self._values.items()}}
+        return {"type": "gauge",
+                "samples": [{"tags": dict(k), "value": v}
+                            for k, v in self._values.items()]}
 
 
 class Histogram(Metric):
@@ -73,14 +85,28 @@ class Histogram(Metric):
         counts[i] += 1
         self._sums[k] = self._sums.get(k, 0.0) + value
 
+    def observe_many(self, values, tags: dict | None = None):
+        """Bulk feed (flush-time batches, e.g. the flight recorder's
+        sampled stage latencies): one key lookup + bisect per value
+        instead of a linear boundary scan per observe."""
+        from bisect import bisect_left
+
+        k = self._key(tags)
+        counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+        b = self.boundaries
+        total = 0.0
+        for v in values:
+            counts[bisect_left(b, v)] += 1
+            total += v
+        self._sums[k] = self._sums.get(k, 0.0) + total
+
     def snapshot(self):
         return {
             "type": "histogram",
             "boundaries": list(self.boundaries),
-            "values": {
-                str(k): {"counts": c, "sum": self._sums.get(k, 0.0)}
-                for k, c in self._counts.items()
-            },
+            "samples": [{"tags": dict(k), "counts": list(c),
+                         "sum": self._sums.get(k, 0.0)}
+                        for k, c in self._counts.items()],
         }
 
 
@@ -121,3 +147,29 @@ object_bytes_put = Counter("rt_object_bytes_put", "bytes written via put")
 objects_spilled = Counter("rt_objects_spilled", "objects spilled to disk")
 objects_restored = Counter("rt_objects_restored", "spilled objects restored")
 task_exec_seconds = Histogram("rt_task_exec_seconds", "worker-side task execution time")
+
+# --- flight-recorder families (PR 4; see utils/recorder.py) -----------------
+# Per-stage fast-lane latency. Fed at flush time from the recorder's
+# retained sample window (bounded batch per flush — Dapper-style
+# sampling under load), NOT per task: the hot path pays one ring store.
+task_stage_seconds = Histogram(
+    "rt_task_stage_seconds",
+    "fast-lane per-stage task latency (sampled by the flight recorder)",
+    boundaries=(1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0),
+    tag_keys=("stage",))
+task_stage_us = Gauge(
+    "rt_task_stage_us",
+    "fast-lane per-stage latency percentiles over the recorder window (µs)",
+    tag_keys=("stage", "q"))
+recorder_samples = Gauge(
+    "rt_recorder_samples", "per-task latency samples recorded (lifetime)")
+# Native shm transport counters (ring.cc RingStats / store.cc StoreStats),
+# summed over live lanes and set at flush time.
+fastpath_ring = Gauge(
+    "rt_fastpath_ring",
+    "shm task-ring counters summed over live lanes (ring.cc RingStats)",
+    tag_keys=("which", "stat"))
+object_store_stat = Gauge(
+    "rt_object_store",
+    "shm arena counters (store.cc StoreStats)",
+    tag_keys=("stat",))

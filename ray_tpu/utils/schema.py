@@ -17,7 +17,13 @@ from __future__ import annotations
 
 # (major, minor): bump MAJOR for incompatible changes (renamed/removed
 # methods, changed field meaning), MINOR for additions.
-PROTOCOL_VERSION = (1, 6)
+#
+# 1.7: flight-recorder telemetry on the fastpath shm records (not RPC
+# methods, but versioned here because both sides must agree): task
+# records may carry an 8-byte submit stamp (prefixes "Q"/"R" beside the
+# unstamped "P"/"S"), and reply records may carry a 16-byte stage stamp
+# (status flag 0x100) — see core/fastpath.py pack_task/pack_reply.
+PROTOCOL_VERSION = (1, 7)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.

@@ -31,6 +31,18 @@ from ray_tpu.config import get_config
 _ctx: contextvars.ContextVar[tuple[str, str] | None] = contextvars.ContextVar(
     "rt_trace_ctx", default=None)
 
+# Span clock: durations come from perf_counter_ns (monotonic, ns
+# resolution — time.time() collapses sub-ms spans to zero on coarse
+# clocks and a wall-clock step mid-span would yield a NEGATIVE
+# duration); one wall anchor captured at import reconstructs absolute
+# start/end times for the timeline.
+_ANCHOR_PERF_NS = time.perf_counter_ns()
+_ANCHOR_WALL_NS = time.time_ns()
+
+
+def _wall_s(t_perf_ns: int) -> float:
+    return (_ANCHOR_WALL_NS + (t_perf_ns - _ANCHOR_PERF_NS)) / 1e9
+
 try:  # probe ONCE: a failed import per span would be a hot-path tax
     from opentelemetry import trace as _otel_trace
 except Exception:  # pragma: no cover - otel genuinely optional
@@ -81,7 +93,8 @@ class span:
         self._otel = None
 
     def __enter__(self):
-        self.start = time.time()
+        self._t0_ns = time.perf_counter_ns()
+        self.start = _wall_s(self._t0_ns)
         self._token = _ctx.set((self.trace_id, self.span_id))
         if _otel_trace is not None:
             try:  # optional mirror onto a configured OTel SDK
@@ -93,7 +106,9 @@ class span:
 
     def __exit__(self, exc_type, exc, tb):
         _ctx.reset(self._token)
-        end = time.time()
+        # same monotonic clock as __enter__: end >= start ALWAYS, and a
+        # 2µs span reports 2µs instead of 0.0
+        end = self.start + (time.perf_counter_ns() - self._t0_ns) / 1e9
         if self._otel is not None:
             try:
                 self._otel.end()

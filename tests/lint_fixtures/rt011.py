@@ -1,0 +1,52 @@
+"""RT011 fixture: metric objects constructed per call instead of once."""
+import ray_tpu.util.metrics
+from ray_tpu.util.metrics import Counter, Histogram
+from ray_tpu.utils import metrics as runtime_metrics
+
+# module level is the designed shape: construct once at import
+REQUESTS = Counter("app_requests", tag_keys=("route",))
+LATENCY = Histogram("app_latency_s", boundaries=(0.01, 0.1, 1.0))
+QUEUE_DEPTH = runtime_metrics.Gauge("app_queue_depth")
+
+
+def handler(route):
+    c = Counter("per_call_requests", tag_keys=("route",))  # expect: RT011
+    c.inc(tags={"route": route})
+
+
+def serve_loop(routes):
+    for r in routes:
+        g = ray_tpu.util.metrics.Gauge("g_" + r)  # expect: RT011
+        g.set(1.0)
+
+
+def qualified_form():
+    return ray_tpu.util.metrics.Histogram("h")  # expect: RT011
+
+
+def runtime_registry_form():
+    return runtime_metrics.Counter("c")  # expect: RT011
+
+
+class Telemetry:
+    # class body runs once at import: construction here is fine
+    calls = Counter("telemetry_calls")
+
+    def bump(self):
+        self.calls.inc()
+        hot = Counter("telemetry_hot")  # expect: RT011
+        hot.inc()
+
+
+hoisted_per_route = [Counter("route_" + r) for r in ("a", "b")]  # expect: RT011
+
+
+def observing_is_clean():
+    REQUESTS.inc(tags={"route": "/infer"})
+    LATENCY.observe(0.02)
+
+
+def unrelated_counter_is_clean():
+    from collections import Counter as StdCounter
+
+    return StdCounter("abracadabra")

@@ -1,0 +1,273 @@
+"""Flight-recorder tests: stage ordering/correlation through the shm fast
+lane, ring wrap, SIGKILL postmortem, and the latency/metrics surfaces
+(ref test strategy: test_task_events.py + test_metrics_agent.py, with the
+recorder playing the always-on task-event role for ring traffic)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.utils import recorder
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=16)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=25, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    raise AssertionError(f"timed out: {msg}")
+
+
+@ray_tpu.remote
+def _echo(x):
+    return x
+
+
+def _driver_samples() -> int:
+    st = recorder.get_stats()
+    return st.n if st is not None else 0
+
+
+def _pump_fast_lane(rt, n=10):
+    """Lone submit-then-get round trips ride the ring once a lane exists;
+    returns once the driver recorder has accumulated samples."""
+    def go():
+        for i in range(n):
+            assert rt.get(_echo.remote(i)) == i
+        return _driver_samples()
+
+    return _wait_for(go, msg="no fast-lane latency samples accumulated")
+
+
+# ------------------------------------------------------- recorder mechanics
+def test_ring_wrap_drop_oldest(tmp_path):
+    r = recorder.Recorder(64, str(tmp_path / "wrap.rec"))
+    for i in range(500):
+        r.record(i.to_bytes(16, "little"), recorder.SUBMIT, a0=i)
+    evs = r.raw_events()
+    assert len(evs) == 64  # fixed-size: drop-oldest, never grows
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs) and seqs[-1] == 500
+    assert evs[-1]["args"][0] == 499  # newest retained
+    r.unlink()
+
+
+def test_recorder_wall_anchor_monotonic(tmp_path):
+    r = recorder.Recorder(64, str(tmp_path / "anchor.rec"))
+    r.record(b"a" * 16, recorder.SUBMIT)
+    time.sleep(0.01)
+    r.record(b"b" * 16, recorder.SUBMIT)
+    e1, e2 = r.raw_events()
+    assert e2["wall_ns"] > e1["wall_ns"]
+    # anchored wall time tracks real wall clock to within a second
+    assert abs(e2["wall_ns"] / 1e9 - time.time()) < 1.0
+    r.unlink()
+
+
+def test_postmortem_read_survives_writer(tmp_path):
+    path = str(tmp_path / "victim.rec")
+    r = recorder.Recorder(128, path)
+    r.record_wtask(b"t" * 16, time.perf_counter_ns(), 10, 20, 30)
+    # reader sees the expanded stage events without the writer's help
+    evs = recorder.read_events(path)
+    assert [e["stage"] for e in evs] == [
+        "worker_pop", "deserialize", "exec_start", "exec_end"]
+    assert all(e["task_id"] == ("74" * 16) for e in evs)
+    r.unlink()
+    assert recorder.read_events(path) == []  # unlinked: no report, no crash
+
+
+# ------------------------------------------------- stage ordering / lanes
+def test_sync_task_stage_ordering(rt):
+    _pump_fast_lane(rt, n=32)  # SAMPLE slots are taken every 4th task
+    st = recorder.get_stats()
+    win = st.window()
+    assert win, "driver accumulated no stage samples"
+    for ring_sub, deser, exec_ns, reply, total in win[-5:]:
+        # stage durations are non-negative and sum to the total
+        assert min(ring_sub, deser, exec_ns, reply) >= 0
+        assert ring_sub + deser + exec_ns + reply == total
+        assert total < 60e9  # sanity: a sub-second echo, not garbage
+    # the driver recorder's expanded SAMPLE events (written on the flush
+    # timer from the raw stats ring) are ordered per task
+    def count_ordered():
+        evs = recorder.get_recorder().events(last=256)
+        by_task = {}
+        for e in evs:
+            if e["stage"] in ("submit", "worker_pop", "exec_start",
+                              "exec_end", "driver_apply"):
+                by_task.setdefault(e["task_id"], []).append(e)
+        ordered = 0
+        for stages in by_task.values():
+            names = [e["stage"] for e in stages]
+            if names == ["submit", "worker_pop", "exec_start", "exec_end",
+                         "driver_apply"]:
+                ts = [e["t_ns"] for e in stages]
+                assert ts == sorted(ts)
+                ordered += 1
+        return ordered
+
+    assert _wait_for(lambda: count_ordered() >= 3,
+                     msg="no fully-ordered task expansions")
+
+
+def test_async_batch_stages(rt):
+    before = _driver_samples()
+
+    def burst():
+        refs = [_echo.remote(i) for i in range(200)]
+        assert rt.get(refs) == list(range(200))
+        return _driver_samples() > before
+
+    _wait_for(burst, msg="async burst produced no samples")
+    lat = _wait_for(lambda: state.list_task_latency() or None,
+                    msg="latency KV never published")
+    for stage in ("ring_sub", "deserialize", "exec", "ring_reply", "total"):
+        assert stage in lat, lat.keys()
+        assert lat[stage]["count"] > 0
+        assert lat[stage]["p99_us"] >= lat[stage]["p50_us"] >= 0.0
+    assert lat["tasks_total"] >= 1
+
+
+def test_actor_call_stages(rt):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = 0
+
+        def bump(self, d):
+            self.v += d
+            return self.v
+
+    h = Holder.remote()
+    assert rt.get(h.bump.remote(1)) == 1
+    before = _driver_samples()
+
+    def actor_burst():
+        for i in range(10):
+            rt.get(h.bump.remote(1))
+        return _driver_samples() > before
+
+    _wait_for(actor_burst, msg="actor fast lane produced no samples")
+    # correlation: worker-side W_TASK events for actor calls carry the
+    # same task ids the driver sampled (check via ordered driver events)
+    st = recorder.get_stats()
+    ring_sub, deser, exec_ns, reply, total = st.window()[-1]
+    assert ring_sub + deser + exec_ns + reply == total
+
+
+# ------------------------------------------------------------- postmortem
+def test_sigkill_death_report(rt):
+    @ray_tpu.remote
+    def whoami():
+        return os.getpid()
+
+    pid = rt.get(whoami.remote())
+    for _ in range(24):  # give the victim's recorder events to dump
+        rt.get(whoami.remote())  # (W_TASK slots are 1-in-16 sampled)
+    os.kill(pid, signal.SIGKILL)
+    reports = _wait_for(
+        lambda: [r for r in state.list_worker_deaths()
+                 if r.get("pid") == pid] or None,
+        msg="no death report for SIGKILLed worker")
+    r = reports[0]
+    assert r["signal"] == signal.SIGKILL
+    assert r["returncode"] == -signal.SIGKILL
+    evs = r["recorder_events"]
+    assert evs, "death report carries no recorder events"
+    stages = {e["stage"] for e in evs}
+    # the victim executed ring tasks: its last-N events show the
+    # worker-side pipeline
+    assert {"worker_pop", "exec_start", "exec_end"} <= stages
+    # postmortem events are wall-anchored near the time of death
+    assert abs(evs[-1]["wall_ns"] / 1e9 - time.time()) < 60
+    # cluster keeps working after the death (lease recovered)
+    assert rt.get(_echo.remote(41)) == 41
+
+
+# ------------------------------------------------------------- surfaces
+def test_prometheus_metrics_and_native_gauges(rt):
+    _pump_fast_lane(rt)
+
+    def surfaced():
+        pm = state.prometheus_metrics()
+        return pm if ("rt_fastpath_ring" in pm
+                      and "rt_task_stage_seconds_bucket" in pm
+                      and "rt_object_store" in pm) else None
+
+    pm = _wait_for(surfaced, msg="native gauges / stage histograms absent")
+    # structured labels render as real prometheus label pairs
+    assert 'stage="exec"' in pm
+    assert 'which="sub"' in pm and 'stat="push_records"' in pm
+    # counts are cumulative per bucket and finite
+    assert 'le="+Inf"' in pm
+    # native stats also visible zero-copy via the core API
+    from ray_tpu.core import api
+
+    ns = api.get_core().native_stats()
+    assert ns["store"] is not None and ns["store"]["creates"] >= 0
+    total_push = sum(d.get("push_records", 0) for d in ns["ring"].values())
+    assert total_push >= 1
+
+
+def test_dashboard_metrics_endpoint(rt):
+    aiohttp = pytest.importorskip("aiohttp")  # noqa: F841
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    _pump_fast_lane(rt)
+    from ray_tpu.core import api
+
+    core = api.get_core()
+    runner, (host, port) = core._run_sync(dashboard.start_dashboard_async())
+    try:
+        def scrape():
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10) as resp:
+                body = resp.read().decode()
+            return body if "rt_task_stage_seconds" in body else None
+
+        body = _wait_for(scrape, msg="/metrics missing stage histograms")
+        assert "# TYPE rt_task_stage_seconds histogram" in body
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/api/latency", timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        core._run_sync(runner.cleanup())
+
+
+def test_timeline_carries_fastlane_stages(rt):
+    _pump_fast_lane(rt)
+
+    def has_fastlane():
+        rows = [e for e in state.timeline() if e.get("cat") == "fastlane"]
+        return rows or None
+
+    rows = _wait_for(has_fastlane, msg="timeline has no fastlane slices")
+    names = {r["name"] for r in rows}
+    assert {"ring_sub", "exec", "ring_reply"} <= names
+    assert all(r["ph"] == "X" and r["dur"] > 0 for r in rows)
+
+
+def test_recorder_disable_switch(tmp_path):
+    # the off switch: no recorder, no stats, zero hot-path work
+    recorder.set_enabled(False)
+    try:
+        assert recorder.get_recorder() is None
+        assert recorder.get_stats() is None
+    finally:
+        recorder.set_enabled(True)

@@ -118,13 +118,24 @@ def test_cluster_metrics_aggregation(rt):
 
     def have_metrics():
         m = state.cluster_metrics()
-        sub = m.get("rt_tasks_submitted", {}).get("values", {}).get("()", 0)
-        puts = m.get("rt_objects_put", {}).get("values", {}).get("()", 0)
-        execs = m.get("rt_task_exec_seconds", {}).get("values", {})
+
+        def untagged(name):
+            for s in m.get(name, {}).get("samples", []):
+                if not s.get("tags"):
+                    return s.get("value", 0)
+            return 0
+
+        sub = untagged("rt_tasks_submitted")
+        puts = untagged("rt_objects_put")
+        execs = m.get("rt_task_exec_seconds", {}).get("samples", [])
         return sub >= 3 and puts >= 1 and execs and m
 
     m = _wait_for(have_metrics, msg="metrics never aggregated")
     assert m["rt_task_exec_seconds"]["type"] == "histogram"
+    # structured tags survive aggregation (the prometheus renderer reads
+    # them directly — no stringified-tuple reparse)
+    fin = m.get("rt_tasks_finished", {}).get("samples", [])
+    assert any(s["tags"].get("outcome") == "ok" for s in fin)
 
 
 def test_summary_tasks(rt):
