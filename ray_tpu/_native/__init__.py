@@ -178,6 +178,12 @@ def get_lib() -> ctypes.CDLL:
             lib.rt_ring_closed.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.rt_ring_pair_close.argtypes = [ctypes.c_void_p]
             lib.rt_ring_pair_destroy.argtypes = [ctypes.c_char_p]
+            # chaos fault arms (devtools/chaos): runtime re-arm of the
+            # env-gated counters in ring.cc / store.cc
+            lib.rt_ring_chaos_set.restype = None
+            lib.rt_ring_chaos_set.argtypes = [u64, u64]
+            lib.rt_store_chaos_set.restype = None
+            lib.rt_store_chaos_set.argtypes = [u64]
             # GCS state engine (gcs_core.cc)
             cp = ctypes.c_char_p
             lib.rt_gcs_open.restype = ctypes.c_void_p

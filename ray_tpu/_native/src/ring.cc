@@ -18,6 +18,7 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 
@@ -87,6 +88,35 @@ struct RingHandle {
 };
 
 uint64_t align_up(uint64_t n, uint64_t a) { return (n + a - 1) & ~(a - 1); }
+
+// ---------------------------------------------------------------------------
+// Chaos fault arms (devtools/chaos): env-gated counters that force the rare
+// ring conditions — partial batch pushes and wait timeouts — on a fixed
+// cadence, so the Python recovery paths (flush retry from the consumed
+// prefix, RPC spill, lane break) are exercised below the Python layer.
+// Disarmed (the default) the cost is one relaxed load of a zero. Armed via
+// RT_CHAOS_RING_*_EVERY at dlopen (spawned workers inherit the env) or
+// rt_ring_chaos_set at runtime. Counters are atomics: the arms must not
+// introduce a data race the TSAN matrix would (rightly) flag.
+uint64_t env_every(const char* name) {
+  const char* raw = getenv(name);
+  if (!raw) return 0;
+  char* end = nullptr;
+  unsigned long long v = strtoull(raw, &end, 10);
+  return (end && *end == '\0') ? (uint64_t)v : 0;
+}
+
+uint64_t g_chaos_partial_every = env_every("RT_CHAOS_RING_PARTIAL_EVERY");
+uint64_t g_chaos_timeout_every = env_every("RT_CHAOS_RING_TIMEOUT_EVERY");
+uint64_t g_chaos_partial_ctr = 0;
+uint64_t g_chaos_timeout_ctr = 0;
+
+// true on every Nth call while armed
+bool chaos_strike(uint64_t* every_p, uint64_t* ctr) {
+  uint64_t every = __atomic_load_n(every_p, __ATOMIC_RELAXED);
+  if (every == 0) return false;
+  return __atomic_add_fetch(ctr, 1, __ATOMIC_RELAXED) % every == 0;
+}
 
 int lock(pthread_mutex_t* mu) {
   int rc = pthread_mutex_lock(mu);
@@ -279,6 +309,8 @@ int rt_ring_push(void* hp, int which, const uint8_t* buf, uint64_t len,
                  int64_t timeout_ms) {
   auto* h = (RingHandle*)hp;
   Ring* r = ring_of(h, which);
+  if (chaos_strike(&g_chaos_timeout_every, &g_chaos_timeout_ctr))
+    return kTimeout;  // forced "ring stayed full": caller retries/spills
   uint64_t need = align_up(4 + len, 8);
   if (need > r->capacity) return kTooBig;
   uint8_t* data = h->base + r->data_off;
@@ -381,6 +413,8 @@ int64_t rt_ring_push_batch(void* hp, int which, const uint8_t* buf,
     }
   }
   uint64_t avail = r->capacity - (r->head - r->tail);
+  if (chaos_strike(&g_chaos_partial_every, &g_chaos_partial_ctr))
+    avail = first;  // forced partial: only the head record fits this call
   uint64_t take = 0;
   uint64_t nrecs = 0;
   while (take + 4 <= len) {
@@ -406,6 +440,8 @@ int64_t rt_ring_pop_batch(void* hp, int which, uint8_t* out, uint64_t outcap,
                           int64_t timeout_ms) {
   auto* h = (RingHandle*)hp;
   Ring* r = ring_of(h, which);
+  if (chaos_strike(&g_chaos_timeout_every, &g_chaos_timeout_ctr))
+    return 0;  // forced empty-wait timeout: consumer loops back around
   uint8_t* data = h->base + r->data_off;
   bool spun = spin_for([r] {
     return __atomic_load_n(&r->head, __ATOMIC_ACQUIRE) !=
@@ -510,5 +546,15 @@ void rt_ring_pair_close(void* hp) {
 }
 
 void rt_ring_pair_destroy(const char* name) { shm_unlink(name); }
+
+// Runtime (re-)arm of the chaos fault counters; 0 disarms. The env path
+// (RT_CHAOS_RING_PARTIAL_EVERY / RT_CHAOS_RING_TIMEOUT_EVERY at dlopen)
+// serves spawned processes; this serves a library already loaded.
+void rt_ring_chaos_set(uint64_t partial_every, uint64_t timeout_every) {
+  __atomic_store_n(&g_chaos_partial_every, partial_every, __ATOMIC_RELAXED);
+  __atomic_store_n(&g_chaos_timeout_every, timeout_every, __ATOMIC_RELAXED);
+  __atomic_store_n(&g_chaos_partial_ctr, 0, __ATOMIC_RELAXED);
+  __atomic_store_n(&g_chaos_timeout_ctr, 0, __ATOMIC_RELAXED);
+}
 
 }  // extern "C"

@@ -21,6 +21,7 @@
 #if defined(__x86_64__) || defined(_M_X64)
 #include <emmintrin.h>  // SSE2 streaming stores (rt_copy_nt)
 #endif
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
 
@@ -57,6 +58,28 @@ enum Error : int {
   kClosed = -7,
   kLost = -8,  // object was evicted after having been sealed
 };
+
+// Chaos fault arm (devtools/chaos): every Nth rt_seal reports kSysError
+// while leaving the entry kCreated, so a retry can succeed — the forced
+// version of a shm-layer seal failure. Armed via RT_CHAOS_STORE_SEAL_
+// FAIL_EVERY at dlopen or rt_store_chaos_set at runtime; disarmed cost
+// is one relaxed load of a zero. Atomics: no new TSAN race.
+uint64_t env_every(const char* name) {
+  const char* raw = getenv(name);
+  if (!raw) return 0;
+  char* end = nullptr;
+  unsigned long long v = strtoull(raw, &end, 10);
+  return (end && *end == '\0') ? (uint64_t)v : 0;
+}
+
+uint64_t g_chaos_seal_every = env_every("RT_CHAOS_STORE_SEAL_FAIL_EVERY");
+uint64_t g_chaos_seal_ctr = 0;
+
+bool chaos_seal_strike() {
+  uint64_t every = __atomic_load_n(&g_chaos_seal_every, __ATOMIC_RELAXED);
+  if (every == 0) return false;
+  return __atomic_add_fetch(&g_chaos_seal_ctr, 1, __ATOMIC_RELAXED) % every == 0;
+}
 
 struct Entry {
   uint8_t id[kIdSize];
@@ -617,6 +640,7 @@ int rt_create(void* hv, const uint8_t* id, uint64_t size, uint64_t* offset_out) 
 int rt_seal(void* hv, const uint8_t* id) {
   auto* h = static_cast<Handle*>(hv);
   StoreHeader* s = h->hdr;
+  if (chaos_seal_strike()) return kSysError;  // entry stays kCreated
   lock(&s->mu);
   Entry* e = find_entry(h, id);
   if (!e) {
@@ -898,6 +922,12 @@ int rt_chan_close(void* hv, const uint8_t* id) {
   pthread_cond_broadcast(&ch->cv);
   pthread_mutex_unlock(&ch->mu);
   return kOK;
+}
+
+// Runtime (re-)arm of the seal-failure chaos counter; 0 disarms.
+void rt_store_chaos_set(uint64_t seal_fail_every) {
+  __atomic_store_n(&g_chaos_seal_every, seal_fail_every, __ATOMIC_RELAXED);
+  __atomic_store_n(&g_chaos_seal_ctr, 0, __ATOMIC_RELAXED);
 }
 
 }  // extern "C"

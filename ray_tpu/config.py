@@ -139,6 +139,20 @@ class Config:
     #: crash/power loss.
     gcs_fsync: bool = False
 
+    # --- chaos / fault injection (devtools/chaos; ref: the reference's
+    # ResourceKiller-driven chaos tests, _private/test_utils.py:1419) ---
+    #: arm the deterministic fault-injection controller in every process
+    #: (driver, raylets, workers, GCS). Off = every chaos.point() site is
+    #: a module-flag check compiled down to a falsy branch.
+    chaos_enabled: bool = False
+    #: ChaosPlan JSON: a file path, or an inline JSON object string
+    chaos_plan: str = ""
+    #: override the plan's seed (< 0 = use the plan's own)
+    chaos_seed: int = -1
+    #: fault-event JSONL dir ("" = <temp_dir>/chaos); read back by
+    #: state.list_chaos_events() and `ray_tpu chaos events`
+    chaos_log_dir: str = ""
+
     # --- timeouts / health (ref: gcs_health_check_manager.h:59) ---
     health_check_period_s: float = 1.0
     health_check_failure_threshold: int = 5

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import atexit
 import functools
+import logging
 import os
 import subprocess
 import sys
@@ -26,6 +27,8 @@ from ray_tpu.core.ref import ActorHandle, ObjectRef
 # importing it here would recurse during package initialization
 from ray_tpu.utils import rpc, serialization
 from ray_tpu.utils.ids import PlacementGroupID
+
+log = logging.getLogger(__name__)
 
 _core: CoreClient | None = None
 _io: rpc.EventLoopThread | None = None
@@ -73,6 +76,13 @@ def init(
     if object_store_memory:
         cfg.object_store_memory = object_store_memory
         set_config(cfg)
+
+    # deterministic fault injection (devtools/chaos): the driver — and
+    # with it every in-process GCS/raylet — arms here; subprocess nodes
+    # and workers arm in their own mains off the serialized config
+    from ray_tpu.devtools import chaos
+
+    chaos.maybe_arm()
 
     _io = rpc.EventLoopThread()
 
@@ -197,28 +207,28 @@ def shutdown() -> None:
         try:
             _io.run(_core.close(), timeout=10)
         except Exception:
-            pass
+            log.debug("core close failed during shutdown", exc_info=True)
     _core = None
     if _owned_cluster is not None:
         try:
             _owned_cluster.shutdown()
         except Exception:
-            pass
+            log.debug("cluster shutdown failed", exc_info=True)
         _owned_cluster = None
     for p in _head_procs:
         try:
             p.terminate()
-        except Exception:
+        except OSError:
             pass
     for p in _head_procs:  # reap: no zombies, and raylets finish shm cleanup
         try:
             p.wait(timeout=5)
-        except Exception:
+        except (subprocess.TimeoutExpired, OSError):
             try:
                 p.kill()
                 p.wait(timeout=2)
-            except Exception:
-                pass
+            except (subprocess.TimeoutExpired, OSError):
+                pass  # unkillable child: the OS reaps it at exit
     _head_procs.clear()
     if _io is not None:
         _io.stop()
@@ -384,11 +394,14 @@ class RemoteFunction:
         t.runtime_env = o.get("runtime_env")
         t.func_id = None
         t.sched_key = None
+        # a custom max_retries does NOT disqualify the fast path: the
+        # driver-side lineage tuple carries the budget, and break-lane
+        # recovery resubmits with it (chaos kill schedules exposed the
+        # earlier config-default reset)
         t.fast_ok = (
             t.num_returns == 1 and t.placement_group is None
             and t.scheduling_node is None and t.runtime_env is None
-            and t.scheduling_strategy is None and t.name is None
-            and t.max_retries is None)
+            and t.scheduling_strategy is None and t.name is None)
         if t.fast_ok:
             # register now (once per template) so steady-state calls skip
             # the per-call registration probe entirely

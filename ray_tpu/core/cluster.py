@@ -11,10 +11,13 @@ background event loop; workers are real OS processes.
 from __future__ import annotations
 
 import asyncio
+import logging
 
 from ray_tpu.core.gcs import GcsServer
 from ray_tpu.core.raylet import Raylet
 from ray_tpu.utils import rpc
+
+_log = logging.getLogger(__name__)
 
 
 class Cluster:
@@ -37,7 +40,7 @@ class Cluster:
         for raylet in self.raylets:
             try:
                 raylet.store.destroy()
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — atexit hook: nowhere to report
                 pass
 
     def add_node(
@@ -84,11 +87,11 @@ class Cluster:
             try:
                 self.io.run(raylet.stop())
             except Exception:
-                pass
+                _log.debug("raylet stop failed", exc_info=True)
         self.raylets.clear()
         try:
             self.io.run(self.gcs.stop())
         except Exception:
-            pass
+            _log.debug("GCS stop failed", exc_info=True)
         if self._own_io:
             self.io.stop()

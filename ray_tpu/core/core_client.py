@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import logging
 import inspect
 import os
 import sys
@@ -53,6 +54,8 @@ from ray_tpu.core.ref import (
 )
 from ray_tpu.utils import aio, metrics, recorder, rpc, serialization
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+
+log = logging.getLogger(__name__)
 
 _NCPU = max(1, os.cpu_count() or 1)
 
@@ -178,7 +181,8 @@ class _TaskEventBuffer:
                 # not permanently skip republishing this window
                 self.core._lat_published = lat["count"]
         except Exception:
-            pass
+            # transient GCS error: this window republishes next flush
+            log.debug("latency window publish failed", exc_info=True)
 
 
 def _strategy_key(strategy: dict | None):
@@ -337,7 +341,7 @@ class CoreClient:
         # has no use for)
         try:
             await self.gcs.call("subscribe", {"channel": "node_removed"})
-        except Exception:
+        except (rpc.RpcError, OSError):
             pass  # cache misses fall back to the directory anyway
         self._bg.spawn(self.task_events._flush_loop(), self.loop)
         if self.cfg.fastpath_enabled and self.store is not None:
@@ -466,10 +470,10 @@ class CoreClient:
                         finally:
                             if conn is not self.raylet:
                                 await conn.close()
-                    except Exception:
-                        pass
+                    except (rpc.RpcError, OSError):
+                        pass  # holder already gone: nothing left to delete
         except Exception:
-            pass
+            log.debug("free() fanout failed", exc_info=True)
 
     # ------------------------------------------------------- borrower side
     def on_borrowed_ref_created(self, oid: ObjectID, owner_address):
@@ -520,8 +524,8 @@ class CoreClient:
                     "borrow_object" if borrow else "unborrow_object",
                     {"object_id": oid.binary(), "borrower": self.worker_id.hex()},
                 )
-        except Exception:
-            pass
+        except (rpc.RpcError, OSError):
+            pass  # owner died: its ref counts died with it
 
     # --------------------------------------------------------- owner RPCs
     async def rpc_borrow_object(self, conn, p):
@@ -595,7 +599,8 @@ class CoreClient:
                 self._run_sync(
                     self.raylet.call("spill_now", {"need": size}), timeout=60)
         except Exception:
-            pass
+            # advisory: create() still retries under arena pressure
+            log.debug("spill_now request failed", exc_info=True)
 
     async def _register_location(self, oid: ObjectID):
         holders = {self.node_id.binary()}
@@ -687,8 +692,8 @@ class CoreClient:
             if blob:
                 try:
                     self._obj_locations[oid] = set(pickle.loads(blob))
-                except Exception:
-                    pass
+                except (pickle.UnpicklingError, TypeError, EOFError):
+                    pass  # torn directory blob: treated as a cache miss
 
     async def _get_one(self, ref: ObjectRef, deadline: float | None):
         oid = ref.id
@@ -828,7 +833,8 @@ class CoreClient:
                             ref, "recover_object", {"object_id": oid.binary()}, 10
                         )
                     except Exception:
-                        pass
+                        log.debug("recover_object escalation failed",
+                                  exc_info=True)
                 await asyncio.sleep(0.05)
                 continue
 
@@ -875,8 +881,8 @@ class CoreClient:
             finally:
                 try:
                     await self.raylet.call("fetch_object_done", obj)
-                except Exception:
-                    pass
+                except (rpc.RpcError, OSError):
+                    pass  # raylet gone: the pin dies with it
             return b"".join(parts)
         except rpc.ConnectionLost:
             return None
@@ -1092,7 +1098,8 @@ class CoreClient:
         self._fast_lanes.append(lane)
         t.start()
 
-    def _try_fast_submit(self, fn, args, kwargs, resources):
+    def _try_fast_submit(self, fn, args, kwargs, resources,
+                         max_retries=None):
         """User-thread fast submit. Returns an ObjectRef, or None to take
         the RPC path. Must never raise."""
         func_id = getattr(fn, "__rt_func_id__", None)
@@ -1103,9 +1110,11 @@ class CoreClient:
         key = (func_id, tuple(sorted(resources.items())), None, -1, None,
                None)
         return self._fast_submit_keyed(fn, func_id, key, resources,
-                                       args, kwargs)
+                                       args, kwargs,
+                                       max_retries=max_retries)
 
-    def _fast_submit_keyed(self, fn, func_id, key, resources, args, kwargs):
+    def _fast_submit_keyed(self, fn, func_id, key, resources, args, kwargs,
+                           max_retries=None):
         """Shared fast-submit tail: the template path enters here directly
         with its precomputed scheduling key (skipping the per-call getattr
         probes and resources sort that _try_fast_submit re-derives)."""
@@ -1182,9 +1191,10 @@ class CoreClient:
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
             return None  # big args belong in the object store
-        ref = self._fast_register_and_push(lane, task_id, rec,
-                                           (fn, args, kwargs, resources),
-                                           defer=gap_ns < 2_000_000, t0=t0)
+        ref = self._fast_register_and_push(
+            lane, task_id, rec,
+            (fn, args, kwargs, resources, max_retries),
+            defer=gap_ns < 2_000_000, t0=t0)
         if ref is None:
             return None
         lane.worker.idle_since = time.monotonic()  # keep the lease warm
@@ -1405,8 +1415,8 @@ class CoreClient:
         if self.store is not None and not self.client_mode:
             try:
                 out["store"] = self.store.stats()
-            except Exception:
-                pass
+            except object_store.ObjectStoreError:
+                pass  # arena torn down mid-flush: skip this sample
         return out
 
     def _publish_recorder_metrics(self) -> None:
@@ -1573,9 +1583,14 @@ class CoreClient:
             metrics.actor_calls.inc()
         return ref
 
-    def _fast_resubmit(self, task_id: TaskID, light) -> None:
-        """Loop-side: re-route a fast-path call through the RPC path
-        (worker death, NEED_SLOW)."""
+    def _fast_resubmit(self, task_id: TaskID, light, lost: bool = True) -> None:
+        """Loop-side: re-route a fast-path call through the RPC path.
+        ``lost=True`` (break-lane recovery: the worker died and may have
+        executed the task) charges one retry from the user's budget and
+        honors at-most-once — a max_retries=0 task FAILS rather than
+        re-executing its side effects. ``lost=False`` (NEED_SLOW
+        migration: the worker declined without executing) keeps the full
+        budget."""
         if light[0] == "actor":
             _, actor_id, method, args, kwargs = light
             spec = {
@@ -1592,7 +1607,19 @@ class CoreClient:
             self._actor_queues.setdefault(actor_id, []).append(spec)
             self._bg.spawn(self._ensure_actor_pump(actor_id), self.loop)
         else:
-            spec = self._fast_light_to_spec(task_id, light)
+            budget = light[4]
+            if budget is None:
+                budget = self.cfg.default_max_task_retries
+            if lost:
+                if budget <= 0:
+                    # at-most-once: the user forbade re-execution and the
+                    # worker may already have run the task's side effects
+                    self._complete_task_error(
+                        self._fast_light_to_spec(task_id, light, 0),
+                        WorkerCrashedError())
+                    return
+                budget -= 1
+            spec = self._fast_light_to_spec(task_id, light, budget)
             self._bg.spawn(self._submit_async(spec), self.loop)
 
     def _fast_reader(self, lane):
@@ -1728,7 +1755,9 @@ class CoreClient:
                     else:
                         self._fast_ineligible_funcs.add(
                             getattr(light[0], "__rt_func_id__", b""))
-                    self._fast_resubmit(task_id, light)
+                    # NEED_SLOW is a migration, not a loss: the worker
+                    # declined without executing, so the full budget rides
+                    self._fast_resubmit(task_id, light, lost=False)
                 continue
             entry = self.memory_store.get(oid)
             if light is None:
@@ -1757,9 +1786,14 @@ class CoreClient:
                     if light is not None and light[0] != "actor":
                         # shm results can be evicted: keep real lineage
                         # (actor calls have no reconstruction, as in the
-                        # reference — actor state is not replayable)
+                        # reference — actor state is not replayable). The
+                        # task COMPLETED, so reconstruction gets the full
+                        # user budget back
+                        budget = light[4]
+                        if budget is None:
+                            budget = self.cfg.default_max_task_retries
                         self._lineage[task_id] = self._fast_light_to_spec(
-                            task_id, light)
+                            task_id, light, budget)
                         self._lineage_live[task_id] = {oid}
                     self._bg.spawn(self._register_location(oid), self.loop)
                 else:  # ERR
@@ -1828,10 +1862,15 @@ class CoreClient:
         finally:
             lane.return_armed = False
 
-    def _fast_light_to_spec(self, task_id: TaskID, light) -> dict:
+    def _fast_light_to_spec(self, task_id: TaskID, light,
+                            budget: int) -> dict:
         """Expand a fast-path lineage tuple into a full RPC task spec
-        (reusing the already-issued task id: its refs are in user hands)."""
-        fn, args, kwargs, resources = light
+        (reusing the already-issued task id: its refs are in user hands).
+        ``budget`` is the remaining retry allowance — _fast_resubmit
+        resolves it from the tuple's user max_retries, charging one loss
+        only when a worker actually died (chaos kill schedules exposed
+        the earlier config-default reset)."""
+        fn, args, kwargs, resources, _max_retries = light
         return {
             "task_id": task_id,
             "name": getattr(fn, "__name__", "task"),
@@ -1843,7 +1882,7 @@ class CoreClient:
             "num_returns": 1,
             "resources": dict(resources),
             "owner_address": self.address,
-            "max_retries": max(0, self.cfg.default_max_task_retries - 1),
+            "max_retries": max(0, budget),
             "placement_group": None,
             "bundle_index": -1,
             "scheduling_node": None,
@@ -2155,7 +2194,8 @@ class CoreClient:
         spec byte-identical to a direct submit_task call."""
         if (tmpl.fast_ok and not self.cfg.tracing_enabled):
             ref = self._fast_submit_keyed(fn, tmpl.func_id, tmpl.sched_key,
-                                          tmpl.resources, args, kwargs)
+                                          tmpl.resources, args, kwargs,
+                                          max_retries=tmpl.max_retries)
             if ref is not None:
                 return ref
         return self.submit_task(
@@ -2198,9 +2238,10 @@ class CoreClient:
                     and scheduling_node is None and runtime_env is None
                     and scheduling_strategy is None
                     and not self.cfg.tracing_enabled
-                    and name is None and max_retries is None):
+                    and name is None):
                 ref = self._try_fast_submit(
-                    fn, args, kwargs, dict(resources or {"CPU": 1.0}))
+                    fn, args, kwargs, dict(resources or {"CPU": 1.0}),
+                    max_retries=max_retries)
                 if ref is not None:
                     return ref
             func_id = self._register_function(fn)
@@ -2955,8 +2996,8 @@ class CoreClient:
             finally:
                 if conn is not self.raylet:
                     await conn.close()
-        except Exception:
-            pass
+        except (rpc.RpcError, OSError):
+            pass  # raylet died: the lease is already gone with it
 
     # ------------------------------------------------------------- actors
     def _resolve_runtime_env(self, env):
@@ -3432,7 +3473,8 @@ class CoreClient:
                     if killed or self._task_worker.get(task_id) != loc:
                         return
                 except Exception:
-                    pass  # worker loop unresponsive/conn dead: raylet fallback
+                    # worker loop unresponsive/conn dead: raylet fallback
+                    log.debug("worker-side cancel failed", exc_info=True)
                 # Fallback (worker wedged): kill via raylet, but only if the
                 # task is still mapped to that same worker.
                 if self._task_worker.get(task_id) != loc:
@@ -3448,7 +3490,7 @@ class CoreClient:
                         if conn is not self.raylet:
                             await conn.close()
                 except Exception:
-                    pass
+                    log.debug("raylet-side cancel kill failed", exc_info=True)
 
     def kill_actor(self, actor_id: ActorID, no_restart=True):
         self._run_sync(self.gcs.call("kill_actor", {"actor_id": actor_id,
@@ -3510,15 +3552,15 @@ class CoreClient:
                     conn = await rpc.connect(*w.raylet_address, timeout=2)
                     await conn.call("return_lease", {"lease_id": w.lease_id})
                     await conn.close()
-                except Exception:
-                    pass
+                except (rpc.RpcError, OSError):
+                    pass  # node already down: nothing to return
         for conn in self._actor_conns.values():
             await conn.close()
         for conn in self._owner_conns.values():
             try:
                 await conn.close()
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # already dead: close is best-effort
         await self.server.stop()
         if self.gcs:
             await self.gcs.close()

@@ -43,6 +43,7 @@ import struct
 import threading
 
 from ray_tpu import _native
+from ray_tpu.devtools import chaos
 from ray_tpu.utils import serialization
 
 SUB = 0  # driver -> worker (task records)
@@ -127,6 +128,10 @@ class RingPair:
 
     def push(self, which: int, payload: bytes, timeout_ms: int = -1) -> int:
         """Returns a _ST_* status; never raises on full/closed."""
+        if chaos.ENABLED:
+            st = _chaos_push(which, len(payload))
+            if st:
+                return st
         if not self._enter():
             return _ST_CLOSED
         try:
@@ -150,6 +155,10 @@ class RingPair:
         consumed (>= 0) or a negative _ST_* status. One lock round and at
         most one consumer wake for the whole batch — the native half of
         the coalesced flush."""
+        if chaos.ENABLED:
+            st = _chaos_push(which, len(framed))
+            if st:
+                return 0 if st == _ST_TIMEOUT else st
         if not self._enter():
             return _ST_CLOSED
         try:
@@ -240,6 +249,21 @@ class RingPair:
         idempotent, so teardown can't leak /dev/shm entries even if the
         owning reader thread never gets to run again."""
         self._lib.rt_ring_pair_destroy(self.name.encode())
+
+
+def _chaos_push(which: int, nbytes: int) -> int:
+    """Chaos verdict for one ring push ("ring.push" fault point): 0 =
+    proceed; drop maps to the ring-full status (caller retries from the
+    consumed prefix / spills to RPC), error maps to closed (caller
+    breaks the lane and recovers over RPC) — both recoveries the rings
+    already promise, now reachable on demand."""
+    try:
+        act = chaos.point("ring.push", which=which, bytes=nbytes)
+    except chaos.ChaosError:
+        return _ST_CLOSED  # pushes report status codes, never raise
+    if act is not None and act.kind == "drop":
+        return _ST_TIMEOUT
+    return 0  # duplicate/corrupt are not meaningful for ring pushes
 
 
 def frame(records: list[bytes]) -> bytes:

@@ -17,6 +17,7 @@ slot behind the table dicts for GCS fault tolerance in a later iteration.
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import sys
 import time
@@ -25,8 +26,11 @@ from typing import Any
 
 from ray_tpu.config import get_config
 from ray_tpu.core import policy
+from ray_tpu.devtools import chaos
 from ray_tpu.utils import aio, rpc
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+log = logging.getLogger(__name__)
 
 # actor lifecycle states (ref: gcs.proto ActorTableData.ActorState)
 PENDING = "PENDING_CREATION"
@@ -177,6 +181,12 @@ class GcsServer:
     async def rpc_kv_put(self, conn, p):
         ns = p.get("ns", "")
         journal = ns != "metrics"  # metrics are volatile: snapshot-only
+        if chaos.ENABLED and journal:
+            # "gcs.wal_append" fault point, journaled-KV flavor: an
+            # `error` action raises out of this handler, so the client
+            # sees a failed (never-acked, never-journaled) write —
+            # delay stalls the ack like a slow disk would
+            chaos.point("gcs.wal_append", ns=ns, kind="kv_put")
         ok = self.kvstore.put(ns, p["key"], p["value"],
                               overwrite=p.get("overwrite", True),
                               journal=journal)
@@ -519,8 +529,8 @@ class GcsServer:
                 wconn = await rpc.connect(*info.address, timeout=2)
                 await wconn.notify("exit_worker", {"force": not p.get("no_restart", False)})
                 await wconn.close()
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # worker already dead: the kill is moot
         await self._on_actor_failure(info, "killed via kill_actor")
         return True
 
@@ -585,7 +595,8 @@ class GcsServer:
                     await c.call("return_bundle", {"pg_id": pg_id, "bundle_index": bundle_index})
                     await c.close()
                 except Exception:
-                    pass
+                    log.debug("bundle rollback failed on %s",
+                              node.node_id.hex()[:12], exc_info=True)
             return {"state": "INFEASIBLE"}
         # phase 2: commit
         for node, bundle_index in prepared:
@@ -651,7 +662,8 @@ class GcsServer:
                 await c.call("return_bundle", {"pg_id": pg.pg_id, "bundle_index": bundle_index})
                 await c.close()
             except Exception:
-                pass
+                log.debug("bundle return failed on %s",
+                          node_id.hex()[:12], exc_info=True)
         pg.state = "REMOVED"
         pg.bundle_nodes = []
         self._journal(("pg", pg))
@@ -750,7 +762,8 @@ class GcsServer:
                 self.named_actors = snap.get("named_actors", {})
                 self.pgs = snap.get("pgs", {})
             except Exception:
-                pass  # unreadable table blob: KV still recovered
+                # unreadable table blob: KV still recovered
+                log.debug("snapshot aux blob unreadable", exc_info=True)
         for op in recovered_ops:
             kind = op[0]
             if kind == "job":
@@ -822,7 +835,8 @@ class GcsServer:
                     state_loaded = True
             snap_ok = True  # absent, non-legacy, or fully journaled
         except Exception:
-            pass
+            # partial migration: sentinel stays absent, next start re-runs
+            log.debug("legacy snapshot migration incomplete", exc_info=True)
         legacy_wal = self.persist_path + ".wal.legacy"
         try:
             if not os.path.exists(legacy_wal):
@@ -864,7 +878,7 @@ class GcsServer:
                     state_loaded = True
                 wal_ok = True
         except Exception:
-            pass
+            log.debug("legacy WAL migration incomplete", exc_info=True)
         if snap_ok and wal_ok:
             try:
                 # Migration-complete sentinel: journaled only when BOTH
@@ -878,8 +892,8 @@ class GcsServer:
                     # every replayed op is in the native WAL (flushed per
                     # append): the legacy copy is redundant
                     os.remove(legacy_wal)
-            except Exception:
-                pass
+            except (OSError, TypeError):
+                pass  # sentinel retry next start; sources still on disk
         if state_loaded:
             self.mark_dirty()  # next snapshot converts to native format
 
@@ -890,10 +904,18 @@ class GcsServer:
     def _journal(self, op: tuple) -> None:
         import pickle as _p
 
+        if chaos.ENABLED:
+            # "gcs.wal_append", table-op flavor: an `error` action raises
+            # out of the mutation handler mid-flight — the un-acked,
+            # un-journaled write the WAL recovery tests replay against
+            chaos.point("gcs.wal_append", kind=op[0])
         try:
             self.kvstore.journal_aux(_p.dumps(op))
-        except Exception:
-            pass  # snapshot loop still covers the mutation
+        except (_p.PicklingError, TypeError, AttributeError):
+            # unpicklable table entry: this aux record is skipped but the
+            # periodic snapshot still covers the mutation
+            log.debug("WAL aux journal skipped for %r", op[0],
+                      exc_info=True)
         self.mark_dirty()
         self._kick_sync()
 
@@ -965,6 +987,8 @@ def _fits_all(bundles: list[dict], avail: dict) -> bool:
 
 def main():
     import argparse
+
+    chaos.maybe_arm()  # fault schedule rides the serialized config
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")

@@ -14,6 +14,7 @@ import mmap
 import os
 
 from ray_tpu._native import get_lib
+from ray_tpu.devtools import chaos
 from ray_tpu.utils import serialization
 from ray_tpu.utils.ids import ObjectID
 
@@ -83,7 +84,7 @@ class _ReleaseGuard:
             try:
                 if self._store._handle:
                     self._store.release(self._oid)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — guard __del__ path must never raise
                 pass
 
     def __del__(self):
@@ -121,6 +122,14 @@ class SharedObjectStore:
         return self._view[off.value : off.value + size]
 
     def seal(self, object_id: ObjectID) -> None:
+        if chaos.ENABLED:
+            # "store.seal" fault point: an `error` action raises here as
+            # an ObjectStoreError — exactly what a native seal failure
+            # (chaos-armed or real) surfaces, so both travel one path
+            try:
+                chaos.point("store.seal", oid=object_id.hex())
+            except chaos.ChaosError as e:
+                raise ObjectStoreError(f"seal {object_id}: {e}") from e
         _check(self._lib.rt_seal(self._handle, object_id.binary()), f"seal {object_id}")
 
     def get_buffer(self, object_id: ObjectID, timeout_ms: int = -1) -> memoryview:
@@ -323,5 +332,5 @@ class SharedObjectStore:
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # raylint: disable=RT012 — __del__ may run at interpreter exit
             pass

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import os
 import pickle
 import subprocess
@@ -31,8 +32,11 @@ from dataclasses import dataclass, field
 from ray_tpu.config import get_config
 from ray_tpu.core import policy
 from ray_tpu.core.object_store import ObjectStoreError, SharedObjectStore
+from ray_tpu.devtools import chaos
 from ray_tpu.utils import aio, metrics, rpc
 from ray_tpu.utils.ids import NodeID, ObjectID, WorkerID
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -190,8 +194,8 @@ class Raylet:
                 from ray_tpu.core.memory_monitor import read_system_memory
 
                 resources["memory"] = float(read_system_memory()[1])
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # no /proc: memory simply isn't advertised
         self.ledger = ResourceLedger(resources)
 
         self.log_dir = os.path.join(
@@ -301,8 +305,8 @@ class Raylet:
         if old is not None:
             try:
                 await old.close()
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # replacing a dead connection: close is best-effort
 
     async def _reregister(self):
         reply = await self.gcs.call(
@@ -371,7 +375,8 @@ class Raylet:
                         await self._reconnect_gcs()
                         failures = 0
                     except Exception:
-                        pass
+                        log.debug("GCS reconnect attempt failed",
+                                  exc_info=True)
             await asyncio.sleep(self.cfg.health_check_period_s)
 
     async def _reaper_loop(self):
@@ -387,7 +392,7 @@ class Raylet:
                 try:
                     self.memory_monitor.maybe_kill()
                 except Exception:
-                    pass
+                    log.debug("memory monitor sweep failed", exc_info=True)
             for w in list(self.all_workers.values()):
                 if w.proc.poll() is not None:
                     await self._on_worker_death(w)
@@ -451,7 +456,7 @@ class Raylet:
                     {"actor_id": w.actor_id, "cause": f"worker pid={w.proc.pid} exited"},
                 )
             except Exception:
-                pass
+                log.debug("actor death report failed", exc_info=True)
 
     async def _report_worker_death(self, w: WorkerHandle):
         """Postmortem: the victim's flight-recorder ring lives in a shm
@@ -488,7 +493,8 @@ class Raylet:
                 "ns": "worker_deaths", "key": w.worker_id.hex(),
                 "value": pickle.dumps(report)})
         except Exception:
-            pass  # GCS unreachable: the death still frees the lease above
+            # GCS unreachable: the death still frees the lease above
+            log.debug("worker death report failed", exc_info=True)
 
     # ---------------------------------------------------------- worker pool
     def _spawn_worker(self, language: str = "python") -> WorkerHandle:
@@ -677,6 +683,16 @@ class Raylet:
         with a spillback address; otherwise queue (infeasible-now).
         """
         resources = dict(p.get("resources") or {"CPU": 1.0})
+        if chaos.ENABLED:
+            # "raylet.lease_grant" fault point: `error` raises out of the
+            # handler (the requester's lease RPC fails — its retry/
+            # spillback logic must absorb it); `drop` refuses the grant
+            # explicitly; `delay` stalls this raylet's loop like an
+            # overloaded node manager would
+            act = chaos.point("raylet.lease_grant",
+                              cpus=float(resources.get("CPU", 0.0)))
+            if act is not None and act.kind == "drop":
+                raise rpc.RpcError("chaos: lease grant dropped")
         pg_key = None
         if p.get("pg_id") is not None:
             pg_key = (p["pg_id"], p.get("bundle_index", 0))
@@ -838,7 +854,7 @@ class Raylet:
                 if time.monotonic() > deadline:
                     try:
                         w.proc.kill()
-                    except Exception:
+                    except OSError:
                         pass
                 await asyncio.sleep(0.05)
             self._tpu_chips_free.extend(chips)
@@ -878,7 +894,7 @@ class Raylet:
             # than recycle (actor workers are single-purpose anyway)
             try:
                 w.proc.terminate()
-            except Exception:
+            except OSError:
                 pass
             self.all_workers.pop(w.worker_id, None)
             self._release_cgroup_after_exit(w)
@@ -1164,7 +1180,7 @@ class Raylet:
         try:
             self.store.channel_close(cid)
         except Exception:
-            pass
+            log.debug("channel close failed", exc_info=True)
         # mirror nodes create a push executor per channel: release it here
         # (the forwarder's finally only runs on the origin node)
         ex = getattr(self, "_chan_execs", {}).pop(cid, None)
@@ -1229,16 +1245,16 @@ class Raylet:
                     await c.call("channel_close", {"chan_id": cid.binary()},
                                  timeout=5)
                 except Exception:
-                    pass
+                    log.debug("mirror channel_close failed", exc_info=True)
             try:
                 self.store.channel_close(cid)
             except Exception:
-                pass
+                log.debug("origin channel close failed", exc_info=True)
             for c in conns:
                 try:
                     await c.close()
-                except Exception:
-                    pass
+                except (rpc.RpcError, OSError):
+                    pass  # reader link already dead
             ex2 = getattr(self, "_chan_execs", {}).pop(cid, None)
             if ex2 is not None:
                 ex2.shutdown(wait=False)
@@ -1366,15 +1382,15 @@ class Raylet:
             except Exception:
                 try:  # abort the half-written create so the slot isn't stuck
                     self.store.delete(oid)
-                except Exception:
-                    pass
+                except ObjectStoreError:
+                    pass  # nothing to abort (create itself failed)
                 raise
         finally:
             if pinned:
                 try:
                     await c.notify("fetch_object_done", {"object_id": oid.binary()})
-                except Exception:
-                    pass
+                except (rpc.RpcError, OSError):
+                    pass  # holder gone: its pin died with it
             await c.close()
 
     async def _ensure_local_bytes(self, oid: ObjectID) -> bool:
@@ -1431,8 +1447,8 @@ class Raylet:
         if self._transfer_pins.pop((conn, oid), None):
             try:
                 self.store.release(oid)
-            except Exception:
-                pass
+            except ObjectStoreError:
+                pass  # already deleted/evicted: the pin is moot
 
     async def rpc_fetch_object_done(self, conn, p):
         self._release_transfer_pin(conn, ObjectID(p["object_id"]))
@@ -1478,18 +1494,18 @@ class Raylet:
         for w in self.all_workers.values():
             try:
                 os.kill(w.proc.pid, _signal.SIGKILL)
-            except Exception:
+            except OSError:
                 pass
         await self.server.stop()
         if self.gcs is not None:
             try:
                 await self.gcs.close()
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # hard-death semantics: no goodbyes anyway
         try:
             self.store.destroy()
         except Exception:
-            pass
+            log.debug("store destroy failed", exc_info=True)
 
     async def stop(self):
         self._stopping = True
@@ -1497,7 +1513,7 @@ class Raylet:
         for w in self.all_workers.values():
             try:
                 w.proc.terminate()
-            except Exception:
+            except OSError:
                 pass
         # terminated workers never run their clean-exit recorder unlink:
         # drop OUR workers' recorder files (256KB each) — only ours, the
@@ -1534,11 +1550,13 @@ class Raylet:
         try:
             self.store.destroy()
         except Exception:
-            pass
+            log.debug("store destroy failed", exc_info=True)
 
 
 def main():
     import argparse
+
+    chaos.maybe_arm()  # fault schedule rides the serialized config
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs", required=True, help="host:port of the GCS")
@@ -1578,11 +1596,11 @@ def main():
             for w in r.all_workers.values():
                 try:
                     w.proc.terminate()
-                except Exception:
+                except OSError:
                     pass
             try:
                 r.store.destroy()
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — exiting via os._exit: nowhere to report
                 pass
         os._exit(0)
 
@@ -1609,7 +1627,7 @@ def main():
         if raylet_box:
             try:
                 raylet_box[0].store.destroy()
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — ^C teardown: nowhere to report
                 pass
 
 

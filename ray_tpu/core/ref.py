@@ -113,7 +113,7 @@ class ObjectRef:
         if core is not None:
             try:
                 core.note_ref_shipped(self.id, self)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — __reduce__ during teardown must never raise
                 pass
         return (_rebuild_borrowed_ref, (self.id, self.owner_address))
 
@@ -125,7 +125,7 @@ class ObjectRef:
                     core.on_borrowed_ref_deleted(self.id, self.owner_address)
                 else:
                     core.on_owned_ref_deleted(self.id)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — __del__ may run at interpreter exit
                 pass
 
     # await support inside async actors
@@ -203,7 +203,7 @@ class ObjectRefGenerator:
         if core is not None:
             try:
                 core.gen_release(self._task_id)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — __del__ may run at interpreter exit
                 pass
 
 

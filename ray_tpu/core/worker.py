@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import inspect
+import logging
 import os
 import sys
 import time
@@ -35,9 +36,11 @@ except ImportError:  # pragma: no cover
 from ray_tpu.config import get_config
 from ray_tpu.core.core_client import CoreClient, _pack_bytes
 from ray_tpu.core.ref import ObjectRef, TaskError
+from ray_tpu.devtools import chaos
 from ray_tpu.utils import metrics, rpc, serialization
 from ray_tpu.utils.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 
+log = logging.getLogger(__name__)
 
 _current_worker = None  # set by Worker.start(): runtime_context introspection
 _profiler = None  # RT_WORKER_PROFILE_DIR cProfile, dumped on exit_worker
@@ -394,7 +397,7 @@ class Worker:
                 # ambiguous failure (e.g. timeout with the RPC still in
                 # flight): the ring re-push below may duplicate records —
                 # safe, the driver applies completions exactly once
-                pass
+                log.debug("result spill over RPC failed", exc_info=True)
         # blocking fallback, chunked so one frame can never exceed the
         # ring capacity (kTooBig would tear down the whole lane)
         chunk: list = []
@@ -506,6 +509,8 @@ class Worker:
                 continue
             t_x0 = time.perf_counter_ns()
             try:
+                if chaos.ENABLED:
+                    chaos.point("worker.exec", name=mname, fast=1)
                 ok, val = True, m(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — reply on
                 ok, val = False, e
@@ -700,6 +705,13 @@ class Worker:
                             continue
                         t_x0 = clock()
                         try:
+                            if chaos.ENABLED:
+                                # "worker.exec", fast-lane flavor: error
+                                # rides the reply as this task's failure;
+                                # kill dies holding buffered completions
+                                chaos.point(
+                                    "worker.exec", fast=1,
+                                    name=getattr(fn, "__name__", "task"))
                             ok, val = True, fn(*args, **kwargs)
                         except BaseException as e:  # noqa: BLE001 — reply on
                             ok, val = False, e
@@ -1057,6 +1069,12 @@ class Worker:
         """Run a user callable inside a child span when the spec carries a
         trace context (ref: tracing_helper.py:36-60 — child spans around
         execution; the contextvar makes nested .remote() calls chain)."""
+        if chaos.ENABLED:
+            # "worker.exec", RPC-path flavor: `error` raises here and
+            # becomes this task's TaskError; `kill` SIGKILLs the worker
+            # mid-task (owner retries); `delay` stretches the execution
+            chaos.point("worker.exec",
+                        name=spec.get("name") or spec.get("method", "task"))
         tc = spec.get("trace_ctx")
         if not tc:
             return fn(*args, **kwargs)
@@ -1068,6 +1086,9 @@ class Worker:
 
     async def _traced_acall(self, spec, coro_fn, args, kwargs):
         """Async twin of _traced_call for coroutine tasks/actor methods."""
+        if chaos.ENABLED:
+            chaos.point("worker.exec",
+                        name=spec.get("name") or spec.get("method", "task"))
         tc = spec.get("trace_ctx")
         if not tc:
             return await coro_fn(*args, **kwargs)
@@ -1111,8 +1132,8 @@ class Worker:
         except Exception as e:
             try:
                 await conn.respond(corr, error=e)
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # caller hung up: nobody is owed this error
 
     def _exec_simple_run(self, run):
         """Thread-side body of the simple-batch fast path: no awaits, no
@@ -1270,7 +1291,8 @@ class Worker:
                     try:
                         await gen.aclose()
                     except Exception:
-                        pass
+                        log.debug("async generator close failed",
+                                  exc_info=True)
             async for value in item_iter:
                 item = await self._pack_item(task_id, index, value)
                 reply = await owner.call(
@@ -1301,8 +1323,8 @@ class Worker:
                 await owner.call(
                     "generator_item", {"task_id": task_id, "done": True, "error": err}
                 )
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # owner gone: the stream dies with its consumer
             return {"error": err}
         finally:
             await owner.close()
@@ -1326,8 +1348,8 @@ class Worker:
         if self.core.spill_pressure(size):
             try:  # free arena by spill, not eviction (local_object_manager.h)
                 await self.core.raylet.call("spill_now", {"need": size})
-            except Exception:
-                pass
+            except (rpc.RpcError, OSError):
+                pass  # advisory: create() below retries under pressure
         from ray_tpu.core.object_store import ObjectStoreFullError
 
         for attempt in range(5):
@@ -1341,8 +1363,8 @@ class Worker:
                     raise
                 try:
                     await self.core.raylet.call("spill_now", {"need": size})
-                except Exception:
-                    pass
+                except (rpc.RpcError, OSError):
+                    pass  # advisory: the backoff retry still runs
                 await asyncio.sleep(0.2 * (attempt + 1))
         serialization.pack_into(meta, buffers, buf)
         self.core.store.seal(oid)
@@ -1625,6 +1647,8 @@ def _as_task_error(e: Exception) -> TaskError:
 
 
 def main():
+    chaos.maybe_arm()  # fault schedule rides the serialized config
+
     async def run():
         worker = Worker()
         await worker.start()

@@ -244,7 +244,7 @@ class BlockAccessor:
 
             try:
                 return pa.concat_tables(blocks, promote_options="default")
-            except Exception:
+            except (pa.ArrowException, TypeError):
                 pass  # schema drift: fall through to columnar concat
         if all(_is_tabular(b) for b in blocks):
             cols = [BlockAccessor(b).columns() for b in blocks]

@@ -18,12 +18,15 @@ Per-op wall-clock and task counts are recorded for Dataset.stats().
 
 from __future__ import annotations
 
+import logging
 import collections
 import time
 from typing import Any, Callable, Iterator
 
 import ray_tpu
 from ray_tpu.data.block import BlockAccessor, normalize_block
+
+_log = logging.getLogger(__name__)
 
 DEFAULT_MAX_IN_FLIGHT = 8
 
@@ -211,12 +214,12 @@ class ActorPoolMapBlocks(Operator):
                     ray_tpu.wait(issued, num_returns=len(issued),
                                  timeout=600, fetch_local=False)
             except Exception:
-                pass
+                _log.debug("drain-before-kill wait failed", exc_info=True)
             for a in actors:
                 try:
                     ray_tpu.kill(a)
                 except Exception:
-                    pass
+                    _log.debug("actor kill failed", exc_info=True)
 
 
 class LimitOp(Operator):

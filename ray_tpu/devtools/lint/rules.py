@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT011: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT012: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -294,6 +294,40 @@ class BlockingGetInAsync(Rule):
                        "blocking ray_tpu.get() inside an async def stalls "
                        "the event loop; await the ObjectRef(s) directly "
                        "(or asyncio.gather them) instead")
+
+
+@register
+class SilentExceptionSwallow(Rule):
+    id = "RT012"
+    summary = "bare `except Exception: pass` (no logging, no re-raise)"
+    rationale = ("an except-all whose whole body is `pass` eats every "
+                 "failure signal on that path — real faults AND injected "
+                 "chaos faults (devtools/chaos) vanish without a trace; "
+                 "narrow the handler to the exception the site actually "
+                 "expects, or log at debug before swallowing")
+
+    def on_try(self, node, ctx: Context):
+        for handler in node.handlers:
+            if self._catch_all(handler) and self._only_pass(handler):
+                caught = "except" if handler.type is None else \
+                    f"except {handler.type.id}"
+                ctx.report(self, handler,
+                           f"`{caught}: pass` swallows every failure "
+                           "silently; catch the specific expected "
+                           "exception or log at debug before swallowing")
+
+    on_trystar = on_try
+
+    def _catch_all(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        return isinstance(t, ast.Name) and t.id in ("Exception",
+                                                    "BaseException")
+
+    def _only_pass(self, handler: ast.ExceptHandler) -> bool:
+        return (len(handler.body) == 1
+                and isinstance(handler.body[0], ast.Pass))
 
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram"}

@@ -217,7 +217,7 @@ class PPO:
         for a in self.runners + self.learners:
             try:
                 ray_tpu.kill(a)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — teardown: actor may already be dead
                 pass
 
     @classmethod

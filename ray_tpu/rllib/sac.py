@@ -319,5 +319,5 @@ class SAC:
         for a in self.runners:
             try:
                 ray_tpu.kill(a)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — teardown: actor may already be dead
                 pass

@@ -407,6 +407,11 @@ def main(argv=None):
     # adapt it to the `args.fn(args)` convention the other commands use
     lp.set_defaults(fn=lambda args: sys.exit(cmd_lint(args)))
 
+    from ray_tpu.devtools.chaos.cli import add_chaos_parser, cmd_chaos
+
+    cp = add_chaos_parser(sub)
+    cp.set_defaults(fn=lambda args: sys.exit(cmd_chaos(args)))
+
     p = sub.add_parser("_autoscaler_monitor")
     p.add_argument("--address", required=True)
     p.add_argument("--min-nodes", type=int, default=1)

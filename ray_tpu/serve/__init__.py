@@ -182,22 +182,22 @@ def shutdown():
         if proxy is not None:
             try:
                 ray_tpu.get(proxy.shutdown.remote(), timeout=10)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — shutdown best-effort; the kill below is the backstop
                 pass
             try:
                 ray_tpu.kill(proxy)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — teardown: proxy may already be dead
                 pass
     controller = ray_tpu.get_core().get_actor_by_name(CONTROLLER_NAME)
     if controller is None:
         return
     try:
         ray_tpu.get(controller.shutdown.remote(), timeout=30)
-    except Exception:
+    except Exception:  # raylint: disable=RT012 — shutdown best-effort; the kill below is the backstop
         pass
     try:
         ray_tpu.kill(controller)
-    except Exception:
+    except Exception:  # raylint: disable=RT012 — teardown: controller may already be dead
         pass
     from ray_tpu.serve import handle as _handle_mod
 

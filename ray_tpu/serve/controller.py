@@ -379,7 +379,7 @@ class ServeController:
                             "get_actor", {"actor_id": rec["handle"].actor_id})
                         if info and info.get("node_id") is not None:
                             rec["node_id"] = info["node_id"].hex()
-                    except Exception:
+                    except Exception:  # raylint: disable=RT012 — placement is advisory; retried next poll
                         pass
                 if not rec.get("ready"):
                     rec["ready"] = True
@@ -438,13 +438,13 @@ class ServeController:
                     core.get_async([ref], cfg.graceful_shutdown_timeout_s + 1),
                     cfg.graceful_shutdown_timeout_s + 2,
                 )
-        except Exception:
+        except Exception:  # raylint: disable=RT012 — graceful drain best-effort; kill below is the backstop
             pass
         try:
             await core.gcs.call(
                 "kill_actor", {"actor_id": rec["handle"].actor_id, "no_restart": True}
             )
-        except Exception:
+        except Exception:  # raylint: disable=RT012 — teardown: replica may already be dead
             pass
 
     async def shutdown(self) -> bool:

@@ -176,8 +176,8 @@ class _Router:
                             self.remote_ongoing[rid] = int(m.get("ongoing", 0))
                             self.inflight_at_probe[rid] = local_now
                             self.models[rid] = list(m.get("models", ()))
-                    except Exception:
-                        pass  # replica mid-restart: keep the stale value
+                    except Exception:  # raylint: disable=RT012 — replica mid-restart: keep the stale value
+                        pass
 
                 await asyncio.gather(*[probe_one(r) for r in reps])
                 await asyncio.sleep(0.15)
@@ -205,7 +205,7 @@ class _Router:
                         self.app_name, self.deployment_name,
                         self._router_id, self._waiting,
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RT012 — telemetry: a lost sample reads as stale demand
                     pass
                 try:
                     await self._refresh_once(self.version, 1.0)
@@ -224,7 +224,7 @@ class _Router:
                     controller.report_handle_queued.remote(  # raylint: disable=RT003
                         self.app_name, self.deployment_name, self._router_id, 0
                     )
-                except Exception:
+                except Exception:  # raylint: disable=RT012 — racing shutdown: stale reports expire server-side
                     pass
 
     # -------------------------------------------------------------- routing

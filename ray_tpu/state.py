@@ -395,6 +395,29 @@ def list_task_latency() -> dict[str, dict]:
     return out
 
 
+def list_chaos_events(limit: int = 10000, log_dir: str | None = None) -> list[dict]:
+    """Faults fired by the chaos subsystem (devtools/chaos), merged
+    across every armed process on this host — each controller appends a
+    JSON line per fired fault (point, rule index, action, pid, ts, ctx)
+    to its file under the chaos log dir, plus killer strikes
+    (``killer.raylet`` / ``killer.worker``). Works without a cluster
+    connection (post-run forensics: ``ray_tpu chaos events``); returns
+    ``[]`` when chaos never armed."""
+    from ray_tpu.devtools import chaos
+    from ray_tpu.devtools.chaos.cli import read_events
+
+    events = read_events(log_dir or chaos.default_log_dir())
+    ctrl = chaos.get_controller()
+    if ctrl is not None:
+        # an unwritable log dir must not hide the in-process events
+        seen = {(e.get("pid"), e.get("n")) for e in events}
+        events.extend(e for e in list(ctrl.events)
+                      if (e.get("pid"), e.get("n")) not in seen)
+        events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0),
+                                   e.get("n", 0)))
+    return events[-limit:]
+
+
 def list_worker_deaths(limit: int = 100) -> list[dict]:
     """Postmortem reports the raylet writes when a worker process dies:
     pid, exit code/signal, lease/actor context, and the victim's last-N

@@ -10,6 +10,7 @@ max_failures_per_trial.
 """
 from __future__ import annotations
 
+import logging
 import json
 import os
 import time
@@ -22,6 +23,8 @@ import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.tune import schedulers as sched_mod
 from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP
+
+_log = logging.getLogger(__name__)
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -162,7 +165,7 @@ class TuneController:
             try:
                 cb.on_experiment_end()
             except Exception:
-                pass
+                _log.debug("callback on_experiment_end failed", exc_info=True)
         return self.trials
 
     def _maybe_suggest(self):
@@ -228,7 +231,7 @@ class TuneController:
             try:
                 cb.on_trial_start(trial.trial_id, trial.config)
             except Exception:
-                pass
+                _log.debug("callback on_trial_start failed", exc_info=True)
 
     def _poll_running(self, running: list[Trial]):
         # submit every poll before retrieving any so trials answer
@@ -252,7 +255,8 @@ class TuneController:
                     try:
                         cb.on_trial_result(trial.trial_id, metrics)
                     except Exception:
-                        pass
+                        _log.debug("callback on_trial_result failed",
+                                   exc_info=True)
                 if ckpt_path:
                     trial.checkpoint_path = ckpt_path
                 decision = self.scheduler.on_result(trial.trial_id, metrics)
@@ -283,14 +287,14 @@ class TuneController:
             try:
                 cb.on_trial_complete(trial.trial_id, trial.metrics or None)
             except Exception:
-                pass
+                _log.debug("callback on_trial_complete failed", exc_info=True)
         self._teardown(trial)
 
     def _stop_trial(self, trial: Trial):
         """Scheduler early-stop: ask the trainable to raise at next report."""
         try:
             ray_tpu.get(trial.actor.request_stop.remote(), timeout=10)
-        except Exception:
+        except Exception:  # raylint: disable=RT012 — actor may already be dead; teardown below reaps it
             pass
         trial.status = STOPPED
         self.scheduler.on_trial_complete(trial.trial_id, trial.metrics or None)
@@ -301,7 +305,7 @@ class TuneController:
             try:
                 cb.on_trial_complete(trial.trial_id, trial.metrics or None)
             except Exception:
-                pass
+                _log.debug("callback on_trial_complete failed", exc_info=True)
         self._teardown(trial)
 
     def _exploit_trial(self, trial: Trial):
@@ -314,7 +318,7 @@ class TuneController:
             return  # nothing to clone yet: keep training
         try:
             ray_tpu.get(trial.actor.request_stop.remote(), timeout=10)
-        except Exception:
+        except Exception:  # raylint: disable=RT012 — actor may already be dead; teardown below reaps it
             pass
         self._teardown(trial)
         trial.config = self.scheduler.explore(dict(donor.config))
@@ -337,19 +341,20 @@ class TuneController:
                 try:
                     cb.on_trial_complete(trial.trial_id, None)
                 except Exception:
-                    pass
+                    _log.debug("callback on_trial_complete failed",
+                               exc_info=True)
 
     def _teardown(self, trial: Trial):
         if trial.actor is not None:
             try:
                 ray_tpu.kill(trial.actor)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — teardown: actor may already be dead
                 pass
             trial.actor = None
         if trial.pg is not None:
             try:
                 ray_tpu.remove_placement_group(trial.pg)
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — teardown: PG may already be gone
                 pass
             trial.pg = None
 

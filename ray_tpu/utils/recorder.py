@@ -54,12 +54,15 @@ COMPLETION_PUSH = 7   # worker: reply batch pushed (arg0=records)
 DRIVER_APPLY = 8      # driver: reply applied to the memory store
 W_TASK = 9            # worker compact record: ring/deser/exec deltas, t=exec end
 SAMPLE = 10           # driver compact record: full per-task stage breakdown
+CHAOS = 11            # chaos fault fired (devtools/chaos): id slot carries
+#                       the point name, args (rule, action code, fault seq)
 
 STAGE_NAMES = {
     SUBMIT: "submit", RING_PUSH: "ring_push", WORKER_POP: "worker_pop",
     DESERIALIZE: "deserialize", EXEC_START: "exec_start",
     EXEC_END: "exec_end", COMPLETION_PUSH: "completion_push",
     DRIVER_APPLY: "driver_apply", W_TASK: "w_task", SAMPLE: "sample",
+    CHAOS: "chaos",
 }
 
 # Reported latency stages (SAMPLE args, ns): both ring hops are covered —

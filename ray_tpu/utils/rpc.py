@@ -12,12 +12,47 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import pickle
 import struct
 import threading
 from typing import Any, Awaitable, Callable
 
+from ray_tpu.devtools import chaos
+
+logger = logging.getLogger(__name__)
+
 _LEN = struct.Struct("<Q")
+
+
+def _chaos_frame(msg: Any, data: bytes):
+    """"rpc.send" fault-point verdict for one outbound frame: the
+    (possibly corrupted) bytes to write, None to drop the frame on the
+    floor, or ``(data, data)`` when the frame must be written twice
+    (duplicate). delay sleeps in place (the transport thread stalls —
+    a slow/frozen peer link); an `error` action surfaces as
+    ConnectionLost, the exact exception a dead transport raises, so the
+    injected fault travels the same recovery paths the real one does."""
+    try:
+        # corruption targets the pickled body, not the length prefix: a
+        # mangled prefix would desync the stream into a silent hang,
+        # while a mangled body surfaces as a deserialization fault the
+        # peer's read loop actually handles
+        act = chaos.point(
+            "rpc.send", data[_LEN.size:],
+            method=msg.get("m") if isinstance(msg, dict) else None,
+            kind=msg.get("k") if isinstance(msg, dict) else None)
+    except chaos.ChaosError as e:
+        raise ConnectionLost(f"chaos: {e}") from e
+    if act is None:
+        return data
+    if act.kind == "drop":
+        return None
+    if act.kind == "corrupt" and act.payload is not None:
+        return data[:_LEN.size] + act.payload
+    if act.kind == "duplicate":
+        return (data, data)
+    return data
 
 
 def _resolve_multi(pending: dict, items: list):
@@ -115,7 +150,16 @@ class Connection:
         """Write a frame without awaiting backpressure (transport buffers)."""
         if self._closed:
             raise ConnectionLost("connection closed")
-        self.writer.write(frame_bytes(msg))
+        data = frame_bytes(msg)
+        if chaos.ENABLED:
+            data = _chaos_frame(msg, data)
+            if data is None:
+                return  # dropped: the peer never sees this frame
+            if isinstance(data, tuple):  # duplicated
+                for d in data:
+                    self.writer.write(d)
+                return
+        self.writer.write(data)
 
     async def send(self, msg: dict):
         self.send_nowait(msg)
@@ -230,6 +274,21 @@ class LoopbackConnection:
     def send_nowait(self, msg: dict):
         if self._closed or self.peer is None:
             raise ConnectionLost("connection closed")
+        if chaos.ENABLED:
+            # loopback is still "rpc.send": head-mode in-process clusters
+            # must see the same drop/duplicate/delay/error faults the
+            # wire path does (corrupt has no byte frame here: log-only);
+            # error surfaces as ConnectionLost exactly like the wire path
+            try:
+                act = chaos.point("rpc.send", method=msg.get("m"),
+                                  kind=msg.get("k"))
+            except chaos.ChaosError as e:
+                raise ConnectionLost(f"chaos: {e}") from e
+            if act is not None:
+                if act.kind == "drop":
+                    return
+                if act.kind == "duplicate":
+                    self.peer._deliver(msg)
         self.peer._deliver(msg)
 
     async def send(self, msg: dict):
@@ -272,7 +331,8 @@ class LoopbackConnection:
                     try:
                         srv.on_disconnect(peer)
                     except Exception:
-                        pass
+                        logger.debug("on_disconnect hook failed",
+                                     exc_info=True)
 
 
 # (host, port) -> (RpcServer, loop) for servers in this process; lets
@@ -402,10 +462,10 @@ class RpcServer:
                 try:
                     self.on_disconnect(conn)
                 except Exception:
-                    pass
+                    logger.debug("on_disconnect hook failed", exc_info=True)
             try:
                 writer.close()
-            except Exception:
+            except OSError:
                 pass
 
     async def _dispatch(self, conn: Connection, msg: dict):
@@ -429,8 +489,8 @@ class RpcServer:
         except Exception as e:
             try:
                 await conn.respond(msg["i"], error=e)
-            except Exception:
-                pass
+            except (ConnectionLost, OSError):
+                pass  # caller hung up: nobody is owed this error
 
     async def stop(self):
         _LOCAL_SERVERS.pop((self._host, self._port), None)
@@ -563,7 +623,14 @@ class MuxConnection:
     def send_nowait(self, msg: dict):
         if self._closed:
             raise ConnectionLost("connection closed")
-        st = self._server._mux_send(self.conn_id, frame_bytes(msg))
+        data = frame_bytes(msg)
+        if chaos.ENABLED:
+            data = _chaos_frame(msg, data)
+            if data is None:
+                return
+            if isinstance(data, tuple):
+                data = b"".join(data)  # one mux write, both frames
+        st = self._server._mux_send(self.conn_id, data)
         if st != 0:
             # a conn we can no longer reply on is DEAD, not just muted:
             # close the socket so the peer observes the disconnect instead
@@ -714,7 +781,8 @@ class NativeRpcServer(RpcServer):
                     try:
                         self.on_disconnect(conn)
                     except Exception:
-                        pass
+                        logger.debug("on_disconnect hook failed",
+                                     exc_info=True)
                 self._lib.rt_mux_release(self._mux, conn_id)
                 continue
             try:
@@ -744,8 +812,8 @@ class NativeRpcServer(RpcServer):
         if self._loop is not None and self._efd >= 0:
             try:
                 self._loop.remove_reader(self._efd)
-            except Exception:
-                pass
+            except (OSError, ValueError, RuntimeError):
+                pass  # loop already closed / fd already unregistered
         for conn in list(self._conns):
             if isinstance(conn, LoopbackConnection):
                 conn._closed = True
@@ -784,5 +852,6 @@ def make_server(host: str = "127.0.0.1", port: int = 0) -> RpcServer:
             _native.get_lib()  # force the build before committing to it
             return NativeRpcServer(host, port)
         except Exception:
-            pass
+            logger.debug("native mux unavailable; asyncio transport",
+                         exc_info=True)
     return RpcServer(host, port)

@@ -72,7 +72,8 @@ def _installed_prefixes() -> tuple:
 
             prefs.update(site.getsitepackages())
             prefs.add(site.getusersitepackages())
-        except Exception:  # pragma: no cover - site can be absent (embedded)
+        except (ImportError, AttributeError,  # pragma: no cover
+                OSError):  # site can be absent (embedded interpreters)
             pass
         # trailing sep so /usr/lib/python3.12 doesn't match .../python3.12-foo
         _INSTALLED_PREFIXES = tuple(
@@ -108,7 +109,7 @@ def _register_by_value(modname) -> None:
     if m is not None and module_ships_by_value(modname):
         try:
             cloudpickle.register_pickle_by_value(m)
-        except Exception:
+        except Exception:  # raylint: disable=RT012 — best-effort hint; pickling falls back by-reference
             pass
     _BY_VALUE_REGISTERED.add(root)
 
@@ -264,7 +265,7 @@ def _copy_buffer(dest: memoryview, start: int, mv: memoryview) -> None:
             s = np.frombuffer(mv, dtype=np.uint8)
             lib.rt_copy_nt(d.ctypes.data, s.ctypes.data, n)
             return
-        except Exception:
+        except (ImportError, OSError, AttributeError):
             pass  # no native lib (client mode): plain slice copy
     dest[start:start + n] = mv
 

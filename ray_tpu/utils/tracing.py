@@ -112,7 +112,7 @@ class span:
         if self._otel is not None:
             try:
                 self._otel.end()
-            except Exception:
+            except Exception:  # raylint: disable=RT012 — optional exporter must never break user code
                 pass
         self.sink({
             "trace_id": self.trace_id,

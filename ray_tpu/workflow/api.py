@@ -19,11 +19,14 @@ task_executor.py + workflow_storage.py). Design:
 """
 from __future__ import annotations
 
+import logging
 import json
 import os
 import time
 import uuid
 from typing import Any, Callable
+
+_log = logging.getLogger(__name__)
 
 try:
     import cloudpickle
@@ -268,7 +271,8 @@ def _cleanup_event_keys(listener_cls, workflow_id: str, node: StepNode) -> None:
             core._run_sync(core.gcs.call(
                 "kv_del", {"ns": listener_cls.NS, "key": marker}))
     except Exception:
-        pass  # a failed delete only leaves a stale blob behind
+        # a failed delete only leaves a stale blob behind
+        _log.debug("workflow event cleanup failed", exc_info=True)
 
 
 def _run_to_completion(storage: _Storage, dag: StepNode) -> Any:

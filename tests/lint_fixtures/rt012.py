@@ -1,0 +1,85 @@
+"""RT012 fixture: silent except-all swallows vs. acceptable handlers."""
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def silent_swallow(path):
+    try:
+        os.unlink(path)
+    except Exception:  # expect: RT012
+        pass
+
+
+def bare_except_swallow(fn):
+    try:
+        fn()
+    except:  # noqa: E722  # expect: RT012
+        pass
+
+
+def base_exception_swallow(fn):
+    try:
+        fn()
+    except BaseException:  # expect: RT012
+        pass
+
+
+def trailing_comment_is_still_silent(fn):
+    # a comment is invisible at runtime: the fault still vanishes
+    try:
+        fn()
+    except Exception:  # expect: RT012
+        pass  # deliberately ignored
+
+
+def narrowed_is_clean(path):
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+
+
+def narrowed_tuple_is_clean(path):
+    try:
+        os.unlink(path)
+    except (OSError, ValueError):
+        pass
+
+
+def logged_is_clean(fn):
+    try:
+        fn()
+    except Exception:
+        log.debug("fn failed", exc_info=True)
+
+
+def reraised_is_clean(fn):
+    try:
+        fn()
+    except Exception:
+        raise
+
+
+def handled_is_clean(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def multi_handler_mixed(fn):
+    try:
+        fn()
+    except ValueError:
+        pass
+    except Exception:  # expect: RT012
+        pass
+
+
+def suppressed_with_reason(fn):
+    try:
+        fn()
+    except Exception:  # raylint: disable=RT012 — teardown best-effort
+        pass

@@ -1,0 +1,473 @@
+"""Chaos subsystem tests (devtools/chaos): deterministic fault injection.
+
+Covers the tentpole surface: seeded determinism (same plan seed ⇒
+byte-identical fault log), every action type at a Python fault point,
+the native ring/store fault arms, process-kill schedules driving a real
+workload to completion through retries, flight-recorder traces of fired
+faults, and the disabled-mode zero-overhead contract (the acceptance
+bar: a disarmed fault point must cost < 0.5µs)."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from ray_tpu.devtools import chaos
+from ray_tpu.devtools.chaos import ChaosError, ChaosPlan
+from ray_tpu.devtools.chaos.plan import ChaosRule
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.disable()
+
+
+def _drive(plan, n=32, log_dir=None):
+    """Run a fixed point-call sequence against a fresh controller;
+    returns (outcomes, signature)."""
+    ctrl = chaos.enable(plan, log_dir=log_dir)
+    outs = []
+    for i in range(n):
+        try:
+            act = chaos.point("t.a", b"payload-%d" % i, i=i)
+            outs.append(act.kind if act else None)
+        except ChaosError:
+            outs.append("error")
+    sig = ctrl.signature()
+    chaos.disable()
+    return outs, sig
+
+
+# ------------------------------------------------------------- determinism
+def test_same_seed_identical_fault_log():
+    plan = ChaosPlan(seed=1234, rules=[
+        {"point": "t.a", "action": "drop", "prob": 0.25},
+        {"point": "t.*", "action": "error", "prob": 0.2},
+        {"point": "t.a", "action": "duplicate", "every": 7},
+    ])
+    outs1, sig1 = _drive(plan)
+    outs2, sig2 = _drive(plan)
+    assert outs1 == outs2
+    assert sig1 == sig2
+    assert any(o for o in outs1), "plan never fired — test proves nothing"
+
+
+def test_different_seed_different_schedule():
+    mk = lambda seed: ChaosPlan(seed=seed, rules=[  # noqa: E731
+        {"point": "t.a", "action": "drop", "prob": 0.5}])
+    _, sig1 = _drive(mk(1))
+    _, sig2 = _drive(mk(2))
+    assert sig1 != sig2
+
+
+def test_rule_timing_fields():
+    """after/every/max_fires gate eligible calls exactly."""
+    plan = ChaosPlan(seed=0, rules=[
+        {"point": "p", "action": "drop", "after": 3, "every": 2,
+         "max_fires": 2}])
+    ctrl = chaos.enable(plan)
+    fired_at = [i for i in range(12)
+                if chaos.point("p") is not None]
+    # eligible calls 4..: every 2nd of the post-`after` stream, max 2
+    assert fired_at == [4, 6]
+    assert len(ctrl.signature()) == 2
+
+
+# ------------------------------------------------------------ action types
+def test_action_delay_sleeps():
+    plan = ChaosPlan(seed=0, rules=[
+        {"point": "d", "action": "delay", "delay_ms": 30.0}])
+    chaos.enable(plan)
+    t0 = time.perf_counter()
+    assert chaos.point("d") is None  # delay handled inside
+    assert time.perf_counter() - t0 >= 0.025
+
+
+def test_action_drop_and_duplicate():
+    plan = ChaosPlan(seed=0, rules=[
+        {"point": "x", "action": "drop", "match": {"op": "a"}},
+        {"point": "x", "action": "duplicate", "match": {"op": "b"}}])
+    chaos.enable(plan)
+    assert chaos.point("x", op="a").kind == "drop"
+    assert chaos.point("x", op="b").kind == "duplicate"
+    assert chaos.point("x", op="c") is None  # match filter holds
+
+
+def test_action_error_raises():
+    chaos.enable(ChaosPlan(seed=0, rules=[{"point": "e", "action": "error"}]))
+    with pytest.raises(ChaosError):
+        chaos.point("e")
+
+
+def test_action_corrupt_flips_one_seeded_byte():
+    plan = ChaosPlan(seed=9, rules=[{"point": "c", "action": "corrupt"}])
+    chaos.enable(plan)
+    a1 = chaos.point("c", b"hello world")
+    chaos.disable()
+    chaos.enable(plan)
+    a2 = chaos.point("c", b"hello world")
+    assert a1.kind == a2.kind == "corrupt"
+    assert a1.payload == a2.payload  # seeded flip site
+    diff = [i for i, (x, y) in enumerate(zip(a1.payload, b"hello world"))
+            if x != y]
+    assert len(diff) == 1
+
+
+def test_action_kill_dies_with_flushed_log(tmp_path):
+    """kill SIGKILLs the process AFTER fsyncing its event log: the fault
+    that explains the death must survive the death."""
+    log_dir = str(tmp_path / "chaos")
+    child = (
+        "import json\n"
+        "from ray_tpu.devtools import chaos\n"
+        "plan = chaos.ChaosPlan(seed=0, rules=[\n"
+        "    {'point': 'k', 'action': 'kill', 'after': 2}])\n"
+        f"chaos.enable(plan, log_dir={log_dir!r})\n"
+        "for _ in range(10):\n"
+        "    chaos.point('k')\n"
+        "print('SURVIVED')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -9, (proc.returncode, proc.stderr[-500:])
+    assert "SURVIVED" not in proc.stdout
+    from ray_tpu.devtools.chaos.cli import read_events
+
+    events = read_events(log_dir)
+    assert [e["action"] for e in events] == ["kill"]
+    assert events[0]["point"] == "k"
+
+
+# ---------------------------------------------------------- native arms
+def test_native_ring_partial_push_arm():
+    from ray_tpu.core import fastpath
+
+    rp = fastpath.RingPair.create(f"/rt_chaos_t_{os.getpid()}", 1 << 16)
+    try:
+        chaos.arm_native(ring_partial_every=1)
+        framed = fastpath.frame([b"a" * 64, b"b" * 64, b"c" * 64])
+        n = rp.push_batch(fastpath.SUB, framed)
+        assert 0 < n < len(framed), "partial-push arm did not engage"
+        chaos.arm_native()  # disarm
+        n2 = rp.push_batch(fastpath.SUB, framed[n:])
+        assert n2 == len(framed) - n
+        assert len(rp.pop_batch(fastpath.SUB, 1000)) == 3
+    finally:
+        chaos.arm_native()
+        rp.close_pair()
+
+
+def test_native_ring_wait_timeout_arm():
+    from ray_tpu.core import fastpath
+
+    rp = fastpath.RingPair.create(f"/rt_chaos_w_{os.getpid()}", 1 << 16)
+    try:
+        chaos.arm_native(ring_timeout_every=1)
+        assert rp.push(fastpath.SUB, b"x", timeout_ms=5000) == \
+            fastpath._ST_TIMEOUT
+        assert rp.pop_batch(fastpath.SUB, timeout_ms=5000) == []
+        chaos.arm_native()
+        assert rp.push(fastpath.SUB, b"x", timeout_ms=1000) == 0
+    finally:
+        chaos.arm_native()
+        rp.close_pair()
+
+
+def test_native_store_seal_failure_arm():
+    from ray_tpu.core.object_store import ObjectStoreError, SharedObjectStore
+    from ray_tpu.utils.ids import ObjectID
+
+    store = SharedObjectStore(f"rt_chaos_s_{os.getpid()}",
+                              capacity=8 << 20, create=True)
+    try:
+        chaos.arm_native(store_seal_fail_every=1)
+        oid = ObjectID.generate()
+        store.create(oid, 16)
+        with pytest.raises(ObjectStoreError):
+            store.seal(oid)
+        chaos.arm_native()
+        store.seal(oid)  # entry stayed kCreated: the retry lands
+        assert store.contains(oid)
+    finally:
+        chaos.arm_native()
+        store.destroy()
+
+
+# --------------------------------------------------- python fault points
+def test_ring_push_point_drop_maps_to_ring_full():
+    from ray_tpu.core import fastpath
+
+    rp = fastpath.RingPair.create(f"/rt_chaos_p_{os.getpid()}", 1 << 16)
+    try:
+        chaos.enable(ChaosPlan(seed=0, rules=[
+            {"point": "ring.push", "action": "drop", "every": 2}]))
+        framed = fastpath.frame([b"z" * 32])
+        takes = [rp.push_batch(fastpath.SUB, framed) for _ in range(4)]
+        # every 2nd push reports "nothing fit": the coalesced-flush retry
+        # path sees exactly a full ring
+        assert takes.count(0) == 2 and takes.count(len(framed)) == 2
+    finally:
+        chaos.disable()
+        rp.close_pair()
+
+
+def test_store_seal_point_error_raises_store_error():
+    from ray_tpu.core.object_store import ObjectStoreError, SharedObjectStore
+    from ray_tpu.utils.ids import ObjectID
+
+    store = SharedObjectStore(f"rt_chaos_e_{os.getpid()}",
+                              capacity=8 << 20, create=True)
+    try:
+        chaos.enable(ChaosPlan(seed=0, rules=[
+            {"point": "store.seal", "action": "error"}]))
+        oid = ObjectID.generate()
+        store.create(oid, 16)
+        with pytest.raises(ObjectStoreError):
+            store.seal(oid)
+        chaos.disable()
+        store.seal(oid)
+    finally:
+        chaos.disable()
+        store.destroy()
+
+
+def test_rpc_send_point_corrupt_and_error():
+    """corrupt must return a mangled frame (payload reaches the
+    controller positionally) and error must surface as ConnectionLost —
+    the same exception a dead transport raises, so the narrowed
+    `except (rpc.RpcError, OSError)` recovery paths absorb it."""
+    from ray_tpu.utils import rpc as _rpc
+
+    chaos.enable(ChaosPlan(seed=0, rules=[
+        {"point": "rpc.send", "action": "corrupt", "match": {"method": "a"}},
+        {"point": "rpc.send", "action": "error", "match": {"method": "b"}}]))
+    msg = {"k": "n", "m": "a"}
+    data = _rpc.frame_bytes(msg)
+    out = _rpc._chaos_frame(msg, data)
+    assert out != data and len(out) == len(data)
+    assert sum(1 for x, y in zip(out, data) if x != y) == 1
+    with pytest.raises(_rpc.ConnectionLost):
+        _rpc._chaos_frame({"k": "n", "m": "b"},
+                          _rpc.frame_bytes({"k": "n", "m": "b"}))
+    assert isinstance(_rpc.ConnectionLost("x"), _rpc.RpcError)
+
+
+# ------------------------------------------------- cluster-level schedules
+def test_seeded_exec_faults_deterministic_across_runs(tmp_path):
+    """The acceptance bar's replay property at the workload level: the
+    same seeded error plan over the same sequential task stream fires on
+    the same calls, so the per-task outcome vector is identical across
+    two fresh clusters."""
+    child = r"""
+import json, sys
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote(max_retries=0)
+def t(i):
+    return i
+
+outs = []
+for i in range(12):
+    try:
+        ray_tpu.get(t.remote(i), timeout=60)
+        outs.append(1)
+    except Exception:
+        outs.append(0)
+ray_tpu.shutdown()
+print("OUTS=" + json.dumps(outs))
+"""
+    plan = {"seed": 7, "rules": [
+        {"point": "worker.exec", "action": "error", "every": 4}]}
+    pf = str(tmp_path / "plan.json")
+    with open(pf, "w") as f:
+        json.dump(plan, f)
+    runs = []
+    for r in range(2):
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "RT_CHAOS_ENABLED": "1", "RT_CHAOS_PLAN": pf,
+               "RT_CHAOS_LOG_DIR": str(tmp_path / f"log{r}")}
+        proc = subprocess.run([sys.executable, "-c", child], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("OUTS=")][0]
+        runs.append(json.loads(line[5:]))
+    assert runs[0] == runs[1]
+    assert 0 in runs[0], "plan never fired"
+
+
+def test_kill_plan_workload_completes_with_retries(tmp_path):
+    """kill-process action at worker.exec: the worker dies mid-task, the
+    owner's retry path re-executes, the workload still completes — and
+    the kill event survives in the shared chaos log."""
+    log_dir = str(tmp_path / "chaos")
+    child = r"""
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote(max_retries=4)
+def t(i):
+    return i * 3
+
+assert [ray_tpu.get(t.remote(i), timeout=120) for i in range(8)] == \
+    [i * 3 for i in range(8)]
+ray_tpu.shutdown()
+print("DONE")
+"""
+    plan = {"seed": 3, "rules": [
+        {"point": "worker.exec", "action": "kill", "after": 3,
+         "max_fires": 1}]}
+    pf = str(tmp_path / "plan.json")
+    with open(pf, "w") as f:
+        json.dump(plan, f)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "RT_CHAOS_ENABLED": "1",
+           "RT_CHAOS_PLAN": pf, "RT_CHAOS_LOG_DIR": log_dir}
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "DONE" in proc.stdout
+    from ray_tpu.devtools.chaos.cli import read_events
+
+    kills = [e for e in read_events(log_dir) if e["action"] == "kill"]
+    # schedules are per process (each worker arms its own): every struck
+    # worker logs exactly one kill (max_fires=1) before dying
+    assert kills and all(k["point"] == "worker.exec" for k in kills)
+    assert len(kills) == len({k["pid"] for k in kills})
+
+
+def test_worker_killer_workload_completes():
+    """chaos.killers worker target: SIGKILL live worker processes under
+    a running cluster; retries absorb every loss without losing a node."""
+    import ray_tpu
+    from ray_tpu.core import api as _api
+    from ray_tpu.devtools.chaos.killers import ProcessKiller
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(max_retries=6)
+        def work(i):
+            time.sleep(0.15)
+            return i + 100
+
+        # warm the pool so the killer has victims from the start
+        assert ray_tpu.get(work.remote(0), timeout=60) == 100
+        killer = ProcessKiller(_api._owned_cluster, seed=1,
+                               interval_s=0.8, target="worker")
+        with killer:
+            results = []
+            for wave in range(4):
+                refs = [work.remote(wave * 6 + j) for j in range(6)]
+                results.extend(ray_tpu.get(refs, timeout=180))
+        assert sorted(results) == [i + 100 for i in range(24)]
+        assert killer.kills, "worker killer never struck"
+        assert all(k["target"] == "worker" for k in killer.kills)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ----------------------------------------------------- observability
+def test_fired_faults_land_in_flight_recorder():
+    from ray_tpu.utils import recorder as _rec
+
+    _rec.init_process_recorder(None)
+    chaos.enable(ChaosPlan(seed=0, rules=[
+        {"point": "obs.x", "action": "drop", "every": 2}]))
+    for _ in range(6):
+        chaos.point("obs.x")
+    events = [e for e in _rec.get_recorder().events()
+              if e["stage"] == "chaos"]
+    assert len(events) == 3
+    # id slot carries the point name; args carry (rule, action code, seq)
+    assert bytes.fromhex(events[0]["task_id"]).rstrip(b"\0") == b"obs.x"
+    from ray_tpu.devtools.chaos.controller import ACTION_CODES
+
+    assert events[0]["args"][1] == ACTION_CODES["drop"]
+    assert [e["args"][2] for e in events] == [1, 2, 3]
+
+
+def test_list_chaos_events_merges_logs(tmp_path):
+    log_dir = str(tmp_path / "chaos")
+    chaos.enable(ChaosPlan(seed=0, rules=[
+        {"point": "ev.a", "action": "drop"}]), log_dir=log_dir)
+    chaos.point("ev.a", x=1)
+    chaos.point("ev.a", x=2)
+    from ray_tpu import state
+
+    evs = state.list_chaos_events(log_dir=log_dir)
+    assert [e["ctx"]["x"] for e in evs] == [1, 2]
+    assert all(e["point"] == "ev.a" and e["action"] == "drop" for e in evs)
+
+
+def test_cli_validate_and_run(tmp_path):
+    plan = {"seed": 11, "rules": [{"point": "cli.x", "action": "delay",
+                                   "delay_ms": 1.0}]}
+    pf = str(tmp_path / "plan.json")
+    with open(pf, "w") as f:
+        json.dump(plan, f)
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "chaos", "validate", pf],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0 and "1 rule(s)" in proc.stderr
+
+    log_dir = str(tmp_path / "logs")
+    child = ("from ray_tpu.devtools import chaos; chaos.maybe_arm(); "
+             "[chaos.point('cli.x') for _ in range(3)]; print('ran')")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "chaos", "run",
+         "--log-dir", log_dir, pf, "--", sys.executable, "-c", child],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "ran" in proc.stdout
+    assert "3 fault(s) fired" in proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "chaos", "events",
+         "--log-dir", log_dir],
+        capture_output=True, text=True, timeout=120)
+    events = json.loads(proc.stdout)
+    assert len(events) == 3 and events[0]["point"] == "cli.x"
+
+
+# --------------------------------------------------- disabled-mode cost
+def test_disabled_fault_point_under_half_microsecond():
+    """The acceptance bar: a disarmed fault point (the `if
+    chaos.ENABLED:` gate every hot path pays) must cost < 0.5µs. The
+    real gate is one module-attribute load + falsy branch (~tens of ns);
+    the bound is generous so shared-host noise can't flake it."""
+    assert not chaos.ENABLED
+    N = 200_000
+
+    def gated_loop():
+        n = 0
+        for _ in range(N):
+            if chaos.ENABLED:
+                chaos.point("hot.path")
+            n += 1
+        return n
+
+    gated_loop()  # warm
+    best = min(_timed(gated_loop) for _ in range(5))
+    per_point_us = best / N * 1e6
+
+    def bare_loop():
+        n = 0
+        for _ in range(N):
+            n += 1
+        return n
+
+    bare_loop()
+    base = min(_timed(bare_loop) for _ in range(5))
+    delta_us = max(0.0, (best - base) / N * 1e6)
+    assert per_point_us - base / N * 1e6 < 0.5 or delta_us < 0.5, (
+        f"disabled fault point costs {delta_us:.3f}µs (budget 0.5)")
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0

@@ -307,12 +307,13 @@ def test_chaos_interval_killer_workload_completes():
     ResourceKiller): a 3-node cluster loses a non-head raylet every few
     seconds — hard kill, no goodbyes — while a retryable task workload
     runs to completion. Retries + lease spillback must absorb every
-    loss; replacement nodes keep capacity from draining to zero."""
-    import threading
-
+    loss; replacement nodes keep capacity from draining to zero. The
+    killer is the reusable seeded chaos.killers.IntervalKiller
+    (devtools/chaos): same seed, same cluster shape ⇒ same victims."""
     from ray_tpu.core import api as _api
     from ray_tpu.core.cluster import Cluster
     from ray_tpu.core.core_client import CoreClient
+    from ray_tpu.devtools.chaos.killers import IntervalKiller
     from ray_tpu.utils import rpc as _rpc
 
     io = _rpc.EventLoopThread()
@@ -325,27 +326,8 @@ def test_chaos_interval_killer_workload_completes():
     old = _api._core
     _api._core = core
 
-    stop_chaos = threading.Event()
-    kills = {"n": 0}
-
-    def killer():
-        # kill a random non-head raylet every ~2s, then restore capacity
-        import random
-
-        rng = random.Random(0)
-        while not stop_chaos.wait(2.0):
-            victims = [r for r in cluster.raylets if r is not head]
-            if not victims:
-                continue
-            try:
-                cluster.kill_node(rng.choice(victims))
-                kills["n"] += 1
-                cluster.add_node(num_cpus=4.0)
-            except Exception:
-                pass
-
-    t = threading.Thread(target=killer, daemon=True)
-    t.start()
+    killer = IntervalKiller(cluster, seed=0, interval_s=2.0, restore=True)
+    killer.start()
     try:
         @ray_tpu.remote(max_retries=8, num_cpus=1.0)
         def work(i):
@@ -359,14 +341,15 @@ def test_chaos_interval_killer_workload_completes():
             refs = [work.remote(wave * 8 + j) for j in range(8)]
             results.extend(ray_tpu.get(refs, timeout=300))
         assert sorted(results) == [i * 2 for i in range(48)]
-        assert kills["n"] >= 2, f"chaos never struck (kills={kills['n']})"
+        assert len(killer.kills) >= 2, \
+            f"chaos never struck (kills={len(killer.kills)})"
+        assert all(k["target"] == "raylet" for k in killer.kills)
     finally:
-        stop_chaos.set()
-        t.join(timeout=10)
+        killer.stop()
         _api._core = old
         try:
             io.run(core.close(), timeout=10)
         except Exception:
-            pass
+            pass  # links already torn by the last kill
         cluster.shutdown()
         io.stop()

@@ -143,3 +143,62 @@ def test_ring_stress_ubsan():
     assert "runtime error:" not in out.stderr, out.stderr[:4000]
     assert out.returncode == 0, (out.stdout, out.stderr[:4000])
     assert "failures=0" in out.stdout
+
+
+# ------------------------------------------------------- chaos fault arms
+# The same stress harnesses with the native chaos counters armed through
+# the environment (devtools/chaos): every Nth ring push_batch is forced
+# partial, every Nth push/pop reports a wait timeout, every Nth store
+# seal fails — so the rare-path handling (partial-prefix retries, timeout
+# loops, unsealed-entry churn) runs under load AND under TSAN, where the
+# arm counters themselves must not introduce a data race.
+_CHAOS_ENV = {
+    "RT_CHAOS_RING_PARTIAL_EVERY": "3",
+    "RT_CHAOS_RING_TIMEOUT_EVERY": "7",
+}
+
+
+def test_ring_stress_fault_armed_plain():
+    binary, err = _build_ring([], "ring_stress_plain")
+    assert binary, err
+    out = subprocess.run([binary, f"/rt_ringcf_{os.getpid()}", "2.0"],
+                         env={**os.environ, **_CHAOS_ENV},
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "failures=0" in out.stdout
+
+
+def test_ring_stress_fault_armed_tsan():
+    binary, err = _build_ring(["-fsanitize=thread"], "ring_stress_tsan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=thread: {err[-200:]}")
+    out = subprocess.run([binary, f"/rt_ringct_{os.getpid()}", "2.0"],
+                         env={**os.environ, **_CHAOS_ENV},
+                         capture_output=True, text=True, timeout=300)
+    assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout
+
+
+def test_store_stress_fault_armed_plain():
+    binary, err = _build([], "store_stress_plain")
+    assert binary, err
+    out = subprocess.run(
+        [binary, f"rt_stresscf_{os.getpid()}", "2.0"],
+        env={**os.environ, "RT_CHAOS_STORE_SEAL_FAIL_EVERY": "5"},
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "failures=0" in out.stdout
+
+
+def test_store_stress_fault_armed_tsan():
+    binary, err = _build(["-fsanitize=thread"], "store_stress_tsan")
+    if binary is None:
+        pytest.skip(f"toolchain lacks -fsanitize=thread: {err[-200:]}")
+    out = subprocess.run(
+        [binary, f"rt_tsancf_{os.getpid()}", "2.0"],
+        env={**os.environ, "RT_CHAOS_STORE_SEAL_FAIL_EVERY": "5"},
+        capture_output=True, text=True, timeout=300)
+    assert "WARNING: ThreadSanitizer" not in out.stderr, out.stderr[:4000]
+    assert out.returncode == 0, (out.stdout, out.stderr[:4000])
+    assert "failures=0" in out.stdout

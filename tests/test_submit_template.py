@@ -241,3 +241,89 @@ def test_submit_cpu_budget(rt):
     assert best < SUBMIT_CPU_CEILING_US, (
         f"driver CPU per steady-state submit regressed: "
         f"{best:.0f}us >= {SUBMIT_CPU_CEILING_US}us")
+
+
+def test_custom_retry_budget_rides_fast_lane(rt):
+    """@remote(max_retries=N) no longer disqualifies the fast path; the
+    driver-side lineage tuple carries the USER'S budget so break-lane
+    recovery resubmits with it instead of resetting to the config
+    default (a retry-budget loss the chaos kill schedules exposed)."""
+    from ray_tpu.core import api
+
+    @ray_tpu.remote(max_retries=7)
+    def t6(x):
+        return x + 1
+
+    assert ray_tpu.get(t6.remote(1), timeout=120) == 2
+    tmpl = t6._tmpl
+    assert tmpl is not None and tmpl.fast_ok
+    assert tmpl.max_retries == 7
+    # break-lane recovery (lost=True) charges exactly the one loss that
+    # broke the lane; a NEED_SLOW migration (lost=False) charges nothing
+    core = api.get_core()
+    from ray_tpu.utils.ids import TaskID
+
+    fn = t6._fn
+    captured = []
+    orig = core._fast_light_to_spec
+
+    def capture(task_id, light, budget):
+        spec = orig(task_id, light, budget)
+        captured.append(spec)
+        return spec
+
+    core._fast_light_to_spec = capture
+    orig_submit = core._submit_async
+    core._submit_async = lambda spec: _noop()
+    try:
+        light = (fn, (1,), {}, {"CPU": 1.0}, 7)
+        core._fast_resubmit(TaskID.generate(), light, lost=True)
+        assert captured[-1]["max_retries"] == 6
+        core._fast_resubmit(TaskID.generate(), light, lost=False)
+        assert captured[-1]["max_retries"] == 7
+        # None means the config default, charged one loss
+        core._fast_resubmit(TaskID.generate(),
+                            (fn, (1,), {}, {"CPU": 1.0}, None), lost=True)
+        assert captured[-1]["max_retries"] == \
+            core.cfg.default_max_task_retries - 1
+    finally:
+        core._fast_light_to_spec = orig
+        core._submit_async = orig_submit
+
+
+def test_zero_retry_task_fails_instead_of_reexecuting(rt):
+    """At-most-once: a @remote(max_retries=0) task caught in break-lane
+    recovery (its worker died, side effects may have run) must FAIL with
+    WorkerCrashedError, never silently re-execute."""
+    from ray_tpu.core import api
+    from ray_tpu.core.ref import WorkerCrashedError
+    from ray_tpu.utils.ids import TaskID
+
+    @ray_tpu.remote(max_retries=0)
+    def t7(x):
+        return x
+
+    assert ray_tpu.get(t7.remote(1), timeout=120) == 1
+    assert t7._tmpl is not None and t7._tmpl.fast_ok
+    core = api.get_core()
+    failed = []
+    orig_err = core._complete_task_error
+    core._complete_task_error = lambda spec, err: failed.append((spec, err))
+    orig_submit = core._submit_async
+    core._submit_async = lambda spec: _noop()
+    try:
+        core._fast_resubmit(TaskID.generate(),
+                            (t7._fn, (1,), {}, {"CPU": 1.0}, 0), lost=True)
+        assert len(failed) == 1
+        assert isinstance(failed[0][1], WorkerCrashedError)
+        # a migration of the same task is NOT a loss: it resubmits
+        core._fast_resubmit(TaskID.generate(),
+                            (t7._fn, (1,), {}, {"CPU": 1.0}, 0), lost=False)
+        assert len(failed) == 1
+    finally:
+        core._complete_task_error = orig_err
+        core._submit_async = orig_submit
+
+
+async def _noop():
+    return None
