@@ -381,6 +381,105 @@ def run_chaos_bench(quick: bool) -> dict[str, float]:
     return out
 
 
+# serve data-plane child: a fixed request stream against a 2-replica
+# deployment with the full FT stack enabled (retries, deadlines,
+# hedging) — 8 closed-loop client threads, per-request latency sampled
+# client-side. Run bare for serve_qps/serve_p99_ms; run under the
+# checked-in seeded kill-replicas plan (tests/plans/) for
+# serve_error_rate_chaos — the ROADMAP SLO sentence as a number.
+_SERVE_BENCH_CHILD = r"""
+import concurrent.futures, json, math, sys, time
+import ray_tpu
+from ray_tpu import serve
+
+n_requests = int(sys.argv[1])
+ray_tpu.init(num_cpus=8)
+
+@serve.deployment(num_replicas=2, max_ongoing_requests=16,
+                  max_request_retries=4, request_timeout_s=60.0,
+                  retry_on="*", hedge_after_ms=400.0)
+class Echo:
+    def __call__(self, x):
+        return x * 2
+
+handle = serve.run(Echo.bind(), name="bench")
+for i in range(16):  # warm: routers, replicas, connections
+    ray_tpu.get(handle.remote(i), timeout=60)
+
+THREADS = 8
+per = max(1, n_requests // THREADS)
+
+def closed_loop(k):
+    out = []
+    for i in range(k):
+        t0 = time.perf_counter()
+        try:
+            assert ray_tpu.get(handle.remote(i), timeout=120) == i * 2
+            out.append(time.perf_counter() - t0)
+        except Exception:
+            out.append(None)  # counted as an error
+    return out
+
+t0 = time.perf_counter()
+with concurrent.futures.ThreadPoolExecutor(max_workers=THREADS) as pool:
+    outs = [f.result() for f in
+            [pool.submit(closed_loop, per) for _ in range(THREADS)]]
+wall = time.perf_counter() - t0
+lat = sorted(v for o in outs for v in o if v is not None)
+errs = sum(1 for o in outs for v in o if v is None)
+total = THREADS * per
+# nearest-rank percentile: ceil(0.99n)-1, NOT int(0.99n) (one rank
+# high — degenerates to the max for n <= 100)
+p99_ms = (lat[max(0, math.ceil(len(lat) * 0.99) - 1)] * 1e3
+          if lat else -1.0)
+serve.shutdown()
+ray_tpu.shutdown()
+print("RES=" + json.dumps({"qps": total / wall, "p99_ms": p99_ms,
+                           "error_rate": errs / total}))
+"""
+
+
+def run_serve_bench(quick: bool) -> dict[str, float]:
+    """serve_qps / serve_p99_ms (steady state) + serve_error_rate_chaos
+    (same workload under the seeded kill-replicas-under-load plan)."""
+    import subprocess
+    import tempfile
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out: dict[str, float] = {}
+
+    def arm(n: int, env: dict) -> dict | None:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _SERVE_BENCH_CHILD, str(n)],
+                env=env, capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            print("serve bench arm timed out", file=sys.stderr)
+            return None
+        if proc.returncode != 0:
+            print(f"serve bench arm failed:\n{proc.stderr[-1500:]}",
+                  file=sys.stderr)
+            return None
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("RES=")]
+        return json.loads(line[-1][4:]) if line else None
+
+    n = 240 if quick else 800
+    res = arm(n, {**os.environ, "JAX_PLATFORMS": "cpu"})
+    if res is not None:
+        out["serve_qps"] = res["qps"]
+        out["serve_p99_ms"] = res["p99_ms"]
+
+    plan = os.path.join(root, "tests", "plans", "serve_kill_replicas.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "RT_CHAOS_ENABLED": "1",
+           "RT_CHAOS_PLAN": plan,
+           "RT_CHAOS_LOG_DIR": tempfile.mkdtemp(prefix="rt_servb_")}
+    res = arm(min(n, 480), env)
+    if res is not None:
+        out["serve_error_rate_chaos"] = res["error_rate"]
+    return out
+
+
 def run_micro(window: float) -> dict[str, float]:
     import numpy as np
 
@@ -833,6 +932,10 @@ def write_benchvs(micro: dict, model: dict | None,
             unit = "GB/s"
         elif name.endswith("_us_per_call") or name.endswith("_us"):
             unit = "µs"  # lower is better; no reference counterpart
+        elif name.endswith("_ms"):
+            unit = "ms"  # lower is better; no reference counterpart
+        elif "error_rate" in name:
+            unit = "(error fraction; SLO < 0.01)"
         elif name.endswith("_avg_batch"):
             unit = "recs/flush"
         elif name.endswith("_s"):
@@ -962,6 +1065,17 @@ def write_benchvs(micro: dict, model: dict | None,
         "exec flips a seeded 5% coin on SIGKILLing its worker, seed "
         "42) — worker death, lease re-grant, and task retry all inside "
         "the measured wall.",
+        "",
+        "`serve_qps`/`serve_p99_ms` — the serve data plane under 8 "
+        "closed-loop client threads against a 2-replica deployment with "
+        "the full request-FT stack on (retries, 60s deadline, 400ms "
+        "hedging; README § Serve fault tolerance). "
+        "`serve_error_rate_chaos` is the same workload under the "
+        "checked-in seeded kill-replicas-under-load plan "
+        "(tests/plans/serve_kill_replicas.json: every replica process "
+        "SIGKILLs itself at its 31st request) — the ROADMAP serve SLO "
+        "is error rate < 1% for idempotent traffic, enforced in tier-1 "
+        "by tests/test_serve_ft.py.",
     ]
     if model:
         lines += [
@@ -1053,6 +1167,10 @@ def main():
             micro.update(run_chaos_bench(args.quick))
         except Exception as e:
             print(f"chaos bench failed: {e!r}", file=sys.stderr)
+        try:
+            micro.update(run_serve_bench(args.quick))
+        except Exception as e:
+            print(f"serve bench failed: {e!r}", file=sys.stderr)
     model = None
     if do_model:
         for attempt in range(2):  # the axon tunnel's remote_compile can flake

@@ -22,6 +22,7 @@ import hashlib
 import logging
 import inspect
 import os
+import random
 import sys
 import time
 import traceback
@@ -244,6 +245,11 @@ class CoreClient:
         self._actor_recover_pending: dict[ActorID, set] = {}
         self._conn_seq: dict[rpc.Connection, int] = {}
         self._subscribed_actors: set[ActorID] = set()
+        # actor-death fan-out: callbacks fired (on the loop thread) when a
+        # subscribed actor's pubsub view flips to DEAD — the serve router
+        # and controller evict/replace replicas in ~one raylet reap tick
+        # instead of waiting out a health-check period
+        self._actor_death_listeners: list = []
         self._task_counter = 0
         self._cancelled_tasks: set[TaskID] = set()
         self._task_worker: dict[TaskID, tuple] = {}  # task -> (conn, worker)
@@ -356,6 +362,12 @@ class CoreClient:
         if channel.startswith("actor:"):
             actor_id = ActorID.from_hex(channel.split(":", 1)[1])
             self._actor_info[actor_id] = message
+            if isinstance(message, dict) and message.get("state") == DEAD:
+                for cb in list(self._actor_death_listeners):
+                    try:
+                        cb(actor_id, message)
+                    except Exception:
+                        log.debug("actor death listener failed", exc_info=True)
         elif channel == "node_removed" and isinstance(message, dict):
             # holder died: drop it from every cached location so the next
             # get falls back to the GCS directory (source of truth)
@@ -545,6 +557,41 @@ class CoreClient:
     def _new_owned_ref(self, oid: ObjectID) -> ObjectRef:
         self.on_owned_ref_created(oid)
         return ObjectRef(oid, self.address, _core=self)
+
+    # -------------------------------------------------- death subscriptions
+    def add_actor_death_listener(self, cb) -> None:
+        """Register ``cb(actor_id, info)`` to fire (loop thread) when any
+        actor this client follows transitions to DEAD. Callbacks must be
+        light and non-blocking — they run inline in the pubsub push."""
+        if cb not in self._actor_death_listeners:
+            self._actor_death_listeners.append(cb)
+
+    def remove_actor_death_listener(self, cb) -> None:
+        try:
+            self._actor_death_listeners.remove(cb)
+        except ValueError:
+            pass  # already removed (idempotent teardown)
+
+    # -------------------------------------------------------- promise refs
+    def create_promise_ref(self):
+        """An owned ObjectRef whose value arrives later: returns
+        ``(ref, resolve)`` where ``resolve(value=..., error=...)`` (loop
+        thread only) fulfills it. The serve router's retry loop rides
+        this — the caller holds ONE ordinary ref while attempts replay
+        behind it; ``get``/``wait``/``await`` all work unchanged."""
+        oid = ObjectID.from_random()
+        entry = _MemEntry()
+        self.memory_store[oid] = entry
+        ref = self._new_owned_ref(oid)
+
+        def resolve(value=None, error: Exception | None = None):
+            if error is not None:
+                entry.error = error
+            else:
+                entry.value = value
+            entry.ready.set()
+
+        return ref, resolve
 
     # ----------------------------------------------------------------- put
     def put_value(self, value: Any) -> ObjectRef:
@@ -938,14 +985,20 @@ class CoreClient:
                         return True
                     await asyncio.sleep(0.05)
                 return True
+            park_fails = 0
             while True:  # borrowed: park at the owner
                 try:
                     r = await self._owner_call(
                         ref, "wait_object",
                         {"object_id": ref.id.binary(), "timeout": 30.0}, 40.0,
                     )
+                    park_fails = 0
                 except Exception:
-                    await asyncio.sleep(0.5)
+                    # capped exponential backoff: an owner mid-restart gets
+                    # room to come back instead of a fixed-rate hammer
+                    park_fails += 1
+                    await asyncio.sleep(min(2.0, 0.25 * (2 ** park_fails))
+                                        * (0.5 + random.random()))
                     continue
                 if r.get("ready"):
                     if fetch_local and r.get("error") is None:
@@ -3325,12 +3378,14 @@ class CoreClient:
         if not replay:
             return
         info = None
-        for _ in range(3):  # ride out a transient GCS blip
+        for i in range(3):  # ride out a transient GCS blip
             try:
                 info = await self._refresh_actor(actor_id)
                 break
             except Exception:
-                await asyncio.sleep(0.2)
+                # exponential backoff: a GCS mid-failover gets room to
+                # come back instead of three probes in 600ms (RT013)
+                await asyncio.sleep(0.1 * (1 << i) * (0.5 + random.random()))
         alive = info and info.get("state") in (
             ALIVE, "RESTARTING", "PENDING_CREATION"
         )
@@ -3363,6 +3418,7 @@ class CoreClient:
             return conn
         info = self._actor_info.get(actor_id)
         deadline = time.monotonic() + self.cfg.worker_start_timeout_s
+        stale_hits = 0
         while True:
             while True:
                 if info is not None:
@@ -3389,10 +3445,25 @@ class CoreClient:
                 # address.
                 if time.monotonic() > deadline:
                     raise ActorError(f"actor {actor_id} not reachable in time")
-                await asyncio.sleep(0.1)
+                # backoff: the restarted actor needs GCS registration +
+                # bind time, and every caller of this actor retries here
+                stale_hits += 1
+                await asyncio.sleep(min(1.0, 0.1 * (2 ** (stale_hits - 1)))
+                                    * (0.5 + random.random()))
                 self._actor_info.pop(actor_id, None)
                 info = None
         self._actor_conns[actor_id] = conn
+        if actor_id not in self._subscribed_actors:
+            # death subscription for every actor we talk to: the wait loop
+            # above only subscribes when the first info lookup missed, but
+            # fast eviction (actor-death listeners) needs the DEAD push
+            # even for actors that resolved ALIVE immediately
+            self._subscribed_actors.add(actor_id)
+            try:
+                await self.gcs.call(
+                    "subscribe", {"channel": f"actor:{actor_id.hex()}"})
+            except (rpc.RpcError, OSError):
+                self._subscribed_actors.discard(actor_id)  # retry next connect
         if (self.cfg.fastpath_enabled and self.store is not None
                 and not self.cfg.tracing_enabled):
             self._bg.spawn(self._fast_actor_attach(actor_id, conn), self.loop)

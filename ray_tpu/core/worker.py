@@ -1639,8 +1639,14 @@ class Worker:
         return {"pid": os.getpid(), "actor": self.actor_id}
 
 
-def _as_task_error(e: Exception) -> TaskError:
+def _as_task_error(e: Exception) -> Exception:
     if isinstance(e, TaskError):
+        return e
+    if getattr(e, "_rt_error_passthrough", False):
+        # typed-error contract (serve/exceptions.py): the class promises
+        # to be importable + picklable everywhere, so it ships as-is and
+        # callers can dispatch on the type (retry classification, proxy
+        # status mapping) instead of parsing a flattened message
         return e
     tb = traceback.format_exc()
     return TaskError(f"{type(e).__name__}: {e}", cause_repr=repr(e), traceback_str=tb)

@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT012: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT013: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -328,6 +328,50 @@ class SilentExceptionSwallow(Rule):
     def _only_pass(self, handler: ast.ExceptHandler) -> bool:
         return (len(handler.body) == 1
                 and isinstance(handler.body[0], ast.Pass))
+
+
+@register
+class ConstantSleepRetryLoop(Rule):
+    id = "RT013"
+    summary = "retry loop sleeps a constant with no backoff/jitter"
+    rationale = ("a loop that catches a failure and sleeps a fixed "
+                 "literal hammers the struggling dependency at a fixed "
+                 "cadence: every caller retries in lockstep (synchronized "
+                 "herd) and the interval never widens to let the fault "
+                 "clear; compute the delay from the attempt number "
+                 "(exponential backoff) and jitter it")
+
+    _SLEEPS = {("time", "sleep"), ("asyncio", "sleep")}
+
+    def on_try(self, node, ctx: Context):
+        # fires on the canonical retry shape: a try INSIDE a loop whose
+        # except handler sleeps a literal constant. Sleeps on the loop's
+        # normal path (polling) are deliberate pacing, not retry backoff,
+        # and stay clean.
+        if not ctx.loop_depth:
+            return
+        for handler in node.handlers:
+            seen: set[int] = set()  # an awaited sleep walks as Await AND Call
+            for stmt in handler.body:
+                for sub in ast.walk(stmt):
+                    call = sub.value if isinstance(sub, ast.Await) else sub
+                    if id(call) in seen:
+                        continue
+                    seen.add(id(call))
+                    if (isinstance(call, ast.Call)
+                            and ctx.imports.resolve(call.func) in self._SLEEPS
+                            and call.args
+                            and isinstance(call.args[0], ast.Constant)
+                            and isinstance(call.args[0].value, (int, float))):
+                        ctx.report(self, call,
+                                   "retry loop sleeps a constant "
+                                   f"{call.args[0].value!r}s on failure; "
+                                   "derive the delay from the attempt "
+                                   "number (exponential backoff) and add "
+                                   "jitter so retries neither hammer nor "
+                                   "synchronize")
+
+    on_trystar = on_try
 
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram"}

@@ -27,7 +27,14 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
 from ray_tpu.serve.deployment import Application, Deployment, build_specs, deployment
-from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+from ray_tpu.serve.exceptions import (
+    BackPressureError,
+    RayServeException,
+    ReplicaUnavailableError,
+    RequestCancelledError,
+    RequestTimeoutError,
+)
+from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.schema import (
     DeploymentSchema,
@@ -40,10 +47,14 @@ from ray_tpu.serve.schema import (
 __all__ = [
     "AutoscalingConfig",
     "Application",
+    "BackPressureError",
     "Deployment",
     "DeploymentConfig",
     "DeploymentHandle",
     "DeploymentSchema",
+    "ReplicaUnavailableError",
+    "RequestCancelledError",
+    "RequestTimeoutError",
     "ServeApplicationSchema",
     "ServeDeploySchema",
     "build_config",

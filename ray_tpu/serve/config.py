@@ -36,7 +36,36 @@ class AutoscalingConfig:
 
 @dataclasses.dataclass
 class DeploymentConfig:
-    """Per-deployment behavior (ref: serve/config.py DeploymentConfig)."""
+    """Per-deployment behavior (ref: serve/config.py DeploymentConfig).
+
+    Request fault tolerance (the router/replica contract, see README
+    § Serve fault tolerance):
+
+    - ``max_request_retries``: per-request replay budget. Routing-time
+      failures (backpressure, replica unreachable before dispatch) are
+      always retryable; failures AFTER dispatch (replica died
+      mid-request) replay only for methods the ``retry_on`` gate marks
+      idempotent — a non-idempotent method effectively gets 0 retries
+      for ambiguous failures.
+    - ``request_timeout_s``: total per-request deadline, stamped by the
+      handle and propagated to the replica (which sheds expired work
+      instead of executing it) and into composed handle calls (nested
+      deployments inherit the remaining budget). None = unbounded.
+    - ``retry_on``: method names whose execution is idempotent and may
+      be replayed/hedged; ``"*"`` marks every method.
+    - ``hedge_after_ms``: tail-latency hedging (Dean & Barroso, The
+      Tail at Scale) — after this many ms without a reply, send a
+      second copy to a different replica and take the first result,
+      cancelling the loser. 0 disables; only ``retry_on`` methods
+      hedge. Recommended value: the deployment's p99 from the flight
+      recorder's stage latencies (``state.list_task_latency()``).
+    - ``max_queued_requests``: per-replica admission cap — beyond
+      ``max_ongoing_requests`` executing plus this many queued, the
+      replica refuses with ``BackPressureError`` (HTTP 429 /
+      gRPC RESOURCE_EXHAUSTED at the proxies). The router applies the
+      same cap to requests parked waiting for membership. -1 =
+      unbounded.
+    """
 
     num_replicas: int = 1
     max_ongoing_requests: int = 8  # per-replica concurrency cap
@@ -46,6 +75,37 @@ class DeploymentConfig:
     health_check_timeout_s: float = 10.0
     graceful_shutdown_timeout_s: float = 5.0
     ray_actor_options: dict = dataclasses.field(default_factory=dict)
+    # --- request fault tolerance ---
+    max_request_retries: int = 3
+    request_timeout_s: float | None = None
+    retry_on: tuple = ()
+    hedge_after_ms: float = 0.0
+    max_queued_requests: int = -1
+
+    def __post_init__(self):
+        if self.max_request_retries < 0:
+            raise ValueError("max_request_retries must be >= 0")
+        if self.request_timeout_s is not None and self.request_timeout_s <= 0:
+            raise ValueError("request_timeout_s must be > 0 (None = unbounded)")
+        if self.hedge_after_ms < 0:
+            raise ValueError("hedge_after_ms must be >= 0 (0 = off)")
+        if self.max_queued_requests < -1:
+            raise ValueError("max_queued_requests must be >= -1")
+        if isinstance(self.retry_on, str):
+            self.retry_on = (self.retry_on,)
+        else:
+            self.retry_on = tuple(self.retry_on)
+
+    def request_ft(self) -> dict:
+        """The router-side slice of this config, shipped with routing
+        info so handles pick up FT policy without a second RPC."""
+        return {
+            "max_request_retries": self.max_request_retries,
+            "request_timeout_s": self.request_timeout_s,
+            "retry_on": self.retry_on,
+            "hedge_after_ms": self.hedge_after_ms,
+            "max_queued_requests": self.max_queued_requests,
+        }
 
     def initial_replicas(self) -> int:
         if self.autoscaling_config is not None:

@@ -59,6 +59,26 @@ class ServeController:
             self._changed = asyncio.Condition()
         if self._loop_task is None:
             self._loop_task = asyncio.get_running_loop().create_task(self._reconcile_loop())
+            # eager replacement: the actor-death pubsub flips a replica
+            # out of the routing table the moment the raylet reaps it —
+            # no waiting for two failed health probes — and the next
+            # reconcile tick (≤0.1s) starts the replacement
+            from ray_tpu.core.api import get_core
+
+            get_core().add_actor_death_listener(self._on_replica_death)
+
+    def _on_replica_death(self, actor_id, info):
+        """Pubsub callback (loop thread): drop the dead replica and wake
+        routers immediately (ref: deployment_state replica-death handling,
+        but push-driven instead of probe-driven)."""
+        for st in self._deployments.values():
+            for rid, rec in list(st.replicas.items()):
+                h = rec.get("handle")
+                if h is not None and getattr(h, "actor_id", None) == actor_id:
+                    st.replicas.pop(rid, None)
+                    st.metrics.pop(rid, None)
+                    asyncio.get_running_loop().create_task(self._bump_version())
+                    return
 
     async def _bump_version(self):
         self._version += 1
@@ -173,13 +193,19 @@ class ServeController:
                     pass
         st = self._deployments.get(f"{app_name}/{name}")
         replicas = []
+        request_ft = None
         if st is not None and not st.deleting:
             replicas = [
                 {"replica_id": rid, "actor_name": rec["actor_name"]}
                 for rid, rec in st.replicas.items()
                 if rec["healthy"] and rec.get("ready")
             ]
-        return {"version": self._version, "replicas": replicas}
+            # FT policy rides the long-poll so handles pick up retry/
+            # deadline/hedge/backpressure config with membership — no
+            # second control-plane RPC on any request path
+            request_ft = st.spec["config"].request_ft()
+        return {"version": self._version, "replicas": replicas,
+                "request_ft": request_ft}
 
     async def report_handle_queued(self, app_name: str, name: str,
                                    router_id: str, queued: int) -> bool:
@@ -280,6 +306,7 @@ class ServeController:
                     rid,
                     cfg.max_ongoing_requests,
                     cfg.user_config,
+                    getattr(cfg, "max_queued_requests", -1),
                 )
             )
             st.replicas[rid] = {

@@ -52,7 +52,12 @@ class Deployment:
                 max_ongoing_requests: int | None = None,
                 autoscaling_config: AutoscalingConfig | dict | None = None,
                 user_config: dict | None = None,
-                ray_actor_options: dict | None = None) -> "Deployment":
+                ray_actor_options: dict | None = None,
+                max_request_retries: int | None = None,
+                request_timeout_s: float | None = None,
+                retry_on: tuple | list | str | None = None,
+                hedge_after_ms: float | None = None,
+                max_queued_requests: int | None = None) -> "Deployment":
         cfg = dataclasses.replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
@@ -66,6 +71,17 @@ class Deployment:
             cfg.user_config = user_config
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if max_request_retries is not None:
+            cfg.max_request_retries = max_request_retries
+        if request_timeout_s is not None:
+            cfg.request_timeout_s = request_timeout_s
+        if retry_on is not None:
+            cfg.retry_on = retry_on
+        if hedge_after_ms is not None:
+            cfg.hedge_after_ms = hedge_after_ms
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        cfg.__post_init__()  # re-validate + renormalize retry_on
         return Deployment(self._callable, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -81,7 +97,12 @@ def deployment(cls_or_fn=None, *, name: str | None = None, num_replicas: int = 1
                user_config: dict | None = None,
                health_check_period_s: float = 1.0,
                graceful_shutdown_timeout_s: float = 5.0,
-               ray_actor_options: dict | None = None):
+               ray_actor_options: dict | None = None,
+               max_request_retries: int = 3,
+               request_timeout_s: float | None = None,
+               retry_on: tuple | list | str = (),
+               hedge_after_ms: float = 0.0,
+               max_queued_requests: int = -1):
     """@serve.deployment decorator (ref: serve/api.py deployment)."""
 
     def wrap(target):
@@ -97,6 +118,11 @@ def deployment(cls_or_fn=None, *, name: str | None = None, num_replicas: int = 1
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             ray_actor_options=dict(ray_actor_options or {}),
+            max_request_retries=max_request_retries,
+            request_timeout_s=request_timeout_s,
+            retry_on=retry_on,
+            hedge_after_ms=hedge_after_ms,
+            max_queued_requests=max_queued_requests,
         )
         return Deployment(target, name or target.__name__, cfg)
 

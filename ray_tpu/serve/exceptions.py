@@ -1,0 +1,53 @@
+"""Typed serve error hierarchy.
+
+TPU-native equivalent of the reference's serve exception taxonomy (ref:
+python/ray/serve/exceptions.py RayServeException, BackPressureError,
+RequestCancelledError + the DEADLINE_EXCEEDED surface of
+_private/proxy.py). Every class sets ``_rt_error_passthrough`` so the
+worker's error wrapper (core/worker.py ``_as_task_error``) ships the
+instance typed through the actor plane instead of flattening it into a
+string-only TaskError — the router's retry classifier and the proxies'
+status mapping both dispatch on these types.
+"""
+from __future__ import annotations
+
+
+class RayServeException(Exception):
+    """Base class for every serve-layer failure."""
+
+    #: worker error wrapper ships marked exceptions typed (not flattened
+    #: into TaskError), so replica-side raises keep their class caller-side
+    _rt_error_passthrough = True
+
+
+class BackPressureError(RayServeException):
+    """The replica (or the router's own queue cap) refused admission:
+    ``max_ongoing_requests`` are executing and ``max_queued_requests``
+    are already waiting. Always safe to retry elsewhere — the request
+    never started executing. Proxies map it to HTTP 429 /
+    gRPC RESOURCE_EXHAUSTED with a Retry-After hint."""
+
+    def __init__(self, message: str = "request refused: queue full",
+                 retry_after_s: float = 0.1):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class RequestTimeoutError(RayServeException):
+    """The request's deadline (``request_timeout_s``, or the remaining
+    budget inherited from a composing deployment) expired — client-side
+    while waiting, or replica-side before execution started (the replica
+    sheds rather than executes already-dead work). Never retried: the
+    deadline is the caller's total budget, not a per-attempt one."""
+
+
+class ReplicaUnavailableError(RayServeException):
+    """Routing-time failure: the chosen replica is gone (actor lookup
+    failed / evicted between choose and dispatch) or no replica became
+    ready within the membership wait. Always safe to retry — nothing was
+    dispatched."""
+
+
+class RequestCancelledError(RayServeException):
+    """The request was cancelled before execution — the losing copy of a
+    hedged request whose winner already returned."""

@@ -82,7 +82,13 @@ class GrpcProxy:
         return pickle.dumps({app: list(deps) for app, deps in status.items()})
 
     async def _call(self, request: bytes, context) -> bytes:
+        import grpc
+
         from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+        from ray_tpu.serve.exceptions import (
+            BackPressureError,
+            RequestTimeoutError,
+        )
 
         try:
             req = pickle.loads(request)
@@ -93,6 +99,19 @@ class GrpcProxy:
                 req.get("method") or "__call__",
                 tuple(req.get("args", ())), dict(req.get("kwargs", {})))
             return pickle.dumps({"result": result})
+        except BackPressureError as e:
+            # overload maps to the canonical gRPC code; retry-after rides
+            # the trailing metadata for clients that honor it
+            context.set_code(grpc.StatusCode.RESOURCE_EXHAUSTED)
+            context.set_details(str(e))
+            context.set_trailing_metadata((
+                ("retry-after",
+                 f"{getattr(e, 'retry_after_s', 1.0):.3f}"),))
+            return pickle.dumps({"error": str(e), "status": 429})
+        except RequestTimeoutError as e:
+            context.set_code(grpc.StatusCode.DEADLINE_EXCEEDED)
+            context.set_details(str(e))
+            return pickle.dumps({"error": str(e), "status": 504})
         except RayServeException as e:
             return pickle.dumps({"error": str(e), "status": 503})
         except Exception as e:  # noqa: BLE001 — ingress must answer
@@ -125,11 +144,38 @@ class GrpcIngressClient:
 
     def call(self, deployment: str, *args, app: str = "default",
              method: str = "", multiplexed_model_id: str = "", **kwargs):
-        reply = pickle.loads(self._unary("Call", pickle.dumps({
-            "app": app, "deployment": deployment, "method": method,
-            "args": args, "kwargs": kwargs,
-            "multiplexed_model_id": multiplexed_model_id,
-        })))
+        import grpc
+
+        from ray_tpu.serve.exceptions import (
+            BackPressureError,
+            RequestTimeoutError,
+        )
+
+        try:
+            reply = pickle.loads(self._unary("Call", pickle.dumps({
+                "app": app, "deployment": deployment, "method": method,
+                "args": args, "kwargs": kwargs,
+                "multiplexed_model_id": multiplexed_model_id,
+            })))
+        except grpc.RpcError as e:
+            # non-OK statuses come back as RpcError with the canonical
+            # code; translate the FT codes to the typed serve errors so
+            # SDK callers see the same taxonomy native handles raise
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                retry_after = 0.1
+                try:
+                    trailers = dict(e.trailing_metadata() or ())
+                    retry_after = float(trailers.get("retry-after",
+                                                     retry_after))
+                except (TypeError, ValueError):
+                    pass  # malformed trailer: keep the default hint
+                raise BackPressureError(
+                    e.details() or "overloaded",
+                    retry_after_s=retry_after) from None
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise RequestTimeoutError(
+                    e.details() or "deadline exceeded") from None
+            raise
         if "error" in reply:
             raise RuntimeError(f"serve error {reply.get('status')}: "
                                f"{reply['error']}")

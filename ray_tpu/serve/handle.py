@@ -8,8 +8,33 @@ replica membership and picks between two random replicas by locally
 tracked in-flight counts — the same ongoing-requests signal the reference
 router uses, with no per-request probe RPC on the hot path.
 
+Request fault tolerance (this layer's half of the router/replica
+contract; see README § Serve fault tolerance):
+
+- **retries with exponential backoff + jitter**: a replica death or
+  refusal replays the request on another replica, charging the
+  deployment's ``max_request_retries`` budget. Failures that provably
+  never executed (``BackPressureError``, ``ReplicaUnavailableError``)
+  retry for every method; ambiguous failures (the replica died while
+  holding the request) replay only methods the ``retry_on`` gate marks
+  idempotent.
+- **deadlines**: ``request_timeout_s`` stamps a deadline that bounds
+  every attempt, travels to the replica (which sheds expired queued
+  work), and is inherited by composed handle calls via
+  serve/context.py — a nested deployment gets the REMAINING budget.
+- **hedged requests** (Dean & Barroso, The Tail at Scale): after
+  ``hedge_after_ms`` without a reply, one duplicate goes to a different
+  replica; first result wins and the loser is cancelled (pre-execution
+  shed replica-side).
+- **backpressure**: the router caps its own membership-wait queue at
+  ``max_queued_requests`` instead of parking unboundedly.
+- **fast failure detection**: the router subscribes to the core
+  actor-death pubsub, so a killed replica leaves the routing table in
+  ~one raylet reap tick instead of a health-check period.
+
 Handles work from two call sites with different blocking rules:
-- driver / plain threads: .remote() routes synchronously, returns ObjectRef
+- driver / plain threads: .remote() routes synchronously, returns an
+  ObjectRef (a promise ref the retry loop fulfills behind the scenes)
 - inside async actors (deployment composition): the event loop must not
   block, so .remote() returns an awaitable response that finishes routing
   asynchronously (the reference's DeploymentResponse shape)
@@ -17,15 +42,49 @@ Handles work from two call sites with different blocking rules:
 from __future__ import annotations
 
 import asyncio
+import itertools
 import random
 import threading
 import time
 
+from ray_tpu.serve import context as serve_context
 from ray_tpu.serve.controller import CONTROLLER_NAME
+from ray_tpu.serve.exceptions import (
+    BackPressureError,
+    RayServeException,
+    ReplicaUnavailableError,
+    RequestCancelledError,
+    RequestTimeoutError,
+)
+
+__all__ = [
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "RayServeException",
+    "BackPressureError",
+    "ReplicaUnavailableError",
+    "RequestCancelledError",
+    "RequestTimeoutError",
+]
+
+def _default_request_ft() -> dict:
+    """Router-side FT policy before the first routing info arrives —
+    derived from DeploymentConfig so the two layers cannot drift."""
+    from ray_tpu.serve.config import DeploymentConfig
+
+    return DeploymentConfig().request_ft()
 
 
-class RayServeException(Exception):
-    pass
+DEFAULT_REQUEST_FT = _default_request_ft()
+
+#: retry backoff: base * 2^(attempt-1) seconds, jittered ±50%, capped
+_BACKOFF_BASE_S = 0.025
+_BACKOFF_CAP_S = 1.0
+
+#: membership wait when no deadline bounds the request (the old
+#: hardcoded 30s/35s pair, now in one place and overridden by
+#: request_timeout_s when configured)
+_DEFAULT_MEMBERSHIP_WAIT_S = 30.0
 
 
 def _core():
@@ -40,6 +99,12 @@ def _on_core_loop() -> bool:
         return asyncio.get_running_loop() is core.loop
     except RuntimeError:
         return False
+
+
+def _retry_backoff_s(attempt: int) -> float:
+    """Exponential backoff with jitter (attempt counts from 1)."""
+    base = min(_BACKOFF_CAP_S, _BACKOFF_BASE_S * (2 ** (attempt - 1)))
+    return base * random.uniform(0.5, 1.5)
 
 
 class _Router:
@@ -62,6 +127,8 @@ class _Router:
         self.inflight_at_probe: dict[str, int] = {}
         # resident multiplexed models per replica (affinity routing)
         self.models: dict[str, list] = {}
+        # per-deployment request-FT policy, refreshed with routing info
+        self.ft: dict = dict(DEFAULT_REQUEST_FT)
         self._last_request_ts = 0.0
         self._probe_generation = 0
         self.lock = threading.Lock()
@@ -69,7 +136,10 @@ class _Router:
         self._stopped = False
         self._controller_handle = None
         self._router_id = f"router-{id(self):x}-{random.getrandbits(32):08x}"
+        self._req_counter = itertools.count(1)
         self._waiting = 0  # requests blocked on empty membership
+        self._death_core = None  # CoreClient the death listener is bound to
+        self._ft_loaded = False  # True once request_ft arrived from the controller
 
     # ----------------------------------------------------------- membership
     async def _controller(self):
@@ -94,6 +164,10 @@ class _Router:
     def _apply(self, info: dict):
         self.version = info["version"]
         self.replicas = info["replicas"]
+        ft = info.get("request_ft")
+        if ft:
+            self.ft = {**DEFAULT_REQUEST_FT, **ft}
+            self._ft_loaded = True
         live = {r["replica_id"] for r in self.replicas}
         for rid in list(self.handles):
             if rid not in live:
@@ -103,10 +177,38 @@ class _Router:
                 self.inflight_at_probe.pop(rid, None)
                 self.models.pop(rid, None)
 
+    # ------------------------------------------------- fast death detection
+    def _ensure_death_listener(self, core):
+        """Subscribe to the core actor-death pubsub (the GCS publishes
+        DEAD on every actor channel the client follows): a killed replica
+        leaves the routing table in ~one raylet reap tick (~0.2s) instead
+        of waiting out a health-check period or the next long-poll."""
+        if self._death_core is core:
+            return
+        core.add_actor_death_listener(self._on_actor_death)
+        self._death_core = core
+
+    def _on_actor_death(self, actor_id, info):
+        with self.lock:
+            rid = None
+            for r, h in self.handles.items():
+                if getattr(h, "actor_id", None) == actor_id:
+                    rid = r
+                    break
+            if rid is None:
+                return
+            self.replicas = [r for r in self.replicas
+                             if r["replica_id"] != rid]
+            for d in (self.handles, self.inflight, self.remote_ongoing,
+                      self.inflight_at_probe, self.models):
+                d.pop(rid, None)
+
     def _ensure_poll_loop(self):
         """Background long-poll keeping membership fresh (the LongPollClient
         role, ref: long_poll.py LongPollClient) plus a queue-depth probe
         loop for cross-caller load visibility."""
+        core = _core()
+        self._ensure_death_listener(core)
         with self.lock:
             self._last_request_ts = time.monotonic()
             if self._poll_started:
@@ -129,7 +231,7 @@ class _Router:
                     failures += 1
                     if failures >= 20:
                         break
-                    await asyncio.sleep(0.5)
+                    await asyncio.sleep(_retry_backoff_s(failures))
             with self.lock:
                 if self._probe_generation == gen:
                     self._poll_started = False
@@ -182,15 +284,71 @@ class _Router:
                 await asyncio.gather(*[probe_one(r) for r in reps])
                 await asyncio.sleep(0.15)
 
-        _core()._call_on_loop(poll())
-        _core()._call_on_loop(probe_queue_lens())
+        core._call_on_loop(poll())
+        core._call_on_loop(probe_queue_lens())
 
     def stop(self):
         self._stopped = True
+        core, self._death_core = self._death_core, None
+        if core is not None:
+            core.remove_actor_death_listener(self._on_actor_death)
 
-    async def _wait_for_replicas(self, timeout_s: float = 30.0):
+    async def _ensure_ft(self):
+        """The first request on a fresh router must see the deployment's
+        FT policy (deadline, retry_on) BEFORE routing decisions are made,
+        not after the background long-poll happens to land; one immediate
+        fetch, then the poll loop keeps it fresh."""
+        if self._ft_loaded:
+            return
+        try:
+            await self._refresh_once(-1, 0.0)
+        except Exception:  # raylint: disable=RT012 — controller slow/missing: defaults apply; routing surfaces the real error
+            pass
+        self._ft_loaded = True  # one attempt per router, never per request
+
+    # ------------------------------------------------------------ deadlines
+    def _compute_deadline(self, inherited: float | None = None) -> float | None:
+        """Absolute monotonic deadline for a new request: the configured
+        request_timeout_s, clamped to any budget inherited from the
+        composing deployment's active request (serve/context.py).
+        ``inherited`` overrides the contextvar read — route_sync captures
+        it on the CALLING thread, because by the time the coroutine runs
+        on the core loop the caller's context is gone."""
+        t = self.ft.get("request_timeout_s")
+        deadline = None if t is None else time.monotonic() + float(t)
+        if inherited is None:
+            inherited = serve_context.current_deadline()
+        if inherited is not None:
+            deadline = inherited if deadline is None else min(deadline, inherited)
+        return deadline
+
+    def _membership_wait_s(self, deadline: float | None) -> float:
+        """How long a request may park waiting for replicas: the caller's
+        remaining deadline, else the configured request timeout, else the
+        legacy 30s default (the old hardcoded fut.result(35.0) pair)."""
+        if deadline is not None:
+            return max(0.05, deadline - time.monotonic())
+        t = self.ft.get("request_timeout_s")
+        return float(t) if t else _DEFAULT_MEMBERSHIP_WAIT_S
+
+    def _idempotent(self, method: str) -> bool:
+        retry_on = self.ft.get("retry_on") or ()
+        return "*" in retry_on or method in retry_on
+
+    async def _wait_for_replicas(self, timeout_s: float | None = None):
+        if timeout_s is None:
+            timeout_s = _DEFAULT_MEMBERSHIP_WAIT_S
+        maxq = int(self.ft.get("max_queued_requests", -1))
+        if maxq >= 0 and self._waiting >= maxq:
+            # router-side backpressure: refuse instead of parking demand
+            # without bound (the replica-side cap's handle-side twin)
+            raise BackPressureError(
+                f"router queue full: {self._waiting} requests already "
+                f"waiting for replicas of "
+                f"{self.app_name}/{self.deployment_name}")
         deadline = time.monotonic() + timeout_s
         self._waiting += 1
+        refresh_failures = 0
         try:
             while time.monotonic() < deadline:
                 with self.lock:
@@ -209,11 +367,17 @@ class _Router:
                     pass
                 try:
                     await self._refresh_once(self.version, 1.0)
+                    refresh_failures = 0
                 except Exception:
-                    await asyncio.sleep(0.2)
-            raise RayServeException(
-                f"no ready replicas for {self.app_name}/{self.deployment_name}"
-            )
+                    refresh_failures += 1
+                    await asyncio.sleep(_retry_backoff_s(refresh_failures))
+            err = ReplicaUnavailableError(
+                f"no ready replicas for {self.app_name}/{self.deployment_name} "
+                f"within {timeout_s:.1f}s")
+            # membership wait consumed its whole budget: the retry loop
+            # must not re-wait it
+            err.exhausted = True
+            raise err
         finally:
             self._waiting -= 1
             if self._waiting == 0:
@@ -228,11 +392,16 @@ class _Router:
                     pass
 
     # -------------------------------------------------------------- routing
-    def _choose(self, model_id: str = "") -> dict | None:
+    def _choose(self, model_id: str = "", exclude: set | None = None) -> dict | None:
         """Power-of-two-choices over replica queue depth (ref:
         pow_2_router.py:52): the score combines the replica's REPORTED
         ongoing count (covers other callers) with this caller's local
         in-flight count (covers requests the probe hasn't seen yet).
+
+        ``exclude`` drops replicas that already failed this request (the
+        retry loop's exclude-and-replay); when every replica is excluded
+        the full set is used again — retrying the survivor beats failing
+        a request a recovered replica could serve.
 
         With a multiplexed ``model_id``, replicas already holding the
         model shadow the rest (ref: multiplex routing affinity) — a cache
@@ -240,6 +409,10 @@ class _Router:
         within the holding set."""
         with self.lock:
             reps = list(self.replicas)
+            if exclude:
+                kept = [r for r in reps if r["replica_id"] not in exclude]
+                if kept:
+                    reps = kept
             if not reps:
                 return None
             if model_id:
@@ -262,69 +435,237 @@ class _Router:
 
             return a if score(a) <= score(b) else b
 
-    async def route_async(self, method: str, args: tuple, kwargs: dict,
-                          model_id: str = ""):
-        """Loop-thread path: full async routing; returns the result."""
-        self._ensure_poll_loop()
-        if self._choose(model_id) is None:
-            await self._wait_for_replicas()
-        chosen = self._choose(model_id)
-        if chosen is None:
-            raise RayServeException("no replicas available")
+    async def _actor_for(self, chosen: dict):
         rid = chosen["replica_id"]
         with self.lock:
             actor = self.handles.get(rid)
+        if actor is not None:
+            return actor
+        actor = await _core().get_actor_by_name_async(chosen["actor_name"])
         if actor is None:
-            actor = await _core().get_actor_by_name_async(chosen["actor_name"])
-            if actor is None:
-                raise RayServeException(f"replica actor {chosen['actor_name']} gone")
+            return None
+        with self.lock:
+            self.handles[rid] = actor
+        return actor
+
+    async def _pick_replica(self, model_id: str, exclude: set,
+                            deadline: float | None) -> tuple[str, object]:
+        chosen = self._choose(model_id, exclude)
+        if chosen is None:
+            await self._wait_for_replicas(self._membership_wait_s(deadline))
+            chosen = self._choose(model_id, exclude)
+            if chosen is None:
+                raise ReplicaUnavailableError(
+                    f"no replicas available for "
+                    f"{self.app_name}/{self.deployment_name}")
+        actor = await self._actor_for(chosen)
+        if actor is None:
+            raise ReplicaUnavailableError(
+                f"replica actor {chosen['actor_name']} gone")
+        return chosen["replica_id"], actor
+
+    async def _call_replica(self, rid: str, actor, method: str, args: tuple,
+                            kwargs: dict, model_id: str,
+                            deadline: float | None, request_id: str):
+        """One attempt on one replica: dispatch + await, bounded by the
+        remaining deadline; the replica receives the remaining budget so
+        it can shed the request if it expires while queued."""
+        from ray_tpu.core.ref import GetTimeoutError
+
+        core = _core()
+        timeout_s = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+        try:
+            ref = actor.handle_request.remote(
+                method, args, kwargs, model_id, timeout_s, request_id)
+            try:
+                (result,) = await core.get_async(
+                    [ref],
+                    None if deadline is None
+                    else max(0.05, deadline - time.monotonic()))
+            except GetTimeoutError:
+                raise RequestTimeoutError(
+                    f"request deadline exceeded waiting on replica {rid} "
+                    f"of {self.app_name}/{self.deployment_name}") from None
+            return result
+        finally:
             with self.lock:
-                self.handles[rid] = actor
-        ref = actor.handle_request.remote(method, args, kwargs, model_id)
-        self.track(rid, ref)
-        return await ref
+                if self.inflight.get(rid, 0) > 0:
+                    self.inflight[rid] -= 1
+
+    def _cancel_loser(self, task: asyncio.Task, rid: str, request_id: str):
+        """The winner returned: stop awaiting the loser and ask its
+        replica to shed the copy if it has not started executing."""
+        if task.done():
+            return
+        task.cancel()
+        with self.lock:
+            actor = self.handles.get(rid)
+        if actor is not None:
+            try:
+                actor.cancel_request.remote(request_id)  # raylint: disable=RT003 — best-effort shed; the loser's result is discarded either way
+            except Exception:  # raylint: disable=RT012 — replica may be gone; its copy dies with it
+                pass
+
+    async def _dispatch(self, rid: str, actor, method: str, args: tuple,
+                        kwargs: dict, model_id: str, deadline: float | None,
+                        request_id: str, hedgeable: bool, exclude: set):
+        """One logical attempt, with optional hedging: if the primary has
+        not answered within hedge_after_ms, mirror the request to a
+        different replica and take the first result (The Tail at Scale's
+        hedged request), cancelling the loser."""
+        hedge_ms = float(self.ft.get("hedge_after_ms") or 0.0)
+        if hedge_ms <= 0 or not hedgeable:
+            # no hedge race possible: skip the per-request Task allocation
+            return await self._call_replica(
+                rid, actor, method, args, kwargs, model_id, deadline,
+                request_id)
+        loop = asyncio.get_running_loop()
+        primary = loop.create_task(self._call_replica(
+            rid, actor, method, args, kwargs, model_id, deadline, request_id))
+        try:
+            return await asyncio.wait_for(asyncio.shield(primary),
+                                          hedge_ms / 1e3)
+        except asyncio.TimeoutError:
+            pass  # slow primary: hedge below
+        alt = self._choose(model_id, exclude | {rid})
+        if alt is None or alt["replica_id"] == rid:
+            return await primary  # nowhere else to hedge
+        actor2 = await self._actor_for(alt)
+        if actor2 is None:
+            return await primary
+        rid2 = alt["replica_id"]
+        hedge = loop.create_task(self._call_replica(
+            rid2, actor2, method, args, kwargs, model_id, deadline,
+            request_id))
+        pending = {primary, hedge}
+        first_err = None
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    if t.exception() is None:
+                        return t.result()
+                    if first_err is None:
+                        first_err = t.exception()
+            # both copies failed: tell the retry loop EVERY replica this
+            # logical attempt burned, so the next attempt excludes the
+            # hedge target too, not just the primary
+            first_err._rt_attempted = (rid, rid2)
+            raise first_err
+        finally:
+            for t, t_rid in ((primary, rid), (hedge, rid2)):
+                if not t.done():
+                    self._cancel_loser(t, t_rid, request_id)
+
+    async def route_async(self, method: str, args: tuple, kwargs: dict,
+                          model_id: str = "",
+                          _inherited_deadline: float | None = None):
+        """Loop-thread path: full async routing with the retry/deadline/
+        hedge machinery; returns the result."""
+        self._ensure_poll_loop()
+        await self._ensure_ft()
+        deadline = self._compute_deadline(_inherited_deadline)
+        request_id = f"{self._router_id}-{next(self._req_counter)}"
+        excluded: set[str] = set()
+        attempt = 0
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise RequestTimeoutError(
+                    f"request deadline exceeded after {attempt} attempt(s) "
+                    f"for {self.app_name}/{self.deployment_name}.{method}")
+            rid = None
+            # re-read per attempt: the poll loop may deliver the policy
+            # (or a redeploy may change it) between attempts
+            idempotent = self._idempotent(method)
+            try:
+                rid, actor = await self._pick_replica(
+                    model_id, excluded, deadline)
+                return await self._dispatch(
+                    rid, actor, method, args, kwargs, model_id, deadline,
+                    request_id, hedgeable=idempotent, exclude=excluded)
+            except RequestTimeoutError:
+                raise  # the deadline is total budget, never per-attempt
+            except (BackPressureError, ReplicaUnavailableError) as e:
+                # never dispatched (or provably refused before execution):
+                # safe to retry elsewhere for every method
+                if getattr(e, "exhausted", False):
+                    raise  # membership wait already consumed the budget
+                err = e
+            except Exception as e:
+                # ambiguous: the replica may have executed (or begun to).
+                # Replay only idempotent methods, and only on the failure
+                # types that mean "replica gone", never app errors.
+                if not (idempotent and _is_replica_failure(e)):
+                    raise
+                err = e
+            attempt += 1
+            if attempt > int(self.ft.get("max_request_retries", 3)):
+                raise err
+            attempted = getattr(err, "_rt_attempted", None)
+            if attempted:  # a failed hedge burned two replicas, not one
+                excluded.update(a for a in attempted if a)
+            elif rid is not None:
+                excluded.add(rid)
+            backoff = _retry_backoff_s(attempt)
+            if deadline is not None:
+                backoff = min(backoff,
+                              max(0.0, deadline - time.monotonic()))
+            await asyncio.sleep(backoff)
 
     def route_sync(self, method: str, args: tuple, kwargs: dict,
                    model_id: str = ""):
-        """Driver-thread path: block briefly for membership; returns ObjectRef."""
-        import ray_tpu
+        """Driver-thread path: returns an ObjectRef immediately; the
+        retry/deadline/hedge machinery runs on the core loop behind a
+        promise ref the caller gets/waits like any task result (this is
+        what lets a replayed request stay ONE ref for the caller)."""
+        core = _core()
+        ref, resolve = core.create_promise_ref()
+        # read the composed-request deadline HERE, on the calling thread
+        # (a replica pool thread for sync methods): the coroutine below
+        # runs on the core loop in a different context where the
+        # contextvar is invisible
+        inherited = serve_context.current_deadline()
 
-        self._ensure_poll_loop()
-        chosen = self._choose(model_id)
-        if chosen is None:
-            core = _core()
-            fut = asyncio.run_coroutine_threadsafe(self._wait_for_replicas(), core.loop)
-            fut.result(35.0)
-            chosen = self._choose(model_id)
-            if chosen is None:
-                raise RayServeException("no replicas available")
-        rid = chosen["replica_id"]
-        with self.lock:
-            actor = self.handles.get(rid)
-        if actor is None:
-            actor = ray_tpu.get_actor(chosen["actor_name"])
-            with self.lock:
-                self.handles[rid] = actor
-        ref = actor.handle_request.remote(method, args, kwargs, model_id)
-        self.track(rid, ref)
+        async def run():
+            try:
+                resolve(value=await self.route_async(
+                    method, args, kwargs, model_id,
+                    _inherited_deadline=inherited))
+            except BaseException as e:
+                resolve(error=e if isinstance(e, Exception)
+                        else RayServeException(repr(e)))
+
+        core._call_on_loop(run())
         return ref
 
     def route_streaming(self, method: str, args: tuple, kwargs: dict):
         """Stream a request from the DRIVER thread: yields one ObjectRef
         per item. The replica's in-flight count stays raised for the
-        stream's whole life so pow-2 routing sees streaming load."""
+        stream's whole life so pow-2 routing sees streaming load.
+        Streams are never replayed mid-flight (consumed items would
+        duplicate); only initial routing is fault-tolerant."""
         import ray_tpu
 
         self._ensure_poll_loop()
+        if not self._ft_loaded:
+            # streaming membership waits derive from the FT policy too
+            core = _core()
+            asyncio.run_coroutine_threadsafe(
+                self._ensure_ft(), core.loop).result(20.0)
         chosen = self._choose()
         if chosen is None:
             core = _core()
+            wait_s = self._membership_wait_s(self._compute_deadline())
             fut = asyncio.run_coroutine_threadsafe(
-                self._wait_for_replicas(), core.loop)
-            fut.result(35.0)
+                self._wait_for_replicas(wait_s), core.loop)
+            fut.result(wait_s + 5.0)
             chosen = self._choose()
             if chosen is None:
-                raise RayServeException("no replicas available")
+                raise ReplicaUnavailableError("no replicas available")
         rid = chosen["replica_id"]
         with self.lock:
             actor = self.handles.get(rid)
@@ -351,21 +692,18 @@ class _Router:
         """Loop-thread variant (composing deployments): async generator of
         ObjectRefs; never blocks the core loop waiting for membership."""
         self._ensure_poll_loop()
+        await self._ensure_ft()
         if self._choose() is None:
-            await self._wait_for_replicas()
+            await self._wait_for_replicas(
+                self._membership_wait_s(self._compute_deadline()))
         chosen = self._choose()
         if chosen is None:
-            raise RayServeException("no replicas available")
+            raise ReplicaUnavailableError("no replicas available")
         rid = chosen["replica_id"]
-        with self.lock:
-            actor = self.handles.get(rid)
+        actor = await self._actor_for(chosen)
         if actor is None:
-            actor = await _core().get_actor_by_name_async(chosen["actor_name"])
-            if actor is None:
-                raise RayServeException(
-                    f"replica actor {chosen['actor_name']} gone")
-            with self.lock:
-                self.handles[rid] = actor
+            raise ReplicaUnavailableError(
+                f"replica actor {chosen['actor_name']} gone")
         gen = actor.handle_request_streaming.options(
             num_returns="streaming").remote(method, args, kwargs)
         with self.lock:
@@ -378,23 +716,15 @@ class _Router:
                 if self.inflight.get(rid, 0) > 0:
                     self.inflight[rid] -= 1
 
-    def track(self, rid: str, ref):
-        """Count the request against the replica until its result is ready."""
-        core = _core()
-        with self.lock:
-            self.inflight[rid] = self.inflight.get(rid, 0) + 1
 
-        async def watch():
-            try:
-                entry = core.memory_store.get(ref.id)
-                if entry is not None:
-                    await entry.ready.wait()
-            finally:
-                with self.lock:
-                    if self.inflight.get(rid, 0) > 0:
-                        self.inflight[rid] -= 1
+def _is_replica_failure(e: Exception) -> bool:
+    """True for failures that mean "the replica is gone", as opposed to
+    an exception the user code raised (which must surface, never
+    replay)."""
+    from ray_tpu.core.ref import ActorError, WorkerCrashedError
+    from ray_tpu.utils import rpc
 
-        core._call_on_loop(watch())
+    return isinstance(e, (ActorError, WorkerCrashedError, rpc.ConnectionLost))
 
 
 _routers: dict[tuple[str, str], _Router] = {}

@@ -69,9 +69,15 @@ class HttpProxy:
         return web.json_response({app: list(deps) for app, deps in status.items()})
 
     async def _handle(self, request):
+        import math
+
         from aiohttp import web
 
         from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+        from ray_tpu.serve.exceptions import (
+            BackPressureError,
+            RequestTimeoutError,
+        )
 
         app_name = request.match_info["app"]
         deployment = request.match_info["deployment"]
@@ -88,6 +94,17 @@ class HttpProxy:
             args = (body,) if body is not None else ()
             result = await handle._invoke(method, args, {})
             return web.json_response({"result": result})
+        except BackPressureError as e:
+            # admission refused (replica or router queue cap): the
+            # standard overload answer — 429 + a Retry-After hint sized
+            # from the refusing replica's queue depth
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After":
+                         str(max(1, math.ceil(
+                             getattr(e, "retry_after_s", 1.0))))})
+        except RequestTimeoutError as e:
+            return web.json_response({"error": str(e)}, status=504)
         except RayServeException as e:
             return web.json_response({"error": str(e)}, status=503)
         except Exception as e:

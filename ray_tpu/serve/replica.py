@@ -6,17 +6,47 @@ wrapper tracks ongoing-request counts (the autoscaling signal), enforces
 the per-replica concurrency cap, resolves handle markers in init args so
 deployments compose (ref: serve deployment graph .bind), and applies
 user_config via the user class's optional ``reconfigure`` method.
+
+Request fault tolerance (this layer's half of the router/replica
+contract):
+
+- **admission control**: beyond ``max_ongoing_requests`` executing plus
+  ``max_queued_requests`` queued, new requests are refused with a typed
+  :class:`BackPressureError` instead of queueing unboundedly — the
+  router retries them elsewhere, the proxies answer 429 /
+  RESOURCE_EXHAUSTED (ref: replica_scheduler queue-length admission).
+- **deadline shedding**: a request whose propagated deadline already
+  expired while queued is dropped at dequeue — executing it would burn
+  MXU time on an answer nobody is waiting for (ref: Tail at Scale's
+  "good enough soon beats perfect late").
+- **hedge cancellation**: :meth:`cancel_request` marks a request id;
+  a marked request still queued is shed before user code runs, so the
+  losing copy of a hedged request costs a queue slot, not an execution.
+- the chaos fault point ``serve.handle_request`` fires here, making the
+  request path schedulable by seeded ChaosPlans (kill-replicas-under-
+  load is the checked-in SLO plan, tests/plans/).
 """
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
+import contextvars
 import inspect
+import time
 
 try:
     import cloudpickle
 except ImportError:  # pragma: no cover
     import pickle as cloudpickle
+
+from ray_tpu.devtools import chaos
+from ray_tpu.serve import context as serve_context
+from ray_tpu.serve.exceptions import (
+    BackPressureError,
+    RequestCancelledError,
+    RequestTimeoutError,
+)
 
 
 class HandleMarker:
@@ -34,7 +64,8 @@ class Replica:
 
     def __init__(self, serialized_cls: bytes, init_args: tuple, init_kwargs: dict,
                  deployment_name: str, replica_id: str, max_ongoing_requests: int,
-                 user_config: dict | None = None):
+                 user_config: dict | None = None,
+                 max_queued_requests: int = -1):
         from ray_tpu.serve.handle import DeploymentHandle
 
         cls = cloudpickle.loads(serialized_cls)
@@ -43,9 +74,18 @@ class Replica:
         self.deployment_name = deployment_name
         self.replica_id = replica_id
         self.max_ongoing_requests = max_ongoing_requests
+        self.max_queued_requests = max_queued_requests
         self._ongoing = 0
+        self._executing = 0
+        self._queued = 0
         self._total = 0
+        self._shed = 0
+        self._refused = 0
         self._gate = None  # asyncio.Semaphore, created lazily on the actor loop
+        # hedge-loser cancellation: ids marked before their request
+        # reached the front of the queue are shed pre-execution; bounded
+        # so a spray of unknown ids can't grow without limit
+        self._cancelled: collections.OrderedDict[str, None] = collections.OrderedDict()
         # sync user methods run here so the cap, not the worker's executor
         # width, bounds real concurrency
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -71,32 +111,86 @@ class Replica:
         fn(user_config)
 
     # ------------------------------------------------------------- requests
+    def _admit(self):
+        """Admission control: refuse (typed, retryable-elsewhere) rather
+        than queue past the declared bound."""
+        if (self.max_queued_requests >= 0
+                and self._executing >= self.max_ongoing_requests
+                and self._queued >= self.max_queued_requests):
+            self._refused += 1
+            raise BackPressureError(
+                f"replica {self.replica_id} at capacity "
+                f"({self._executing} executing, {self._queued} queued)",
+                # a slot frees when the oldest executing request finishes;
+                # the queue depth is the best local estimate of that wait
+                retry_after_s=0.05 * (1 + self._queued),
+            )
+
+    def _check_shed(self, deadline: float | None, request_id: str):
+        """At dequeue (post-gate): drop work that is already dead."""
+        if request_id and request_id in self._cancelled:
+            self._cancelled.pop(request_id, None)
+            self._shed += 1
+            raise RequestCancelledError(
+                f"request {request_id} cancelled before execution")
+        if deadline is not None and time.monotonic() >= deadline:
+            self._shed += 1
+            raise RequestTimeoutError(
+                f"deadline expired while queued on replica {self.replica_id}; "
+                "shedding instead of executing")
+
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
-                             multiplexed_model_id: str = ""):
+                             multiplexed_model_id: str = "",
+                             timeout_s: float | None = None,
+                             request_id: str = ""):
+        if chaos.ENABLED:
+            chaos.point("serve.handle_request", method=method,
+                        deployment=self.deployment_name,
+                        replica=self.replica_id)
         if self._gate is None:
             self._gate = asyncio.Semaphore(self.max_ongoing_requests)
+        self._admit()
+        # arrival-relative deadline: the router sends REMAINING budget so
+        # cross-node clock domains never skew the absolute deadline
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
         self._ongoing += 1
         self._total += 1
+        self._queued += 1
         if multiplexed_model_id:
             # task-local: concurrent requests on this async actor each see
             # their own id through serve.get_multiplexed_model_id()
             from ray_tpu.serve.multiplex import _set_request_model_id
 
             _set_request_model_id(multiplexed_model_id)
+        dequeued = False
         try:
             async with self._gate:
-                fn = getattr(self.user, method) if method else self.user
-                if inspect.iscoroutinefunction(fn):
-                    return await fn(*args, **kwargs)
-                loop = asyncio.get_running_loop()
-                # copy_context: the multiplexed-model-id contextvar must be
-                # visible inside sync methods running on the pool thread
-                import contextvars
-
-                ctx = contextvars.copy_context()
-                return await loop.run_in_executor(
-                    self._pool, lambda: ctx.run(fn, *args, **kwargs))
+                self._queued -= 1
+                dequeued = True
+                self._check_shed(deadline, request_id)
+                self._executing += 1
+                try:
+                    # composed handle calls inside user code inherit the
+                    # remaining budget through this contextvar
+                    token = serve_context.set_deadline(deadline)
+                    try:
+                        fn = getattr(self.user, method) if method else self.user
+                        if inspect.iscoroutinefunction(fn):
+                            return await fn(*args, **kwargs)
+                        loop = asyncio.get_running_loop()
+                        # copy_context: the model-id and deadline
+                        # contextvars must be visible inside sync methods
+                        # running on the pool thread
+                        ctx = contextvars.copy_context()
+                        return await loop.run_in_executor(
+                            self._pool, lambda: ctx.run(fn, *args, **kwargs))
+                    finally:
+                        serve_context.reset_deadline(token)
+                finally:
+                    self._executing -= 1
         finally:
+            if not dequeued:  # cancelled while waiting on the gate
+                self._queued -= 1
             self._ongoing -= 1
 
     async def handle_request_streaming(self, method: str, args: tuple,
@@ -104,17 +198,43 @@ class Replica:
         """Streaming requests: the user method must be an async generator;
         items ride the actor streaming-generator plane back to the caller
         (ref: serve streaming responses over ReportGeneratorItemReturns)."""
+        if chaos.ENABLED:
+            chaos.point("serve.handle_request", method=method,
+                        deployment=self.deployment_name,
+                        replica=self.replica_id, streaming=True)
         if self._gate is None:
             self._gate = asyncio.Semaphore(self.max_ongoing_requests)
+        self._admit()
         self._ongoing += 1
         self._total += 1
+        self._queued += 1
+        dequeued = False
         try:
             async with self._gate:
-                fn = getattr(self.user, method) if method else self.user
-                async for item in fn(*args, **kwargs):
-                    yield item
+                self._queued -= 1
+                dequeued = True
+                self._executing += 1
+                try:
+                    fn = getattr(self.user, method) if method else self.user
+                    async for item in fn(*args, **kwargs):
+                        yield item
+                finally:
+                    self._executing -= 1
         finally:
+            if not dequeued:  # torn down while waiting on the gate
+                self._queued -= 1
             self._ongoing -= 1
+
+    def cancel_request(self, request_id: str) -> bool:
+        """Best-effort pre-execution cancel (hedge losers): if the id is
+        still queued it is shed at dequeue; an already-executing request
+        runs to completion (actor tasks are never killed mid-flight)."""
+        if not request_id:
+            return False
+        self._cancelled[request_id] = None
+        while len(self._cancelled) > 256:  # bound stale-id growth
+            self._cancelled.popitem(last=False)
+        return True
 
     # ------------------------------------------------------------ lifecycle
     def get_metrics(self) -> dict:
@@ -123,6 +243,9 @@ class Replica:
         return {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
+            "queued": self._queued,
+            "shed": self._shed,
+            "refused": self._refused,
             "total": self._total,
             # resident multiplexed models: the router's affinity signal
             # (ref: multiplex model-id membership via long-poll)

@@ -15,6 +15,7 @@ SURVEY §7 "hard parts").
 
 from __future__ import annotations
 
+import random
 import time
 import traceback
 from typing import Any, Callable
@@ -148,8 +149,11 @@ class JaxTrainer:
                         history[-1] if history else {}, manager.latest(), history,
                         error=TrainingFailedError(str(e)),
                     )
-                # elastic restart of the whole group (same world size)
-                time.sleep(0.5)
+                # elastic restart of the whole group (same world size);
+                # backoff widens with consecutive failures so a node still
+                # draining its last group isn't hammered at a fixed rate
+                time.sleep(min(5.0, 0.5 * (2 ** (attempt - 1)))
+                           * (0.5 + random.random()))
 
     def _run_attempt(self, name: str, attempt: int, manager: CheckpointManager,
                      history: list[dict]) -> dict:

@@ -14,6 +14,7 @@ import asyncio
 import itertools
 import logging
 import pickle
+import random
 import struct
 import threading
 from typing import Any, Awaitable, Callable
@@ -523,6 +524,7 @@ async def connect(host: str, port: int, timeout: float = 30.0,
         return local[0].attach_loopback()  # same process: same version
     deadline = asyncio.get_running_loop().time() + timeout
     last_err: Exception | None = None
+    refused = 0
     while asyncio.get_running_loop().time() < deadline:
         try:
             reader, writer = await asyncio.open_connection(host, port)
@@ -538,7 +540,12 @@ async def connect(host: str, port: int, timeout: float = 30.0,
             return conn
         except (ConnectionRefusedError, OSError) as e:
             last_err = e
-            await asyncio.sleep(0.05)
+            # backoff (capped low: callers are usually waiting on a
+            # process that binds within tens of ms) so mass reconnects
+            # after a peer restart don't arrive in lockstep
+            refused += 1
+            await asyncio.sleep(min(0.4, 0.05 * (2 ** (refused - 1)))
+                                * (0.5 + random.random()))
     raise ConnectionLost(f"could not connect to {host}:{port}: {last_err}")
 
 

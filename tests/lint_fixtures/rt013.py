@@ -1,0 +1,75 @@
+"""RT013 fixture: constant-sleep retry loops vs. backoff/poll shapes."""
+import asyncio
+import random
+import time
+
+
+def constant_sleep_retry(fn):
+    while True:
+        try:
+            return fn()
+        except ConnectionError:
+            time.sleep(0.2)  # expect: RT013
+
+
+def constant_sleep_retry_for(fn):
+    for _ in range(5):
+        try:
+            return fn()
+        except OSError:
+            time.sleep(1)  # expect: RT013
+
+
+async def constant_async_sleep_retry(fn):
+    while True:
+        try:
+            return await fn()
+        except ConnectionError:
+            await asyncio.sleep(0.5)  # expect: RT013
+
+
+def sleep_deep_in_handler(fn, log):
+    for _ in range(3):
+        try:
+            return fn()
+        except OSError:
+            log.debug("retrying")
+            if log:
+                time.sleep(0.1)  # expect: RT013
+
+
+def backoff_is_clean(fn):
+    for i in range(5):
+        try:
+            return fn()
+        except OSError:
+            time.sleep(0.1 * (2 ** i))
+
+
+def jittered_is_clean(fn):
+    while True:
+        try:
+            return fn()
+        except ConnectionError:
+            time.sleep(random.uniform(0.1, 0.4))
+
+
+def poll_loop_is_clean(ready):
+    # sleeping on the NORMAL path is pacing, not retry backoff
+    while not ready():
+        time.sleep(0.2)
+
+
+def sleep_outside_loop_is_clean(fn):
+    try:
+        return fn()
+    except OSError:
+        time.sleep(0.2)  # one-shot wait, no loop: nothing to back off
+
+
+def suppressed_with_reason(fn):
+    while True:
+        try:
+            return fn()
+        except OSError:
+            time.sleep(0.05)  # raylint: disable=RT013 — fixed-rate probe by design
