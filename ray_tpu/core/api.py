@@ -484,9 +484,22 @@ def _actor_resources(o: dict) -> dict:
 
 
 def remote(*args, **options):
-    """@ray_tpu.remote decorator for functions and classes."""
+    """@ray_tpu.remote decorator for functions and classes.
+
+    ``in_specs``/``out_specs`` (PartitionSpecs) switch the handle onto
+    the sharded object plane: one task per shard, routed to the node
+    holding it, with collective-backed resharding on spec disagreement
+    (see ray_tpu/sharded/submit.py)."""
 
     def wrap(obj):
+        if "in_specs" in options or "out_specs" in options:
+            if isinstance(obj, type):
+                raise TypeError(
+                    "in_specs/out_specs apply to functions; shard actor "
+                    "inputs by passing ShardedObjectRefs to methods")
+            from ray_tpu.sharded.submit import ShardedFunction
+
+            return ShardedFunction(obj, options)
         if isinstance(obj, type):
             return ActorClass(obj, **options)
         return RemoteFunction(obj, **options)
@@ -494,6 +507,31 @@ def remote(*args, **options):
     if len(args) == 1 and not options and callable(args[0]):
         return wrap(args[0])
     return wrap
+
+
+# ---------------------------------------------------------- sharded plane
+def put_sharded(value, **kw):
+    """Store a sharded array as per-host shm shards behind ONE manifest
+    (see ray_tpu/sharded/plane.py). Never materializes the global array."""
+    from ray_tpu.sharded import plane
+
+    return plane.put_sharded(value, **kw)
+
+
+def get_sharded(sref, **kw):
+    """Reassemble a device-local jax.Array from a ShardedObjectRef,
+    zero-copy from local shm shards."""
+    from ray_tpu.sharded import plane
+
+    return plane.get_sharded(sref, **kw)
+
+
+def reshard(sref, spec, **kw):
+    """Redistribute a ShardedObjectRef to a new PartitionSpec through one
+    XLA collective program (no driver gather-scatter)."""
+    from ray_tpu.sharded.reshard import reshard as _reshard
+
+    return _reshard(sref, spec, **kw)
 
 
 class CppFunction:

@@ -181,6 +181,21 @@ class _TaskEventBuffer:
                 # only after the put landed: a transient GCS error must
                 # not permanently skip republishing this window
                 self.core._lat_published = lat["count"]
+            # registered extra windows (sharded plane stages, ...): each
+            # source returns a {stages} snapshot or None when it has
+            # nothing new since its last CONFIRMED publish
+            for suffix, (fn, confirm) in list(
+                    self.core._latency_sources.items()):
+                snap = fn()
+                if snap is not None:
+                    await self.core.gcs.call(
+                        "kv_put",
+                        {"ns": "latency",
+                         "key": f"{self.core.worker_id.hex()}.{suffix}",
+                         "value": pickle.dumps(snap)},
+                    )
+                    if confirm is not None:
+                        confirm()
         except Exception:
             # transient GCS error: this window republishes next flush
             log.debug("latency window publish failed", exc_info=True)
@@ -318,6 +333,11 @@ class CoreClient:
         self._rec_enabled = recorder.enabled()
         self._rec_published = -1  # stats.n at the last metrics publish
         self._lat_published = -1  # stats.n at the last latency kv_put
+        # extra latency windows published beside the recorder's on the
+        # flush timer (ns="latency", key "<worker>.<suffix>") — the
+        # sharded plane registers its shard_seal/shard_fetch/reshard
+        # stage window here; list_task_latency merges every key
+        self._latency_sources: dict[str, Any] = {}
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -469,6 +489,13 @@ class CoreClient:
         try:
             blob = await self.gcs.call("kv_get", {"ns": "obj_loc", "key": oid.hex()})
             holders = pickle.loads(blob) if blob else set()
+            if not holders and self.node_id is not None:
+                # a put followed by an immediate last-ref drop can race
+                # its own _register_location kv_put: the directory reads
+                # empty and the sealed local copy would leak forever.
+                # The owner's node is always a candidate holder — include
+                # it so the local delete lands regardless.
+                holders = {self.node_id.binary()}
             await self.gcs.call("kv_del", {"ns": "obj_loc", "key": oid.hex()})
             nodes = {tuple(n["address"]): n["node_id"].binary() if hasattr(n["node_id"], "binary") else n["node_id"]
                      for n in await self.gcs.call("get_cluster", {})}
@@ -572,6 +599,18 @@ class CoreClient:
         except ValueError:
             pass  # already removed (idempotent teardown)
 
+    def add_latency_source(self, suffix: str, fn, confirm=None) -> None:
+        """Register an extra latency window beside the flight recorder's:
+        ``fn()`` returns a ``{stages: {name: [ns, ...]}}`` snapshot (or
+        None when idle) and is published on the task-event flush timer
+        under ns="latency" key ``<worker>.<suffix>`` —
+        ``state.list_task_latency()`` merges every key in the namespace,
+        so the extra stages surface with zero new API. ``confirm`` (if
+        given) fires only after the kv_put LANDED, so a transient GCS
+        error republishes the window next flush (the same invariant
+        ``_lat_published`` keeps for the recorder's own window)."""
+        self._latency_sources[suffix] = (fn, confirm)
+
     # -------------------------------------------------------- promise refs
     def create_promise_ref(self):
         """An owned ObjectRef whose value arrives later: returns
@@ -594,14 +633,19 @@ class CoreClient:
         return ref, resolve
 
     # ----------------------------------------------------------------- put
-    def put_value(self, value: Any) -> ObjectRef:
+    def put_value(self, value: Any, prefer_shm: bool = False) -> ObjectRef:
+        """Store an owned object. ``prefer_shm`` forces the shm path even
+        under the inline threshold (the sharded plane's shard seals: a
+        shard must be arena-resident so consumers on this node read it
+        zero-copy and remote nodes can pull it without an owner hop)."""
         oid = ObjectID.from_random()
         meta, buffers = serialization.dumps_with_buffers(value)
         size = serialization.total_size(meta, buffers)
         metrics.objects_put.inc()
         metrics.object_bytes_put.inc(size)
         entry = _MemEntry()
-        if size <= self.cfg.max_inline_object_size or self.store is None:
+        if (size <= self.cfg.max_inline_object_size
+                and not prefer_shm) or self.store is None:
             # client mode has no local shm: every owned object is memory-
             # store resident and owner-served (borrowers fetch over RPC)
             entry.packed = _pack_bytes(meta, buffers, size)

@@ -77,6 +77,16 @@ def enable(plan: ChaosPlan, log_dir: str | None = None) -> ChaosController:
     """Arm chaos in this process: compile ``plan``, open the per-process
     event log, apply the plan's native arms, flip :data:`ENABLED`."""
     global ENABLED, _controller
+    if any(r.cluster_once for r in plan.rules):
+        # per-run id for cluster_once sentinels: the first armer (the
+        # driver, ahead of any spawn) stamps it into the environment so
+        # every descendant process shares one claim namespace, and a
+        # REUSED log dir re-arms the rule on the next run
+        import time as _time
+
+        os.environ.setdefault(
+            "RT_CHAOS_RUN_ID",
+            f"{os.getpid():x}-{int(_time.time() * 1e3):x}")
     log_path = None
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)

@@ -21,6 +21,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 from ray_tpu.devtools.chaos.plan import ChaosPlan
 
@@ -113,6 +114,9 @@ def cmd_chaos(args) -> int:
     env["RT_CHAOS_PLAN"] = (args.plan if args.plan.lstrip().startswith("{")
                             else os.path.abspath(args.plan))
     env["RT_CHAOS_LOG_DIR"] = log_dir
+    # fresh per-run id: cluster_once sentinels are namespaced by it, so
+    # re-running against the SAME log dir re-arms those rules
+    env["RT_CHAOS_RUN_ID"] = f"{os.getpid():x}-{int(time.time() * 1e3):x}"
     if args.seed is not None:
         env["RT_CHAOS_SEED"] = str(args.seed)
     # native arms also ride plain env so C++ picks them up at dlopen in

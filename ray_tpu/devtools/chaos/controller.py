@@ -112,6 +112,8 @@ class ChaosController:
                     continue
                 if r.prob is not None and cr.rng.random() >= r.prob:
                     continue
+                if r.cluster_once and not self._claim_cluster_once(cr):
+                    continue  # another process (or a past fire) owns it
                 cr.fired += 1
                 self._log_locked(name, r.action, cr.index, ctx)
                 # every rng draw stays under the lock so concurrent
@@ -124,6 +126,32 @@ class ChaosController:
             return None
         cr, flip_at = decided
         return self._execute(name, cr, payload, flip_at)
+
+    def _claim_cluster_once(self, cr: _CompiledRule) -> bool:
+        """Atomically claim a cluster_once rule's single fire: an O_EXCL
+        sentinel in the SHARED chaos log dir (every armed process points
+        at the same dir via RT_CHAOS_LOG_DIR), named by the per-run id
+        (RT_CHAOS_RUN_ID, stamped at arm time and inherited by every
+        child) plus rule index — so log dirs REUSED across runs re-arm
+        the rule each run instead of staying disarmed by a stale
+        sentinel. Controllers are per-process; without the shared claim
+        a shard-loss kill rule would strike every fresh worker a
+        recovery retry lands on. No log dir configured -> degrade to
+        per-process once (this controller's own fired counter)."""
+        if self._log_path is None:
+            return cr.fired == 0
+        run_id = os.environ.get("RT_CHAOS_RUN_ID", "")
+        sentinel = os.path.join(
+            os.path.dirname(self._log_path),
+            f"once-{run_id}-{cr.index}.fired" if run_id
+            else f"once-{cr.index}.fired")
+        try:
+            os.close(os.open(sentinel, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            return cr.fired == 0  # unwritable dir: per-process fallback
 
     def _execute(self, name: str, cr: _CompiledRule,
                  payload: bytes | None, flip_at: int):

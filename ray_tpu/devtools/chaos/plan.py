@@ -31,6 +31,14 @@ Rule fields:
   fire on every Nth), ``prob`` (seeded coin flip per eligible call),
   ``max_fires`` (stop after N fires). All optional; a rule with none of
   them fires on every eligible call.
+- ``cluster_once``: fire at most once ACROSS the whole cluster run, not
+  per process. Controllers are per-process, so without this a "lose one
+  shard" kill rule would strike every fresh worker the recovery path
+  retries onto, defeating the recovery it means to test. Implemented as
+  an O_EXCL sentinel file in the shared chaos log dir, namespaced by
+  the per-run RT_CHAOS_RUN_ID (stamped at arm time, inherited by every
+  child) so a reused log dir re-arms the rule each run; falls back to
+  per-process once when no log dir is configured.
 
 Determinism: rules are evaluated in plan order, each owns a
 ``random.Random`` seeded from ``(plan.seed, rule index)``, and every
@@ -58,6 +66,7 @@ class ChaosRule:
     every: int = 0
     after: int = 0
     max_fires: int = 0  # 0 = unlimited
+    cluster_once: bool = False  # at most one fire across ALL processes
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -75,7 +84,7 @@ class ChaosRule:
             d["match"] = dict(self.match)
         if self.action == "delay":
             d["delay_ms"] = self.delay_ms
-        for k in ("prob", "every", "after", "max_fires"):
+        for k in ("prob", "every", "after", "max_fires", "cluster_once"):
             v = getattr(self, k)
             if v:
                 d[k] = v

@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT013: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT014: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -401,3 +401,68 @@ class MetricConstructedPerCall(Rule):
                        "re-registers in the global metrics registry every "
                        "call (accumulated values silently reset); hoist "
                        "the metric to module level")
+
+
+_SHARDED_PRODUCERS = {"put_sharded", "reshard"}
+
+
+@register
+class ShardedRefMaterializedOnDriver(Rule):
+    id = "RT014"
+    summary = "driver-side materialization of a ShardedObjectRef"
+    rationale = ("a ShardedObjectRef is a manifest of per-host shm "
+                 "shards; ray_tpu.get()/np.asarray() on one outside a "
+                 "worker gathers every shard's bytes through this one "
+                 "process — exactly the driver funnel the sharded plane "
+                 "exists to avoid; use get_sharded() (device-local "
+                 "assembly) or pass the ref to a @remote(in_specs=...) "
+                 "task so shards stay on their nodes")
+
+    def __init__(self):
+        self._sharded: set[str] = set()
+
+    def on_functiondef(self, node: ast.FunctionDef, ctx: Context):
+        # per-function scope: a name bound from put_sharded in one
+        # function must not taint a same-named parameter or binding in
+        # a later function (the engine's array_bindings save/restore
+        # idiom, done rule-locally; nested defs trade a rare false
+        # negative for no false positives)
+        self._sharded.clear()
+
+    on_asyncfunctiondef = on_functiondef
+
+    def on_assign(self, node: ast.Assign, ctx: Context):
+        # simple forward flow: names bound from put_sharded()/reshard()
+        # calls (resolved through the import table, so rt.put_sharded,
+        # ray_tpu.sharded.reshard and bare imports all count) are
+        # ShardedObjectRefs until rebound
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            return
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Call):
+            origin = ctx.imports.resolve(node.value.func)
+            if (origin and origin[0] == "ray_tpu"
+                    and origin[-1] in _SHARDED_PRODUCERS):
+                self._sharded.add(name)
+                return
+        self._sharded.discard(name)
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if not self._sharded or ctx.in_remote:
+            return  # inside a task/actor method the shards ARE local
+        op = ctx.framework_op(node.func)
+        numpy_op = ctx.is_numpy_ctor(node.func)
+        if op != "get" and numpy_op not in ("asarray", "array"):
+            return
+        for arg in node.args:
+            if isinstance(arg, ast.Name) and arg.id in self._sharded:
+                fn = ("ray_tpu.get" if op == "get"
+                      else f"np.{numpy_op}")
+                ctx.report(self, node,
+                           f"{fn}({arg.id}) materializes a "
+                           "ShardedObjectRef on the driver (every shard "
+                           "funnels through this process); use "
+                           "get_sharded() for device-local assembly or "
+                           "consume it in a @remote(in_specs=...) task")
+                return

@@ -1,0 +1,37 @@
+"""Sharded object plane: first-class distributed arrays in the object store.
+
+The TPU-native feature the reference lacks (ROADMAP item 3): a
+:class:`ShardedObjectRef` is a manifest — global shape/dtype,
+PartitionSpec, mesh axes, per-shard ObjectRefs + owning node — whose
+shards seal directly into each host's shm arena. ``put_sharded`` never
+materializes the global array; ``get_sharded`` reassembles a
+device-local ``jax.Array`` zero-copy from local shm;
+``@ray_tpu.remote(in_specs=..., out_specs=...)`` fans one task per
+shard, routed to the shard's node; spec disagreements redistribute
+through one XLA collective (collective/xla_group.redistribute), never
+through the driver.
+"""
+
+from ray_tpu.sharded.manifest import (  # noqa: F401
+    ShardedObjectRef,
+    ShardEntry,
+    ShardManifest,
+    partition_boxes,
+    spec_to_tuple,
+    tuple_to_spec,
+)
+from ray_tpu.sharded.plane import (  # noqa: F401
+    fetch_shard,
+    get_sharded,
+    manifest_nbytes,
+    put_sharded,
+    stats,
+)
+from ray_tpu.sharded.reshard import reshard  # noqa: F401
+from ray_tpu.sharded.submit import ShardedFunction  # noqa: F401
+
+__all__ = [
+    "ShardedObjectRef", "ShardEntry", "ShardManifest", "ShardedFunction",
+    "put_sharded", "get_sharded", "fetch_shard", "reshard", "stats",
+    "partition_boxes", "spec_to_tuple", "tuple_to_spec", "manifest_nbytes",
+]

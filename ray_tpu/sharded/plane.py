@@ -1,0 +1,225 @@
+"""put_sharded / get_sharded: the sharded object plane's data path.
+
+``put_sharded(jax_array)`` walks the array's addressable shards, dedupes
+replicas by tile box, and seals each unique shard DIRECTLY into this
+host's shm arena — the global array is never materialized, and nothing
+but the manifest exists driver-side. ``get_sharded(ref)`` reassembles a
+device-local ``jax.Array`` the opposite way: each shard is read
+zero-copy out of local shm (the completion lane's location cache and
+owner memory-store make the local-hit check one dict probe), device_put
+onto its mesh position, and stitched with
+``jax.make_array_from_single_device_arrays``.
+
+Placement is partition-rule driven: a numpy input plus
+``rules=PartitionRules.llama(), path="wq/kernel"`` picks its spec with
+the same ``spec_for`` table the train layer shards parameters with.
+
+Fault story: every seal passes the ``sharded.shard_seal`` chaos point
+(action ``error`` -> ObjectStoreError, ``drop`` -> the sealed copy is
+deleted after landing, i.e. "the seal was lost"). A shard produced by a
+task (see submit.py) recovers from loss through the task's core lineage
+— only THAT shard's producing task re-runs; put_sharded shards have no
+producer and surface ObjectLostError, like ``ray.put`` values.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ray_tpu.core import object_store
+from ray_tpu.core.ref import ObjectLostError, ObjectRef
+from ray_tpu.devtools import chaos
+from ray_tpu.sharded import telemetry
+from ray_tpu.sharded.manifest import (
+    ShardedObjectRef,
+    ShardEntry,
+    ShardManifest,
+    box_of_indices,
+    partition_boxes,
+    spec_to_tuple,
+    tuple_to_spec,
+)
+
+
+def _core():
+    from ray_tpu.core import api
+
+    return api.get_core()
+
+
+def _mesh_axes_of(mesh) -> dict:
+    return {str(name): int(size) for name, size in mesh.shape.items()}
+
+
+def manifest_nbytes(m: ShardManifest) -> int:
+    """Deterministic size estimate of the wire manifest (what actually
+    crosses the driver for this sharded object): fixed header + per-dim
+    extents + ~(oid + owner address + box + node id) per shard. Used by
+    the driver-bytes counter so bench can show O(manifest) vs O(array)
+    without a side-effecting pickle of live ObjectRefs."""
+    return 48 + 24 * len(m.global_shape) + 96 * len(m.shards)
+
+
+def _seal_shard(core, value: np.ndarray, *, shard: int,
+                phase: str) -> ObjectRef:
+    """Seal one shard's bytes into the local shm arena (memory store in
+    client mode) and return its owned ref. The ``sharded.shard_seal``
+    fault point fires here for the put/reshard phases."""
+    act = None
+    if chaos.ENABLED:
+        try:
+            act = chaos.point("sharded.shard_seal", shard=int(shard),
+                              phase=phase)
+        except chaos.ChaosError as e:
+            raise object_store.ObjectStoreError(
+                f"shard {shard} seal: {e}") from e
+    t0 = time.perf_counter_ns()
+    ref = core.put_value(value, prefer_shm=True)
+    dur = time.perf_counter_ns() - t0
+    if act is not None and act.kind == "drop" and core.store is not None:
+        # "the seal was lost": the bytes landed, then vanished — exactly
+        # the window a node-local eviction/crash opens. Consumers see a
+        # missing local copy and go through pull -> lineage recovery.
+        core.store.delete(ref.id)
+    telemetry.record(telemetry.SHARD_SEAL, dur, int(value.nbytes))
+    return ref
+
+
+def put_sharded(value, *, spec=None, mesh=None, rules=None, path: str = "",
+                mesh_spec=None) -> ShardedObjectRef:
+    """Store a sharded array as a manifest of per-host shm shards.
+
+    ``value`` may be a jax.Array carrying a NamedSharding (mesh/spec are
+    taken from it unless overridden) or a host array plus an explicit
+    ``mesh`` (or ``mesh_spec``) and either ``spec`` or
+    ``rules``+``path`` (PartitionRules.spec_for drives the choice).
+    The global array is never serialized whole; replicas dedupe to one
+    sealed copy per unique tile box.
+    """
+    core = _core()
+    from ray_tpu.utils.device import configure_jax
+
+    configure_jax()
+    import jax
+
+    if mesh is None and mesh_spec is not None:
+        mesh = mesh_spec.build()
+
+    # a NamedSharding-carrying jax.Array defaults mesh and spec
+    # INDEPENDENTLY: overriding one must not silently drop the other
+    if isinstance(value, jax.Array) and hasattr(value.sharding, "mesh"):
+        if mesh is None:
+            mesh = value.sharding.mesh
+        if spec is None and rules is None:
+            spec = value.sharding.spec
+    if rules is not None and spec is None:
+        ndim = getattr(value, "ndim", 0)
+        spec = rules.spec_for(path, ndim)
+    if mesh is None:
+        raise ValueError("put_sharded needs a mesh (or a jax.Array with "
+                         "a NamedSharding)")
+    if spec is None:
+        from jax.sharding import PartitionSpec as P
+
+        spec = P()  # fully replicated: one shard
+    spec_t = spec_to_tuple(spec)
+    axes = _mesh_axes_of(mesh)
+    global_shape = tuple(int(d) for d in value.shape)
+    dtype = str(value.dtype)
+    boxes = partition_boxes(global_shape, spec_t, axes)
+
+    shard_values: dict[tuple, np.ndarray] = {}
+    if isinstance(value, jax.Array):
+        for s in value.addressable_shards:
+            box = box_of_indices(s.index, global_shape)
+            if box not in shard_values:
+                shard_values[box] = np.asarray(s.data)
+    else:
+        arr = np.asarray(value)
+        for box in boxes:
+            shard_values[box] = arr[tuple(slice(a, b) for a, b in box)]
+
+    entries: list[ShardEntry] = []
+    node = core.node_id.binary() if core.node_id is not None else None
+    for i, box in enumerate(boxes):
+        sv = shard_values.get(box)
+        if sv is None:
+            raise ValueError(
+                f"shard for box {box} is not addressable from this host; "
+                "put_sharded runs where the shards live (call it in the "
+                "worker that owns them)")
+        sv = np.ascontiguousarray(sv)
+        ref = _seal_shard(core, sv, shard=i, phase="put")
+        entries.append(ShardEntry(box=box, ref=ref, node=node,
+                                  nbytes=int(sv.nbytes)))
+    m = ShardManifest(global_shape=global_shape, dtype=dtype, spec=spec_t,
+                      mesh_axes=axes, shards=entries)
+    telemetry.count_driver_bytes(manifest_nbytes(m))
+    return ShardedObjectRef(m)
+
+
+def fetch_shard(sref: ShardedObjectRef, i: int):
+    """One shard's host value — zero-copy from local shm when the bytes
+    are on this node, a raylet pull otherwise (api.get's caller-thread
+    prepass handles the local hit). A lost task-produced shard
+    re-materializes from lineage inside the get (only that shard's
+    producing task re-runs); put_sharded shards have no producer."""
+    _core()  # ensure the runtime is up before touching refs
+    entry = sref.manifest.shards[i]
+    t0 = time.perf_counter_ns()
+    try:
+        from ray_tpu.core import api
+
+        value = api.get(entry.ref)
+    except ObjectLostError as e:
+        raise ObjectLostError(
+            f"shard {i} of {sref!r} is lost and could not be "
+            "re-materialized (put_sharded shards have no lineage; a "
+            "task-produced shard's reconstruction was exhausted)"
+        ) from e
+    telemetry.record(telemetry.SHARD_FETCH, time.perf_counter_ns() - t0,
+                     int(getattr(value, "nbytes", 0)))
+    return value
+
+
+def get_sharded(sref: ShardedObjectRef, *, mesh=None):
+    """Reassemble a device-local ``jax.Array`` from the manifest: each
+    unique shard is fetched once (zero-copy local read), device_put onto
+    every mesh position that addresses its tile, and stitched without
+    ever forming the global host array."""
+    from ray_tpu.utils.device import configure_jax
+
+    configure_jax()
+    import jax
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        mesh = sref.build_mesh()
+    shape = sref.shape
+    sharding = NamedSharding(mesh, tuple_to_spec(sref.spec))
+    index_map = sharding.addressable_devices_indices_map(shape)
+    by_box = sref.manifest.box_index()
+    cache: dict[tuple, np.ndarray] = {}
+    parts = []
+    for dev, index in index_map.items():
+        box = box_of_indices(index, shape)
+        i = by_box.get(box)
+        if i is None:
+            raise ValueError(
+                f"mesh/spec disagree with the manifest: no shard covers "
+                f"{box} (manifest spec {sref.spec}, axes {sref.mesh_axes})")
+        val = cache.get(box)
+        if val is None:
+            val = np.asarray(fetch_shard(sref, i))
+            cache[box] = val
+        parts.append(jax.device_put(val, dev))
+    telemetry.count_driver_bytes(manifest_nbytes(sref.manifest))
+    return jax.make_array_from_single_device_arrays(shape, sharding, parts)
+
+
+def stats() -> dict:
+    """Sharded-plane counters: driver metadata bytes vs shard payload
+    bytes, plus op counts (the bench arm's zero-copy evidence)."""
+    return telemetry.counters()

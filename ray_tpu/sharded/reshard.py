@@ -1,0 +1,51 @@
+"""Collective-backed resharding: spec A -> spec B without a driver hop.
+
+When a consumer's ``in_spec`` disagrees with a stored manifest's spec,
+the redistribute runs as ONE XLA program: local shards assemble into a
+device-resident global array (shm -> device, zero host gathering), a
+jit whose ``out_shardings`` names the new spec makes the compiler insert
+the collective (all-gather / all-to-all / collective-permute over
+ICI/DCN — GSPMD's resharding machinery), and the output shards seal
+straight back into shm. The driver sees two manifests and nothing else;
+the array bytes never ride an RPC frame. The XLA entry point lives in
+``collective/xla_group.redistribute`` beside the eager collectives.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ray_tpu.sharded import telemetry
+from ray_tpu.sharded.manifest import (
+    ShardedObjectRef,
+    norm_spec,
+    spec_to_tuple,
+)
+from ray_tpu.sharded.plane import get_sharded, put_sharded
+
+
+def reshard(sref: ShardedObjectRef, spec, *, mesh=None) -> ShardedObjectRef:
+    """Redistribute ``sref`` to ``spec``, returning a new
+    ShardedObjectRef. A no-op (same manifest) when the specs already
+    agree — compared dim-positionally, so P("dp") == P("dp", None).
+    Runs device-side through the XLA collective layer; records the
+    ``reshard`` stage and the new manifest's driver bytes."""
+    ndim = len(sref.shape)
+    spec_t = spec_to_tuple(spec)
+    if norm_spec(spec_t, ndim) == norm_spec(tuple(sref.spec), ndim):
+        return sref
+    from ray_tpu.collective.xla_group import redistribute
+    from ray_tpu.sharded.manifest import tuple_to_spec
+
+    # canonical hashable PartitionSpec: list-ish specs must still hit
+    # the per-(mesh, spec) cached redistribute program
+    spec = tuple_to_spec(spec_t)
+    if mesh is None:
+        mesh = sref.build_mesh()
+    t0 = time.perf_counter_ns()
+    garr = get_sharded(sref, mesh=mesh)
+    out = redistribute(garr, mesh, spec)
+    new = put_sharded(out, mesh=mesh, spec=spec)
+    telemetry.record(telemetry.RESHARD, time.perf_counter_ns() - t0,
+                     int(sref.nbytes))
+    return new

@@ -56,13 +56,20 @@ W_TASK = 9            # worker compact record: ring/deser/exec deltas, t=exec en
 SAMPLE = 10           # driver compact record: full per-task stage breakdown
 CHAOS = 11            # chaos fault fired (devtools/chaos): id slot carries
 #                       the point name, args (rule, action code, fault seq)
+# Sharded object plane (ray_tpu/sharded): per-shard seal/fetch and whole-
+# array reshard events; args are (duration_ns clamped u32, nbytes lo,
+# nbytes hi) so a postmortem shows which shard op a process died inside.
+SHARD_SEAL = 12       # one shard sealed into the local shm arena
+SHARD_FETCH = 13      # one shard read (zero-copy local or pulled)
+RESHARD = 14          # collective-backed spec redistribute completed
 
 STAGE_NAMES = {
     SUBMIT: "submit", RING_PUSH: "ring_push", WORKER_POP: "worker_pop",
     DESERIALIZE: "deserialize", EXEC_START: "exec_start",
     EXEC_END: "exec_end", COMPLETION_PUSH: "completion_push",
     DRIVER_APPLY: "driver_apply", W_TASK: "w_task", SAMPLE: "sample",
-    CHAOS: "chaos",
+    CHAOS: "chaos", SHARD_SEAL: "shard_seal", SHARD_FETCH: "shard_fetch",
+    RESHARD: "reshard",
 }
 
 # Reported latency stages (SAMPLE args, ns): both ring hops are covered —

@@ -1,0 +1,49 @@
+"""RT014 fixture: driver-side materialization of a ShardedObjectRef."""
+import numpy as np
+
+import ray_tpu
+from ray_tpu.sharded import put_sharded, reshard
+
+
+def driver_gathers(mesh, arr, P):
+    sref = put_sharded(arr, mesh=mesh, spec=P("dp"))
+    return ray_tpu.get(sref)  # expect: RT014
+
+
+def driver_asarray(mesh, arr, P):
+    sref = ray_tpu.put_sharded(arr, mesh=mesh, spec=P("dp"))
+    return np.asarray(sref)  # expect: RT014
+
+
+def resharded_then_gathered(sref2, P):
+    out = reshard(sref2, P("tp"))
+    return np.array(out)  # expect: RT014
+
+
+def sanctioned_consumption(mesh, arr, P):
+    sref = put_sharded(arr, mesh=mesh, spec=P("dp"))
+    local = ray_tpu.get_sharded(sref, mesh=mesh)  # device-local: clean
+    return np.asarray(local)  # plain jax array, not a sharded ref: clean
+
+
+def rebound_name_is_clean(mesh, arr, P):
+    sref = put_sharded(arr, mesh=mesh, spec=P("dp"))
+    sref = ray_tpu.get_sharded(sref, mesh=mesh)  # rebound to an array
+    return np.asarray(sref)  # clean: no longer a ShardedObjectRef
+
+
+@ray_tpu.remote
+def worker_side_get(sref):
+    # inside a task the shards ARE local: materializing is the point
+    return np.asarray(ray_tpu.get_sharded(sref))
+
+
+def suppressed(mesh, arr, P):
+    sref = put_sharded(arr, mesh=mesh, spec=P("dp"))
+    return ray_tpu.get(sref)  # raylint: disable=RT014 — debugging helper
+
+
+def same_name_other_function(sref):
+    # `sref` here is THIS function's parameter (a plain value), not the
+    # sharded binding from the functions above: per-function scope
+    return np.asarray(sref)  # clean
