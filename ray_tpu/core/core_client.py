@@ -226,6 +226,25 @@ def _handle_options(spec: dict) -> dict:
     return {"method_num_returns": spec.get("method_num_returns") or {}}
 
 
+class ActorCallTemplate:
+    """Frozen per-(handle, method) submission state — the actor-call
+    analogue of api.SubmitTemplate (ref: actor_task_submitter.h:75 cached
+    per-handle submission state). Everything `.remote()` used to re-derive
+    per call — the packed method-key bytes, the options-eligibility
+    verdict (num_returns/concurrency-group/tracing), and the lane binding
+    — is resolved ONCE at the first call of an ActorMethod (which PR 2
+    already made a cached per-handle object).
+
+    Invalidation: ``lane`` is re-looked-up whenever the bound lane is
+    broken or retired (worker death, reattach after restart), and dropped
+    when no live lane exists — the RPC path, which stays the source of
+    truth, then serves the call. ``.options()`` forks build a new
+    ActorMethod and therefore a new template. Never serialized
+    (ActorMethod.__getstate__ strips it)."""
+
+    __slots__ = ("core", "actor_id", "method", "mkey", "opts_ok", "lane")
+
+
 class CoreClient:
     def __init__(self, loop: asyncio.AbstractEventLoop | None = None,
                  client_mode: bool = False):
@@ -333,6 +352,15 @@ class CoreClient:
         self._rec_enabled = recorder.enabled()
         self._rec_published = -1  # stats.n at the last metrics publish
         self._lat_published = -1  # stats.n at the last latency kv_put
+        # actor-call stage window: actor fast-lane replies store their raw
+        # (t0, t_rx, tid, stamp) samples here instead of the task window,
+        # published beside it (ns="latency" key "<worker>.actor", stages
+        # prefixed actor_*) so list_task_latency shows the actor-call
+        # stage breakdown the ROADMAP item asked for
+        self._actor_stats = recorder.StageStats(self.cfg.recorder_events_cap)
+        self._actor_rec_published = 0   # astats.n at last metrics publish
+        self._actor_lat_published = -1  # astats.n at last CONFIRMED kv_put
+        self._actor_lat_pending = -1
         # extra latency windows published beside the recorder's on the
         # flush timer (ns="latency", key "<worker>.<suffix>") — the
         # sharded plane registers its shard_seal/shard_fetch/reshard
@@ -372,6 +400,8 @@ class CoreClient:
         self._bg.spawn(self.task_events._flush_loop(), self.loop)
         if self.cfg.fastpath_enabled and self.store is not None:
             self._bg.spawn(self._fast_health_loop(), self.loop)
+        self.add_latency_source("actor", self._actor_latency_snapshot,
+                                self._actor_latency_confirm)
 
     # -------------------------------------------------------------- pubsub
     def _on_push(self, msg):
@@ -1532,6 +1562,25 @@ class CoreClient:
         if ns["store"]:
             for k, v in ns["store"].items():
                 metrics.object_store_stat.set(v, tags={"stat": k})
+        astats = self._actor_stats if self._rec_enabled else None
+        if (astats is not None and astats.n
+                and astats.n != self._actor_rec_published):
+            # actor-call stage families, same bounded feed as tasks below
+            # (stage tags prefixed actor_*)
+            self._actor_rec_published = astats.n
+            fresh = astats.new_since_flush()
+            if fresh:
+                for i, name in enumerate(recorder.LATENCY_STAGES):
+                    metrics.task_stage_seconds.observe_many(
+                        [s[i] / 1e9 for s in fresh],
+                        tags={"stage": f"actor_{name}"})
+            win = astats.window(512)
+            for i, name in enumerate(recorder.LATENCY_STAGES):
+                vals = sorted(s[i] for s in win)
+                for q, qn in ((0.5, "p50"), (0.99, "p99")):
+                    metrics.task_stage_us.set(
+                        recorder.percentile(vals, q) / 1e3,
+                        tags={"stage": f"actor_{name}", "q": qn})
         stats = recorder.get_stats() if self._rec_enabled else None
         if stats is None or stats.n == 0 or stats.n == self._rec_published:
             return  # recorder off / idle: stage aggregation has no new work
@@ -1587,6 +1636,25 @@ class CoreClient:
         snap["worker_id"] = self.worker_id.hex()
         return snap
 
+    def _actor_latency_snapshot(self) -> dict | None:
+        """Latency-source hook (flush timer): the actor-call stage window
+        as actor_*-prefixed stage lists, skipped while idle. Publish is
+        confirmed by _actor_latency_confirm only after the kv_put LANDS,
+        so a transient GCS error republishes the window next flush."""
+        stats = self._actor_stats
+        if stats is None or stats.n == 0 or stats.n == self._actor_lat_published:
+            return None
+        win = stats.window(1024)
+        if not win:
+            return None
+        self._actor_lat_pending = stats.n
+        return {"count": stats.n,
+                "stages": {f"actor_{name}": [s[i] for s in win]
+                           for i, name in enumerate(recorder.LATENCY_STAGES)}}
+
+    def _actor_latency_confirm(self) -> None:
+        self._actor_lat_published = self._actor_lat_pending
+
     async def _fast_actor_attach(self, actor_id: ActorID, conn):
         """Ring lane to a same-node actor's worker: actor calls then skip
         the loop + socket entirely, with the ring's SPSC order AS the
@@ -1619,6 +1687,10 @@ class CoreClient:
                  "owner": list(self.address)}, timeout=10)
         except Exception:
             ok = False
+        methods = None
+        if isinstance(ok, dict):  # 1.8 reply: method eligibility table
+            methods = ok.get("methods")
+            ok = ok.get("ok")
         if not ok or self._actor_conns.get(actor_id) is not conn:
             ring.close_pair()
             return
@@ -1627,6 +1699,8 @@ class CoreClient:
             SimpleNamespace(conn=conn, fast_lane=None, idle_since=0.0,
                             queued=0),
             ("actor", actor_id))
+        lane.methods = methods
+        lane.drain_evt = asyncio.Event()  # created ON the loop (waiters too)
         t = _threading.Thread(target=self._fast_reader, args=(lane,),
                               name="rt-fastread-actor", daemon=True)
         lane.reader = t
@@ -1634,43 +1708,118 @@ class CoreClient:
         self._fast_lanes.append(lane)
         t.start()
 
+    def actor_call_template(self, actor_id: ActorID, method: str,
+                            num_returns, concurrency_group) -> ActorCallTemplate:
+        """Build the frozen per-(handle, method) submission template
+        (cached on the ActorMethod by ref.ActorMethod.remote)."""
+        t = ActorCallTemplate()
+        t.core = self
+        t.actor_id = actor_id
+        t.method = method
+        t.mkey = b"am:" + method.encode()
+        t.opts_ok = (num_returns == 1 and concurrency_group is None
+                     and not self.cfg.tracing_enabled)
+        t.lane = None
+        return t
+
+    def fast_actor_lane_stats(self, actor_id: ActorID) -> dict | None:
+        """Seq/out-of-order accounting of an actor's ring lane (tests,
+        bench): None when no lane is attached."""
+        lane = self._fast_actor_lanes.get(actor_id)
+        if lane is None:
+            return None
+        return {"next_seq": lane.next_seq, "done_seq": lane.done_seq,
+                "ooo_replies": lane.ooo_replies, "broken": lane.broken,
+                "retired": lane.retired, "inflight": len(lane.inflight)}
+
+    def _fast_resolve_ref_args(self, args, kwargs):
+        """Top-level ObjectRef arguments: resolve the locally-ready ones
+        inline on the caller thread (the completion lane's
+        get_local_prepass — ready memory-store entries and sealed local
+        shm objects, zero event-loop round trip) so the call stays on the
+        ring. Returns (args, kwargs, ok); ok=False when any ref is still
+        pending/remote/errored — THAT call takes the RPC path (which owns
+        dependency blocking and error surfacing), the lane stays live."""
+        refs = [a for a in args if isinstance(a, ObjectRef)]
+        if kwargs:
+            refs.extend(v for v in kwargs.values()
+                        if isinstance(v, ObjectRef))
+        if not refs:
+            return args, kwargs, True
+        hits = self.get_local_prepass(refs)
+        for r in refs:
+            hit = hits.get(r.id)
+            if hit is None or hit[0] != "V":
+                return args, kwargs, False
+        args = tuple(hits[a.id][1] if isinstance(a, ObjectRef) else a
+                     for a in args)
+        if kwargs:
+            kwargs = {k: hits[v.id][1] if isinstance(v, ObjectRef) else v
+                      for k, v in kwargs.items()}
+        return args, kwargs, True
+
     def _try_fast_actor_submit(self, actor_id: ActorID, method: str,
-                               args, kwargs):
-        """User-thread fast actor call; None -> RPC path. An ineligible
-        argument RETIRES the lane (permanent RPC downgrade) so ring and
-        socket traffic can never reorder a caller's calls."""
+                               args, kwargs, tmpl=None):
+        """User-thread fast actor call; None -> RPC path for THIS call
+        only (per-call downgrade — the lane survives). FIFO across the
+        mixed stream: a slow-path call drains the lane's in-flight
+        records before dispatching (_prepare_actor_task), and while RPC
+        calls are queued/in-flight this gate keeps new calls off the ring
+        so ring and socket traffic can never reorder a caller's calls."""
         from ray_tpu.core import fastpath
 
-        lane = self._fast_actor_lanes.get(actor_id)
-        if lane is None or lane.broken or lane.retired:
+        # Loop-resident callers (the serve router, async actor methods
+        # making nested calls) stay on the RPC path: its reply applies
+        # directly ON the loop, while a ring completion detours through
+        # the sweeper thread + migrate queue — two extra handoffs that
+        # measured a ~40% serve_qps hit on a 2-vCPU box. The ring wins
+        # for user threads, where the blocking get() steals the reply
+        # consumer; a loop caller can never block-steal.
+        if _threading.get_ident() == getattr(self.loop, "_thread_id", None):
             return None
+        lane = tmpl.lane if tmpl is not None else None
+        if lane is None or lane.broken or lane.retired:
+            lane = self._fast_actor_lanes.get(actor_id)
+            if lane is None or lane.broken or lane.retired:
+                if tmpl is not None:
+                    tmpl.lane = None
+                return None
+            if tmpl is not None:
+                tmpl.lane = lane  # rebind on (re)attach
+        # worker-shipped eligibility: generator methods and names the
+        # worker never heard of go RPC per call, without a ring round trip
+        mt = lane.methods
+        if mt is not None:
+            v = mt.get(method)
+            if v is None or v[0] == "gen":
+                return None
         # per-caller FIFO: never overtake queued/in-flight RPC calls
         if self._actor_queues.get(actor_id) or self._actor_inflight.get(
                 actor_id):
             return None
-        for a in args:
-            if isinstance(a, ObjectRef):
-                self._fast_retire_actor_lane(lane)
-                return None
-        if kwargs:
-            for a in kwargs.values():
-                if isinstance(a, ObjectRef):
-                    self._fast_retire_actor_lane(lane)
-                    return None
+        has_ref = any(isinstance(a, ObjectRef) for a in args)
+        if not has_ref and kwargs:
+            has_ref = any(isinstance(v, ObjectRef) for v in kwargs.values())
+        if has_ref:
+            args, kwargs, ok = self._fast_resolve_ref_args(args, kwargs)
+            if not ok:
+                return None  # pending/remote ref: RPC path for this call
         task_id = TaskID.generate_actor()
         tid = task_id.binary()
         now_ns = time.perf_counter_ns()
         t0 = now_ns if self._rec_enabled else 0
+        mkey = tmpl.mkey if tmpl is not None else b"am:" + method.encode()
+        # seq label rides the record (protocol 1.8): lock-free draw — a
+        # racing retire is caught by _fast_register_and_push under the cv
+        seq = next(lane.seq_counter)
+        lane.next_seq = seq + 1  # advisory mirror (stats/tests)
         try:
-            rec = fastpath.pack_task(tid, b"am:" + method.encode(), args,
-                                     kwargs, t0)
+            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0, seq)
         except Exception:
-            self._fast_retire_actor_lane(lane)
-            return None
+            return None  # unpicklable args: RPC path for this call
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
-            self._fast_retire_actor_lane(lane)
-            return None
+            return None  # big args belong in the object store
         gap_ns = now_ns - self._fast_last_submit
         self._fast_last_submit = now_ns
         ref = self._fast_register_and_push(
@@ -1758,14 +1907,24 @@ class CoreClient:
         # budget on slow interpreters (bench.py recorder_overhead_us)
         if stats is not None:
             sring, scap = stats.ring, stats.cap
+        astats = self._actor_stats
         batch = []
+        drained = False
         with self._fast_cv:
             for rec in recs:
-                tid_b, status, payload, stamp = fastpath.unpack_reply(rec)
+                tid_b, status, payload, stamp, seq = fastpath.unpack_reply(rec)
                 task_id = TaskID(tid_b)
                 light = lane.inflight.pop(task_id, None)
                 oid = ObjectID.for_task_return(task_id, 0)
                 ent = self._fast_oid_lane.pop(oid, None)
+                if seq is not None and light is not None:
+                    # out-of-order completion accounting (async actors
+                    # reply as each method finishes): seq below the high
+                    # water is evidence the lane completed out of order
+                    if seq < lane.done_seq:
+                        lane.ooo_replies += 1
+                    elif seq > lane.done_seq:
+                        lane.done_seq = seq
                 if light is None:
                     # untracked completion: a duplicate delivery (the
                     # spill RPC's timeout path may re-send records whose
@@ -1776,21 +1935,40 @@ class CoreClient:
                     if entry is None or entry.ready.is_set():
                         continue
                 if (stamp is not None and ent is not None and ent[1]
-                        and stats is not None
                         and status != fastpath.NEED_SLOW):
                     # ONE raw tuple store per task — stamp decoding,
                     # percentile math and shm SAMPLE slots all happen on
-                    # the flush timer over bounded windows, never here
-                    sring[stats.n % scap] = (ent[1], t_rx, tid_b, stamp)
-                    stats.n += 1
+                    # the flush timer over bounded windows, never here.
+                    # Actor calls land in their own window so the stage
+                    # breakdown surfaces as actor_* rows beside the task
+                    # rows in state.list_task_latency().
+                    if task_id.is_actor_task():
+                        if astats is not None:
+                            astats.ring[astats.n % astats.cap] = (
+                                ent[1], t_rx, tid_b, stamp)
+                            astats.n += 1
+                    elif stats is not None:
+                        sring[stats.n % scap] = (ent[1], t_rx, tid_b, stamp)
+                        stats.n += 1
                 if status != fastpath.NEED_SLOW:
                     self._fast_done[oid] = (status, payload)
                 batch.append((task_id, oid, status, payload, light))
+            if (not lane.inflight and lane.drain_waiters
+                    and lane.drain_evt is not None):
+                # wake RPC-fallback calls parked on the drain barrier —
+                # gated on drain_waiters so the pure-ring round trip
+                # never pays this loop self-pipe wake
+                drained = True
             self._fast_migrate_q.extend(batch)
             arm = not self._fast_migrate_armed
             if arm:
                 self._fast_migrate_armed = True
             self._fast_cv.notify_all()
+        if drained:
+            try:
+                self.loop.call_soon_threadsafe(lane.drain_evt.set)
+            except RuntimeError:
+                pass  # loop gone (shutdown)
         if arm:
             try:
                 self.loop.call_soon_threadsafe(self._drain_fast_migrations)
@@ -1844,11 +2022,17 @@ class CoreClient:
             if status == fastpath.NEED_SLOW:
                 if light is not None:
                     if light[0] == "actor":
-                        # one ineligible method downgrades the whole lane:
-                        # partial fast/slow mixing would break FIFO
+                        # worker-side NEED_SLOW: a method the shipped
+                        # eligibility table didn't cover (dynamically
+                        # added / stale table). The worker NEED_SLOWed
+                        # the whole in-flight tail in ring order, so
+                        # retiring here keeps FIFO; driver-visible
+                        # ineligibility (ref args, generators, option
+                        # overrides) never reaches this path — those
+                        # fall back per CALL and the lane lives on
                         lane = self._fast_actor_lanes.get(light[1])
                         if lane is not None:
-                            lane.retired = True
+                            self._fast_retire_actor_lane(lane)
                     else:
                         self._fast_ineligible_funcs.add(
                             getattr(light[0], "__rt_func_id__", b""))
@@ -1987,10 +2171,12 @@ class CoreClient:
         }
 
     def _fast_retire_actor_lane(self, lane) -> None:
-        """Permanent RPC downgrade of an actor lane (ineligible call).
-        When nothing is in flight the ring closes right away so the
-        worker's executor-resident pump cycle stops; otherwise the drain
-        path closes it once the last reply lands."""
+        """Permanent RPC downgrade of an actor lane. Since 1.8 only a
+        worker-side NEED_SLOW (method missing from the shipped
+        eligibility table) lands here — driver-visible ineligibility
+        falls back per call. When nothing is in flight the ring closes
+        right away so the worker's executor-resident pump cycle stops;
+        otherwise the drain path closes it once the last reply lands."""
         lane.retired = True
         with self._fast_cv:
             drained = not lane.inflight and not lane.broken
@@ -2026,6 +2212,11 @@ class CoreClient:
                     self._fast_oid_lane.pop(
                         ObjectID.for_task_return(task_id, 0), None)
             self._fast_cv.notify_all()
+        if lane.drain_evt is not None and lane.drain_waiters:
+            try:  # nothing is in flight on a broken lane: wake drain waiters
+                self.loop.call_soon_threadsafe(lane.drain_evt.set)
+            except RuntimeError:
+                pass  # loop gone (shutdown)
         with lane.txlock:
             # buffered records were in the inflight snapshot above (or in
             # an earlier break's): the RPC resubmission owns them now
@@ -3249,14 +3440,23 @@ class CoreClient:
 
     def submit_actor_task(self, handle: ActorHandle, method: str, args, kwargs,
                           num_returns=1,
-                          concurrency_group: str | None = None
+                          concurrency_group: str | None = None,
+                          _tmpl: ActorCallTemplate | None = None
                           ) -> ObjectRef | list[ObjectRef]:
         """Submission order is fixed here (sync, caller thread); a per-actor
         pump coroutine then resolves deps, assigns per-connection sequence
         numbers and pipelines pushes — the reference's ActorTaskSubmitter
         shape (ref: actor_task_submitter.h:75, ordered sends + out-of-order
-        replies)."""
-        if (num_returns == 1 and concurrency_group is None
+        replies). ``_tmpl`` (set by ref.ActorMethod.remote) carries the
+        frozen per-(handle, method) submission state so the fast try skips
+        every per-call re-derivation."""
+        if _tmpl is not None:
+            if _tmpl.opts_ok:
+                ref = self._try_fast_actor_submit(handle.actor_id, method,
+                                                  args, kwargs, _tmpl)
+                if ref is not None:
+                    return ref
+        elif (num_returns == 1 and concurrency_group is None
                 and not self.cfg.tracing_enabled):
             ref = self._try_fast_actor_submit(handle.actor_id, method,
                                               args, kwargs)
@@ -3361,12 +3561,30 @@ class CoreClient:
             spec["_resolved"] = True
             if pins:
                 self._inflight_pins[spec["task_id"]] = pins
-        # per-caller FIFO across the fast->RPC downgrade: ring records
-        # already in flight must complete before any RPC call dispatches
+        # per-caller FIFO across the fast->RPC per-call fallback: ring
+        # records already in flight must complete before any RPC call
+        # dispatches. Event-driven: the reply thread sets drain_evt when
+        # the lane's inflight map empties (and break-lane does too), with
+        # a bounded re-check instead of the old 1ms constant-sleep poll
+        # (the RT013 shape).
         lane = self._fast_actor_lanes.get(spec["actor_id"])
-        if lane is not None:
-            while lane.inflight and not lane.broken:
-                await asyncio.sleep(0.001)
+        if lane is not None and lane.inflight and not lane.broken:
+            evt = lane.drain_evt
+            lane.drain_waiters += 1  # reply threads signal only when > 0
+            try:
+                while lane.inflight and not lane.broken:
+                    if evt is None:  # no event (not expected): bounded poll
+                        await asyncio.sleep(0.01)
+                        continue
+                    evt.clear()
+                    if not lane.inflight or lane.broken:
+                        break  # emptied between the check and the clear
+                    try:
+                        await asyncio.wait_for(evt.wait(), timeout=0.25)
+                    except asyncio.TimeoutError:
+                        pass  # defensive re-check; the set may have raced
+            finally:
+                lane.drain_waiters -= 1
         conn = await self._actor_connection(spec["actor_id"])
         if self._actor_recover_pending.get(spec["actor_id"]):
             # a connection died while this dispatch was suspended: the

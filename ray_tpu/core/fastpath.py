@@ -30,9 +30,19 @@ full past ``fastpath_reply_spill_ms`` the remainder spills to the driver
 over RPC (``rpc_fast_result``), so a stalled driver can never wedge task
 execution.
 
-Anything that doesn't fit — object-ref args, generators, actors with
-options, worker death mid-flight — falls back to the ordinary RPC path,
-which stays the single source of truth for scheduling semantics.
+Anything that doesn't fit — generators, tasks with options, worker death
+mid-flight — falls back to the ordinary RPC path, which stays the single
+source of truth for scheduling semantics.
+
+Actor lanes (protocol 1.8) ride the same rings with three extras: records
+carry a per-lane call sequence number, replies echo it, and completions
+may stream back OUT of submission order — async-actor methods execute on
+the worker's event loop and reply as each finishes, so ring order is the
+per-caller FIFO *dispatch* invariant, not a completion invariant. Calls
+the lane cannot carry (a not-yet-local ObjectRef argument, a generator
+method, a per-call options override) fall back to the RPC path per CALL:
+the driver drains the lane's in-flight records first (FIFO across the
+mixed stream) and the lane resumes fast service afterwards.
 """
 
 from __future__ import annotations
@@ -351,8 +361,45 @@ def unpack_task(rec: bytes):
 # (protocol 1.7; kept ≤ 16 bytes so inline results stay under the
 # fastpath_inline_result_max threshold budget)
 STAMPED = 0x100
+# reply-status flag bit (protocol 1.8): a 4-byte per-call sequence number
+# follows the header (after the stamp when both are present). Actor-lane
+# replies echo the seq the submit record carried, so the driver can match
+# completions that stream back OUT of submission order (async actors
+# reply as each method finishes) while ring order stays the per-caller
+# FIFO *dispatch* invariant.
+SEQED = 0x200
 _STAMP = struct.Struct("<IIQ")  # ring_ns (sat), deser_ns (sat), exec_ns
+_SEQ = struct.Struct("<I")
+_AHDR = struct.Struct("<IQ")    # actor record header: seq, t_submit_ns
 _U32_MAX = 0xFFFFFFFF
+
+
+def pack_actor_task(task_id: bytes, mkey: bytes, args, kwargs,
+                    t_ns: int, seq: int) -> bytes:
+    """Actor-lane task record (protocol 1.8). Same two-tier arg encoding
+    as :func:`pack_task` ("A" = C pickler, "C" = serialization.pack), but
+    the header always carries the per-lane call sequence number plus the
+    submit stamp (0 when the recorder is off) — the seq is what lets
+    async-actor completions stream back out of ring order while the
+    driver still accounts each call exactly once."""
+    if _simple(args) and (not kwargs or _simple(kwargs)):
+        body = pickle.dumps((task_id, mkey, args, kwargs), protocol=5)
+        return b"A" + _AHDR.pack(seq, t_ns) + body
+    body = serialization.pack((task_id, mkey, args, kwargs))
+    return b"C" + _AHDR.pack(seq, t_ns) + body
+
+
+def unpack_actor_task(rec: bytes):
+    """-> (task_id, mkey, args, kwargs, t_submit_ns, seq). Pre-1.8 actor
+    records ("P"/"S"/"Q"/"R") decode with seq=None."""
+    kind = rec[:1]
+    if kind == b"A":
+        seq, t_ns = _AHDR.unpack_from(rec, 1)
+        return (*pickle.loads(rec[13:]), t_ns, seq)
+    if kind == b"C":
+        seq, t_ns = _AHDR.unpack_from(rec, 1)
+        return (*serialization.unpack(rec[13:]), t_ns, seq)
+    return (*unpack_task(rec), None)
 
 
 def pack_stamp(ring_ns: int, deser_ns: int, exec_ns: int) -> bytes:
@@ -376,18 +423,31 @@ def unpack_stamp(stamp: bytes) -> tuple[int, int, int]:
 
 
 def pack_reply(task_id: bytes, status: int, payload: bytes,
-               stamp: bytes = b"") -> bytes:
+               stamp: bytes = b"", seq: int | None = None) -> bytes:
+    if seq is not None:
+        status |= SEQED
+        tail = (stamp + _SEQ.pack(seq)) if stamp else _SEQ.pack(seq)
+        if stamp:
+            status |= STAMPED
+        return struct.pack("<16sI", task_id, status) + tail + payload
     if stamp:
         return struct.pack("<16sI", task_id, status | STAMPED) + stamp + payload
     return struct.pack("<16sI", task_id, status) + payload
 
 
 def unpack_reply(rec: bytes):
-    """-> (task_id, status, payload, stamp | None)."""
+    """-> (task_id, status, payload, stamp | None, seq | None)."""
     task_id, status = struct.unpack_from("<16sI", rec)
+    off = 20
+    stamp = None
+    seq = None
     if status & STAMPED:
-        return task_id, status & ~STAMPED, rec[36:], rec[20:36]
-    return task_id, status, rec[20:], None
+        stamp = rec[off:off + 16]
+        off += 16
+    if status & SEQED:
+        (seq,) = _SEQ.unpack_from(rec, off)
+        off += 4
+    return task_id, status & ~(STAMPED | SEQED), rec[off:], stamp, seq
 
 
 def pack_shm_size(size: int) -> bytes:
@@ -413,7 +473,9 @@ class FastLane:
 
     __slots__ = ("ring", "worker", "key", "inflight", "broken", "reader",
                  "return_armed", "rx_lock", "user_wants", "resume_evt",
-                 "retired", "txbuf", "txbytes", "txlock")
+                 "retired", "txbuf", "txbytes", "txlock", "seq_counter",
+                 "next_seq", "done_seq", "ooo_replies", "drain_evt",
+                 "drain_waiters", "methods")
 
     def __init__(self, ring: RingPair, worker, key):
         self.ring = ring
@@ -423,6 +485,33 @@ class FastLane:
         self.broken = False
         self.reader: threading.Thread | None = None
         self.return_armed = False  # one idle lease-return watcher at a time
+        # actor lanes (protocol 1.8): per-lane call sequence — drawn
+        # lock-free (itertools.count: next() is GIL-atomic) at submit,
+        # echoed in every reply so completions may stream back out of
+        # submission order (async actors). done_seq is the highest seq
+        # applied; ooo_replies counts replies that arrived below it (the
+        # out-of-order evidence, surfaced by
+        # CoreClient.fast_actor_lane_stats for tests and the bench);
+        # next_seq is the advisory mirror those stats read.
+        import itertools
+
+        self.seq_counter = itertools.count()
+        self.next_seq = 0
+        self.done_seq = -1
+        self.ooo_replies = 0
+        # RPC-fallback drain barrier: an asyncio.Event (created on the
+        # loop at attach) set whenever ``inflight`` empties WHILE a
+        # slow-path call waits on it (drain_waiters > 0 — the gate keeps
+        # the loop self-pipe wake OFF the pure-ring round trip, where it
+        # measured ~25% of the whole sync call). The actor pump awaits
+        # the event before dispatching a slow-path call, replacing the
+        # old 1ms busy-poll (the RT013 shape).
+        self.drain_evt = None
+        self.drain_waiters = 0
+        # worker-shipped method eligibility table (attach reply, 1.8):
+        # name -> (verdict, concurrency_group); None = unknown (pre-1.8
+        # worker), in which case the worker-side NEED_SLOW stays the gate
+        self.methods = None
         # Coalesced submit flush: framed records buffered here during a
         # burst ride ONE rt_ring_push_batch (one ring lock round + at most
         # one futex wake) instead of a push per record. Every buffered
@@ -431,9 +520,11 @@ class FastLane:
         self.txbuf: list = []
         self.txbytes = 0
         self.txlock = threading.Lock()
-        # actor lanes: permanently downgraded to the RPC path (the first
-        # ineligible call would otherwise race ring traffic and break the
-        # per-caller FIFO contract); in-flight records still drain
+        # actor lanes: permanent RPC downgrade. Since 1.8 this fires ONLY
+        # on a worker-side NEED_SLOW (a method missing from the shipped
+        # eligibility table — dynamically added, or a stale table);
+        # ineligible ARGUMENTS and ineligible methods the driver can see
+        # coming fall back per CALL instead. In-flight records still drain.
         self.retired = False
         # reply-ring consumer election: a blocking get() steals consumption
         # from the sweeper thread (one thread hop fewer per result); the

@@ -247,6 +247,19 @@ class ActorMethod:
         self._name = name
         self._num_returns = num_returns
         self._concurrency_group = concurrency_group
+        # frozen per-(handle, method) submission template
+        # (core_client.ActorCallTemplate): method-key bytes + options
+        # eligibility + lane binding resolved once at the first call —
+        # the actor twin of PR 2's SubmitTemplate. ActorMethods are
+        # cached on the handle, so the template survives across calls.
+        self._ftmpl = None
+
+    def __getstate__(self):
+        # the template pins the driver's CoreClient and lane: never ship
+        # it with a method handle (it rebuilds wherever the method lands)
+        state = self.__dict__.copy()
+        state["_ftmpl"] = None
+        return state
 
     def options(self, num_returns: int | None = None,
                 concurrency_group: str | None = None, **kw):
@@ -263,10 +276,16 @@ class ActorMethod:
             # backfill a deserialized handle once: later calls (and later
             # methods of the same handle) skip the lookup
             core = self._handle._core = api.get_core()
+        tmpl = self._ftmpl
+        if tmpl is None or tmpl.core is not core:
+            tmpl = self._ftmpl = core.actor_call_template(
+                self._handle.actor_id, self._name,
+                self._num_returns or 1, self._concurrency_group)
         return core.submit_actor_task(
             self._handle, self._name, args, kwargs,
             num_returns=self._num_returns or 1,
             concurrency_group=self._concurrency_group,
+            _tmpl=tmpl,
         )
 
     def bind(self, *args) -> Any:

@@ -281,21 +281,20 @@ class Worker:
         """Driver attaches a shm task ring (see core/fastpath.py). The pump
         thread lives until the ring closes (driver teardown or our exit).
         kind="actor" rings carry actor method calls: the SPSC order IS the
-        caller's FIFO, execution rides the SAME single task executor as
-        RPC calls so actor state keeps one thread."""
+        caller's FIFO *dispatch* order. Sync methods on a strictly serial
+        actor execute inline on the pump (zero thread handoffs); async
+        methods, threaded actors (max_concurrency > 1) and concurrency-
+        group methods are DISPATCHED in ring order to the event loop /
+        the right pool and reply as each finishes — out-of-order
+        completions, matched driver-side by the per-call seq (1.8).
+
+        The reply ships the actor's init-time method eligibility table so
+        the driver routes generator/unknown methods to the RPC path per
+        call without a ring round trip."""
         import threading
 
         from ray_tpu.core import fastpath
 
-        if (p.get("kind") == "actor"
-                and getattr(self, "_actor_max_concurrency", 1) > 1):
-            # Threaded actors (max_concurrency > 1) must not take the ring
-            # lane: the pump runs records strictly sequentially through one
-            # executor job, so methods that legitimately block on each
-            # other (wait()/signal() coordination) would deadlock. Mirror
-            # the RPC batched-run gate (see _actor_max_concurrency == 1
-            # check in the dispatch path) by refusing the attach outright.
-            return False
         ring = fastpath.RingPair.open(p["name"])
         # the driver's server address: spill target for completion records
         # the result ring cannot absorb (see _fast_spill_replies)
@@ -303,23 +302,38 @@ class Worker:
         self._fast_rings.append(ring)
         loop = asyncio.get_running_loop()
         if p.get("kind") == "actor":
-            # Two-mode pump. HOT: a self-resubmitting job on the actor's
-            # single executor thread (_fast_actor_pump_cycle) — ring
-            # records execute inline with ZERO thread handoffs (each
-            # cross-thread wake costs 60-200us on this class of host,
-            # which was most of the sync-call round trip), RPC-path jobs
-            # interleave between cycles. PARKED: after ~100ms of silence
-            # the cycle chain exits and a dedicated thread blocks on the
-            # ring with long timeouts, so an idle actor costs nothing on
-            # the executor; the first batch of a new busy period runs via
-            # one executor handoff, then the chain goes hot again.
+            table = getattr(self, "_actor_method_table", None)
+            # Dispatch-only lanes: whenever two of this actor's methods
+            # could legitimately block on each other across threads
+            # (thread pool, loop-resident async methods, group pools),
+            # inline pump execution could deadlock a rendezvous — every
+            # record is dispatched instead, the pump never executes user
+            # code. A pure-sync serial actor keeps the zero-handoff
+            # inline pump (the measured 1_1_actor_calls_sync win).
+            dispatch_only = (
+                getattr(self, "_actor_max_concurrency", 1) > 1
+                or bool(self._group_execs)
+                or any(v[0] == "async" for v in (table or {}).values()))
+            # Two-mode pump (inline lanes). HOT: a self-resubmitting job
+            # on the actor's single executor thread
+            # (_fast_actor_pump_cycle) — ring records execute inline with
+            # ZERO thread handoffs (each cross-thread wake costs 60-200us
+            # on this class of host, which was most of the sync-call
+            # round trip), RPC-path jobs interleave between cycles.
+            # PARKED: after ~100ms of silence the cycle chain exits and a
+            # dedicated thread blocks on the ring with long timeouts, so
+            # an idle actor costs nothing on the executor; the first
+            # batch of a new busy period runs via one executor handoff,
+            # then the chain goes hot again. Dispatch-only lanes skip the
+            # hot chain entirely: the park thread pops and dispatches.
             state = {"downgraded": False, "idle": 0,
-                     "parked": threading.Event()}
+                     "parked": threading.Event(),
+                     "dispatch_only": dispatch_only}
             t = threading.Thread(
                 target=self._fast_actor_park, args=(ring, state),
                 name="rt-fastpark", daemon=True)
             t.start()
-            return True
+            return {"ok": True, "methods": table}
         t = threading.Thread(
             target=self._fast_pump, args=(ring, loop),
             name="rt-fastpump", daemon=True)
@@ -440,6 +454,14 @@ class Worker:
                     return
                 if not recs:
                     continue
+                if state.get("dispatch_only"):
+                    # async/threaded/grouped actor: this thread pops and
+                    # dispatches in ring order, never executes user code
+                    # (replies stream back as each dispatched call ends)
+                    if not self._fast_actor_exec_batch(ring, state, recs):
+                        self._fast_pump_close(ring)
+                        return
+                    continue
                 state["idle"] = 0
                 state["parked"].clear()
                 try:
@@ -477,40 +499,107 @@ class Worker:
         state["closed"] = True
         state["parked"].set()
 
+    @staticmethod
+    def _classify_method(m) -> str:
+        """One fast-lane verdict for a callable: sync | async | gen."""
+        if inspect.isgeneratorfunction(m) or inspect.isasyncgenfunction(m):
+            return "gen"
+        if inspect.iscoroutinefunction(m):
+            return "async"
+        return "sync"
+
+    def _actor_fast_verdict(self, mname: str):
+        """(verdict, group) for one method — init-time table hit in the
+        steady state (satellite: no per-record getattr + inspect.is*);
+        dynamically-added callables classify once on first sight and are
+        cached. None = not callable here (NEED_SLOW: the RPC path owns
+        the error surface)."""
+        table = getattr(self, "_actor_method_table", None)
+        if table is None:
+            table = self._actor_method_table = {}
+        v = table.get(mname)
+        if v is not None:
+            return v
+        inst = self.actor_instance
+        m = getattr(inst, mname, None) if inst is not None else None
+        if not callable(m):
+            return None
+        v = table[mname] = (self._classify_method(m),
+                            self._method_groups.get(mname))
+        return v
+
+    def _build_actor_method_table(self, cls) -> dict:
+        """Precompute every public method's fast-lane verdict ONCE at
+        actor init: name -> (sync|async|gen, concurrency_group). Walks
+        the CLASS (dir covers the MRO) so property getters never fire;
+        instance-assigned callables classify lazily via
+        _actor_fast_verdict. Shipped to the driver in the
+        attach_fast_ring reply (protocol 1.8) so ineligible methods are
+        routed to the RPC path per call without a ring round trip."""
+        table: dict = {}
+        for name in dir(cls):
+            if name.startswith("_"):
+                continue
+            m = getattr(cls, name, None)
+            if not callable(m):
+                continue
+            table[name] = (self._classify_method(m),
+                           self._method_groups.get(name))
+        return table
+
     def _fast_actor_exec_batch(self, ring, state: dict, recs) -> bool:
-        """Execute one batch of ring records inline; False = ring done."""
+        """One batch of actor ring records, in ring (= per-caller FIFO)
+        order; False = ring done. Sync methods on an inline lane execute
+        right here (zero handoffs); async / grouped / threaded-actor
+        methods are handed to the event loop IN ORDER and reply as each
+        finishes — dispatch stays the FIFO invariant, completion does
+        not (the reply's seq lets the driver match them out of order)."""
         from ray_tpu.core import fastpath
         from ray_tpu.utils import recorder as _rec
 
         inline_max = self.cfg.fastpath_inline_result_max
         inst = self.actor_instance
         rec_r = _rec.get_recorder()
+        loop = self.core.loop
         t_prev = t_pop = time.perf_counter_ns()
         if rec_r is not None:
             rec_r.record(b"", _rec.WORKER_POP, t_pop, a0=len(recs))
         replies = []
+        dispatch_items = []
         for rec in recs:
-            tid, mkey, args, kwargs, t_sub = fastpath.unpack_task(rec)
+            tid, mkey, args, kwargs, t_sub, seq = \
+                fastpath.unpack_actor_task(rec)
             mname = mkey[3:].decode()  # b"am:<method>"
-            m = getattr(inst, mname, None)
-            if (state["downgraded"]
-                    or inst is None
-                    or getattr(self, "_actor_max_concurrency", 1) > 1
-                    or not callable(m)
-                    or inspect.iscoroutinefunction(m)
-                    or inspect.isgeneratorfunction(m)
-                    or inspect.isasyncgenfunction(m)
-                    or self._method_groups.get(mname)):
+            verdict = None if state["downgraded"] or inst is None \
+                else self._actor_fast_verdict(mname)
+            if verdict is None or verdict[0] == "gen":
+                # Sticky for the in-flight tail: replies stream back in
+                # ring order from here, the driver requeues them over RPC
+                # in FIFO order and retires the lane. Reaching this means
+                # the driver's copy of the eligibility table missed the
+                # method (added after attach) — the ordinary tables keep
+                # generators off the ring entirely.
                 state["downgraded"] = True
                 replies.append(fastpath.pack_reply(
-                    tid, fastpath.NEED_SLOW, b""))
+                    tid, fastpath.NEED_SLOW, b"", seq=seq))
                 t_prev = time.perf_counter_ns()  # skipped record: don't
                 # bill its handling to the next record's deserialize
+                continue
+            kind, group = verdict
+            if (kind == "async" or group
+                    or state.get("dispatch_only")):
+                # out-of-order completion lane: collected in ring order,
+                # handed to the loop in ONE wake per batch below; each
+                # coroutine replies when its call ends
+                dispatch_items.append((tid, mname, kind, group, args,
+                                       kwargs, t_sub, t_pop, seq))
+                t_prev = time.perf_counter_ns()
                 continue
             t_x0 = time.perf_counter_ns()
             try:
                 if chaos.ENABLED:
                     chaos.point("worker.exec", name=mname, fast=1)
+                m = getattr(inst, mname)
                 ok, val = True, m(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — reply on
                 ok, val = False, e
@@ -522,7 +611,7 @@ class Worker:
             replies.append(self._fast_pack_result(
                 tid, ok, val, inline_max,
                 fastpath.pack_stamp(ring_ns, deser_ns, exec_ns)
-                if t_sub else b""))
+                if t_sub else b"", seq=seq))
             if rec_r is not None:
                 # same 1-in-16 W_TASK sampling as the normal pump (the
                 # counter lives on self: batches don't reset it)
@@ -531,10 +620,117 @@ class Worker:
                     rec_r.record_wtask(
                         tid, t_x1, min(max(ring_ns, 0), 0xFFFFFFFF),
                         min(deser_ns, 0xFFFFFFFF), exec_ns)
+        if dispatch_items:
+            # ONE self-pipe wake for the whole batch (a wake per record
+            # measured as the difference between parity and a 2x win on
+            # pipelined async bursts); create_task order inside the
+            # callback preserves ring order = dispatch FIFO
+            try:
+                loop.call_soon_threadsafe(
+                    self._fast_dispatch_records, ring, dispatch_items)
+            except RuntimeError:
+                return False  # loop gone (worker exit): ring is done
+        if not replies:
+            return True  # pure-dispatch batch: nothing to push from here
         ok_push = self._fast_push_replies(ring, replies) == 0
         if rec_r is not None:
             rec_r.record(b"", _rec.COMPLETION_PUSH, a0=len(replies))
         return ok_push
+
+    def _fast_dispatch_records(self, ring, items):
+        """Loop-side fan-out of one dispatched batch, in ring order. The
+        tasks are strongly held until done — the loop only keeps weak
+        refs, and a GC'd pending task would eat its reply and wedge the
+        driver's inflight accounting."""
+        loop = asyncio.get_running_loop()
+        pending = getattr(self, "_fast_dispatch_pending", None)
+        if pending is None:
+            pending = self._fast_dispatch_pending = set()
+        for it in items:
+            t = loop.create_task(self._fast_exec_dispatched(ring, *it))
+            pending.add(t)
+            t.add_done_callback(pending.discard)
+
+    async def _fast_exec_dispatched(self, ring, tid, mname, kind, group,
+                                    args, kwargs, t_sub, t_pop, seq):
+        """Loop-side execution of one dispatched actor ring record: async
+        methods run on the loop (group semaphore honored), sync methods
+        of threaded/grouped actors on the right pool — exactly where the
+        RPC path runs them — then the reply pushes as THIS call
+        finishes, out of order with its batch-mates."""
+        from ray_tpu.core import fastpath
+
+        inst = self.actor_instance
+        t_x0 = time.perf_counter_ns()
+        try:
+            if chaos.ENABLED:
+                chaos.point("worker.exec", name=mname, fast=1)
+            m = getattr(inst, mname)
+            if group and group not in self._group_execs:
+                # loud, exactly like the RPC path (rpc_push_actor_task):
+                # silently running on the default pool would lose the
+                # isolation the group asked for
+                raise TaskError(
+                    f"concurrency group {group!r} not declared on this "
+                    f"actor (declared: {sorted(self._group_execs)})")
+            if kind == "async":
+                sem = self._group_sems.get(group) if group else None
+                if sem is not None:
+                    async with sem:  # group-bounded async slots
+                        val = await m(*args, **kwargs)
+                else:
+                    val = await m(*args, **kwargs)
+            else:
+                executor = (self._group_execs[group] if group
+                            else self.executor)
+                val = await asyncio.get_running_loop().run_in_executor(
+                    executor, lambda: m(*args, **kwargs))
+            ok = True
+        except BaseException as e:  # noqa: BLE001 — reply on
+            ok, val = False, e
+        t_x1 = time.perf_counter_ns()
+        if t_sub:
+            # the dispatch hop (pump -> loop/pool) rides the deserialize
+            # stage; exec covers the await, so concurrent async calls
+            # overlap inside it — per-call wall, not CPU
+            stamp = fastpath.pack_stamp(
+                t_pop - t_sub, max(0, t_x0 - t_pop), t_x1 - t_x0)
+        else:
+            stamp = b""
+        rep = self._fast_pack_result(
+            tid, ok, val, self.cfg.fastpath_inline_result_max, stamp,
+            seq=seq)
+        await self._fast_reply_one(ring, rep)
+
+    async def _fast_reply_one(self, ring, rec: bytes):
+        """Completion push for one out-of-order reply, loop-side (the
+        ring mutex makes the pump thread + loop concurrent producers
+        safe). Mirrors _fast_push_replies' semantics without blocking
+        the loop: non-blocking pushes with short async backoffs, then
+        the RPC spill once the result ring has stayed full past the
+        spill deadline."""
+        from ray_tpu.core import fastpath
+
+        framed = fastpath.frame_one(rec)
+        loop = asyncio.get_running_loop()
+        deadline = (loop.time()
+                    + max(1, self.cfg.fastpath_reply_spill_ms) / 1000.0)
+        while True:
+            took = ring.push_batch(fastpath.REP, framed, 0)
+            if took < 0 or took >= len(framed):
+                return  # delivered, or ring closed (driver recovery owns it)
+            if loop.time() >= deadline:
+                owner = getattr(ring, "_owner_addr", None)
+                if owner is not None:
+                    try:
+                        await self._send_spilled_results(owner, [rec])
+                        return
+                    except Exception:
+                        # driver unreachable over RPC too: keep nudging
+                        # the ring until it closes (break-lane recovery)
+                        log.debug("ooo result spill failed", exc_info=True)
+                deadline = loop.time() + 0.1
+            await asyncio.sleep(0.002)
 
     def _fast_actor_pump_cycle(self, ring, state: dict):
         """ONE pump cycle, ON the actor's single executor thread: pop a
@@ -786,18 +982,19 @@ class Worker:
     _FAST_ERR_MAX = 256 * 1024
 
     def _fast_pack_result(self, tid: bytes, ok: bool, val, inline_max: int,
-                          stamp: bytes = b""):
+                          stamp: bytes = b"", seq: int | None = None):
         from ray_tpu.core import fastpath
 
         if not ok:
             return fastpath.pack_reply(tid, fastpath.ERR,
-                                       self._fast_pack_error(val), stamp)
+                                       self._fast_pack_error(val), stamp, seq)
         try:
             meta, buffers = serialization.dumps_with_buffers(val)
             size = serialization.total_size(meta, buffers)
             if size <= inline_max:
                 return fastpath.pack_reply(
-                    tid, fastpath.OK, _pack_bytes(meta, buffers, size), stamp)
+                    tid, fastpath.OK, _pack_bytes(meta, buffers, size),
+                    stamp, seq)
             # big result: place it in the node's arena under the return oid
             # (same-node owner reads it directly; location registration is
             # the owner's migration step)
@@ -808,10 +1005,11 @@ class Worker:
             # size rides in the record: the owner's location cache is
             # primed at completion time, no directory round-trip on get
             return fastpath.pack_reply(tid, fastpath.OK_SHM,
-                                       fastpath.pack_shm_size(size), stamp)
+                                       fastpath.pack_shm_size(size), stamp,
+                                       seq)
         except Exception as e:
             return fastpath.pack_reply(tid, fastpath.ERR,
-                                       self._fast_pack_error(e), stamp)
+                                       self._fast_pack_error(e), stamp, seq)
 
     def _fast_pack_error(self, exc) -> bytes:
         payload = cloudpickle.dumps(_as_task_error(exc))
@@ -1411,6 +1609,10 @@ class Worker:
         except Exception as e:
             raise _as_task_error(e) from None
         self.actor_id = spec["actor_id"]
+        # fast-lane method eligibility, resolved ONCE per actor lifetime
+        # (the ring pump and the attach reply both read it; see
+        # _build_actor_method_table)
+        self._actor_method_table = self._build_actor_method_table(cls)
         return {"ok": True}
 
     async def rpc_push_actor_task(self, conn, p):

@@ -111,7 +111,7 @@ class TaskID(BaseID):
         return cls(job_id.binary() + b"\x00" * (cls.SIZE - JobID.SIZE))
 
     @classmethod
-    def generate(cls):
+    def _generate_marked(cls, mark: bytes) -> "TaskID":
         # fork safety WITHOUT a per-call os.getpid(): that's a real
         # syscall (~30us under syscall-intercepting sandboxes) on the
         # submission hot path. _reset_task_prefix below invalidates the
@@ -122,14 +122,20 @@ class TaskID(BaseID):
                     cls._gen_prefix = os.urandom(cls.SIZE - 8)
                     cls._gen_counter = itertools.count()
         n = next(cls._gen_counter) % (1 << 56)
-        tail = n.to_bytes(7, "little") + b"\x00"
-        return cls(cls._gen_prefix + tail)
+        return cls(cls._gen_prefix + n.to_bytes(7, "little") + mark)
+
+    @classmethod
+    def generate(cls):
+        return cls._generate_marked(b"\x00")
 
     @classmethod
     def generate_actor(cls) -> "TaskID":
-        raw = bytearray(os.urandom(cls.SIZE))
-        raw[-1] = cls._ACTOR_MARK
-        return cls(bytes(raw))
+        # same prefix+counter scheme as generate() — a per-call
+        # os.urandom(16) measured ~288us under the syscall-intercepting
+        # sandbox, 60%+ of the whole actor submission hot path. The kind
+        # tag in the final byte keeps actor ids disjoint from normal
+        # task ids minted from the same prefix and counter.
+        return cls._generate_marked(bytes((cls._ACTOR_MARK,)))
 
     def is_actor_task(self) -> bool:
         return self._bytes[-1] == self._ACTOR_MARK

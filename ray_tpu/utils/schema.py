@@ -23,7 +23,16 @@ from __future__ import annotations
 # records may carry an 8-byte submit stamp (prefixes "Q"/"R" beside the
 # unstamped "P"/"S"), and reply records may carry a 16-byte stage stamp
 # (status flag 0x100) — see core/fastpath.py pack_task/pack_reply.
-PROTOCOL_VERSION = (1, 7)
+#
+# 1.8: actor fast lane v2. Actor-lane task records use the "A"/"C"
+# prefixes with a <u32 seq, u64 t_submit_ns> header (per-lane call
+# sequence number); reply records may carry the echoed seq (status flag
+# 0x200, 4 bytes after the optional stamp) so completions can stream
+# back OUT of submission order (async actors) while ring order stays the
+# per-caller FIFO dispatch invariant. attach_fast_ring's actor reply is
+# now a dict carrying the actor's init-time method eligibility table —
+# see core/fastpath.py pack_actor_task/pack_reply.
+PROTOCOL_VERSION = (1, 8)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -175,7 +184,12 @@ CATALOG: dict[str, dict[str, dict]] = {
                     "should pump (see core/fastpath.py)",
             "kind": "'actor' for actor-call rings (since 1.3)",
             "owner": "(host, port) optional (since (1, 6)): driver server "
-                     "address — the result-ring spill target"}},
+                     "address — the result-ring spill target",
+            "->": "bool, or for actor rings since (1, 8) "
+                  "{ok: bool, methods: {name: (sync|async|gen, group)}} — "
+                  "the actor's init-time method eligibility table; the "
+                  "driver routes gen/unknown methods to the RPC path per "
+                  "call without a ring round trip"}},
         "dump_stack": {"since": (1, 3), "fields": {}},
         "heap_profile": {"since": (1, 4), "fields": {
             "action": "start | snapshot | stop (tracemalloc control)",

@@ -20,6 +20,13 @@
 // completions arrive exactly once, in submit order, with matching
 // checksums.
 //
+// Phase 3 (ooo) drives the ACTOR fast lane v2 shape (protocol 1.8):
+// replies come from TWO concurrent producer threads (the worker pump +
+// the event loop pushing out-of-order async-actor completions in the
+// Python runtime) in arbitrary order, exercising the ring mutex under
+// multi-producer contention. The driver matches completions by seq —
+// exactly-once, checksum-balanced, order NOT required.
+//
 // Usage: ring_stress <shm-name> <seconds>
 
 #include <atomic>
@@ -28,6 +35,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -350,6 +358,193 @@ int run_echo_phase(const char* name, double seconds) {
   return failures.load() ? 1 : 0;
 }
 
+// ---- phase 3: out-of-order reply echo (actor lane v2, multi-producer) --
+
+struct OooWork {
+  uint64_t seq;
+  uint64_t sum;
+};
+
+struct OooShared {
+  std::vector<OooWork> q;
+  std::mutex mu;
+  bool done = false;  // SUB drained: repliers exit once q empties
+};
+
+// worker pop side: parse submit records, hand each to the shared reply
+// queue — two replier threads drain it CONCURRENTLY (the pump thread +
+// event loop both producing completions in the Python runtime).
+void ooo_worker_pop(void* h, OooShared* sh) {
+  std::vector<uint8_t> in(kPopBuf);
+  for (;;) {
+    int64_t n = rt_ring_pop_batch(h, SUB, in.data(), in.size(), 50);
+    if (n == -7) break;
+    if (n < 0) {
+      fail("ooo worker pop status");
+      break;
+    }
+    if (n == 0) continue;
+    int64_t off = 0;
+    while (off + 4 <= n) {
+      uint32_t len;
+      memcpy(&len, in.data() + off, 4);
+      if (off + 4 + (int64_t)len > n) {
+        fail("ooo worker truncated record");
+        break;
+      }
+      OooWork w;
+      memcpy(&w.seq, in.data() + off + 4, 8);
+      w.sum = 0;
+      for (uint64_t i = 8; i < len; i++) w.sum += in[off + 4 + i];
+      {
+        std::lock_guard<std::mutex> g(sh->mu);
+        sh->q.push_back(w);
+      }
+      off += (int64_t)frame_len(len);
+    }
+  }
+  std::lock_guard<std::mutex> g(sh->mu);
+  sh->done = true;
+}
+
+// one of two concurrent reply producers: pops work items (randomly from
+// either END of the queue, so completion order diverges from submit
+// order) and pushes single-record reply frames — two threads pushing
+// the SAME ring direction is the multi-producer shape under test.
+void ooo_replier(void* h, OooShared* sh, unsigned seed) {
+  std::vector<uint8_t> out(frame_len(16));
+  for (;;) {
+    OooWork w;
+    {
+      std::lock_guard<std::mutex> g(sh->mu);
+      if (sh->q.empty()) {
+        if (sh->done) return;
+        w.seq = ~0ull;
+      } else if (((seed = seed * 1103515245 + 12345) >> 16) & 1) {
+        w = sh->q.back();
+        sh->q.pop_back();
+      } else {
+        w = sh->q.front();
+        sh->q.erase(sh->q.begin());
+      }
+    }
+    if (w.seq == ~0ull) {
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+      continue;
+    }
+    uint32_t rlen = 16;
+    memset(out.data(), 0, out.size());
+    memcpy(out.data(), &rlen, 4);
+    memcpy(out.data() + 4, &w.seq, 8);
+    memcpy(out.data() + 12, &w.sum, 8);
+    uint64_t roff = 0;
+    while (roff < out.size()) {
+      int64_t took = rt_ring_push_batch(h, REP, out.data() + roff,
+                                        out.size() - roff, 5);
+      if (took == -7) return;  // driver closed mid-drain
+      if (took < 0) {
+        fail("ooo reply push_batch status");
+        return;
+      }
+      roff += (uint64_t)took;  // 0 = timeout: stalled consumer, retry
+    }
+  }
+}
+
+// driver result side: completions arrive in ARBITRARY order — match by
+// seq, require exactly-once and a balanced checksum total.
+void ooo_driver_results(void* h, std::atomic<uint64_t>* received,
+                        std::atomic<uint64_t>* recv_sum,
+                        std::vector<uint8_t>* seen, std::mutex* seen_mu) {
+  std::vector<uint8_t> buf(kPopBuf);
+  int batches = 0;
+  for (;;) {
+    int64_t n = rt_ring_pop_batch(h, REP, buf.data(), buf.size(), 50);
+    if (n == -7) return;
+    if (n < 0) {
+      fail("ooo result pop status");
+      return;
+    }
+    if (n == 0) continue;
+    int64_t off = 0;
+    while (off + 4 <= n) {
+      uint32_t len;
+      memcpy(&len, buf.data() + off, 4);
+      if (len != 16 || off + 4 + (int64_t)len > n) {
+        fail("ooo result bad record");
+        return;
+      }
+      uint64_t seq, sum;
+      memcpy(&seq, buf.data() + off + 4, 8);
+      memcpy(&sum, buf.data() + off + 12, 8);
+      {
+        std::lock_guard<std::mutex> g(*seen_mu);
+        if (seq >= seen->size()) seen->resize(seq + 1024, 0);
+        if ((*seen)[seq]) {
+          fail("ooo result duplicated seq");
+          return;
+        }
+        (*seen)[seq] = 1;
+      }
+      received->fetch_add(1);
+      recv_sum->fetch_add(sum);
+      off += (int64_t)frame_len(len);
+    }
+    if (++batches % 9 == 0 && !stop_flag.load(std::memory_order_relaxed)) {
+      // stall: let REP fill so the repliers contend on a full ring
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  }
+}
+
+int run_ooo_phase(const char* name, double seconds) {
+  std::string ooo_name = std::string(name) + "_ooo";
+  rt_ring_pair_destroy(ooo_name.c_str());
+  // small REP ring: the two repliers regularly contend on a full ring
+  void* creator = rt_ring_pair_create(ooo_name.c_str(), 16 * 1024);
+  void* opener = rt_ring_pair_open(ooo_name.c_str());
+  if (!creator || !opener) {
+    fail("ooo create/open");
+    return 1;
+  }
+  stop_flag.store(false);
+  std::atomic<uint64_t> submitted{0}, submit_sum{0}, received{0},
+      recv_sum{0};
+  OooShared shared;
+  std::vector<uint8_t> seen;
+  std::mutex seen_mu;
+  std::thread t_sub(echo_driver_submit, creator, &submitted, &submit_sum,
+                    23u);
+  std::thread t_pop(ooo_worker_pop, opener, &shared);
+  std::thread t_rep_a(ooo_replier, opener, &shared, 5u);
+  std::thread t_rep_b(ooo_replier, opener, &shared, 77u);
+  std::thread t_res(ooo_driver_results, creator, &received, &recv_sum,
+                    &seen, &seen_mu);
+  std::this_thread::sleep_for(
+      std::chrono::milliseconds((long)(seconds * 1000)));
+  stop_flag.store(true);
+  t_sub.join();                // submit side quiesces first
+  rt_ring_close(opener, SUB);  // worker pop drains SUB to -7, then exits
+  t_pop.join();
+  t_rep_a.join();              // repliers drain the shared queue dry
+  t_rep_b.join();
+  rt_ring_close(creator, REP);  // results drain to -7
+  t_res.join();
+
+  if (received.load() != submitted.load())
+    fail("ooo completion count mismatch (lost or duplicated results)");
+  if (recv_sum.load() != submit_sum.load())
+    fail("ooo completion checksum mismatch");
+  if (submitted.load() == 0) fail("ooo moved no traffic");
+
+  rt_ring_pair_close(opener);
+  rt_ring_pair_close(creator);
+  rt_ring_pair_destroy(ooo_name.c_str());
+  printf("ooo=%llu failures=%ld\n", (unsigned long long)submitted.load(),
+         failures.load());
+  return failures.load() ? 1 : 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -405,5 +600,9 @@ int main(int argc, char** argv) {
   if (failures.load()) return 1;
 
   // phase 2: completion-lane echo (result ring under partial-push load)
-  return run_echo_phase(name, seconds);
+  if (run_echo_phase(name, seconds) != 0) return 1;
+
+  // phase 3: out-of-order reply echo (actor lane v2 — two concurrent
+  // reply producers, completions matched by seq)
+  return run_ooo_phase(name, seconds);
 }

@@ -1,0 +1,312 @@
+"""Actor fast lane v2 tests (ISSUE 8): per-(handle, method) frozen
+templates, per-call (not per-lane) RPC fallback with FIFO preserved
+across the mixed fast/slow stream, out-of-order completions for async
+actors over the seq-matched reply protocol, and a seeded chaos plan
+killing the actor mid-ring-burst with exactly-once-retry replay.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PLAN = os.path.join(HERE, "plans", "actor_kill_burst.json")
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def _lane_for(core, handle, warm_call, timeout=15):
+    """The ring lane attaches asynchronously after the first connection;
+    keep warming until it exists."""
+    deadline = time.monotonic() + timeout
+    lane = core._fast_actor_lanes.get(handle.actor_id)
+    while lane is None and time.monotonic() < deadline:
+        ray_tpu.get(warm_call(), timeout=60)
+        time.sleep(0.1)
+        lane = core._fast_actor_lanes.get(handle.actor_id)
+    assert lane is not None, "actor fast lane never attached"
+    return lane
+
+
+@ray_tpu.remote(num_cpus=0)
+class Rec:
+    def __init__(self):
+        self.log = []
+
+    def mark(self, x):
+        self.log.append(x)
+        return x
+
+    def get_log(self):
+        return list(self.log)
+
+    def legacy_gen(self):
+        yield 1
+
+
+# ----------------------------------------------------------- templates
+def test_actor_method_template_cached_per_handle_method(rt):
+    a = Rec.remote()
+    m = a.mark
+    assert m is a.mark  # ActorMethod cached on the handle (PR 2)
+    assert m._ftmpl is None  # template built lazily at the first call
+    assert ray_tpu.get(m.remote(0), timeout=60) == 0
+    tmpl = m._ftmpl
+    assert tmpl is not None
+    assert tmpl.mkey == b"am:mark" and tmpl.opts_ok
+    assert ray_tpu.get(m.remote(1), timeout=60) == 1
+    assert m._ftmpl is tmpl  # steady state: same frozen template
+    # .options() forks get their own ActorMethod and so their own template
+    fork = m.options(num_returns=1)
+    assert fork is not m and fork._ftmpl is None
+    # templates never ship with a pickled method handle
+    import cloudpickle
+
+    clone = cloudpickle.loads(cloudpickle.dumps(m))
+    assert clone._ftmpl is None
+
+
+def test_method_table_shipped_at_attach(rt):
+    core = api.get_core()
+    a = Rec.remote()
+    assert ray_tpu.get(a.mark.remote(0), timeout=60) == 0
+    lane = _lane_for(core, a, lambda: a.mark.remote(0))
+    assert lane.methods is not None
+    assert lane.methods["mark"][0] == "sync"
+    assert lane.methods["legacy_gen"][0] == "gen"
+
+
+# ------------------------------------------- per-call fallback + FIFO
+def test_ref_args_fall_back_per_call_and_fifo_holds(rt):
+    """A pending-ref call takes the RPC path for THAT call only; the
+    lane survives, execution order matches submission order across the
+    mixed fast/slow stream, and later calls ride the ring again."""
+    core = api.get_core()
+    a = Rec.remote()
+    assert ray_tpu.get(a.mark.remote("w"), timeout=60) == "w"
+    lane = _lane_for(core, a, lambda: a.mark.remote("w"))
+
+    @ray_tpu.remote
+    def slow_val():
+        time.sleep(0.4)
+        return "S"
+
+    ready = ray_tpu.put("R")  # locally ready: resolves inline, stays fast
+    time.sleep(0.05)
+    pend = slow_val.remote()  # NOT ready at submit: RPC path for the call
+    seq_before = lane.next_seq
+    refs = [a.mark.remote(1), a.mark.remote(ready), a.mark.remote(2),
+            a.mark.remote(pend), a.mark.remote(3), a.mark.remote(4)]
+    ray_tpu.get(refs, timeout=120)
+    log = ray_tpu.get(a.get_log.remote(), timeout=60)
+    assert log[-6:] == [1, "R", 2, "S", 3, 4], log[-6:]
+    st = core.fast_actor_lane_stats(a.actor_id)
+    assert st is not None and not st["retired"] and not st["broken"], st
+    # the ready-ref call rode the ring (inline local resolve), and calls
+    # after the slow one resumed fast service: the lane's seq advanced
+    assert lane.next_seq > seq_before + 1
+    # ...and a fresh call still rides the ring
+    after = lane.next_seq
+    assert ray_tpu.get(a.mark.remote(5), timeout=60) == 5
+    assert lane.next_seq == after + 1
+
+
+def test_generator_method_routes_rpc_without_retiring(rt):
+    """The shipped eligibility table routes generator methods to the RPC
+    path per call — the lane is never retired and sync calls keep the
+    ring afterwards."""
+    core = api.get_core()
+    a = Rec.remote()
+    assert ray_tpu.get(a.mark.remote(0), timeout=60) == 0
+    lane = _lane_for(core, a, lambda: a.mark.remote(0))
+    with pytest.raises(Exception):
+        # legacy generator semantics: plain call of a generator method is
+        # an error on the RPC path (declare num_returns='streaming')
+        ray_tpu.get(a.legacy_gen.remote(), timeout=60)
+    st = core.fast_actor_lane_stats(a.actor_id)
+    assert st is not None and not st["retired"] and not st["broken"], st
+    before = lane.next_seq
+    assert ray_tpu.get(a.mark.remote(9), timeout=60) == 9
+    assert lane.next_seq == before + 1  # back on the ring
+
+
+# --------------------------------------- async actors: out of order
+def test_async_actor_rides_ring_and_completes_out_of_order(rt):
+    @ray_tpu.remote(num_cpus=0, max_concurrency=8)
+    class AA:
+        async def work(self, d, tag):
+            await asyncio.sleep(d)
+            return tag
+
+    core = api.get_core()
+    aa = AA.remote()
+    assert ray_tpu.get(aa.work.remote(0.0, "w"), timeout=60) == "w"
+    lane = _lane_for(core, aa, lambda: aa.work.remote(0.0, "w"))
+    assert lane.methods["work"][0] == "async"
+    r_slow = aa.work.remote(0.6, "slow")
+    r_fast = aa.work.remote(0.0, "fast")
+    ready, rest = ray_tpu.wait([r_slow, r_fast], num_returns=1, timeout=30)
+    assert ready == [r_fast], "fast call did not complete out of order"
+    assert ray_tpu.get([r_slow, r_fast], timeout=60) == ["slow", "fast"]
+    st = core.fast_actor_lane_stats(aa.actor_id)
+    assert st is not None, "async-actor lane was dropped"
+    assert not st["retired"] and not st["broken"], st
+    assert st["ooo_replies"] >= 1, st  # seq-matched: reply below high water
+
+
+def test_sync_actor_burst_stays_in_order(rt):
+    """Per-caller FIFO as the dispatch invariant: a serial sync actor's
+    ring burst executes in submission order, completions matched by seq
+    with no out-of-order replies."""
+    core = api.get_core()
+    a = Rec.remote()
+    assert ray_tpu.get(a.mark.remote(-1), timeout=60) == -1
+    lane = _lane_for(core, a, lambda: a.mark.remote(-1))
+    n0 = len(ray_tpu.get(a.get_log.remote(), timeout=60))
+    refs = [a.mark.remote(i) for i in range(40)]
+    assert ray_tpu.get(refs, timeout=120) == list(range(40))
+    log = ray_tpu.get(a.get_log.remote(), timeout=60)
+    assert log[n0:] == list(range(40))
+    st = core.fast_actor_lane_stats(a.actor_id)
+    assert st["ooo_replies"] == 0, st
+
+
+def test_concurrency_group_methods_ride_the_ring(rt):
+    @ray_tpu.remote(num_cpus=0, concurrency_groups={"io": 2})
+    class Grouped:
+        @ray_tpu.method(concurrency_group="io")
+        def fetch(self, x):
+            return ("io", x)
+
+        def plain(self, x):
+            return ("plain", x)
+
+    core = api.get_core()
+    g = Grouped.remote()
+    assert ray_tpu.get(g.plain.remote(0), timeout=60) == ("plain", 0)
+    lane = _lane_for(core, g, lambda: g.plain.remote(0))
+    assert lane.methods["fetch"] == ("sync", "io")
+    out = ray_tpu.get([g.fetch.remote(i) for i in range(8)]
+                      + [g.plain.remote(9)], timeout=120)
+    assert out == [("io", i) for i in range(8)] + [("plain", 9)]
+    st = core.fast_actor_lane_stats(g.actor_id)
+    assert st is not None and not st["retired"] and not st["broken"], st
+
+
+# -------------------------------------------- fast == slow, byte-wise
+def test_actor_fast_results_byte_identical_to_rpc_path(rt):
+    """The same actor method through the ring lane and through the
+    forced RPC road must produce byte-identical values — inline,
+    shm-sealed, and array payloads (the task-side test's actor twin)."""
+    import numpy as np
+
+    @ray_tpu.remote(num_cpus=0)
+    class Payload:
+        def make(self, kind):
+            if kind == "small":
+                return {"k": b"v" * 512, "n": 7}
+            if kind == "mid":
+                return b"m" * 40_000  # > inline cap -> shm on the ring
+            return np.arange(6000, dtype=np.float64) * 1.5
+
+    core = api.get_core()
+    p = Payload.remote()
+    assert ray_tpu.get(p.make.remote("small"), timeout=60)["n"] == 7
+    _lane_for(core, p, lambda: p.make.remote("small"))
+    orig = core._try_fast_actor_submit
+    for kind in ("small", "mid", "array"):
+        fast_val = ray_tpu.get(p.make.remote(kind), timeout=120)
+        core._try_fast_actor_submit = lambda *a, **k: None  # force RPC
+        try:
+            slow_val = ray_tpu.get(p.make.remote(kind), timeout=120)
+        finally:
+            core._try_fast_actor_submit = orig
+        if kind == "array":
+            assert fast_val.dtype == slow_val.dtype
+            assert fast_val.shape == slow_val.shape
+            assert fast_val.tobytes() == slow_val.tobytes()
+        else:
+            assert fast_val == slow_val
+
+
+# ----------------------------------------------- seeded chaos replay
+_CHAOS_CHILD = """
+import json, os, time
+import ray_tpu
+from ray_tpu.core import api
+
+cdir = os.environ["RT_TEST_CDIR"]
+ray_tpu.init(num_cpus=8)
+
+@ray_tpu.remote(num_cpus=0, max_restarts=1)
+class Counter:
+    def bump(self, i):
+        import os, uuid
+        open(os.path.join(os.environ["RT_TEST_CDIR"],
+                          f"{i}-{uuid.uuid4().hex[:6]}"), "w").close()
+        return i
+
+c = Counter.remote()
+assert ray_tpu.get(c.bump.remote(-1), timeout=60) == -1
+core = api.get_core()
+deadline = time.time() + 15
+while (time.time() < deadline
+       and core._fast_actor_lanes.get(c.actor_id) is None):
+    ray_tpu.get(c.bump.remote(-2), timeout=60)
+    time.sleep(0.1)
+assert core._fast_actor_lanes.get(c.actor_id) is not None
+refs = [c.bump.remote(i) for i in range(30)]
+out = ray_tpu.get(refs, timeout=180)
+counts = {}
+for f in os.listdir(cdir):
+    k = f.split("-")[0]
+    counts[k] = counts.get(k, 0) + 1
+print("RES=" + json.dumps({"ok": out == list(range(30)),
+                           "counts": counts}))
+ray_tpu.shutdown()
+"""
+
+
+@pytest.mark.parametrize("plan", [PLAN])
+def test_seeded_kill_mid_ring_burst_replays_once(plan, tmp_path):
+    """The checked-in seeded plan SIGKILLs the actor's worker at its
+    11th fast-lane exec, mid-burst. The lane breaks, in-flight records
+    replay over the RPC path onto the restarted actor (max_restarts=1)
+    in FIFO order, and the replay charges exactly one retry: every call
+    completes, no call executes more than twice, and the chaos log shows
+    exactly one strike (cluster_once — the restarted worker must not be
+    struck again)."""
+    log_dir = str(tmp_path / "chaos")
+    cdir = str(tmp_path / "execs")
+    os.makedirs(cdir)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "RT_CHAOS_ENABLED": "1", "RT_CHAOS_PLAN": plan,
+           "RT_CHAOS_LOG_DIR": log_dir, "RT_TEST_CDIR": cdir}
+    proc = subprocess.run([sys.executable, "-c", _CHAOS_CHILD], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RES=")][0]
+    res = json.loads(line[4:])
+    assert res["ok"], "burst results wrong after seeded mid-burst kill"
+    counts = res["counts"]
+    for i in range(30):
+        assert 1 <= counts.get(str(i), 0) <= 2, (i, counts)
+    from ray_tpu.devtools.chaos.cli import read_events
+
+    kills = [e for e in read_events(log_dir)
+             if e["action"] == "kill" and e["point"] == "worker.exec"]
+    assert len(kills) == 1, kills  # cluster_once: exactly one strike
