@@ -283,9 +283,30 @@ def get(refs, timeout: float | None = None):
 
 
 async def _async_get(ref: ObjectRef):
+    import asyncio
+
     core = get_core()
-    values = await core.get_async([ref], None)
+    if _in_core_loop(core):
+        values = await core.get_async([ref], None)
+        return values[0]
+    # foreign event loop (driver asyncio code, a user loop in a worker
+    # thread): the core client's wait primitives are affine to the core
+    # loop — run the get THERE and await the bridged future here, else
+    # completion wakeups land on a loop that is not running this task
+    # and the await never resolves
+    fut = asyncio.run_coroutine_threadsafe(core.get_async([ref], None),
+                                           core.loop)
+    values = await asyncio.wrap_future(fut)
     return values[0]
+
+
+def _in_core_loop(core) -> bool:
+    import asyncio
+
+    try:
+        return asyncio.get_running_loop() is core.loop
+    except RuntimeError:
+        return False
 
 
 def wait(

@@ -263,6 +263,7 @@ class CoreClient:
         self.server.add_routes(self)
         self.address: tuple[str, int] | None = None
 
+        self._store_exec = None  # lazy: see _store_executor()
         self.memory_store: dict[ObjectID, _MemEntry] = {}
         self.sched_keys: dict[tuple, _SchedulingKeyState] = {}
         self._func_cache: dict[bytes, Any] = {}
@@ -291,6 +292,8 @@ class CoreClient:
         # distributed refcounting state (ref: reference_count.h:72)
         self._local_refs: dict[ObjectID, int] = {}      # owner-side handles
         self._borrowers: dict[ObjectID, set] = {}       # owner-side registry
+        self._borrow_seen: set[ObjectID] = set()        # ≥1 borrow ever landed
+        self._shipped_expect: set[ObjectID] = set()     # payload-shipped refs
         self._borrowed_counts: dict[ObjectID, int] = {} # borrower-side handles
         self._shipped_at: dict[ObjectID, float] = {}
         self._owner_conns: dict[tuple, rpc.Connection] = {}
@@ -437,9 +440,24 @@ class CoreClient:
     # registered, and no shipment of the ref is recently in flight.
 
     BORROW_GRACE_S = 3.0  # covers serialize->deserialize windows
+    # A shipped ref whose recipient has NEVER registered a borrow gets a
+    # much longer leash: the borrow notify is an async coroutine on the
+    # recipient's loop and under load (concurrent jit compiles, reply
+    # bursts) it can land SECONDS late — freeing at +3s turned cached
+    # disagg KV pages into "unknown to owner" for every later adopter.
+    # Once any borrower registers, lifetime is governed by the borrower
+    # set; this timeout only reclaims shipments whose recipient died.
+    SHIP_NO_BORROW_GRACE_S = 60.0
 
-    def note_ref_shipped(self, oid: ObjectID, ref=None):
+    def note_ref_shipped(self, oid: ObjectID, ref=None,
+                         expect_borrow: bool = False):
+        """``expect_borrow``: the ref was pickled INSIDE a payload and will
+        rehydrate as an ObjectRef at the recipient (borrow registration
+        coming); spec-path arg shipments dep-resolve to values and never
+        borrow, so they keep the short grace."""
         self._shipped_at[oid] = time.monotonic()
+        if expect_borrow:
+            self._shipped_expect.add(oid)
         col = self._ship_collect
         if col is not None and ref is not None:
             col.append(ref)  # pin the live handle for the flight
@@ -496,15 +514,25 @@ class CoreClient:
                 return  # an unborrow will re-trigger the free check
             shipped = self._shipped_at.get(oid)
             if shipped is not None:
-                wait = self.BORROW_GRACE_S - (time.monotonic() - shipped)
+                # payload-shipped ref whose borrower has NEVER registered:
+                # the recipient's borrow notify may still be queued behind
+                # a loaded loop — hold the object for the long leash,
+                # re-checking so a landed borrow parks the free immediately
+                grace = (self.SHIP_NO_BORROW_GRACE_S
+                         if (oid in self._shipped_expect
+                             and oid not in self._borrow_seen)
+                         else self.BORROW_GRACE_S)
+                wait = grace - (time.monotonic() - shipped)
                 if wait > 0:  # a borrow registration may still be in flight
-                    await asyncio.sleep(wait)
+                    await asyncio.sleep(min(wait, 1.0))
                     continue
             break
         if self._closed:
             return
         self._shipped_at.pop(oid, None)
         self._borrowers.pop(oid, None)
+        self._borrow_seen.discard(oid)
+        self._shipped_expect.discard(oid)
         self._obj_locations.pop(oid, None)
         entry = self.memory_store.pop(oid, None)
         # lineage pins its task's arg refs only while some return is live
@@ -599,7 +627,13 @@ class CoreClient:
     # --------------------------------------------------------- owner RPCs
     async def rpc_borrow_object(self, conn, p):
         oid = ObjectID(p["object_id"])
+        if oid not in self.memory_store:
+            # the object is already gone (freed, or never ours): tracking
+            # this borrower would create a zombie entry no free path ever
+            # clears — the borrower's get surfaces the loss itself
+            return False
         self._borrowers.setdefault(oid, set()).add(p["borrower"])
+        self._borrow_seen.add(oid)
         return True
 
     async def rpc_unborrow_object(self, conn, p):
@@ -885,7 +919,14 @@ class CoreClient:
                 continue
             if self.store.contains(oid):
                 try:
-                    return await self.loop.run_in_executor(None, self.store.get, oid, 10_000)
+                    # dedicated executor: the loop's default pool is shared
+                    # with arbitrary user run_in_executor(None, ...) work —
+                    # actor code commonly parks blocking api.get calls
+                    # there, and once those occupy every default thread the
+                    # store read that would unblock them queues behind them
+                    # forever (executor self-deadlock at ~6 concurrent gets)
+                    return await self.loop.run_in_executor(
+                        self._store_executor(), self.store.get, oid, 10_000)
                 except object_store.ObjectEvictedError:
                     # Local copy was LRU-evicted under memory pressure between
                     # contains() and get(): re-pull from another holder (the
@@ -956,6 +997,12 @@ class CoreClient:
                     except Exception:
                         log.debug("recover_object escalation failed",
                                   exc_info=True)
+                if pull_fails >= 45:
+                    # the owner keeps claiming shm residency but no holder
+                    # can produce the bytes and recovery changed nothing —
+                    # without a deadline this loop would spin forever on a
+                    # stale owner entry; surface the loss instead
+                    raise ObjectLostError(f"{ref}: no reachable copy")
                 await asyncio.sleep(0.05)
                 continue
 
@@ -3857,6 +3904,19 @@ class CoreClient:
         return fut.result(timeout)
 
     # ------------------------------------------------------------ helpers
+    def _store_executor(self):
+        """Small private pool for blocking shm-store reads issued FROM the
+        core loop. Never the loop's default executor: user code blocks
+        api.get calls on that shared pool, and a store read queued behind
+        a full set of blocked gets deadlocks the process."""
+        ex = self._store_exec
+        if ex is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            ex = self._store_exec = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="rt-store-get")
+        return ex
+
     def _run_sync(self, coro, timeout=None):
         if _in_loop(self.loop):
             raise RuntimeError("sync call from loop thread")
@@ -3865,6 +3925,9 @@ class CoreClient:
     async def close(self):
         await self.task_events.flush()
         self._closed = True
+        if self._store_exec is not None:
+            self._store_exec.shutdown(wait=False)
+            self._store_exec = None
         with self._fast_flush_cv:  # release the flusher backstop thread
             self._fast_flush_cv.notify_all()
         for lane in list(self._fast_lanes):

@@ -112,7 +112,10 @@ class ObjectRef:
             core = api._core
         if core is not None:
             try:
-                core.note_ref_shipped(self.id, self)
+                # payload-embedded ref: the recipient rehydrates it as an
+                # ObjectRef and registers a borrow — the owner holds the
+                # object on the long no-borrow leash until that lands
+                core.note_ref_shipped(self.id, self, expect_borrow=True)
             except Exception:  # raylint: disable=RT012 — __reduce__ during teardown must never raise
                 pass
         return (_rebuild_borrowed_ref, (self.id, self.owner_address))

@@ -11,8 +11,19 @@ continuous-batching paged-KV engine for serving.
 - serving: LLMServer (@serve.batch coalescing) and LLMEngineServer
   (continuous batching + streaming) deployments
 - batch: build_llm_processor over ray_tpu.data datasets
+- disagg: disaggregated serving — prefill/decode pools over the KV-page
+  plane with cross-request prefix caching (DisaggLLMServer)
 """
 from ray_tpu.llm.batch import build_llm_processor
+from ray_tpu.llm.disagg import (
+    DecodeWorker,
+    DisaggLLMServer,
+    KVPageManifest,
+    PrefillWorker,
+    PrefixCache,
+    build_disagg_deployment,
+    prefix_hint,
+)
 from ray_tpu.llm.engine import ContinuousBatchingEngine, EngineFull
 from ray_tpu.llm.generation import generate, generate_tokens, pad_prompts
 from ray_tpu.llm.serving import (
@@ -24,9 +35,15 @@ from ray_tpu.llm.serving import (
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "DecodeWorker",
+    "DisaggLLMServer",
     "EngineFull",
+    "KVPageManifest",
     "LLMEngineServer",
     "LLMServer",
+    "PrefillWorker",
+    "PrefixCache",
+    "build_disagg_deployment",
     "build_llm_deployment",
     "build_llm_engine_deployment",
     "build_llm_processor",

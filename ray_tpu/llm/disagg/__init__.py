@@ -1,0 +1,55 @@
+"""ray_tpu.llm.disagg — disaggregated LLM serving on the ray_tpu runtime.
+
+The TPU-native composition of DistServe's prefill/decode disaggregation
+(Zhong et al., OSDI'24) and vLLM's paged-KV-as-shareable-cache insight
+(Kwon et al., SOSP'23) over this repo's own planes:
+
+- **KV-page plane** (:mod:`.kv_plane`): prefill workers seal the KV
+  pages they produce directly into the local shm arena (the sharded
+  plane's ``put_value(prefer_shm=True)`` path) and hand decode workers a
+  :class:`KVPageManifest` — token ids + per-page object refs + node +
+  nbytes, the ShardManifest shape at page granularity. Adoption scatters
+  the pages into free slots of the decode pool: zero-copy when
+  same-node, via the object plane across nodes; array bytes never cross
+  the driver.
+- **Prefill/decode pools** (:mod:`.pools`): ``PrefillWorker`` batches
+  prompts into padded waves on ``paged_prefill_batch`` (suffix-only
+  prefill over cached prefix pages via ``paged_prefill_suffix``);
+  ``DecodeWorker`` runs the existing continuous-batching ring, admitting
+  requests only with adopted KV.
+- **Scheduler** (:mod:`.scheduler`): ``DisaggLLMServer`` — a serve
+  deployment fronting both pools with admission control driven by
+  decode-pool page headroom (``EngineFull`` never reaches the caller; it
+  becomes router backpressure) and decode-death recovery by manifest
+  re-adoption or re-prefill.
+- **Cross-request prefix cache** (:mod:`.prefix_cache`): a radix tree
+  over token-id pages mapping to pinned manifests, with hit/miss
+  accounting, arena-pressure LRU eviction, and prefix-affinity routing
+  hints surfaced through the serve layer.
+"""
+
+from ray_tpu.llm.disagg.kv_plane import (
+    KVPageManifest,
+    KVShipError,
+    adopt_pages,
+    ship_pages,
+)
+from ray_tpu.llm.disagg.pools import DecodeWorker, PrefillWorker
+from ray_tpu.llm.disagg.prefix_cache import PrefixCache, prefix_hint
+from ray_tpu.llm.disagg.scheduler import (
+    DisaggLLMServer,
+    build_disagg_deployment,
+)
+
+__all__ = [
+    "DecodeWorker",
+    "DisaggLLMServer",
+    "KVPageManifest",
+    "KVShipError",
+    "PrefillWorker",
+    "PrefixCache",
+    "adopt_pages",
+    "build_disagg_deployment",
+    "prefix_hint",
+    "ship_pages",
+]

@@ -1,0 +1,345 @@
+"""DisaggLLMServer: the deployment that composes the planes.
+
+One serve replica of this class fronts TWO actor pools (its private
+prefill and decode workers) plus a replica-local :class:`PrefixCache`:
+
+    request -> [admission: decode page headroom]
+            -> [prefix cache lookup (pinned)]
+            -> prefill pool   (full prompt, or suffix over cached pages)
+            -> KV-page plane  (manifest: metadata through RPC, pages via shm)
+            -> decode pool    (adopt + continuous-batching ring)
+            -> [cache insert of the new full pages] -> response
+
+Admission control is page-headroom based: the scheduler tracks an
+optimistic in-flight page estimate per decode worker and refuses — with
+the serve layer's typed :class:`BackPressureError`, carrying
+``retry_after_s`` — before any prefill work is spent on a request the
+decode pool cannot seat. ``EngineFull`` therefore never reaches a
+caller: the PR 6 router treats the refusal as never-dispatched and
+retries/hedges to another replica.
+
+Fault story (the decode-death window ``tests/plans/llm_decode_kill.json``
+exercises): a decode worker dying mid-request surfaces as an
+ActorError-class failure. The prompt's KV pages live in the PREFILL
+workers' shm arenas — they survive the death — so recovery is manifest
+RE-ADOPTION on another decode worker, zero duplicate prefill FLOPs.
+Only when the pages themselves are gone (KVShipError / ObjectLostError:
+injected loss, arena eviction on a dead node) does the scheduler
+re-prefill, counting it in ``duplicate_prefills`` so tests can bound the
+wasted work.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+
+from ray_tpu.core.ref import (
+    ActorError,
+    ObjectLostError,
+    WorkerCrashedError,
+)
+from ray_tpu.llm.disagg import telemetry
+from ray_tpu.llm.disagg.kv_plane import KVPageManifest, KVShipError
+from ray_tpu.llm.disagg.pools import DecodeWorker, PrefillWorker
+from ray_tpu.llm.disagg.prefix_cache import PrefixCache
+
+
+def _is_worker_death(e: BaseException) -> bool:
+    from ray_tpu.utils import rpc
+
+    return isinstance(e, (ActorError, WorkerCrashedError,
+                          rpc.ConnectionLost))
+
+
+class DisaggLLMServer:
+    """Deployment class; bind with a model config + params source (the
+    LLMEngineServer surface, served disaggregated)."""
+
+    def __init__(self, model_config, params=None, params_fn=None, *,
+                 n_prefill: int = 2, n_decode: int = 2,
+                 max_batch: int = 8, page_size: int = 16,
+                 n_pages: int = 256, max_seq_len: int = 512,
+                 eos_id: int | None = None, kv_dtype: str | None = None,
+                 lora_adapters: dict | None = None, lora_rank: int = 8,
+                 default_max_tokens: int = 32,
+                 prefix_cache_bytes: int = 64 << 20,
+                 prefill_n_pages: int | None = None,
+                 max_wave: int = 8, wave_wait_s: float = 0.004,
+                 max_attempts: int = 3, decode_max_restarts: int = 2):
+        import ray_tpu
+
+        self.PS = page_size
+        self.n_pages = n_pages
+        self.default_max_tokens = default_max_tokens
+        self.max_attempts = max_attempts
+        self.cache = PrefixCache(page_size,
+                                 capacity_bytes=prefix_cache_bytes,
+                                 kv_dtype=kv_dtype or "native")
+        model_kw = dict(kv_dtype=kv_dtype, lora_adapters=lora_adapters,
+                        lora_rank=lora_rank)
+        # prefill pool: async actors with enough concurrency for calls to
+        # coalesce into padded waves; staging pools freed per wave
+        pf_cls = ray_tpu.remote(PrefillWorker).options(
+            max_concurrency=max(16, 4 * max_wave))
+        self.prefill_pool = [
+            pf_cls.remote(model_config, params, params_fn,
+                          page_size=page_size,
+                          n_pages=prefill_n_pages or n_pages,
+                          max_wave=max_wave, wave_wait_s=wave_wait_s,
+                          seed=i, **model_kw)
+            for i in range(n_prefill)]
+        # decode pool: restartable (a killed worker rejoins the rotation;
+        # in-flight requests re-adopt elsewhere meanwhile)
+        dw_cls = ray_tpu.remote(DecodeWorker).options(
+            max_concurrency=max(16, 2 * max_batch),
+            max_restarts=decode_max_restarts)
+        self.decode_pool = [
+            dw_cls.remote(model_config, params, params_fn,
+                          max_batch=max_batch, page_size=page_size,
+                          n_pages=n_pages, max_seq_len=max_seq_len,
+                          eos_id=eos_id, **model_kw)
+            for i in range(n_decode)]
+        # optimistic in-flight page estimate per decode worker — the
+        # admission-control signal (refreshed implicitly: reservations
+        # are returned in the same finally that awaited the decode)
+        self._est_pages = [0] * n_decode
+        self._capacity = n_pages - 1  # page 0 is the junk page
+        self._pf_rr = itertools.count()
+        self._dw_rr = itertools.count()
+        self.duplicate_prefills = 0
+        self.decode_retries = 0
+        self.backpressured = 0
+        self.requests = 0
+
+    # ------------------------------------------------------------ routing
+    def _pick_decode(self, n_need: int, exclude: set[int]) -> int | None:
+        """Headroom-first pick: the worker with the most estimated free
+        pages that can seat the request; round-robin start for tie
+        spread. None = no pool-wide headroom (backpressure)."""
+        start = next(self._dw_rr) % len(self.decode_pool)
+        best, best_free = None, -1
+        for off in range(len(self.decode_pool)):
+            i = (start + off) % len(self.decode_pool)
+            if i in exclude:
+                continue
+            free = self._capacity - self._est_pages[i]
+            if free >= n_need and free > best_free:
+                best, best_free = i, free
+        return best
+
+    def _backpressure(self, n_need: int):
+        from ray_tpu.serve.exceptions import BackPressureError
+
+        self.backpressured += 1
+        total_free = sum(self._capacity - e for e in self._est_pages)
+        # drain estimate: decode frees pages as resident requests finish;
+        # scale the hint by how oversubscribed the pools are
+        raise BackPressureError(
+            f"decode pools out of KV page headroom ({n_need} pages "
+            f"needed, {total_free} free across {len(self.decode_pool)} "
+            f"workers)",
+            retry_after_s=min(2.0, 0.05 * max(1, n_need)),
+        )
+
+    # ------------------------------------------------------------ serving
+    async def __call__(self, request: dict) -> dict:
+        """{prompt_tokens, max_tokens?, temperature?, model?} ->
+        {completion_tokens, usage} — the LLMEngineServer protocol."""
+        toks = [int(t) for t in request["prompt_tokens"]]
+        if not toks:
+            raise ValueError("empty prompt")
+        mt = int(request.get("max_tokens", self.default_max_tokens))
+        temp = float(request.get("temperature", 0.0))
+        adapter = request.get("model")
+        t_arr = time.perf_counter_ns()
+        self.requests += 1
+        n_need = -(-(len(toks) + mt) // self.PS)
+        if n_need > self._capacity:
+            raise ValueError(
+                f"request needs {n_need} KV pages but decode pools hold "
+                f"{self._capacity}")
+        excluded: set[int] = set()
+        prefix_m = None   # pinned cache manifest (release on every exit)
+        manifest = extra = first = None
+        t_first = None
+        last_err = None
+        try:
+            for attempt in range(self.max_attempts + 1):
+                widx = self._pick_decode(n_need, excluded)
+                if widx is None and excluded:
+                    # every worker burned by THIS request: let it retry
+                    # anywhere (a restarted worker may be back) rather
+                    # than dead-ending with headroom elsewhere
+                    excluded.clear()
+                    widx = self._pick_decode(n_need, excluded)
+                if widx is None:
+                    self._backpressure(n_need)
+                # reserve at PICK time, not after the prefill: concurrent
+                # requests admitting against a zero estimate would all
+                # pass and spend prefill work the decode pools cannot
+                # seat — the exact waste admission control exists to stop
+                self._est_pages[widx] += n_need
+                try:
+                    if manifest is None:
+                        try:
+                            (manifest, extra, first,
+                             prefix_m) = await self._prefill(
+                                toks, temp, adapter)
+                        except Exception as e:  # noqa: BLE001 — prefill leg
+                            last_err = e
+                            if isinstance(e, (KVShipError,
+                                              ObjectLostError)):
+                                # cached prefix pages vanished mid-adopt:
+                                # drop the cached path, full re-prefill
+                                self.cache.invalidate(toks)
+                                prefix_m = None
+                                continue
+                            if _is_worker_death(e):
+                                # a PREFILL actor died — retry the
+                                # prefill; the decode pick stays valid
+                                continue
+                            raise
+                        if attempt:
+                            self.duplicate_prefills += 1
+                            telemetry.count(duplicate_prefills=1)
+                        if t_first is None:
+                            t_first = time.perf_counter_ns()
+                            telemetry.record(telemetry.TTFT,
+                                             t_first - t_arr)
+                    out = await self.decode_pool[widx].\
+                        decode_adopted.remote(
+                            toks, manifest, extra, first,
+                            max_tokens=mt, temperature=temp,
+                            adapter=adapter)
+                    return self._finish(toks, out, manifest, extra,
+                                        prefix_m, t_arr, t_first, widx,
+                                        attempt)
+                except Exception as e:  # noqa: BLE001 — decode leg
+                    last_err = e
+                    if isinstance(e, (KVShipError, ObjectLostError)):
+                        # the pages themselves are gone: drop the cached
+                        # path and re-prefill (the bounded-duplicate leg)
+                        self.cache.release(prefix_m)
+                        self.cache.invalidate(toks)
+                        prefix_m = manifest = extra = first = None
+                        continue
+                    if _is_worker_death(e):
+                        # decode worker died holding the request; the
+                        # pages survive in the prefill arenas — re-adopt
+                        # the SAME manifest elsewhere
+                        excluded.add(widx)
+                        self.decode_retries += 1
+                        continue
+                    from ray_tpu.serve.exceptions import BackPressureError
+
+                    if isinstance(e, BackPressureError):
+                        # headroom estimate was stale for this worker
+                        excluded.add(widx)
+                        continue
+                    raise
+                finally:
+                    self._est_pages[widx] -= n_need
+            raise last_err
+        finally:
+            self.cache.release(prefix_m)
+
+    async def _prefill(self, toks, temp, adapter):
+        """Cache-aware prefill: longest cached page prefix rides the
+        suffix path; a miss runs the full prompt. Returns
+        (manifest, extra, first_token, pinned_prefix)."""
+        # cap the prefix below the prompt length: the prefill must see
+        # >= 1 suffix token to produce the first-token logits
+        prefix_m = self.cache.lookup(toks, max_tokens=len(toks) - 1)
+        pf = self.prefill_pool[next(self._pf_rr) % len(self.prefill_pool)]
+        try:
+            if prefix_m is not None:
+                sm, first = await pf.prefill.remote(
+                    toks[prefix_m.n_tokens:], temperature=temp,
+                    adapter=adapter, prefix=prefix_m)
+                return prefix_m, sm, first, prefix_m
+            m, first = await pf.prefill.remote(
+                toks, temperature=temp, adapter=adapter)
+            return m, None, first, None
+        except BaseException:
+            self.cache.release(prefix_m)
+            raise
+
+    def _finish(self, toks, out, manifest, extra, prefix_m, t_arr,
+                t_first, widx, attempt) -> dict:
+        t_done = time.perf_counter_ns()
+        if len(out) > 1:
+            telemetry.record(telemetry.TPOT,
+                             (t_done - t_first) // (len(out) - 1))
+        # cache the request's full pages for the NEXT request sharing the
+        # prefix (existing nodes are shared, new suffix pages extend them)
+        pages = list(manifest.pages) + (list(extra.pages) if extra else [])
+        if pages and len(toks) >= self.PS:
+            self.cache.insert(KVPageManifest(
+                token_ids=tuple(toks), page_size=self.PS,
+                kv_dtype=self.cache.kv_dtype, pages=pages))
+        return {
+            "completion_tokens": out,
+            "usage": {
+                "prompt_tokens": len(toks),
+                "completion_tokens": len(out),
+                "cached_prefix_tokens": (prefix_m.n_tokens
+                                         if prefix_m else 0),
+                "latency_s": (t_done - t_arr) / 1e9,
+                "ttft_s": (t_first - t_arr) / 1e9,
+                "decode_worker": widx,
+                "attempts": attempt + 1,
+            },
+        }
+
+    # ---------------------------------------------------------- telemetry
+    async def stats(self) -> dict:
+        """Scheduler + cache + pool-wide KV-plane counters (the byte
+        ledger summed across every worker process)."""
+        refs = [w.disagg_counters.remote()
+                for w in (*self.prefill_pool, *self.decode_pool)]
+        vals = await asyncio.gather(*refs, return_exceptions=True)
+        ledger: dict[str, int] = {}
+        for v in vals:
+            if isinstance(v, dict):
+                for k, n in v.items():
+                    ledger[k] = ledger.get(k, 0) + int(n)
+        for k, n in telemetry.counters().items():  # scheduler-local leg
+            ledger[k] = ledger.get(k, 0) + int(n)
+        return {
+            "requests": self.requests,
+            "duplicate_prefills": self.duplicate_prefills,
+            "decode_retries": self.decode_retries,
+            "backpressured": self.backpressured,
+            "est_pages": list(self._est_pages),
+            "prefix_cache": self.cache.stats(),
+            "kv_plane": ledger,
+        }
+
+    async def shutdown(self):
+        refs = [w.stop.remote() for w in self.decode_pool]
+        await asyncio.gather(*refs, return_exceptions=True)
+
+
+def build_disagg_deployment(model_config, *, params=None, params_fn=None,
+                            num_replicas: int = 1, num_tpus: float = 0.0,
+                            name: str = "DisaggLLMServer",
+                            max_ongoing_requests: int = 64, **kw):
+    """Bound serve application around the disaggregated stack. Route
+    with ``handle.options(routing_hint=prefix_hint(tokens)).remote(...)``
+    so requests sharing a cacheable prefix land on the replica already
+    holding its pages."""
+    from ray_tpu import serve
+
+    opts: dict = {}
+    if num_tpus:
+        opts["num_tpus"] = num_tpus
+    dep = serve.deployment(
+        DisaggLLMServer,
+        name=name,
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        ray_actor_options=opts,
+    )
+    return dep.bind(model_config, params, params_fn, **kw)

@@ -1,0 +1,163 @@
+"""Disagg-serving telemetry: stage windows, Prometheus feeds, byte ledger.
+
+Mirrors the sharded plane's instrumentation (sharded/telemetry.py):
+every disagg operation records (stage, duration_ns, nbytes) — stages
+``prefill_queue`` / ``kv_ship`` / ``decode_queue`` plus the derived
+request metrics ``ttft`` / ``tpot`` — into
+
+- the process flight-recorder ring (utils/recorder.py stage ids 15-17),
+  so postmortems show which serving leg a worker died inside;
+- ``metrics.task_stage_seconds`` histograms + ``task_stage_us``
+  percentile gauges (Prometheus/dashboard, the same families the task
+  and sharded stages feed);
+- a bounded per-process latency window published on the task-event
+  flush under GCS ns="latency" (key ``<worker>.llm``) so
+  ``state.list_task_latency()`` merges the serving stages beside
+  ring_sub/exec/... with no extra surface.
+
+The byte ledger backs the zero-copy claim: ``kv_driver_bytes`` counts
+only manifest metadata that crossed the driver/actor RPC plane;
+``kv_array_bytes`` counts KV page payload bytes that moved via shm or
+the object plane instead.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ray_tpu.utils import metrics, recorder
+
+PREFILL_QUEUE = "prefill_queue"
+KV_SHIP = "kv_ship"
+DECODE_QUEUE = "decode_queue"
+TTFT = "ttft"
+TPOT = "tpot"
+STAGES = (PREFILL_QUEUE, KV_SHIP, DECODE_QUEUE, TTFT, TPOT)
+
+# ttft/tpot are request-level derived metrics: they live in the latency
+# window + Prometheus but not in the per-op recorder ring
+_REC_STAGE = {PREFILL_QUEUE: recorder.PREFILL_QUEUE,
+              KV_SHIP: recorder.KV_SHIP,
+              DECODE_QUEUE: recorder.DECODE_QUEUE}
+
+_WINDOW_CAP = 2048
+
+_lock = threading.Lock()
+_windows: dict[str, list[int]] = {s: [] for s in STAGES}
+_count = 0
+_published = -1
+_snapped = -1
+_counters = {"kv_driver_bytes": 0, "kv_array_bytes": 0,
+             "pages_shipped": 0, "pages_adopted": 0,
+             "prefills": 0, "suffix_prefills": 0, "adoptions": 0}
+_registered_core = None
+
+
+def record(stage: str, dur_ns: int, nbytes: int = 0) -> None:
+    """One disagg stage event (ms-scale ops: inline histogram observe)."""
+    global _count
+    dur_ns = max(0, int(dur_ns))
+    with _lock:
+        win = _windows[stage]
+        win.append(dur_ns)
+        if len(win) > _WINDOW_CAP:
+            del win[: len(win) - _WINDOW_CAP]
+        _count += 1
+    metrics.task_stage_seconds.observe(dur_ns / 1e9, tags={"stage": stage})
+    rec_stage = _REC_STAGE.get(stage)
+    if rec_stage is not None:
+        rec = recorder.get_recorder()
+        if rec is not None:
+            rec.record(b"", rec_stage,
+                       a0=min(dur_ns, 0xFFFFFFFF),
+                       a1=nbytes & 0xFFFFFFFF,
+                       a2=(nbytes >> 32) & 0xFFFFFFFF)
+    _maybe_register()
+
+
+def count(**deltas: int) -> None:
+    """Bump ledger counters (kv_driver_bytes, kv_array_bytes, ...).
+    Unseen keys start at zero — recovery-path counters
+    (duplicate_prefills, ...) only exist on runs that took that path."""
+    with _lock:
+        for k, v in deltas.items():
+            _counters[k] = _counters.get(k, 0) + int(v)
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Bench A/B support: zero the byte/op counters (windows kept)."""
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def stage_window(stage: str) -> list[int]:
+    """Copy of one stage's bounded duration window (ns) — the bench arm
+    reads ttft/tpot percentiles from here without a GCS round trip."""
+    with _lock:
+        return list(_windows[stage])
+
+
+def snapshot_if_fresh() -> dict | None:
+    """Latency-source hook (CoreClient.add_latency_source): the bounded
+    stage windows in the ns="latency" publish format, or None when
+    nothing new happened since the last CONFIRMED publish."""
+    global _snapped
+    with _lock:
+        if _count == _published:
+            return None
+        _snapped = _count
+        stages = {s: list(w) for s, w in _windows.items() if w}
+    if not stages:
+        return None
+    for name, vals in stages.items():
+        svals = sorted(vals)
+        for q, qn in ((0.5, "p50"), (0.99, "p99")):
+            metrics.task_stage_us.set(
+                recorder.percentile(svals, q) / 1e3,
+                tags={"stage": name, "q": qn})
+    return {"stages": stages}
+
+
+def mark_published() -> None:
+    """Publish confirmation from the flush (kv_put landed)."""
+    global _published
+    with _lock:
+        _published = _snapped
+
+
+def _maybe_register() -> None:
+    """Attach this window to the CURRENT CoreClient's latency publish
+    loop (idempotent per core identity — an init/shutdown/init cycle
+    re-registers on the fresh core, same invariant as the sharded
+    source)."""
+    global _registered_core
+    from ray_tpu.core import api
+
+    core = api._core
+    if core is None or core is _registered_core:
+        return
+    try:
+        core.add_latency_source("llm", snapshot_if_fresh,
+                                confirm=mark_published)
+        _registered_core = core
+    except AttributeError:
+        pass
+
+
+def _reset_for_tests() -> None:
+    global _count, _published, _snapped, _registered_core
+    with _lock:
+        for w in _windows.values():
+            w.clear()
+        _count = 0
+        _published = -1
+        _snapped = -1
+        _registered_core = None
+        for k in _counters:
+            _counters[k] = 0

@@ -84,6 +84,31 @@ def _kv_read(pool, i, page_tables, B, MAXP, PS, KV, hd, dtype):
     return q.astype(dtype) * s.astype(dtype)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages_jit(pool, idx, stack):
+    if isinstance(pool, dict):
+        return {"q": pool["q"].at[:, idx].set(stack["q"]),
+                "s": pool["s"].at[:, idx].set(stack["s"])}
+    return pool.at[:, idx].set(stack.astype(pool.dtype))
+
+
+def scatter_pages(pool, page_ids, stack):
+    """Write an adopted page stack into pool rows ``page_ids`` (device
+    op; the engine runs this at admission points, ordered like a prefill
+    dispatch). ``stack`` is a bare ``[L, n, PS, KV, hd]`` array for plain
+    pools or a ``{"q", "s"}`` dict for int8 pools — the shape
+    ``disagg.adopt_pages`` returns. The pool is DONATED: an unjitted
+    ``.at[].set`` copies the entire pool per adoption (tens of MB for a
+    few adopted KB), which priced cache hits above the prefills they
+    save; callers must rebind their pool to the return value."""
+    idx = jnp.asarray(np.asarray(page_ids, np.int32))
+    if isinstance(pool, dict):
+        stack = {"q": jnp.asarray(stack["q"]), "s": jnp.asarray(stack["s"])}
+    else:
+        stack = jnp.asarray(stack)
+    return _scatter_pages_jit(pool, idx, stack)
+
+
 def _decode_body(params, loras, aids, tokens, pos, page_tables,
                  kpool, vpool, active, temps, key, cfg: LlamaConfig):
     """One decode step for every slot (masked where inactive).
@@ -233,6 +258,72 @@ def paged_prefill_batch(params, loras, aids, tokens, pages, kpool, vpool,
     return toks, kpool, vpool
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(5, 6))
+def paged_prefill_suffix(params, loras, aids, tokens, pages, kpool, vpool,
+                         prefix_lens, true_lens, temps, key, cfg: LlamaConfig):
+    """Prefill only a prompt's SUFFIX over already-resident prefix KV —
+    the cross-request prefix-cache fast path (vLLM's PagedAttention
+    sharing argument run cross-request: a cached prefix of k full pages
+    is adopted into this pool verbatim and never recomputed).
+
+    tokens: [N, Ts_pad] right-padded suffix tokens; pages: [N, W] page
+    table covering prefix AND suffix positions in prompt order (junk
+    page 0 beyond); prefix_lens: [N] PAGE-ALIGNED token counts already
+    in the pool; true_lens: [N] real suffix lengths. Suffix position j
+    sits at absolute position prefix_len + j, so its KV lands in the
+    suffix pages and its attention window — gathered through the page
+    table exactly like decode — covers the prefix for free. Returns
+    (first tokens [N], kpool, vpool).
+
+    int8 pools: the suffix queries read the prefix (and their own fresh
+    K/V) back through dequantization, where full prefill attends the
+    fresh float K/V directly — parity with the aggregated path is exact
+    for float pools and within quantization noise for int8."""
+    N, Ts = tokens.shape
+    L, P, PS, KV, hd = _kv_shape(kpool)
+    W = pages.shape[1]
+    cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    positions = prefix_lens[:, None] + jnp.arange(Ts)[None, :]  # [N, Ts]
+    rows = jnp.take_along_axis(pages, positions // PS, axis=1)
+    offs = positions % PS
+    key_idx = jnp.arange(W * PS)
+    # window index == absolute position (the table is prompt-ordered),
+    # so causal masking is one compare; tail junk-page keys sit past
+    # every real position and mask out
+    mask = key_idx[None, None, :] <= positions[:, :, None]  # [N, Ts, W*PS]
+    x = params["tok"]["embedding"][tokens]
+    for i in range(cfg.n_layers):
+        layer = params[f"layers_{i}"]
+        h = rms_norm(x, layer["attn_norm"]["scale"])
+        q = (h @ layer["wq"]["kernel"] + _lora_delta(h, loras, "wq", aids)
+             ).reshape(N, Ts, cfg.n_heads, hd)
+        k = (h @ layer["wk"]["kernel"]).reshape(N, Ts, KV, hd)
+        v = (h @ layer["wv"]["kernel"] + _lora_delta(h, loras, "wv", aids)
+             ).reshape(N, Ts, KV, hd)
+        q = rope(q, cos, sin, positions)
+        k = rope(k, cos, sin, positions)
+        kpool = _kv_write(kpool, i, rows, offs, k)
+        vpool = _kv_write(vpool, i, rows, offs, v)
+        kb = _kv_read(kpool, i, pages, N, W, PS, KV, hd, k.dtype)
+        vb = _kv_read(vpool, i, pages, N, W, PS, KV, hd, v.dtype)
+        att = _gqa_attn(q, kb, vb, mask)
+        x = x + att.reshape(N, Ts, -1) @ layer["wo"]["kernel"]
+        x = _ffn(layer, x)
+    x = rms_norm(x, params["norm"]["scale"])
+    last = jnp.take_along_axis(
+        x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    logits = last @ params["lm_head"]["kernel"]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled():
+        s = jax.random.categorical(
+            key, logits / jnp.maximum(temps, 1e-6)[:, None]).astype(jnp.int32)
+        return jnp.where(temps > 0, s, greedy)
+
+    toks = jax.lax.cond(jnp.any(temps > 0), sampled, lambda: greedy)
+    return toks, kpool, vpool
+
+
 def make_lora_stack(cfg: LlamaConfig, adapters: dict[str, dict], rank: int):
     """Stack named adapters into gatherable arrays. Index 0 is the base
     model (zero delta). adapters: name -> {"wq_a": [D,r], "wq_b": [r,O],
@@ -256,6 +347,35 @@ def make_lora_stack(cfg: LlamaConfig, adapters: dict[str, dict], rank: int):
     return {k: jnp.asarray(v) for k, v in stack.items()}, idx
 
 
+def make_kv_pools(cfg: LlamaConfig, page_size: int, n_pages: int,
+                  kv_dtype: str | None):
+    """One (kpool, vpool) pair for a paged cache: plain
+    ``[L, P, PS, KV, hd]`` arrays for native/bf16, ``{"q", "s"}``
+    quantized dicts for int8. Shared by the engine and the disagg
+    prefill workers so the two pools are structurally identical and a
+    page sliced from one scatters into the other."""
+    dtype = jnp.dtype(cfg.dtype)
+    pool_shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
+                  cfg.head_dim)
+    if kv_dtype == "int8":
+        # quantized cache: half the HBM bytes through the decode
+        # page-table gather (the bottleneck past ~64 slots) at the
+        # cost of per-(token, kv-head) symmetric int8 rounding
+        def make_pool():
+            return {"q": jnp.zeros(pool_shape, jnp.int8),
+                    "s": jnp.zeros(pool_shape[:-1], jnp.float32)}
+
+        return make_pool(), make_pool()
+    if kv_dtype in (None, "native"):
+        kpool = jnp.zeros(pool_shape, dtype)
+        return kpool, jnp.zeros_like(kpool)
+    if kv_dtype == "bf16":
+        # explicit half-precision cache, regardless of cfg.dtype
+        kpool = jnp.zeros(pool_shape, jnp.bfloat16)
+        return kpool, jnp.zeros_like(kpool)
+    raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+
+
 @dataclass
 class _Request:
     req_id: int
@@ -269,6 +389,10 @@ class _Request:
     planned: int = 0  # tokens scheduled on-device (planned mode)
     cancelled: bool = False
     finished: bool = False  # completed normally (max_tokens or eos)
+    # disaggregated admission (llm/disagg): (k_stack, v_stack, first_tok)
+    # adopted from a prefill worker's KVPageManifest — admission scatters
+    # the stacks into this engine's pool instead of running a prefill
+    prefilled: tuple | None = None
 
 
 class EngineFull(Exception):
@@ -298,28 +422,8 @@ class ContinuousBatchingEngine:
         # request, so short interactive requests stay low-latency while
         # long generations amortize dispatch 64x
         self.block_buckets = tuple(sorted(block_buckets))
-        dtype = jnp.dtype(cfg.dtype)
-        pool_shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads,
-                      cfg.head_dim)
-        if kv_dtype == "int8":
-            # quantized cache: half the HBM bytes through the decode
-            # page-table gather (the bottleneck past ~64 slots) at the
-            # cost of per-(token, kv-head) symmetric int8 rounding
-            def make_pool():
-                return {"q": jnp.zeros(pool_shape, jnp.int8),
-                        "s": jnp.zeros(pool_shape[:-1], jnp.float32)}
-
-            self.kpool = make_pool()
-            self.vpool = make_pool()
-        elif kv_dtype in (None, "native"):
-            self.kpool = jnp.zeros(pool_shape, dtype)
-            self.vpool = jnp.zeros_like(self.kpool)
-        elif kv_dtype == "bf16":
-            # explicit half-precision cache, regardless of cfg.dtype
-            self.kpool = jnp.zeros(pool_shape, jnp.bfloat16)
-            self.vpool = jnp.zeros_like(self.kpool)
-        else:
-            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        self.kpool, self.vpool = make_kv_pools(cfg, page_size, n_pages,
+                                               kv_dtype)
         self.kv_dtype = kv_dtype or "native"
         self.n_pages = n_pages
         self.free_pages = list(range(1, n_pages))  # page 0 = junk page
@@ -401,6 +505,71 @@ class ContinuousBatchingEngine:
         self.waiting.append(req)
         self._wake.set()
         return req.req_id
+
+    def submit_prefilled(self, prompt_tokens: list[int], k_stack, v_stack,
+                         first_token: int, *, max_tokens: int = 32,
+                         temperature: float = 0.0,
+                         adapter: str | None = None) -> int:
+        """Queue a request whose prompt KV was ALREADY produced elsewhere
+        (a disaggregated prefill worker): admission scatters the adopted
+        page stacks (``[L, n_pages, PS, KV, hd]`` arrays, or ``{"q","s"}``
+        dicts for int8 pools — the shape ``disagg.adopt_pages`` returns)
+        into this engine's pool and starts decoding at position
+        ``len(prompt_tokens)`` with ``first_token`` — no prefill dispatch,
+        no recompute. The stacks must cover ``ceil(len(prompt)/PS)`` pages
+        of a pool with this engine's page_size and kv_dtype."""
+        if self.error is not None:
+            raise RuntimeError("engine loop died") from self.error
+        if len(self.waiting) >= self.max_waiting:
+            raise EngineFull(f"{len(self.waiting)} requests already waiting")
+        if len(prompt_tokens) + max_tokens > self.MAXP * self.PS:
+            raise ValueError(
+                f"prompt ({len(prompt_tokens)}) + max_tokens ({max_tokens}) "
+                f"exceeds the engine's max_seq_len ({self.MAXP * self.PS})")
+        n_cover = -(-len(prompt_tokens) // self.PS)
+        n_got = (k_stack["q"] if isinstance(k_stack, dict)
+                 else k_stack).shape[1]
+        if n_got < n_cover:
+            raise ValueError(
+                f"adopted stacks cover {n_got} pages but the prompt "
+                f"needs {n_cover}")
+        aid = self.lora_index.get(adapter or "__base__")
+        if aid is None:
+            raise ValueError(f"unknown LoRA adapter {adapter!r} "
+                             f"(loaded: {sorted(self.lora_index)})")
+        req = _Request(next(self._req_ids), list(prompt_tokens),
+                       int(max_tokens), float(temperature), aid)
+        req.prefilled = (k_stack, v_stack, int(first_token))
+        self._reqs[req.req_id] = req
+        self.waiting.append(req)
+        self._wake.set()
+        return req.req_id
+
+    def export_pages(self, req_id: int):
+        """Page-export hook: seal a LIVE request's prompt KV pages into
+        the local shm arena and return their ``KVPageManifest`` — how an
+        aggregated engine donates a prefix to the cross-request cache.
+        Must be called while the request still holds its slot (prompt
+        positions are stable once prefilled; decode writes land past
+        them)."""
+        from ray_tpu.llm.disagg.kv_plane import ship_pages
+
+        req = self._reqs.get(req_id)
+        if req is None or req.slot < 0:
+            raise KeyError(f"request {req_id} is not holding a slot")
+        n_cover = -(-len(req.prompt) // self.PS)
+        page_ids = [int(p) for p in self.page_tables[req.slot, :n_cover]]
+        return ship_pages(self.kpool, self.vpool, page_ids, req.prompt,
+                          page_size=self.PS, kv_dtype=self.kv_dtype)
+
+    def headroom(self) -> dict:
+        """Admission-control snapshot for the disagg scheduler: free KV
+        pages and decode slots, plus the queue depth."""
+        return {"free_pages": len(self.free_pages),
+                "free_slots": sum(r is None for r in self.slot_req),
+                "waiting": len(self.waiting),
+                "n_pages": self.n_pages, "page_size": self.PS,
+                "max_batch": self.B, "kv_dtype": self.kv_dtype}
 
     async def stream(self, req_id: int):
         """Async iterator of generated token ids for one request. Raises
@@ -504,6 +673,7 @@ class ContinuousBatchingEngine:
         request that fits; no host sync — returns [(requests,
         first-token device array)] per pad-bucket group."""
         groups: dict[int, list[_Request]] = {}
+        adopted: list[_Request] = []
         while self.waiting:
             nxt = self.waiting[0]
             if nxt.cancelled:
@@ -513,9 +683,25 @@ class ContinuousBatchingEngine:
             if self._reserve_slot(nxt) is None:
                 break
             self.waiting.pop(0)
+            if nxt.prefilled is not None:
+                adopted.append(nxt)
+                continue
             Tp_pad = -(-len(nxt.prompt) // self.PS) * self.PS
             groups.setdefault(Tp_pad, []).append(nxt)
         out = []
+        for req in adopted:
+            # slot adoption (llm/disagg): the prompt KV was produced by a
+            # prefill worker and fetched via the KV-page plane — scatter
+            # it into this pool's freshly allocated pages. Runs at the
+            # same admission points as prefill dispatches, so the
+            # functional pool update is ordered exactly like one.
+            k_stack, v_stack, first = req.prefilled
+            req.prefilled = None  # release the host copies after scatter
+            n_cover = -(-len(req.prompt) // self.PS)
+            rows = self.page_tables[req.slot, :n_cover].copy()
+            self.kpool = scatter_pages(self.kpool, rows, k_stack)
+            self.vpool = scatter_pages(self.vpool, rows, v_stack)
+            out.append(([req], np.asarray([first], np.int32)))
         for Tp_pad, reqs in groups.items():
             npages = Tp_pad // self.PS
             nb = next(b for b in self._WAVE_BUCKETS if b >= len(reqs)) \

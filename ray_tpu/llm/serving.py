@@ -115,12 +115,28 @@ class LLMEngineServer:
         await self.engine.start()
 
     def _submit(self, request: dict) -> int:
-        return self.engine.submit(
-            list(request["prompt_tokens"]),
-            max_tokens=int(request.get("max_tokens", self.default_max_tokens)),
-            temperature=float(request.get("temperature", 0.0)),
-            adapter=request.get("model"),
-        )
+        from ray_tpu.llm.engine import EngineFull
+        from ray_tpu.serve.exceptions import BackPressureError
+
+        try:
+            return self.engine.submit(
+                list(request["prompt_tokens"]),
+                max_tokens=int(request.get("max_tokens",
+                                           self.default_max_tokens)),
+                temperature=float(request.get("temperature", 0.0)),
+                adapter=request.get("model"),
+            )
+        except EngineFull as e:
+            # typed, never-dispatched refusal: the PR 6 router retries /
+            # hedges this request on another replica instead of surfacing
+            # an untyped ActorError from an overloaded engine
+            raise BackPressureError(
+                f"LLM engine full: {e}",
+                # a waiting slot frees at decode-block granularity; queue
+                # depth is the best local estimate of the drain time
+                retry_after_s=min(2.0,
+                                  0.02 * (1 + len(self.engine.waiting))),
+            ) from None
 
     async def __call__(self, request: dict) -> dict:
         """Full completion: {prompt_tokens, max_tokens?, temperature?,

@@ -1,0 +1,33 @@
+"""jax version compatibility for the parallel layer.
+
+``jax.shard_map`` became a top-level export in jax 0.6; on the 0.4.x
+line the same transform lives at ``jax.experimental.shard_map.shard_map``
+with the replication check spelled ``check_rep`` instead of
+``check_vma``. Every module in this package imports :func:`shard_map`
+from here so the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma spelling
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # jax 0.4.x: experimental module, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-stable ``shard_map``: accepts the modern ``check_vma``
+    keyword and translates it to ``check_rep`` on the legacy API."""
+    kw = ({"check_rep": check_vma} if _LEGACY else {"check_vma": check_vma})
+    if f is None:
+        def deco(fn):
+            return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+        return deco
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)

@@ -73,7 +73,9 @@ def ring_attention_local(q, k, v, *, axis_name: str, causal: bool = True,
     def _vary(x):
         if hasattr(lax, "pcast"):
             return lax.pcast(x, axes, to="varying")
-        return lax.pvary(x, axes)
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, axes)
+        return x  # jax 0.4.x: no vma typing, nothing to mark
 
     m0 = _vary(jnp.full((B, H, t), _NEG_BIG, dtype=jnp.float32))
     l0 = _vary(jnp.zeros((B, H, t), dtype=jnp.float32))
@@ -89,8 +91,9 @@ def ring_attention(q, k, v, mesh, *, axis_name: str = "sp", causal: bool = True,
     """Sharded entry point: q/k/v [B, T, H, D] with T sharded on ``axis_name``.
     Batch stays sharded over the data axes (dp/fsdp) so this composes with
     data parallelism inside one jitted step."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel._compat import shard_map
 
     batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
     spec = P(batch_axes or None, axis_name, None, None)

@@ -392,7 +392,8 @@ class _Router:
                     pass
 
     # -------------------------------------------------------------- routing
-    def _choose(self, model_id: str = "", exclude: set | None = None) -> dict | None:
+    def _choose(self, model_id: str = "", exclude: set | None = None,
+                hint: str = "") -> dict | None:
         """Power-of-two-choices over replica queue depth (ref:
         pow_2_router.py:52): the score combines the replica's REPORTED
         ongoing count (covers other callers) with this caller's local
@@ -406,7 +407,15 @@ class _Router:
         With a multiplexed ``model_id``, replicas already holding the
         model shadow the rest (ref: multiplex routing affinity) — a cache
         hit beats a shorter queue; the pow-2 tie-break still applies
-        within the holding set."""
+        within the holding set.
+
+        A ``hint`` (``options(routing_hint=...)``) switches to rendezvous
+        hashing over the surviving replica set: every caller holding the
+        same hint picks the same replica — the affinity signal for
+        replica-LOCAL state like the disagg prefix cache, where a cache
+        hit saves recompute but only on the replica holding the pages.
+        Exclusion still applies first, so a dead/failed replica falls
+        back to the next-highest-weight one deterministically."""
         with self.lock:
             reps = list(self.replicas)
             if exclude:
@@ -421,6 +430,15 @@ class _Router:
                                r["replica_id"], ())]
                 if holding:
                     reps = holding
+            if hint:
+                import hashlib
+
+                def weight(r):
+                    return hashlib.blake2b(
+                        f"{hint}|{r['replica_id']}".encode(),
+                        digest_size=8).digest()
+
+                return max(reps, key=weight)
             if len(reps) == 1:
                 return reps[0]
             a, b = random.sample(reps, 2)
@@ -449,11 +467,12 @@ class _Router:
         return actor
 
     async def _pick_replica(self, model_id: str, exclude: set,
-                            deadline: float | None) -> tuple[str, object]:
-        chosen = self._choose(model_id, exclude)
+                            deadline: float | None,
+                            hint: str = "") -> tuple[str, object]:
+        chosen = self._choose(model_id, exclude, hint)
         if chosen is None:
             await self._wait_for_replicas(self._membership_wait_s(deadline))
-            chosen = self._choose(model_id, exclude)
+            chosen = self._choose(model_id, exclude, hint)
             if chosen is None:
                 raise ReplicaUnavailableError(
                     f"no replicas available for "
@@ -562,7 +581,7 @@ class _Router:
                     self._cancel_loser(t, t_rid, request_id)
 
     async def route_async(self, method: str, args: tuple, kwargs: dict,
-                          model_id: str = "",
+                          model_id: str = "", hint: str = "",
                           _inherited_deadline: float | None = None):
         """Loop-thread path: full async routing with the retry/deadline/
         hedge machinery; returns the result."""
@@ -583,7 +602,7 @@ class _Router:
             idempotent = self._idempotent(method)
             try:
                 rid, actor = await self._pick_replica(
-                    model_id, excluded, deadline)
+                    model_id, excluded, deadline, hint)
                 return await self._dispatch(
                     rid, actor, method, args, kwargs, model_id, deadline,
                     request_id, hedgeable=idempotent, exclude=excluded)
@@ -617,7 +636,7 @@ class _Router:
             await asyncio.sleep(backoff)
 
     def route_sync(self, method: str, args: tuple, kwargs: dict,
-                   model_id: str = ""):
+                   model_id: str = "", hint: str = ""):
         """Driver-thread path: returns an ObjectRef immediately; the
         retry/deadline/hedge machinery runs on the core loop behind a
         promise ref the caller gets/waits like any task result (this is
@@ -633,7 +652,7 @@ class _Router:
         async def run():
             try:
                 resolve(value=await self.route_async(
-                    method, args, kwargs, model_id,
+                    method, args, kwargs, model_id, hint,
                     _inherited_deadline=inherited))
             except BaseException as e:
                 resolve(error=e if isinstance(e, Exception)
@@ -745,16 +764,18 @@ class DeploymentResponse:
     actors composing deployments); ref: serve/handle.py DeploymentResponse."""
 
     def __init__(self, router: _Router, method: str, args: tuple, kwargs: dict,
-                 model_id: str = ""):
+                 model_id: str = "", hint: str = ""):
         self._router = router
         self._method = method
         self._args = args
         self._kwargs = kwargs
         self._model_id = model_id
+        self._hint = hint
 
     def __await__(self):
         return self._router.route_async(
-            self._method, self._args, self._kwargs, self._model_id).__await__()
+            self._method, self._args, self._kwargs, self._model_id,
+            self._hint).__await__()
 
 
 class _MethodCaller:
@@ -784,18 +805,30 @@ class DeploymentHandle:
     tags requests for model-affinity routing (ref: multiplex.py)."""
 
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", routing_hint: str = ""):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.multiplexed_model_id = multiplexed_model_id
+        self.routing_hint = routing_hint
 
-    def options(self, *, multiplexed_model_id: str = "") -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment_name, self.app_name,
-                                multiplexed_model_id)
+    def options(self, *, multiplexed_model_id: str | None = None,
+                routing_hint: str | None = None) -> "DeploymentHandle":
+        """Tagged copy of the handle. ``multiplexed_model_id`` routes to
+        replicas already holding a multiplexed model;
+        ``routing_hint`` rendezvous-routes every request carrying the
+        same hint to the same replica (replica-local state affinity —
+        e.g. ``disagg.prefix_hint(prompt_tokens)`` so a shared prompt
+        prefix hits the replica whose cache holds its KV pages)."""
+        return DeploymentHandle(
+            self.deployment_name, self.app_name,
+            self.multiplexed_model_id if multiplexed_model_id is None
+            else multiplexed_model_id,
+            self.routing_hint if routing_hint is None else routing_hint)
 
     def __getattr__(self, name: str) -> _MethodCaller:
         if name.startswith("_") or name in ("deployment_name", "app_name",
-                                            "multiplexed_model_id"):
+                                            "multiplexed_model_id",
+                                            "routing_hint"):
             raise AttributeError(name)
         return _MethodCaller(self, name)
 
@@ -806,11 +839,13 @@ class DeploymentHandle:
         router = _router_for(self.app_name, self.deployment_name)
         if _on_core_loop():
             return DeploymentResponse(router, method, args, kwargs,
-                                      self.multiplexed_model_id)
+                                      self.multiplexed_model_id,
+                                      self.routing_hint)
         return router.route_sync(method, args, kwargs,
-                                 self.multiplexed_model_id)
+                                 self.multiplexed_model_id,
+                                 self.routing_hint)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment_name, self.app_name,
-                 self.multiplexed_model_id))
+                 self.multiplexed_model_id, self.routing_hint))

@@ -62,6 +62,13 @@ CHAOS = 11            # chaos fault fired (devtools/chaos): id slot carries
 SHARD_SEAL = 12       # one shard sealed into the local shm arena
 SHARD_FETCH = 13      # one shard read (zero-copy local or pulled)
 RESHARD = 14          # collective-backed spec redistribute completed
+# Disaggregated LLM serving (ray_tpu/llm/disagg): the request's journey
+# through the prefill pool, the KV-page plane, and the decode pool; args
+# are (duration_ns clamped u32, nbytes lo, nbytes hi) like the sharded
+# stages, so a postmortem shows which leg a worker died inside.
+PREFILL_QUEUE = 15    # request waited in a prefill worker's wave queue
+KV_SHIP = 16          # KV pages sealed to shm (prefill) or adopted (decode)
+DECODE_QUEUE = 17     # adopted request waited for a decode ring slot
 
 STAGE_NAMES = {
     SUBMIT: "submit", RING_PUSH: "ring_push", WORKER_POP: "worker_pop",
@@ -69,7 +76,8 @@ STAGE_NAMES = {
     EXEC_END: "exec_end", COMPLETION_PUSH: "completion_push",
     DRIVER_APPLY: "driver_apply", W_TASK: "w_task", SAMPLE: "sample",
     CHAOS: "chaos", SHARD_SEAL: "shard_seal", SHARD_FETCH: "shard_fetch",
-    RESHARD: "reshard",
+    RESHARD: "reshard", PREFILL_QUEUE: "prefill_queue", KV_SHIP: "kv_ship",
+    DECODE_QUEUE: "decode_queue",
 }
 
 # Reported latency stages (SAMPLE args, ns): both ring hops are covered —

@@ -241,10 +241,10 @@ def test_hedged_request_one_logical_effect(rt):
     # with it excluded and must land on the free one
     orig_choose = router._choose
 
-    def biased(model_id="", exclude=None):
+    def biased(model_id="", exclude=None, hint=""):
         if not exclude:
             return stalled_rep
-        return orig_choose(model_id, exclude)
+        return orig_choose(model_id, exclude, hint)
 
     router._choose = biased
     try:
