@@ -563,6 +563,71 @@ def run_sharded_bench(quick: bool) -> dict[str, float]:
     }
 
 
+# placement-group churn child: a real GCS + N simulated raylet endpoints
+# (ray_tpu.devtools.churn) joining/leaving on a seeded schedule while PG
+# create/remove cyclers and persistent PG-bound sim actors run, with the
+# checked-in seeded 2PC-fault plan (tests/plans/pg_churn.json) armed via
+# the env. Emits the ROADMAP item-5 scheduling-scale-under-failure rows.
+_PG_CHURN_CHILD = r"""
+import json, sys
+from ray_tpu.devtools.churn import ChurnHarness
+
+nodes, dur = int(sys.argv[1]), float(sys.argv[2])
+h = ChurnHarness(nodes=nodes, seed=7)
+h.start()
+try:
+    m = h.run(duration_s=dur, pg_cyclers=4, persistent_pgs=8,
+              bundles_per_pg=2, actors_per_pg=1, kill_every_s=0.8,
+              min_nodes=max(4, nodes // 2))
+    audit = h.audit()
+    m["churn_leaked_bundles"] = len(audit["leaked"]) + len(audit["missing"])
+    m["churn_nodes"] = nodes
+finally:
+    h.stop()
+print("RES=" + json.dumps(m))
+"""
+
+
+def run_pg_churn_bench(quick: bool) -> dict[str, float]:
+    """Simulated-churn arm (ROADMAP item 5): scheduling scale under
+    failure as tracked numbers. Bounded node count + duration so the arm
+    stays tier-2-safe under the suite ceiling; the same harness scales
+    to hundreds of nodes off-CI."""
+    import subprocess
+    import tempfile
+
+    nodes, dur = (32, 5.0) if quick else (96, 15.0)
+    plan = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tests", "plans", "pg_churn.json")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "RT_CHAOS_ENABLED": "1",
+           "RT_CHAOS_PLAN": plan,
+           "RT_CHAOS_LOG_DIR": tempfile.mkdtemp(prefix="rt_pgchurn_")}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PG_CHURN_CHILD, str(nodes), str(dur)],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        print("pg churn arm timed out", file=sys.stderr)
+        return {}
+    if proc.returncode != 0:
+        print(f"pg churn arm failed:\n{proc.stderr[-1500:]}",
+              file=sys.stderr)
+        return {}
+    line = [ln for ln in proc.stdout.splitlines() if ln.startswith("RES=")]
+    if not line:
+        return {}
+    res = json.loads(line[-1][4:])
+    return {
+        "pg_create_removal_per_s": res["pg_create_removal_per_s"],
+        "pg_reschedule_p50_ms": res["pg_reschedule_p50_ms"],
+        "pg_reschedule_p99_ms": res["pg_reschedule_p99_ms"],
+        "churn_unsatisfied_pg_s": res["churn_unsatisfied_pg_s"],
+        "churn_node_kills": float(res["node_kills"]),
+        "churn_leaked_bundles": float(res["churn_leaked_bundles"]),
+        "churn_nodes": float(res["churn_nodes"]),
+    }
+
+
 def run_micro(window: float) -> dict[str, float]:
     import numpy as np
 
@@ -1170,6 +1235,11 @@ def write_benchvs(micro: dict, model: dict | None,
             unit = "bytes"
         elif name.endswith("_avg_batch"):
             unit = "recs/flush"
+        elif name.endswith("_per_s"):
+            unit = "/s"
+        elif name in ("churn_node_kills", "churn_leaked_bundles",
+                      "churn_nodes"):
+            unit = "(count)"
         elif name.endswith("_s"):
             unit = "s"  # lower is better; no reference counterpart
         else:
@@ -1204,6 +1274,49 @@ def write_benchvs(micro: dict, model: dict | None,
         "program execution bound, not fabric (the identity program itself "
         "is lru-cached per (mesh, spec): ~104µs/dispatch warm, was "
         "24ms/call when it recompiled each time).",
+        "",
+        "`pg_create_removal_per_s` / `pg_reschedule_p50/p99_ms` / "
+        "`churn_unsatisfied_pg_s` — the simulated-churn arm (README § "
+        "Placement-group fault tolerance): `churn_nodes` simulated "
+        "raylet endpoints join/leave on a seeded schedule (a kill every "
+        "~0.8s, `churn_node_kills` total) under the checked-in seeded "
+        "2PC-fault plan `tests/plans/pg_churn.json` while PG "
+        "create/remove cyclers and persistent PG-bound actors run. "
+        "Create/remove throughput is measured WITH the churn and faults "
+        "active; reschedule latency is node death → RESCHEDULING → "
+        "re-CREATED from the GCS's pgs pubsub stream; "
+        "`churn_unsatisfied_pg_s` integrates PG·seconds spent out of "
+        "CREATED; `churn_leaked_bundles` is the post-settle audit "
+        "(every reservation on every surviving node cross-checked "
+        "against the GCS table) and must be 0.",
+        "",
+        "## Placement-group 2PC A/B (r10, same-host interleaved)",
+        "",
+        "Pre/post the PG lifecycle rework (BundleTxn parallel "
+        "prepare/commit over pooled GCS→raylet connections + repair, "
+        "README § Placement-group fault tolerance), alternating-order "
+        "subprocess rounds on one host, best-of per arm. The "
+        "`placement_group_create_removal` row above swings with the "
+        "shared box (828→680→476/s across three same-code runs as "
+        "`host_memcpy_gbps` fell 10.4→7.2); the interleaved A/B is the "
+        "controlled comparison:",
+        "",
+        "| Arm | A (pre) best | B (post) best | Ratio |",
+        "|---|---:|---:|---:|",
+        "| 1-bundle create+remove, end-to-end | 890/s | 1,028/s | **1.15×** |",
+        "| 4-bundle create+remove, end-to-end | 486/s | 529/s | **1.09×** |",
+        "| 1-bundle cycle, GCS-side (in-process) | 475µs | 439µs | **1.08×** |",
+        "| 4-bundle cycle, GCS-side (in-process) | 1,505µs | 1,216µs | **1.24×** |",
+        "",
+        "The end-to-end cycle is dominated by the driver→GCS RTT "
+        "(~250µs of ~1ms), so the pooled-connection savings read "
+        "larger GCS-side; the 4-bundle gap is the parallel prepare "
+        "(RTTs overlap instead of summing). Two costs were tuned out "
+        "en route, both ~70µs/Task on this host: single-bundle phases "
+        "skip the asyncio.gather wrapping, and the per-call wait_for "
+        "timeout was replaced by the pool's "
+        "drop-connection-on-node-death guarantee (a dead node fails "
+        "in-flight 2PC calls via ConnectionLost instead of a timer).",
         "",
         "## Sub-baseline metrics: hardware-bound analysis",
         "",
@@ -1504,6 +1617,10 @@ def main():
             micro.update(run_sharded_bench(args.quick))
         except Exception as e:
             print(f"sharded bench failed: {e!r}", file=sys.stderr)
+        try:
+            micro.update(run_pg_churn_bench(args.quick))
+        except Exception as e:
+            print(f"pg churn bench failed: {e!r}", file=sys.stderr)
     model = None
     if do_model:
         for attempt in range(2):  # the axon tunnel's remote_compile can flake

@@ -158,6 +158,13 @@ class Config:
     health_check_failure_threshold: int = 5
     rpc_connect_timeout_s: float = 30.0
     worker_start_timeout_s: float = 60.0
+    #: raylet-side lease on a PREPARED-but-uncommitted placement-group
+    #: bundle reservation: if the coordinating GCS dies between the 2PC
+    #: prepare and commit, the raylet returns the reservation after this
+    #: many seconds instead of leaking the capacity forever (a repeated
+    #: prepare — the GCS repairing/retrying — refreshes the lease);
+    #: <= 0 disables the GC
+    pg_bundle_lease_s: float = 30.0
 
     # --- task / actor fault tolerance ---
     default_max_task_retries: int = 3

@@ -659,14 +659,19 @@ class PlacementGroup:
         self.bundles = bundles
 
     def ready(self, timeout: float = 30.0) -> bool:
-        core = get_core()
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            info = core._run_sync(core.gcs.call("get_placement_group", {"pg_id": self.id}))
-            if info and info["state"] == "CREATED":
-                return True
-            time.sleep(0.02)
-        return False
+        """True once every bundle is committed. Observes the PG state
+        machine (PENDING → CREATED → RESCHEDULING → REMOVED): PENDING
+        and RESCHEDULING keep waiting — the GCS is creating or repairing
+        the group after a node death — so a call issued mid-repair
+        returns True when the repair commits rather than flapping False."""
+        return get_core().wait_placement_group_ready(self.id, timeout)
+
+    def state(self) -> dict | None:
+        """Latest GCS view: ``{state, bundle_nodes, bundles, strategy,
+        reschedule_cause, reschedules}`` — ``state`` is one of PENDING /
+        CREATED / RESCHEDULING / REMOVED; ``reschedule_cause`` names the
+        node loss behind the most recent repair."""
+        return get_core().get_placement_group_state(self.id)
 
     @property
     def bundle_specs(self):

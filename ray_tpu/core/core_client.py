@@ -285,6 +285,13 @@ class CoreClient:
         # and controller evict/replace replicas in ~one raylet reap tick
         # instead of waiting out a health-check period
         self._actor_death_listeners: list = []
+        # placement-group state pushes ("pgs" channel, subscribed lazily
+        # on the first ready()/wait): pg_id hex -> latest view, plus
+        # waiter events so ready() observes PENDING→CREATED and
+        # RESCHEDULING→CREATED transitions push-driven instead of polling
+        self._pg_info: dict[str, dict] = {}
+        self._pg_waiters: dict[str, list[asyncio.Event]] = {}
+        self._pg_subscribed = False
         self._task_counter = 0
         self._cancelled_tasks: set[TaskID] = set()
         self._task_worker: dict[TaskID, tuple] = {}  # task -> (conn, worker)
@@ -421,6 +428,17 @@ class CoreClient:
                         cb(actor_id, message)
                     except Exception:
                         log.debug("actor death listener failed", exc_info=True)
+        elif channel == "pgs" and isinstance(message, dict):
+            pg_hex = message.get("pg_id")
+            if pg_hex:
+                waiters = self._pg_waiters.pop(pg_hex, None)
+                if waiters:
+                    # retained only while a waiter is parked (it consumes
+                    # the view): no per-PG residue for the ones this
+                    # driver never waits on
+                    self._pg_info[pg_hex] = message
+                    for evt in waiters:
+                        evt.set()
         elif channel == "node_removed" and isinstance(message, dict):
             # holder died: drop it from every cached location so the next
             # get falls back to the GCS directory (source of truth)
@@ -432,6 +450,58 @@ class CoreClient:
                 holders.discard(nb)
                 if not holders:
                     del self._obj_locations[oid]
+
+    # ---------------------------------------------------- placement groups
+    def wait_placement_group_ready(self, pg_id, timeout: float = 30.0) -> bool:
+        """Block until the PG is CREATED (every bundle committed). The
+        wait observes the full PG state machine: PENDING and RESCHEDULING
+        keep waiting — creation or a node-death repair is in flight on
+        the GCS — while REMOVED (or the timeout) returns False.
+        Push-driven via the "pgs" pubsub channel, with a polling backstop
+        for lost pushes (e.g. a GCS restart dropping the subscription)."""
+        return self._run_sync(self._wait_pg_ready(pg_id, timeout))
+
+    def get_placement_group_state(self, pg_id) -> dict | None:
+        """Latest GCS view of one PG (state, bundle_nodes, reschedule
+        cause/count); None for an unknown id."""
+        return self._run_sync(
+            self.gcs.call("get_placement_group", {"pg_id": pg_id}))
+
+    async def _wait_pg_ready(self, pg_id, timeout: float) -> bool:
+        if not self._pg_subscribed:
+            self._pg_subscribed = True
+            try:
+                await self.gcs.call("subscribe", {"channel": "pgs"})
+            except (rpc.RpcError, OSError):
+                self._pg_subscribed = False  # degrade to pure polling
+        deadline = time.monotonic() + timeout
+        pg_hex = pg_id.hex()
+        view = None  # pushed "pgs" state consumed after each wake
+        while True:
+            if view is None:
+                view = await self.gcs.call(
+                    "get_placement_group", {"pg_id": pg_id})
+            if view is None or view["state"] == "REMOVED":
+                return False
+            if view["state"] == "CREATED":
+                return True
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            evt = asyncio.Event()
+            self._pg_waiters.setdefault(pg_hex, []).append(evt)
+            try:
+                await asyncio.wait_for(evt.wait(), min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                pass  # backstop: re-poll (a GCS restart can drop pushes)
+            finally:
+                waiters = self._pg_waiters.get(pg_hex)
+                if waiters and evt in waiters:
+                    waiters.remove(evt)
+                if not waiters:
+                    self._pg_waiters.pop(pg_hex, None)
+            # consume the pushed view; None falls back to the poll above
+            view = self._pg_info.pop(pg_hex, None)
 
     # ----------------------------------------------------------- ownership
     # Distributed reference counting (ref: reference_count.h:72): the owner

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import sys
 import time
 from dataclasses import dataclass, field
@@ -103,8 +104,122 @@ class PlacementGroupInfo:
     pg_id: PlacementGroupID
     bundles: list[dict[str, float]]
     strategy: str
-    state: str  # PENDING / CREATED / REMOVED
-    bundle_nodes: list[NodeID] = field(default_factory=list)
+    # state machine: PENDING -> CREATED -> (RESCHEDULING <-> CREATED) -> REMOVED
+    # (ref: gcs_placement_group_mgr.h:232 PlacementGroupState — RESCHEDULING
+    # is the reconciled-desired-state leg: bundles on dead nodes are
+    # re-placed instead of the PG being abandoned)
+    state: str
+    # one slot per bundle; None = not (or no longer) placed. Fully
+    # populated exactly when state == CREATED.
+    bundle_nodes: list[NodeID | None] = field(default_factory=list)
+    reschedule_cause: str | None = None
+    reschedules: int = 0
+
+    def __setstate__(self, state):
+        # WAL/snapshot records from before the FT fields existed restore
+        # without them: default in place so recovery never AttributeErrors
+        self.__dict__.update(state)
+        self.__dict__.setdefault("reschedule_cause", None)
+        self.__dict__.setdefault("reschedules", 0)
+
+    def lost_indices(self, alive: "set[NodeID]") -> list[int]:
+        return [i for i, nid in enumerate(self.bundle_nodes)
+                if nid is None or nid not in alive]
+
+
+class BundleTxn:
+    """Tracker for one two-phase reservation round over a subset of a
+    PG's bundles (the LeaseStatusTracker role, ref:
+    gcs_placement_group_scheduler.h:133). Prepare and commit each fan
+    out in PARALLEL over the GCS's pooled raylet connections; per-bundle
+    outcomes land in ``prepared`` / ``committed`` / ``failed`` so the
+    caller can repair exactly what broke instead of raising out of the
+    RPC with reservations stranded on every prepared node."""
+
+    def __init__(self, gcs: "GcsServer", pg: PlacementGroupInfo,
+                 placement: dict[int, NodeInfo]):
+        self.gcs = gcs
+        self.pg = pg
+        self.placement = placement  # bundle index -> target node
+        self.prepared: dict[int, NodeInfo] = {}
+        self.committed: dict[int, NodeInfo] = {}
+        self.failed: dict[int, NodeInfo] = {}
+
+    async def _phase_one(self, point: str, method: str, index: int,
+                         node: NodeInfo) -> bool:
+        if chaos.ENABLED:
+            # "gcs.pg_prepare" / "gcs.pg_commit" fault points: `error`
+            # raises here and is absorbed as THAT bundle's phase failure
+            # (repair re-places it); `drop` refuses the reservation;
+            # `delay` time.sleeps the whole GCS loop — the
+            # frozen-coordinator shape, same semantics as the other
+            # GCS-side points (keep delay_ms small in plans)
+            act = chaos.point(point, pg=self.pg.pg_id.hex()[:12],
+                              bundle=index, node=node.node_id.hex()[:12])
+            if act is not None and act.kind == "drop":
+                return False
+        # no call/phase timeout on purpose: wait_for task-wraps its
+        # awaitable (~70µs per Task on a small host — it dominated the
+        # create path). The unhang guarantee comes from the pool
+        # instead: _mark_node_dead drops the node's pooled connection,
+        # which fails every in-flight call here with ConnectionLost.
+        r = await self.gcs._node_call(
+            node, method,
+            {"pg_id": self.pg.pg_id, "bundle_index": index,
+             "resources": self.pg.bundles[index]})
+        return bool(r and r.get("ok"))
+
+    async def _phase(self, point: str, method: str,
+                     items: list[tuple[int, NodeInfo]],
+                     into: dict[int, NodeInfo]) -> bool:
+        """Run one 2PC phase over ``items``. Multi-bundle fans out in
+        parallel (the RTTs overlap); a single bundle awaits directly —
+        the gather/Task wrapping costs ~70µs a phase on a small host,
+        most of a 1-bundle PG's create path."""
+        if len(items) == 1:
+            index, node = items[0]
+            try:
+                ok = await self._phase_one(point, method, index, node)
+            except Exception:
+                ok = False
+            (into if ok else self.failed)[index] = node
+            return not self.failed
+        results = await asyncio.gather(
+            *(self._phase_one(point, method, i, n) for i, n in items),
+            return_exceptions=True)
+        for (index, node), ok in zip(items, results):
+            if ok is True:
+                into[index] = node
+            else:
+                self.failed[index] = node
+        return not self.failed
+
+    async def prepare(self) -> bool:
+        """Parallel phase 1. True iff every bundle reserved."""
+        return await self._phase("gcs.pg_prepare", "prepare_bundle",
+                                 list(self.placement.items()),
+                                 self.prepared)
+
+    async def commit(self) -> bool:
+        """Parallel phase 2 over the prepared set. Failures (node died
+        between phases, injected faults) land in ``failed`` for repair —
+        they are NEVER raised out of the transaction."""
+        return await self._phase("gcs.pg_commit", "commit_bundle",
+                                 list(self.prepared.items()),
+                                 self.committed)
+
+    async def rollback(self) -> None:
+        """Return every reservation this txn made that did not commit
+        (prepared-only slots, plus commit-phase failures whose node may
+        still hold the prepared bundle). Best effort: a dead node's
+        reservation died with it; a live-but-unreachable node's
+        uncommitted one is reclaimed by its own bundle-lease GC, and a
+        commit that LANDED but whose ack was lost (lease GC skips
+        committed entries) is caught by the GCS's periodic ledger audit
+        (_audit_node_bundles)."""
+        victims = [(self.pg.pg_id, i, n) for i, n in self.prepared.items()
+                   if i not in self.committed]
+        await self.gcs._return_bundles(victims)
 
 
 class GcsServer:
@@ -147,6 +262,15 @@ class GcsServer:
         self.subs: dict[str, set[rpc.Connection]] = {}
         # connections that are raylets (for health/cleanup): conn -> node_id
         self.raylet_conns: dict[rpc.Connection, NodeID] = {}
+        # pooled GCS->raylet connections for short control RPCs (bundle
+        # prepare/commit/return): the old per-bundle rpc.connect loop was
+        # most of the placement-group benchmark's cost. Never used for
+        # parking calls (lease_worker), whose cancel-on-disconnect
+        # semantics need a per-request connection.
+        self._node_conns: dict[NodeID, rpc.Connection] = {}
+        # placement-group reconciliation: pg ids with a drive pass in
+        # flight (one reconciler per PG at a time)
+        self._pg_reconciling: set[PlacementGroupID] = set()
         # actor worker connections for cleanup: conn -> actor_ids
         self._stopping = False
         self._bg = aio.TaskGroup()
@@ -275,6 +399,70 @@ class GcsServer:
         self._journal(("job", self.job_counter))
         return JobID(self.job_counter.to_bytes(4, "little"))
 
+    # ----------------------------------------------- pooled raylet control RPC
+    async def _node_conn(self, node: NodeInfo) -> rpc.Connection:
+        conn = self._node_conns.get(node.node_id)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = await rpc.connect(*node.address,
+                                 timeout=self.cfg.rpc_connect_timeout_s)
+        cur = self._node_conns.get(node.node_id)
+        if cur is not None and not cur._closed:
+            # lost a concurrent-dial race (parallel 2PC legs to one
+            # node): keep the pooled winner, close ours — overwriting
+            # would leak the first socket until process exit
+            self._bg.spawn(conn.close())
+            return cur
+        self._node_conns[node.node_id] = conn
+        return conn
+
+    def _drop_node_conn(self, node_id: NodeID) -> None:
+        conn = self._node_conns.pop(node_id, None)
+        if conn is not None:
+            self._bg.spawn(conn.close())
+
+    async def _node_call(self, node: NodeInfo, method: str, payload: dict,
+                         timeout: float | None = None):
+        """One short control RPC over the pooled connection. A pooled
+        socket that died since its last use is replaced and the call
+        retried ONCE on the fresh dial; a failure on the fresh socket is
+        the node's problem and propagates."""
+        for attempt in (0, 1):
+            try:
+                conn = await self._node_conn(node)
+                return await conn.call(method, payload, timeout=timeout)
+            except (rpc.RpcError, OSError, asyncio.TimeoutError):
+                self._drop_node_conn(node.node_id)
+                if attempt:
+                    raise
+
+    async def _return_bundles(
+            self,
+            victims: list[tuple[PlacementGroupID, int, NodeInfo]]) -> None:
+        """Parallel best-effort bundle returns (2PC rollback/repair,
+        remove and drain paths). Dead or unreachable nodes are skipped —
+        their reservations are reclaimed by the raylet bundle-lease GC
+        or died with the process."""
+
+        async def one(pg_id: PlacementGroupID, index: int, node: NodeInfo):
+            try:
+                # no wait_for (Task-wrap cost, see BundleTxn._phase_one):
+                # a dying node's pooled conn drop fails this call instead
+                await self._node_call(
+                    node, "return_bundle",
+                    {"pg_id": pg_id, "bundle_index": index})
+            except Exception:
+                log.debug("bundle return failed on %s",
+                          node.node_id.hex()[:12], exc_info=True)
+
+        live = [(p, i, n) for p, i, n in victims
+                if self.nodes.get(n.node_id) is not None
+                and self.nodes[n.node_id].alive]
+        if len(live) == 1:
+            await one(*live[0])  # skip the gather wrapping (see _phase)
+        elif live:
+            await asyncio.gather(*(one(p, i, n) for p, i, n in live))
+
     # ------------------------------------------------------------------- nodes
     async def rpc_register_node(self, conn, p):
         info = NodeInfo(
@@ -287,14 +475,64 @@ class GcsServer:
             pid=int(p.get("pid", 0)),
         )
         self.nodes[info.node_id] = info
+        self._drop_node_conn(info.node_id)  # pooled socket may predate a restart
         # a re-registering raylet (GCS-FT reconnect) replaces its old
         # connection mapping, so the old socket's close is a no-op
         for old_conn, nid in list(self.raylet_conns.items()):
             if nid == info.node_id and old_conn is not conn:
                 self.raylet_conns.pop(old_conn, None)
         self.raylet_conns[conn] = info.node_id
+        # bundle reconciliation (GCS FT): the raylet reports every bundle
+        # reservation its ledger holds; reservations the recovered pgs
+        # table doesn't recognize are returned, committed ones it does are
+        # adopted back into bundle_nodes (the table may have been restored
+        # from a snapshot older than the placement)
+        stale = self._reconcile_reported_bundles(
+            info, p.get("bundles") or ())
         await self.publish("nodes", {"event": "added", "node": info.view()})
-        return {"node_id": info.node_id, "cluster": self.cluster_view()}
+        # fresh capacity: wake PENDING (infeasible-at-create) and
+        # RESCHEDULING placement groups
+        self._kick_pgs()
+        return {"node_id": info.node_id, "cluster": self.cluster_view(),
+                "return_bundles": stale}
+
+    def _reconcile_reported_bundles(self, info: NodeInfo, reported,
+                                    live_audit: bool = False) -> list[tuple]:
+        stale: list[tuple] = []
+        for b in reported:
+            pg_id, index = b["pg_id"], int(b["bundle_index"])
+            pg = self.pgs.get(pg_id)
+            if (pg is None or pg.state == "REMOVED"
+                    or index >= len(pg.bundles)):
+                stale.append((pg_id, index))
+                continue
+            if not b.get("committed"):
+                # registration path: a reservation the coordinating 2PC
+                # never committed (it died with the old GCS) — return it.
+                # Live-audit path: this may be THIS GCS's own prepare in
+                # flight between the phases — leave it to the raylet's
+                # bundle-lease GC.
+                if not live_audit:
+                    stale.append((pg_id, index))
+                continue
+            if len(pg.bundle_nodes) != len(pg.bundles):
+                pg.bundle_nodes = [None] * len(pg.bundles)
+            current = pg.bundle_nodes[index]
+            if current is not None and current != info.node_id:
+                # rescheduled elsewhere while this node was away: its old
+                # copy of the bundle is stale capacity
+                stale.append((pg_id, index))
+            elif current is None and pg_id in self._pg_reconciling:
+                # a repair txn for this PG is mid-flight and may be about
+                # to commit this very slot on another node — adopting now
+                # would be overwritten by the commit and strand this
+                # node's committed reservation forever (the lease GC only
+                # reclaims uncommitted ones). Return it; the txn's
+                # outcome is authoritative.
+                stale.append((pg_id, index))
+            else:
+                pg.bundle_nodes[index] = info.node_id
+        return stale
 
     async def rpc_heartbeat(self, conn, p):
         info = self.nodes.get(p["node_id"])
@@ -329,7 +567,23 @@ class GcsServer:
         return [n.view() for n in self.nodes.values() if n.alive]
 
     async def rpc_drain_node(self, conn, p):
-        await self._mark_node_dead(p["node_id"], "drained")
+        node_id = p["node_id"]
+        info = self.nodes.get(node_id)
+        if info is not None and info.alive:
+            # graceful half of a drain: hand the node's bundle
+            # reservations back (one parallel wave) while its raylet is
+            # still up, so the ledger frees NOW instead of waiting on
+            # the raylet-side bundle-lease GC after the dead-mark
+            victims = []
+            for pg in self.pgs.values():
+                if pg.state == "REMOVED":
+                    continue
+                victims.extend(
+                    (pg.pg_id, i, info)
+                    for i, nid in enumerate(pg.bundle_nodes)
+                    if nid == node_id)
+            await self._return_bundles(victims)
+        await self._mark_node_dead(node_id, "drained")
         return True
 
     async def _mark_node_dead(self, node_id: NodeID, cause: str):
@@ -337,12 +591,27 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
+        self._drop_node_conn(node_id)
         await self.publish("nodes", {"event": "removed", "node_id": node_id, "cause": cause})
         # dedicated low-traffic channel for location-cache invalidation:
         # every CoreClient subscribes to THIS, not "nodes" — the "nodes"
         # channel also carries per-heartbeat resource gossip that every
         # driver and worker would otherwise receive and discard
         await self.publish("node_removed", {"node_id": node_id})
+        # placement groups FIRST (before the actor failover below): a
+        # PG-bound actor rescheduling must observe RESCHEDULING and wait
+        # for the repair — not a still-CREATED pg whose bundle_nodes
+        # point at the dead node, which would spin its _pick_node loop
+        # against a bundle that can never grant until the start timeout
+        # killed it
+        for pg in list(self.pgs.values()):
+            if pg.state not in ("CREATED", "RESCHEDULING"):
+                continue
+            lost = [i for i, nid in enumerate(pg.bundle_nodes)
+                    if nid == node_id]
+            if lost:
+                await self._reschedule_lost(
+                    pg, lost, f"node {node_id.hex()[:12]} {cause}")
         # fail actors living on that node (ref: gcs_actor_manager.cc OnNodeDead)
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING):
@@ -378,42 +647,74 @@ class GcsServer:
     async def _schedule_actor(self, info: ActorInfo):
         """GCS-side actor scheduling (ref: gcs_actor_scheduler.h): lease a
         worker from a raylet chosen by resource fit, then push the creation
-        task to that worker directly."""
+        task to that worker directly. Lease races and raylet deaths
+        retry with exponential backoff + jitter under ONE
+        worker_start_timeout_s deadline (the old path respawned itself
+        with a flat 0.05s sleep and a fresh deadline every time —
+        raylint RT013's synchronized-herd shape, and an actor could
+        retry forever)."""
         try:
             resources = info.spec.get("resources", {"CPU": 1.0})
             pg_id = info.spec.get("placement_group")
             bundle_index = info.spec.get("bundle_index", -1)
             strategy = info.spec.get("scheduling_strategy")
             deadline = time.monotonic() + self.cfg.worker_start_timeout_s
+            retries = 0
             while True:
                 node = self._pick_node(resources, pg_id, bundle_index,
                                        strategy)
-                if node is not None:
+                if node is None:
+                    if time.monotonic() > deadline:
+                        info.state = DEAD
+                        info.death_cause = (
+                            f"no node can host actor resources {resources}"
+                            + (f" under strategy {strategy}" if strategy
+                               else "")
+                            + (" (placement group not CREATED)"
+                               if pg_id is not None else ""))
+                        await self.publish("actors", info.view())
+                        await self.publish(
+                            f"actor:{info.actor_id.hex()}", info.view())
+                        return
+                    await asyncio.sleep(0.1)  # poll: placement may repair
+                    continue
+                # leases go over a per-request connection, NOT the pooled
+                # one: a parked lease request must die with its requester
+                # (the raylet cancels waiters on disconnect)
+                lease = None
+                try:
+                    conn = await rpc.connect(*node.address)
+                    try:
+                        lease = await conn.call(
+                            "lease_worker",
+                            {"resources": resources,
+                             "for_actor": info.actor_id,
+                             "pg_id": pg_id, "bundle_index": bundle_index},
+                            timeout=max(1.0, deadline - time.monotonic()),
+                        )
+                    finally:
+                        await conn.close()
+                except (rpc.RpcError, OSError, asyncio.TimeoutError):
+                    # chosen raylet died or stalled mid-grant: re-pick —
+                    # node death will have updated self.nodes by the time
+                    # the backoff elapses
+                    log.debug("actor lease attempt on %s failed",
+                              node.node_id.hex()[:12], exc_info=True)
+                if lease and lease.get("granted"):
                     break
                 if time.monotonic() > deadline:
                     info.state = DEAD
                     info.death_cause = (
-                        f"no node can host actor resources {resources}"
-                        + (f" under strategy {strategy}" if strategy else ""))
+                        f"actor lease not granted within "
+                        f"worker_start_timeout_s="
+                        f"{self.cfg.worker_start_timeout_s}")
                     await self.publish("actors", info.view())
+                    await self.publish(
+                        f"actor:{info.actor_id.hex()}", info.view())
                     return
-                await asyncio.sleep(0.1)
-
-            conn = await rpc.connect(*node.address)
-            try:
-                lease = await conn.call(
-                    "lease_worker",
-                    {"resources": resources, "for_actor": info.actor_id,
-                     "pg_id": pg_id, "bundle_index": bundle_index},
-                    timeout=self.cfg.worker_start_timeout_s,
-                )
-            finally:
-                await conn.close()
-            if not lease.get("granted"):
-                # retry scheduling (resources raced away)
-                await asyncio.sleep(0.05)
-                self._bg.spawn(self._schedule_actor(info))
-                return
+                retries += 1
+                base = min(0.05 * (2 ** min(retries, 5)), 1.0)
+                await asyncio.sleep(base * (0.5 + random.random() / 2))
 
             worker_addr = tuple(lease["worker_address"])
             wconn = await rpc.connect(*worker_addr)
@@ -502,16 +803,7 @@ class GcsServer:
         return [a.view() for a in self.actors.values()]
 
     async def rpc_list_placement_groups(self, conn, p):
-        return [
-            {
-                "pg_id": pg.pg_id.hex(),
-                "bundles": pg.bundles,
-                "strategy": pg.strategy,
-                "state": pg.state,
-                "bundle_nodes": [n.hex() for n in pg.bundle_nodes],
-            }
-            for pg in self.pgs.values()
-        ]
+        return [self._pg_view(pg) for pg in self.pgs.values()]
 
     async def rpc_report_actor_death(self, conn, p):
         info = self.actors.get(p["actor_id"])
@@ -554,62 +846,185 @@ class GcsServer:
                 self._journal(("namedel", info.name))
 
     # -------------------------------------------------------- placement groups
+    # PGs are a RECONCILED desired state, not a one-shot RPC (ref:
+    # gcs_placement_group_mgr.h:232 + the Borg model of placement as a
+    # converged spec): _drive_pg runs the two-phase reservation through a
+    # BundleTxn with parallel prepare/commit over pooled connections,
+    # repairs commit-phase failures by re-placing exactly the failed
+    # bundles, and is re-kicked by node registration, node death, and the
+    # health-loop sweep until the PG converges (or is removed).
+
     async def rpc_create_placement_group(self, conn, p):
         """Two-phase bundle reservation across raylets (ref:
         gcs_placement_group_scheduler.h:288 prepare/commit protocol)."""
         pg_id = p["pg_id"]
         bundles = p["bundles"]
         strategy = p.get("strategy", "PACK")
-        pg = PlacementGroupInfo(pg_id=pg_id, bundles=bundles, strategy=strategy, state="PENDING")
+        pg = PlacementGroupInfo(
+            pg_id=pg_id, bundles=bundles, strategy=strategy, state="PENDING",
+            bundle_nodes=[None] * len(bundles))
         self.pgs[pg_id] = pg
         self._journal(("pg", pg))
+        await self._reconcile_pg(pg)
+        if pg.state == "CREATED":
+            return {"state": "CREATED",
+                    "bundle_nodes": list(pg.bundle_nodes)}
+        # infeasible now: the PG stays PENDING and a later node
+        # registration wakes it (the caller's ready()/wait observes the
+        # transition via the "pgs" pubsub channel)
+        return {"state": "INFEASIBLE"}
 
-        assignment = self._place_bundles(bundles, strategy)
-        if assignment is None:
-            pg.state = "PENDING"  # infeasible now; caller may wait/retry
-            return {"state": "INFEASIBLE"}
-
-        # phase 1: prepare all reservations
-        prepared: list[tuple[NodeInfo, int]] = []
-        ok = True
-        for bundle_index, node in enumerate(assignment):
-            try:
-                c = await rpc.connect(*node.address)
-                r = await c.call(
-                    "prepare_bundle",
-                    {"pg_id": pg_id, "bundle_index": bundle_index,
-                     "resources": bundles[bundle_index]},
-                )
-                await c.close()
-                if not r.get("ok"):
-                    ok = False
-                    break
-                prepared.append((node, bundle_index))
-            except Exception:
-                ok = False
-                break
-        if not ok:  # rollback
-            for node, bundle_index in prepared:
-                try:
-                    c = await rpc.connect(*node.address)
-                    await c.call("return_bundle", {"pg_id": pg_id, "bundle_index": bundle_index})
-                    await c.close()
-                except Exception:
-                    log.debug("bundle rollback failed on %s",
-                              node.node_id.hex()[:12], exc_info=True)
-            return {"state": "INFEASIBLE"}
-        # phase 2: commit
-        for node, bundle_index in prepared:
-            c = await rpc.connect(*node.address)
-            await c.call("commit_bundle", {"pg_id": pg_id, "bundle_index": bundle_index})
-            await c.close()
-        pg.state = "CREATED"
-        pg.bundle_nodes = [n.node_id for n in assignment]
+    async def _reschedule_lost(self, pg: PlacementGroupInfo,
+                               lost: list[int], cause: str) -> None:
+        """Shared node-loss bookkeeping (_mark_node_dead and the
+        GCS-restart sweep): null the lost slots, move to RESCHEDULING,
+        stamp the cause, journal + publish the transition, kick the
+        reconciler (a no-op while a pass is in flight — that pass's
+        liveness re-check picks the loss up instead)."""
+        for i in lost:
+            pg.bundle_nodes[i] = None
+        pg.state = "RESCHEDULING"
+        pg.reschedules += 1
+        pg.reschedule_cause = cause
         self._journal(("pg", pg))
-        return {"state": "CREATED", "bundle_nodes": pg.bundle_nodes}
+        await self._publish_pg(pg)
+        self._kick_pg(pg)
 
-    def _place_bundles(self, bundles, strategy) -> list[NodeInfo] | None:
-        alive = [n for n in self.nodes.values() if n.alive]
+    async def _audit_node_bundles(self, info: NodeInfo) -> None:
+        """Audit one live node's bundle ledger against the pgs table:
+        reservations the table doesn't assign to this node are returned
+        (stranded committed bundles included), recognized committed ones
+        are adopted — the same reconciliation re-registration runs,
+        initiated server-side on the health-loop cadence."""
+        try:
+            held = await self._node_call(info, "list_bundles", {})
+        except Exception:
+            log.debug("bundle audit of %s failed",
+                      info.node_id.hex()[:12], exc_info=True)
+            return
+        stale = self._reconcile_reported_bundles(info, held or (),
+                                                 live_audit=True)
+        if stale:
+            await self._return_bundles(
+                [(pg_id, index, info) for pg_id, index in stale])
+
+    def _kick_pg(self, pg: PlacementGroupInfo) -> None:
+        if (pg.state in ("PENDING", "RESCHEDULING")
+                and pg.pg_id not in self._pg_reconciling):
+            self._bg.spawn(self._reconcile_pg(pg))
+
+    def _kick_pgs(self) -> None:
+        for pg in list(self.pgs.values()):
+            self._kick_pg(pg)
+
+    async def _reconcile_pg(self, pg: PlacementGroupInfo) -> None:
+        """Serialized entry: at most one drive pass per PG in flight."""
+        if pg.pg_id in self._pg_reconciling:
+            return
+        self._pg_reconciling.add(pg.pg_id)
+        try:
+            await self._drive_pg(pg)
+        finally:
+            self._pg_reconciling.discard(pg.pg_id)
+
+    async def _drive_pg(self, pg: PlacementGroupInfo) -> None:
+        """One reconciliation pass: place every unassigned/lost bundle,
+        2PC the placement, repair per-bundle failures by re-placing them
+        on other nodes. Leaves the PG PENDING/RESCHEDULING when the
+        cluster can't satisfy it right now — node registration or the
+        health-loop sweep kicks another pass later."""
+        bad: set[NodeID] = set()  # nodes that failed a phase this pass
+        failures = 0
+        for _round in range(16):  # hard cap: the next kick resumes
+            if pg.state not in ("PENDING", "RESCHEDULING"):
+                return
+            if len(pg.bundle_nodes) != len(pg.bundles):
+                pg.bundle_nodes = [None] * len(pg.bundles)
+            alive = {nid for nid, n in self.nodes.items() if n.alive}
+            lost = pg.lost_indices(alive)
+            if not lost:
+                # the liveness check above is the ONLY gate to CREATED:
+                # a node death that landed while this pass was awaiting
+                # a 2PC phase (its _kick_pg no-opped on the reconciling
+                # guard) shows up here as a fresh lost slot and loops
+                # back into placement instead of being declared CREATED
+                # with a dead/None bundle_nodes entry
+                await self._pg_created(pg)
+                return
+            if failures >= 4:
+                break
+            for i in lost:
+                pg.bundle_nodes[i] = None
+            survivors = {nid for nid in pg.bundle_nodes if nid is not None}
+            placement = self._place_bundles(
+                [pg.bundles[i] for i in lost], pg.strategy,
+                exclude=bad, used=survivors)
+            if placement is None:
+                if bad:
+                    # a phase-failed node may have been a transient fault,
+                    # not a death: widen the candidate set once before
+                    # giving up the pass
+                    bad.clear()
+                    continue
+                return  # infeasible now; stays PENDING/RESCHEDULING
+            txn = BundleTxn(self, pg, dict(zip(lost, placement)))
+            if not await txn.prepare():
+                await txn.rollback()
+                bad.update(n.node_id for n in txn.failed.values())
+                failures += 1
+                continue
+            await txn.commit()
+            if pg.state == "REMOVED":
+                # removal raced the commit: hand everything straight back
+                await self._return_bundles(
+                    [(pg.pg_id, i, n) for i, n in txn.placement.items()])
+                return
+            for index, node in txn.committed.items():
+                pg.bundle_nodes[index] = node.node_id
+            if txn.failed:
+                # commit-phase failures (node died between phases /
+                # injected fault): REPAIR — return what may still be
+                # reserved there and re-place just those bundles — never
+                # raise out with reservations stranded
+                await txn.rollback()
+                bad.update(n.node_id for n in txn.failed.values())
+                failures += 1
+            # success or repair: loop back to the liveness re-check
+        log.warning("placement group %s did not converge this pass "
+                    "(state=%s); will retry on the next kick",
+                    pg.pg_id.hex()[:12], pg.state)
+
+    async def _pg_created(self, pg: PlacementGroupInfo) -> None:
+        pg.state = "CREATED"
+        self._journal(("pg", pg))
+        await self._publish_pg(pg)
+
+    def _pg_view(self, pg: PlacementGroupInfo) -> dict:
+        return {
+            "pg_id": pg.pg_id.hex(),
+            "bundles": pg.bundles,
+            "strategy": pg.strategy,
+            "state": pg.state,
+            "bundle_nodes": [n.hex() if n is not None else None
+                             for n in pg.bundle_nodes],
+            "reschedule_cause": pg.reschedule_cause,
+            "reschedules": pg.reschedules,
+        }
+
+    async def _publish_pg(self, pg: PlacementGroupInfo) -> None:
+        await self.publish("pgs", dict(self._pg_view(pg), ts=time.time()))
+
+    def _place_bundles(self, bundles, strategy, *,
+                       exclude: set | frozenset = frozenset(),
+                       used: set | frozenset = frozenset(),
+                       ) -> list[NodeInfo] | None:
+        """Place ``bundles`` on alive nodes. ``exclude`` removes nodes
+        from candidacy entirely (repair passes exclude nodes that just
+        failed a 2PC phase); ``used`` seeds the spread constraint with
+        nodes already holding SURVIVING bundles of the same PG, so a
+        STRICT_SPREAD repair never doubles up on a survivor."""
+        alive = [n for n in self.nodes.values()
+                 if n.alive and n.node_id not in exclude]
         avail = {n.node_id: dict(n.resources_available) for n in alive}
 
         def take(node, bundle):
@@ -622,8 +1037,12 @@ class GcsServer:
 
         assignment: list[NodeInfo] = []
         if strategy in ("STRICT_PACK", "PACK"):
-            # try to fit everything on one node first
-            for n in alive:
+            # try to fit everything on one node first; a partial
+            # STRICT_PACK repair must land on the node holding the
+            # surviving bundles (there is at most one by construction)
+            candidates = ([n for n in alive if n.node_id in used]
+                          if strategy == "STRICT_PACK" and used else alive)
+            for n in candidates:
                 snapshot = dict(avail[n.node_id])
                 if _fits_all(bundles, snapshot):
                     for b in bundles:
@@ -633,15 +1052,15 @@ class GcsServer:
                 return None
         if strategy in ("SPREAD", "STRICT_SPREAD", "PACK"):
             nodes_sorted = sorted(alive, key=lambda n: -sum(avail[n.node_id].values()))
-            used: set[NodeID] = set()
+            pg_used: set[NodeID] = set(used)
             for b in bundles:
                 placed = False
                 for n in nodes_sorted:
-                    if strategy == "STRICT_SPREAD" and n.node_id in used:
+                    if strategy == "STRICT_SPREAD" and n.node_id in pg_used:
                         continue
                     if take(n, b):
                         assignment.append(n)
-                        used.add(n.node_id)
+                        pg_used.add(n.node_id)
                         placed = True
                         break
                 if not placed:
@@ -653,28 +1072,24 @@ class GcsServer:
         pg = self.pgs.get(p["pg_id"])
         if pg is None:
             return False
-        for bundle_index, node_id in enumerate(pg.bundle_nodes):
-            node = self.nodes.get(node_id)
-            if node is None or not node.alive:
-                continue
-            try:
-                c = await rpc.connect(*node.address)
-                await c.call("return_bundle", {"pg_id": pg.pg_id, "bundle_index": bundle_index})
-                await c.close()
-            except Exception:
-                log.debug("bundle return failed on %s",
-                          node_id.hex()[:12], exc_info=True)
-        pg.state = "REMOVED"
-        pg.bundle_nodes = []
+        victims = [(pg.pg_id, i, self.nodes[nid])
+                   for i, nid in enumerate(pg.bundle_nodes)
+                   if nid is not None and nid in self.nodes]
+        pg.state = "REMOVED"  # set BEFORE the returns: an in-flight
+        pg.bundle_nodes = []  # reconcile pass observes it and backs out
         self._journal(("pg", pg))
+        await self._return_bundles(victims)
+        await self._publish_pg(pg)
         return True
 
     async def rpc_get_placement_group(self, conn, p):
         pg = self.pgs.get(p["pg_id"])
         if pg is None:
             return None
-        return {"state": pg.state, "bundle_nodes": pg.bundle_nodes, "bundles": pg.bundles,
-                "strategy": pg.strategy}
+        return {"state": pg.state, "bundle_nodes": list(pg.bundle_nodes),
+                "bundles": pg.bundles, "strategy": pg.strategy,
+                "reschedule_cause": pg.reschedule_cause,
+                "reschedules": pg.reschedules}
 
     # -------------------------------------------------- task events / timeline
     async def rpc_report_task_events(self, conn, p):
@@ -703,6 +1118,21 @@ class GcsServer:
             for info in list(self.nodes.values()):
                 if info.alive and now - info.last_heartbeat > deadline:
                     await self._mark_node_dead(info.node_id, "health check timeout")
+            # reconciler safety net: kick any PENDING/RESCHEDULING pg
+            # with no drive pass in flight (event kicks cover the common
+            # cases; this rescues passes that gave up mid-churn)
+            self._kick_pgs()
+            # ledger audit (every ~10 ticks): cross-check each live
+            # node's held bundles against the pgs table. The backstop
+            # for a commit that LANDED raylet-side but whose ack was
+            # lost (dead pooled socket, raylet alive): the bundle is
+            # committed, so the raylet's own lease GC will never
+            # reclaim it — only this sweep (or a re-register) can
+            self._audit_tick = getattr(self, "_audit_tick", 0) + 1
+            if self._audit_tick % 10 == 0:
+                for info in list(self.nodes.values()):
+                    if info.alive:
+                        await self._audit_node_bundles(info)
             # restored ALIVE actors whose node never re-registered after a
             # GCS restart are dead, not merely unobserved
             restored_at = getattr(self, "_restored_at", None)
@@ -714,6 +1144,15 @@ class GcsServer:
                         await self._on_actor_failure(
                             info, "node lost across GCS restart"
                         )
+                # restored CREATED pgs with bundles on nodes that never
+                # came back reschedule exactly like a live node death
+                for pg in list(self.pgs.values()):
+                    if pg.state != "CREATED":
+                        continue
+                    lost = pg.lost_indices(alive_nodes)
+                    if lost:
+                        await self._reschedule_lost(
+                            pg, lost, "node lost across GCS restart")
 
     def _restore(self):
         """Recover durable tables (ref role: GCS FT via the Redis store
@@ -967,6 +1406,12 @@ class GcsServer:
     async def stop(self):
         self._stopping = True
         await self._bg.cancel_all()
+        for conn in list(self._node_conns.values()):
+            try:
+                await conn.close()
+            except (rpc.RpcError, OSError):
+                pass  # pooled socket already dead
+        self._node_conns.clear()
         if self.persist_path and self._dirty:
             self._write_snapshot()  # final flush: acknowledged writes survive
         await self.server.stop()

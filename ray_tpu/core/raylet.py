@@ -118,7 +118,11 @@ class ResourceLedger:
 
     # -- placement group bundles ------------------------------------------
     def prepare_bundle(self, key: tuple, resources: dict[str, float]) -> bool:
-        if key in self.bundles:
+        b = self.bundles.get(key)
+        if b is not None:
+            # 2PC retry over an already-held reservation: refresh the
+            # lease stamp so the GC clock restarts with the new round
+            b["prepared_at"] = time.monotonic()
             return True
         if not self.allocate(resources):
             return False
@@ -126,6 +130,12 @@ class ResourceLedger:
             "resources": _fp_dict(resources),
             "available": _fp_dict(resources),
             "committed": False,
+            # prepared-but-uncommitted reservations carry a lease: if the
+            # coordinating GCS dies between prepare and commit, the
+            # raylet-side GC (Raylet._gc_stale_bundles) reclaims the
+            # capacity after cfg.pg_bundle_lease_s instead of leaking it
+            # forever
+            "prepared_at": time.monotonic(),
         }
         return True
 
@@ -158,6 +168,31 @@ class ResourceLedger:
         for k, v in req.items():
             cap = b["resources"].get(k, _fp(v))
             b["available"][k] = min(b["available"].get(k, 0) + _fp(v), cap)
+
+    def held_bundles(self) -> list[dict]:
+        """The wire shape bundle reservations travel in (register_node
+        reports, rpc_list_bundles audits) — shared by the real raylet
+        and the churn harness's SimRaylet so they can't drift."""
+        return [
+            {"pg_id": key[0], "bundle_index": key[1],
+             "resources": _unfp_dict(b["resources"]),
+             "committed": bool(b.get("committed"))}
+            for key, b in self.bundles.items()
+        ]
+
+    def gc_stale_bundles(self, now: float, lease_s: float) -> list[tuple]:
+        """Return (and free) prepared-but-never-committed reservations
+        whose lease expired: the coordinating GCS died (or gave up)
+        mid-2PC, so nothing will ever commit or return them. Returns the
+        reclaimed keys."""
+        if lease_s <= 0:
+            return []
+        stale = [key for key, b in self.bundles.items()
+                 if not b.get("committed")
+                 and now - b.get("prepared_at", now) > lease_s]
+        for key in stale:
+            self.return_bundle(key)
+        return stale
 
 
 class Raylet:
@@ -283,9 +318,11 @@ class Raylet:
                 "resources": self.ledger.total,
                 "labels": self.labels,
                 "pid": os.getpid(),
+                "bundles": self._held_bundles(),
             },
         )
         self.cluster_view = reply["cluster"]
+        self._apply_bundle_reconciliation(reply)
         await self.gcs.call("subscribe", {"channel": "nodes"})
         self._bg.spawn(self._heartbeat_loop())
         self._bg.spawn(self._reaper_loop())
@@ -309,6 +346,10 @@ class Raylet:
                 pass  # replacing a dead connection: close is best-effort
 
     async def _reregister(self):
+        # held bundles ride the registration so a restarted GCS can
+        # reconcile its recovered pgs table against what this node's
+        # ledger actually reserves (adopt committed bundles, order stale
+        # ones returned)
         reply = await self.gcs.call(
             "register_node",
             {
@@ -318,10 +359,19 @@ class Raylet:
                 "resources": self.ledger.total,
                 "labels": self.labels,
                 "pid": os.getpid(),
+                "bundles": self._held_bundles(),
             },
         )
         self.cluster_view = reply["cluster"]
+        self._apply_bundle_reconciliation(reply)
         await self.gcs.call("subscribe", {"channel": "nodes"})
+
+    def _apply_bundle_reconciliation(self, reply: dict) -> None:
+        stale = reply.get("return_bundles") or ()
+        for key in stale:
+            self.ledger.return_bundle(tuple(key))
+        if stale:
+            self._grant_waiters()
 
     def _on_gcs_push(self, msg):
         if msg.get("m") == "pubsub" and msg["p"]["channel"] == "nodes":
@@ -393,6 +443,7 @@ class Raylet:
                     self.memory_monitor.maybe_kill()
                 except Exception:
                     log.debug("memory monitor sweep failed", exc_info=True)
+            self._gc_stale_bundles(now)
             for w in list(self.all_workers.values()):
                 if w.proc.poll() is not None:
                     await self._on_worker_death(w)
@@ -963,7 +1014,30 @@ class Raylet:
 
     async def rpc_return_bundle(self, conn, p):
         self.ledger.return_bundle((p["pg_id"], p["bundle_index"]))
+        self._grant_waiters()
         return {"ok": True}
+
+    async def rpc_list_bundles(self, conn, p):
+        """Bundle reservations this node's ledger holds (the PG
+        fault-tolerance audit surface: the churn harness and tests
+        assert zero leaked reservations here after settle)."""
+        return self._held_bundles()
+
+    def _held_bundles(self) -> list[dict]:
+        return self.ledger.held_bundles()
+
+    def _gc_stale_bundles(self, now: float) -> None:
+        """Reclaim expired prepared-uncommitted reservations (the sweep
+        behind the bundle-lease semantics — without it a GCS crash
+        between prepare and commit leaks the capacity forever)."""
+        stale = self.ledger.gc_stale_bundles(
+            now, getattr(self.cfg, "pg_bundle_lease_s", 30.0))
+        for key in stale:
+            log.warning(
+                "returned stale prepared bundle %s (no commit within the "
+                "lease: 2PC coordinator lost)", key)
+        if stale:
+            self._grant_waiters()
 
     async def rpc_report_demand(self, conn, p):
         """Client backlog report: tasks queued driver-side (including shm

@@ -221,6 +221,14 @@ def list_nodes(filters=None, limit: int = 1000) -> list[dict]:
 
 
 def list_placement_groups(filters=None, limit: int = 1000) -> list[dict]:
+    """Per-PG rows from the GCS table: ``pg_id``, ``bundles``,
+    ``strategy``, ``state`` (PENDING / CREATED / RESCHEDULING / REMOVED),
+    ``bundle_nodes`` (hex node id per bundle; ``None`` for a bundle whose
+    node died and is being re-placed), ``reschedule_cause`` (the node
+    loss behind the most recent repair) and ``reschedules`` (lifetime
+    repair count). Filter e.g. ``[("state", "=", "RESCHEDULING")]`` to
+    watch repairs in flight (the dashboard's /api/placement_groups serves
+    the same rows)."""
     rows = _call("list_placement_groups")
     return [r for r in rows if _match(r, filters)][:limit]
 
