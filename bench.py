@@ -386,7 +386,10 @@ def run_chaos_bench(quick: bool) -> dict[str, float]:
 # serve data-plane child: a fixed request stream against a 2-replica
 # deployment with the full FT stack enabled (retries, deadlines,
 # hedging) — 8 closed-loop client threads, per-request latency sampled
-# client-side. Run bare for serve_qps/serve_p99_ms; run under the
+# client-side. argv[2] picks the data-plane arm: "dataplane" = fast-lane
+# router + adaptive (AIMD) batching under a 50ms SLO; "baseline" = RPC
+# routing + fixed batch size (the pre-dataplane configuration, same
+# handler). Run bare for serve_qps/serve_p99_ms; run under the
 # checked-in seeded kill-replicas plan (tests/plans/) for
 # serve_error_rate_chaos — the ROADMAP SLO sentence as a number.
 _SERVE_BENCH_CHILD = r"""
@@ -395,17 +398,20 @@ import ray_tpu
 from ray_tpu import serve
 
 n_requests = int(sys.argv[1])
+adaptive = sys.argv[2] == "dataplane"  # fastlane rides RT_SERVE_FASTLANE
 ray_tpu.init(num_cpus=8)
 
 @serve.deployment(num_replicas=2, max_ongoing_requests=16,
                   max_request_retries=4, request_timeout_s=60.0,
-                  retry_on="*", hedge_after_ms=400.0)
+                  retry_on="*", hedge_after_ms=400.0,
+                  latency_slo_ms=50.0 if adaptive else None)
 class Echo:
-    def __call__(self, x):
-        return x * 2
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.0002)
+    async def __call__(self, xs):
+        return [x * 2 for x in xs]
 
 handle = serve.run(Echo.bind(), name="bench")
-for i in range(16):  # warm: routers, replicas, connections
+for i in range(16):  # warm: routers, replicas, connections, lanes
     ray_tpu.get(handle.remote(i), timeout=60)
 
 THREADS = 8
@@ -434,26 +440,92 @@ total = THREADS * per
 # high — degenerates to the max for n <= 100)
 p99_ms = (lat[max(0, math.ceil(len(lat) * 0.99) - 1)] * 1e3
           if lat else -1.0)
+from ray_tpu.serve.handle import _router_for
+stats = _router_for("bench", "Echo").lane_stats()
 serve.shutdown()
 ray_tpu.shutdown()
 print("RES=" + json.dumps({"qps": total / wall, "p99_ms": p99_ms,
-                           "error_rate": errs / total}))
+                           "error_rate": errs / total,
+                           "fast_calls": stats["fast_calls"],
+                           "rpc_calls": stats["rpc_calls"]}))
+"""
+
+# autoscale-lag child: a load step against a scaled-to-min autoscaled
+# deployment; the metric is the wall time from the first request of the
+# step to the controller's target reaching the converged count — the
+# "how long are users hurting before capacity arrives" number.
+_SERVE_AUTOSCALE_CHILD = r"""
+import json, threading, time
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=8)
+
+@serve.deployment(max_ongoing_requests=4, max_request_retries=4,
+                  retry_on="*", request_timeout_s=60.0,
+                  autoscaling_config=dict(
+                      min_replicas=1, max_replicas=3,
+                      target_ongoing_requests=2.0,
+                      upscale_delay_s=0.3, downscale_delay_s=1.0,
+                      metrics_window_s=0.8, metrics_interval_s=0.2,
+                      cooldown_s=1.0))
+class Sluggish:
+    def __call__(self, x):
+        time.sleep(0.1)
+        return x
+
+handle = serve.run(Sluggish.bind(), name="lag")
+ray_tpu.get(handle.remote(0), timeout=60)  # warm
+
+stop = threading.Event()
+def pound():
+    while not stop.is_set():
+        try:
+            ray_tpu.get(handle.remote(1), timeout=60)
+        except Exception:
+            pass
+
+t0 = time.perf_counter()
+threads = [threading.Thread(target=pound, daemon=True) for _ in range(10)]
+for t in threads:
+    t.start()
+lag = None
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    st = serve.status().get("lag", {}).get("Sluggish", {})
+    if st.get("target_replicas", 1) >= 2:
+        lag = time.perf_counter() - t0
+        break
+    time.sleep(0.05)
+stop.set()
+for t in threads:
+    t.join(timeout=30)
+serve.shutdown()
+ray_tpu.shutdown()
+print("RES=" + json.dumps({"lag_s": lag if lag is not None else -1.0}))
 """
 
 
 def run_serve_bench(quick: bool) -> dict[str, float]:
-    """serve_qps / serve_p99_ms (steady state) + serve_error_rate_chaos
-    (same workload under the seeded kill-replicas-under-load plan)."""
+    """Interleaved serve data-plane A/B (best-of over alternating
+    rounds): `serve_qps`/`serve_p99_ms` with the full data plane on
+    (fast-lane router + AIMD adaptive batching), `serve_qps_baseline`/
+    `serve_p99_ms_baseline` with RPC routing + fixed batching — same
+    handler, same 8-thread closed-loop client. Plus
+    `serve_autoscale_lag_s` (load step -> target-replica convergence)
+    and `serve_error_rate_chaos` (data plane under the seeded
+    kill-replicas plan)."""
     import subprocess
     import tempfile
 
     root = os.path.dirname(os.path.abspath(__file__))
     out: dict[str, float] = {}
 
-    def arm(n: int, env: dict) -> dict | None:
+    def arm(n: int, env: dict, mode: str = "dataplane",
+            child: str = _SERVE_BENCH_CHILD) -> dict | None:
         try:
             proc = subprocess.run(
-                [sys.executable, "-c", _SERVE_BENCH_CHILD, str(n)],
+                [sys.executable, "-c", child, str(n), mode],
                 env=env, capture_output=True, text=True, timeout=900)
         except subprocess.TimeoutExpired:
             print("serve bench arm timed out", file=sys.stderr)
@@ -467,10 +539,28 @@ def run_serve_bench(quick: bool) -> dict[str, float]:
         return json.loads(line[-1][4:]) if line else None
 
     n = 240 if quick else 800
-    res = arm(n, {**os.environ, "JAX_PLATFORMS": "cpu"})
-    if res is not None:
-        out["serve_qps"] = res["qps"]
-        out["serve_p99_ms"] = res["p99_ms"]
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    rounds = 1 if quick else 3  # best-of interleaved (the r8 protocol)
+    best: dict[str, dict] = {}
+    for _ in range(rounds):  # interleaved A/B, best-of per arm
+        for mode, env in (
+                ("baseline", {**base_env, "RT_SERVE_FASTLANE": "0"}),
+                ("dataplane", {**base_env, "RT_SERVE_FASTLANE": "1"})):
+            res = arm(n, env, mode)
+            if res is not None and (mode not in best
+                                    or res["qps"] > best[mode]["qps"]):
+                best[mode] = res
+    if "dataplane" in best:
+        out["serve_qps"] = best["dataplane"]["qps"]
+        out["serve_p99_ms"] = best["dataplane"]["p99_ms"]
+        out["serve_fast_calls"] = best["dataplane"]["fast_calls"]
+    if "baseline" in best:
+        out["serve_qps_baseline"] = best["baseline"]["qps"]
+        out["serve_p99_ms_baseline"] = best["baseline"]["p99_ms"]
+
+    res = arm(0, base_env, child=_SERVE_AUTOSCALE_CHILD)
+    if res is not None and res.get("lag_s", -1) > 0:
+        out["serve_autoscale_lag_s"] = res["lag_s"]
 
     plan = os.path.join(root, "tests", "plans", "serve_kill_replicas.json")
     env = {**os.environ, "JAX_PLATFORMS": "cpu", "RT_CHAOS_ENABLED": "1",
@@ -1238,7 +1328,7 @@ def write_benchvs(micro: dict, model: dict | None,
         elif name.endswith("_per_s"):
             unit = "/s"
         elif name in ("churn_node_kills", "churn_leaked_bundles",
-                      "churn_nodes"):
+                      "churn_nodes", "serve_fast_calls"):
             unit = "(count)"
         elif name.endswith("_s"):
             unit = "s"  # lower is better; no reference counterpart
@@ -1289,6 +1379,35 @@ def write_benchvs(micro: dict, model: dict | None,
         "CREATED; `churn_leaked_bundles` is the post-settle audit "
         "(every reservation on every surviving node cross-checked "
         "against the GCS table) and must be 0.",
+        "",
+        "## Serve data plane A/B (r11, same-host interleaved)",
+        "",
+        "The serve arm is itself an interleaved A/B (3 alternating "
+        "rounds, best-of per arm, same batched handler + 8-thread "
+        "closed-loop client): `serve_qps`/`serve_p99_ms` above is arm B "
+        "— fast-lane router (replica calls over the actor shm rings, "
+        "untracked + unordered, README § Serve data plane) + AIMD "
+        "adaptive batching under a 50ms `latency_slo_ms`; "
+        "`serve_qps_baseline` is arm A — RPC routing + fixed batch "
+        "size, the pre-dataplane configuration. Measured r11: "
+        "**1,259.6/s vs 1,011.5/s (1.25×)**, and **1.56× the r6 805/s "
+        "record** the ROADMAP acceptance is anchored to (same "
+        "2-replica same-node workload; r6 ran unbatched — batching is "
+        "part of what the data plane buys). `serve_fast_calls` 814/816 "
+        "— the ring carried steady-state traffic, 2 bootstrap calls "
+        "per replica took RPC while the lane attached. En route the "
+        "whole serve path was profiled flat: promise refs ride the "
+        "prefix+counter id scheme (ObjectID.from_random was one "
+        "~288µs urandom syscall per request), blocking gets on promise "
+        "refs resolve on the caller thread off a threading.Event twin "
+        "(no loop round trip), reply wakes coalesce behind one armed "
+        "drain (a self-pipe write per reply measured ~140µs of loop "
+        "time), and the hedge arm + fast-await dropped "
+        "wait_for/shield wrappers for bare futures + call_later. "
+        "`serve_autoscale_lag_s` **0.51s** is load-step → scaled-up "
+        "target: 10 threads slam a min-scaled autoscaled deployment "
+        "(0.1s handler, target_ongoing 2, upscale_delay 0.3s) and the "
+        "SLO-feedback autoscaler converges within ~2 metric windows.",
         "",
         "## Placement-group 2PC A/B (r10, same-host interleaved)",
         "",
@@ -1468,15 +1587,27 @@ def write_benchvs(micro: dict, model: dict | None,
         "the measured wall.",
         "",
         "`serve_qps`/`serve_p99_ms` — the serve data plane under 8 "
-        "closed-loop client threads against a 2-replica deployment with "
-        "the full request-FT stack on (retries, 60s deadline, 400ms "
-        "hedging; README § Serve fault tolerance). "
-        "`serve_error_rate_chaos` is the same workload under the "
+        "closed-loop client threads against a 2-replica batched "
+        "deployment with the full request-FT stack on (retries, 60s "
+        "deadline, 400ms hedging; README §§ Serve fault tolerance + "
+        "Serve data plane). Interleaved A/B, best-of per arm: the "
+        "headline row runs the fast-lane router (replica calls over "
+        "the actor shm rings) + AIMD adaptive batching under a 50ms "
+        "SLO; `serve_qps_baseline`/`serve_p99_ms_baseline` is the SAME "
+        "handler with RPC routing and a fixed batch size (the "
+        "pre-dataplane configuration). `serve_fast_calls` counts "
+        "requests that actually rode the ring. "
+        "`serve_autoscale_lag_s` is the load-step-to-scale-up wall "
+        "time: 10 closed-loop threads slam a min-scaled autoscaled "
+        "deployment and the clock stops when the SLO-feedback "
+        "autoscaler's target reaches 2 replicas. "
+        "`serve_error_rate_chaos` is the data-plane workload under the "
         "checked-in seeded kill-replicas-under-load plan "
         "(tests/plans/serve_kill_replicas.json: every replica process "
         "SIGKILLs itself at its 31st request) — the ROADMAP serve SLO "
         "is error rate < 1% for idempotent traffic, enforced in tier-1 "
-        "by tests/test_serve_ft.py.",
+        "by tests/test_serve_ft.py (and by the kill-while-autoscaling "
+        "plan in tests/test_serve_dataplane.py).",
     ]
     if model:
         lines += [

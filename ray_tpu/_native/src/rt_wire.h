@@ -22,7 +22,7 @@ namespace wire {
 // Wire-schema version; must match ray_tpu/utils/schema.py PROTOCOL_VERSION
 // (tests/test_wire_schema.py cross-checks the two).
 constexpr int kProtocolMajor = 1;
-constexpr int kProtocolMinor = 8;
+constexpr int kProtocolMinor = 9;
 
 inline bool read_exact(int fd, void* buf, size_t n) {
   auto* p = (char*)buf;

@@ -105,6 +105,11 @@ class Config:
     #: before spilling the undelivered completion records to the driver
     #: over RPC (driver stalled / result ring full)
     fastpath_reply_spill_ms: int = 200
+    #: serve data plane: route same-node replica calls over the actor shm
+    #: rings (serve/dataplane) instead of the actor RPC plane; per-call
+    #: RPC fallback (ref args, big payloads, broken lane) is always kept.
+    #: Off switch for A/B (bench.py serve arm) and paranoia.
+    serve_fastlane: bool = True
 
     # --- native RPC mux (ref: grpc_server.h:88 completion-queue threads;
     # _native/src/mux.cc) ---

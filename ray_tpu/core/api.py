@@ -257,6 +257,18 @@ def get(refs, timeout: float | None = None):
     if len(fast) < len(ref_list):
         fast.update(core.get_local_prepass(
             [r for r in ref_list if r.id not in fast]))
+    # promise refs (the serve router's retry-loop refs) resolve on this
+    # thread off their threading.Event twin — but only when EVERY
+    # pending ref is promise-backed: a mixed list must go through
+    # get_async so promise waits and remote pulls overlap (a serial
+    # prepass here would degrade mixed-list latency from max toward sum)
+    pending = [r for r in ref_list if r.id not in fast]
+    if pending and all(
+            getattr(core.memory_store.get(r.id), "t_ready", None) is not None
+            for r in pending):
+        remaining = (None if timeout is None
+                     else max(0.0, timeout - (time.monotonic() - start)))
+        fast.update(core.promise_prepass(pending, remaining))
     slow_refs = ([r for r in ref_list if r.id not in fast]
                  if fast else ref_list)
     slow_values = []

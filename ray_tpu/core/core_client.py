@@ -76,6 +76,11 @@ class _MemEntry:
     error: Exception | None = None
     ready: asyncio.Event = field(default_factory=asyncio.Event)
     in_shm: bool = False  # large result living in some node's shm store
+    # promise refs only: a thread-waitable twin of `ready`, so a caller
+    # thread blocked in get() resolves without a loop round trip (the
+    # serve router resolves one promise per request — see
+    # promise_prepass)
+    t_ready: Any = None
 
 
 @dataclass
@@ -224,6 +229,20 @@ def _handle_options(spec: dict) -> dict:
     """Driver-side method metadata carried on creation handles (num_returns
     from @method annotations; worker-side group routing uses the spec)."""
     return {"method_num_returns": spec.get("method_num_returns") or {}}
+
+
+def _expire_future(fut) -> None:
+    """fast_actor_await's timeout timer: cancel the waiter, marked so
+    the await can tell a timeout from a genuine caller cancellation."""
+    if not fut.done():
+        fut._rt_expired = True
+        fut.cancel()
+
+
+class FastLaneDeclined(Exception):
+    """The worker NEED_SLOWed an untracked fast actor call (stale
+    method-eligibility table): the call never executed; the caller
+    re-dispatches it over the RPC plane."""
 
 
 class ActorCallTemplate:
@@ -376,6 +395,20 @@ class CoreClient:
         # sharded plane registers its shard_seal/shard_fetch/reshard
         # stage window here; list_task_latency merges every key
         self._latency_sources: dict[str, Any] = {}
+        # loop-resident fast-lane waiters (the serve data plane's router
+        # hop): oid -> asyncio.Future resolved DIRECTLY from the reply
+        # thread with (status, payload) — skipping the migrate queue's
+        # 2ms linger, which is pure added latency for a coroutine that is
+        # already parked on the loop. Guarded by _fast_cv; (None, None)
+        # means "the lane broke mid-flight". Resolutions ride _fast_wake_q
+        # behind ONE armed drain callback with a burst linger (the
+        # _drain_xq shape): a self-pipe write per reply batch measured
+        # ~140µs of loop time under the syscall-intercepting sandbox —
+        # at serve QPS that one wake per request was the single largest
+        # loop cost.
+        self._fast_loop_waiters: dict[ObjectID, asyncio.Future] = {}
+        self._fast_wake_q: list = []
+        self._fast_wake_armed = False
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -754,6 +787,7 @@ class CoreClient:
         behind it; ``get``/``wait``/``await`` all work unchanged."""
         oid = ObjectID.from_random()
         entry = _MemEntry()
+        entry.t_ready = _threading.Event()
         self.memory_store[oid] = entry
         ref = self._new_owned_ref(oid)
 
@@ -763,8 +797,32 @@ class CoreClient:
             else:
                 entry.value = value
             entry.ready.set()
+            entry.t_ready.set()  # caller-thread getters (promise_prepass)
 
         return ref, resolve
+
+    def promise_prepass(self, refs, timeout: float | None) -> dict:
+        """Blocking wait (user thread) for promise refs: resolves them
+        straight off the threading.Event twin their resolve() sets — no
+        loop round trip for the get half of a serve request. Refs that
+        are not promise-backed (or time out) are left for the normal get
+        path. Returns {oid: ("V", value) | ("e", exc)}."""
+        out: dict = {}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for ref in refs:
+            entry = self.memory_store.get(ref.id)
+            evt = getattr(entry, "t_ready", None)
+            if entry is None or evt is None:
+                continue
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not evt.wait(remaining):
+                continue  # timed out: the slow path owns the error
+            if entry.error is not None:
+                out[ref.id] = ("e", entry.error)
+            else:
+                out[ref.id] = ("V", entry.value)
+        return out
 
     # ----------------------------------------------------------------- put
     def put_value(self, value: Any, prefer_shm: bool = False) -> ObjectRef:
@@ -1462,8 +1520,8 @@ class CoreClient:
         return ref
 
     def _fast_register_and_push(self, lane, task_id: TaskID, rec: bytes,
-                                light, defer: bool = False, t0: int = 0
-                                ) -> ObjectRef | None:
+                                light, defer: bool = False, t0: int = 0,
+                                track: bool = True):
         """Shared submit tail for task and actor lanes: register the
         in-flight entry under the cv, create the pending memory-store
         entry, then push — coalesced: the framed record lands in the
@@ -1475,7 +1533,13 @@ class CoreClient:
         the flusher thread's linger timer. On a closed ring undo — unless
         a concurrent break-lane already snapshotted our entry and
         resubmitted it over RPC, in which case the ref is handed out
-        as-is (no duplicate call)."""
+        as-is (no duplicate call).
+
+        ``track=False`` (the serve router's untracked calls): no
+        memory-store entry and no ObjectRef — the return value is True
+        on success, None for the RPC fallback; completion/break state
+        reaches the caller through its registered loop waiter
+        instead."""
         from ray_tpu.core import fastpath
 
         oid = ObjectID.for_task_return(task_id, 0)
@@ -1487,7 +1551,8 @@ class CoreClient:
             # dict op serves routing AND telemetry (t0 is 0 when the
             # recorder is off)
             self._fast_oid_lane[oid] = (lane, t0)
-        self.memory_store[oid] = _MemEntry()
+        if track:
+            self.memory_store[oid] = _MemEntry()
         cfg = self.cfg
         kick = False
         undo = False
@@ -1523,10 +1588,16 @@ class CoreClient:
                 owned = lane.inflight.pop(task_id, None) is not None
                 self._fast_oid_lane.pop(oid, None)
             if not owned:
-                return self._new_owned_ref(oid)
+                # a concurrent break-lane snapshotted the entry: tracked
+                # tasks were resubmitted over RPC (the ref resolves);
+                # untracked ones had their waiter woken with the broken
+                # sentinel — either way the call is someone else's now
+                return self._new_owned_ref(oid) if track else True
+            if not track:
+                return None
             self.memory_store.pop(oid, None)
             return None
-        return self._new_owned_ref(oid)
+        return self._new_owned_ref(oid) if track else True
 
     def _fast_flush_locked(self, lane, timeout_ms: int = 0) -> int:
         """Push the lane's buffered records (caller holds lane.txlock) in
@@ -1946,6 +2017,203 @@ class CoreClient:
             metrics.actor_calls.inc()
         return ref
 
+    def fast_actor_submit_loop(self, actor_id: ActorID, method: str,
+                               args, kwargs, tmpl=None):
+        """LOOP-thread fast actor submit — the serve data plane's router
+        hop. The thread-path fast lane (_try_fast_actor_submit) refuses
+        loop-resident callers because its reply detours through the
+        migrate queue's 2ms linger; this variant registers an
+        asyncio.Future the reply thread resolves DIRECTLY (one
+        call_soon_threadsafe per reply batch), so a router coroutine
+        gets (status, payload) the moment the completion record pops.
+
+        UNTRACKED, by design: no ObjectRef, no memory-store entry, no
+        task events, no migrate-queue bookkeeping, and — unlike every
+        other fast path — no automatic RPC resubmission on a broken
+        lane. The serve router OWNS the request lifecycle: its promise
+        ref is the caller-visible handle, and its retry_on idempotency
+        gate decides whether a maybe-executed request may replay (core
+        at-least-once resubmission would re-execute non-idempotent
+        requests behind the router's back). A lane break therefore
+        surfaces as ConnectionLost from :meth:`fast_actor_await` — the
+        same exception the RPC plane raises for a died-mid-request
+        replica. Inline results skip the whole owned-object plane; only
+        shm-sealed results (> fastpath_inline_result_max) mint a ref at
+        await time to ride the normal read/free path.
+
+        Unordered, also by design (every serve request is an
+        independent logical call): no FIFO gate against queued RPC
+        traffic in either direction.
+
+        Returns ``(task_id, future)`` or None — None means THIS call
+        takes the RPC path (per-call fallback, the lane stays live): no
+        live lane, ineligible method, pending/remote ref args,
+        oversized record, or tracing. Decode the future with
+        :meth:`fast_actor_await`."""
+        from ray_tpu.core import fastpath
+
+        if self.cfg.tracing_enabled:
+            return None
+        lane = tmpl.lane if tmpl is not None else None
+        if lane is None or lane.broken or lane.retired:
+            lane = self._fast_actor_lanes.get(actor_id)
+            if lane is None or lane.broken or lane.retired:
+                if tmpl is not None:
+                    tmpl.lane = None
+                return None
+            if tmpl is not None:
+                tmpl.lane = lane  # rebind on (re)attach
+        mt = lane.methods
+        if mt is not None:
+            v = mt.get(method)
+            if v is None or v[0] == "gen":
+                return None
+        has_ref = any(isinstance(a, ObjectRef) for a in args)
+        if not has_ref and kwargs:
+            has_ref = any(isinstance(v, ObjectRef) for v in kwargs.values())
+        if has_ref:
+            args, kwargs, ok = self._fast_resolve_ref_args(args, kwargs)
+            if not ok:
+                return None  # pending/remote ref: RPC path for this call
+        task_id = TaskID.generate_actor()
+        tid = task_id.binary()
+        now_ns = time.perf_counter_ns()
+        t0 = now_ns if self._rec_enabled else 0
+        mkey = tmpl.mkey if tmpl is not None else b"am:" + method.encode()
+        seq = next(lane.seq_counter)
+        lane.next_seq = seq + 1
+        try:
+            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0, seq)
+        except Exception:
+            return None  # unpicklable args: RPC path for this call
+        if len(rec) > min(self.cfg.fastpath_record_max,
+                          fastpath.POP_BUF_BYTES - 64):
+            return None  # big args belong in the object store
+        oid = ObjectID.for_task_return(task_id, 0)
+        fut = self.loop.create_future()
+        with self._fast_cv:
+            self._fast_loop_waiters[oid] = fut
+        self._fast_last_submit = now_ns
+        # never defer: the caller's coroutine parks on the reply — a
+        # buffered submit tail would trade its latency for nothing
+        ok = self._fast_register_and_push(
+            lane, task_id, rec, ("serve", actor_id, method),
+            defer=False, t0=t0, track=False)
+        if ok is None:
+            with self._fast_cv:
+                self._fast_loop_waiters.pop(oid, None)
+            return None
+        metrics.actor_calls.inc()
+        return task_id, fut
+
+    async def fast_actor_await(self, task_id: TaskID, fut, timeout=None):
+        """Decode a fast_actor_submit_loop reply: returns the call's
+        value or raises its (typed) exception. Raises
+
+        - :class:`FastLaneDeclined` when the worker NEED_SLOWed the
+          record (stale method table) — the call never executed, the
+          caller re-dispatches it over RPC;
+        - ``rpc.ConnectionLost`` when the lane broke mid-flight — the
+          replica may have executed the request, so the caller's own
+          idempotency policy decides about a replay (exactly the
+          died-mid-request contract of the RPC plane);
+        - ``GetTimeoutError`` when ``timeout`` elapses first (the
+          in-flight call keeps running; its late reply resolves the
+          abandoned future, which nobody awaits)."""
+        from ray_tpu.core import fastpath
+        from ray_tpu.core.ref import GetTimeoutError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if timeout is None:
+            status, payload = await fut
+        else:
+            # manual timer instead of asyncio.wait_for: this await is on
+            # EVERY fast serve request, and wait_for's wrapper future +
+            # timeout machinery measured real loop time at serve QPS
+            timer = self.loop.call_later(timeout, _expire_future, fut)
+            try:
+                status, payload = await fut
+            except asyncio.CancelledError:
+                if getattr(fut, "_rt_expired", False):
+                    raise GetTimeoutError(
+                        "timed out waiting for fast-lane actor reply"
+                    ) from None
+                raise  # genuine cancellation (hedge loser): propagate
+            finally:
+                timer.cancel()
+        if status == fastpath.OK:
+            return serialization.unpack(payload)
+        if status == fastpath.ERR:
+            try:
+                err = pickle.loads(payload)
+            except Exception as e:
+                err = TaskError(f"task failed: {e!r}")
+            raise err
+        if status == fastpath.OK_SHM:
+            # large result sealed in the node arena: mint the ref NOW so
+            # the read and the eventual free ride the normal owned-object
+            # path (the reply processor created the entry + bookkeeping
+            # for exactly this case)
+            oid = ObjectID.for_task_return(task_id, 0)
+            ref = self._new_owned_ref(oid)
+            if self.store is not None:
+                hit = self.store.try_get(oid)
+                if hit is not None:
+                    return hit[0]
+            # REMAINING budget only: the future wait above already spent
+            # part of the timeout, and re-spending it whole would let a
+            # slow arena read overshoot the caller's deadline ~2x
+            (value,) = await self.get_async(
+                [ref], None if deadline is None
+                else max(0.05, deadline - time.monotonic()))
+            return value
+        if status == fastpath.NEED_SLOW:
+            raise FastLaneDeclined()
+        raise rpc.ConnectionLost("fast lane broke mid-request")
+
+    def _queue_loop_wakes(self, items) -> None:
+        """Thread-safe: queue router-future resolutions and arm the loop
+        drain at most once — while reply traffic flows the drain lingers
+        armed (call_soon re-pass), so reply threads stop paying the
+        self-pipe write per batch. From the loop itself the arm is a
+        plain call_soon — call_soon_threadsafe writes the self-pipe even
+        from the owning thread."""
+        with self._fast_cv:
+            self._fast_wake_q.extend(items)
+            arm = not self._fast_wake_armed
+            if arm:
+                self._fast_wake_armed = True
+        if arm:
+            try:
+                if _in_loop(self.loop):
+                    self.loop.call_soon(self._drain_loop_wakes)
+                else:
+                    self.loop.call_soon_threadsafe(self._drain_loop_wakes)
+            except RuntimeError:
+                pass  # loop gone (shutdown)
+
+    def _drain_loop_wakes(self):
+        """Loop-side: resolve router futures with their raw reply
+        tuples. A done future means the caller timed out and went away —
+        its reply is dropped, except a shm-sealed result, whose entry is
+        adopted-and-dropped so the arena copy frees instead of leaking
+        (nobody else will ever mint its ref)."""
+        from ray_tpu.core import fastpath
+
+        with self._fast_cv:
+            batch = self._fast_wake_q
+            self._fast_wake_q = []
+            if not batch:
+                self._fast_wake_armed = False
+                return
+        for fut, status, payload, oid in batch:
+            if not fut.done():
+                fut.set_result((status, payload))
+            elif status == fastpath.OK_SHM:
+                self._new_owned_ref(oid)  # dropped at once: frees the seal
+        # burst linger: stay armed one more tick while traffic flows
+        self.loop.call_soon(self._drain_loop_wakes)
+
     def _fast_resubmit(self, task_id: TaskID, light, lost: bool = True) -> None:
         """Loop-side: re-route a fast-path call through the RPC path.
         ``lost=True`` (break-lane recovery: the worker died and may have
@@ -2027,6 +2295,8 @@ class CoreClient:
         astats = self._actor_stats
         batch = []
         drained = False
+        wake = None  # loop-waiter resolutions (serve fast-lane router)
+        retire_serve = None  # lane whose method table went stale
         with self._fast_cv:
             for rec in recs:
                 tid_b, status, payload, stamp, seq = fastpath.unpack_reply(rec)
@@ -2034,6 +2304,12 @@ class CoreClient:
                 light = lane.inflight.pop(task_id, None)
                 oid = ObjectID.for_task_return(task_id, 0)
                 ent = self._fast_oid_lane.pop(oid, None)
+                if self._fast_loop_waiters:
+                    fut = self._fast_loop_waiters.pop(oid, None)
+                    if fut is not None:
+                        if wake is None:
+                            wake = []
+                        wake.append((fut, status, payload, oid))
                 if seq is not None and light is not None:
                     # out-of-order completion accounting (async actors
                     # reply as each method finishes): seq below the high
@@ -2067,6 +2343,23 @@ class CoreClient:
                     elif stats is not None:
                         sring[stats.n % scap] = (ent[1], t_rx, tid_b, stamp)
                         stats.n += 1
+                if light is not None and light[0] == "serve":
+                    # untracked serve call: the waiter resolution above
+                    # IS the completion — no entry, no events, no
+                    # migrate bookkeeping. Only a shm-sealed result
+                    # needs the owned-object plane (entry created here,
+                    # ref minted by fast_actor_await); a NEED_SLOW means
+                    # the worker's method table went stale — retire the
+                    # lane (outside the cv) exactly like the tracked
+                    # path would, the waiters re-dispatch over RPC.
+                    if status == fastpath.NEED_SLOW:
+                        retire_serve = lane
+                    elif status == fastpath.OK_SHM:
+                        if oid not in self.memory_store:
+                            self.memory_store[oid] = _MemEntry()
+                        self._fast_done[oid] = (status, payload)
+                        batch.append((task_id, oid, status, payload, light))
+                    continue
                 if status != fastpath.NEED_SLOW:
                     self._fast_done[oid] = (status, payload)
                 batch.append((task_id, oid, status, payload, light))
@@ -2081,6 +2374,10 @@ class CoreClient:
             if arm:
                 self._fast_migrate_armed = True
             self._fast_cv.notify_all()
+        if wake:
+            self._queue_loop_wakes(wake)
+        if retire_serve is not None:
+            self._fast_retire_actor_lane(retire_serve)
         if drained:
             try:
                 self.loop.call_soon_threadsafe(lane.drain_evt.set)
@@ -2165,7 +2462,7 @@ class CoreClient:
                     # guard (first copy drained in between): the value,
                     # events and metrics were all applied already
                     continue
-            elif light[0] == "actor":
+            elif light[0] in ("actor", "serve"):
                 name = light[2]
             else:
                 name = getattr(light[0], "__name__", "task")
@@ -2181,7 +2478,8 @@ class CoreClient:
                     result_bytes[oid] = fastpath.unpack_shm_size(payload)
                     self._obj_locations.setdefault(oid, set()).add(
                         self.node_id.binary())
-                    if light is not None and light[0] != "actor":
+                    if light is not None and light[0] not in ("actor",
+                                                              "serve"):
                         # shm results can be evicted: keep real lineage
                         # (actor calls have no reconstruction, as in the
                         # reference — actor state is not replayable). The
@@ -2318,6 +2616,7 @@ class CoreClient:
     def _fast_break_lane(self, lane):
         """Thread-safe: stop routing to this lane and resubmit whatever is
         in flight through the RPC path (worker death / lease return)."""
+        wake = []
         with self._fast_cv:
             if lane.broken:
                 leftovers = {}
@@ -2326,9 +2625,16 @@ class CoreClient:
                 leftovers = dict(lane.inflight)
                 lane.inflight.clear()
                 for task_id in leftovers:
-                    self._fast_oid_lane.pop(
-                        ObjectID.for_task_return(task_id, 0), None)
+                    oid = ObjectID.for_task_return(task_id, 0)
+                    self._fast_oid_lane.pop(oid, None)
+                    fut = self._fast_loop_waiters.pop(oid, None)
+                    if fut is not None:
+                        # broken mid-flight: fast_actor_await raises
+                        # ConnectionLost, the router's policy owns replay
+                        wake.append((fut, None, None, oid))
             self._fast_cv.notify_all()
+        if wake:
+            self._queue_loop_wakes(wake)
         if lane.drain_evt is not None and lane.drain_waiters:
             try:  # nothing is in flight on a broken lane: wake drain waiters
                 self.loop.call_soon_threadsafe(lane.drain_evt.set)
@@ -2356,6 +2662,12 @@ class CoreClient:
                 for task_id, light in leftovers.items():
                     if task_id in self._cancelled_tasks:
                         continue  # entries already failed by cancel_task
+                    if light[0] == "serve":
+                        # untracked: the broken-sentinel wake above told
+                        # the router, whose retry_on gate owns replay —
+                        # core resubmission would re-execute
+                        # non-idempotent requests behind its back
+                        continue
                     self._fast_resubmit(task_id, light)
             try:
                 self.loop.call_soon_threadsafe(resub)
@@ -3558,7 +3870,8 @@ class CoreClient:
     def submit_actor_task(self, handle: ActorHandle, method: str, args, kwargs,
                           num_returns=1,
                           concurrency_group: str | None = None,
-                          _tmpl: ActorCallTemplate | None = None
+                          _tmpl: ActorCallTemplate | None = None,
+                          unordered: bool = False
                           ) -> ObjectRef | list[ObjectRef]:
         """Submission order is fixed here (sync, caller thread); a per-actor
         pump coroutine then resolves deps, assigns per-connection sequence
@@ -3604,6 +3917,11 @@ class CoreClient:
             "seq": None,
             "concurrency_group": concurrency_group,
         }
+        if unordered:
+            # independent logical call (serve router fallback): skips the
+            # fast->RPC drain barrier in _prepare_actor_task, so it never
+            # parks behind the lane's in-flight ring traffic
+            spec["unordered"] = True
         if self.cfg.tracing_enabled:
             self._emit_submit_span(spec, method)
         q = self._actor_queues.setdefault(actor_id, [])
@@ -3685,6 +4003,8 @@ class CoreClient:
         # a bounded re-check instead of the old 1ms constant-sleep poll
         # (the RT013 shape).
         lane = self._fast_actor_lanes.get(spec["actor_id"])
+        if spec.get("unordered"):
+            lane = None  # independent call: no FIFO barrier against the ring
         if lane is not None and lane.inflight and not lane.broken:
             evt = lane.drain_evt
             lane.drain_waiters += 1  # reply threads signal only when > 0

@@ -299,6 +299,13 @@ class GcsServer:
         self.subs.setdefault(p["channel"], set()).add(conn)
         return True
 
+    async def rpc_publish(self, conn, p):
+        """Client-originated pubsub (ref: GcsPublisher — workers publish
+        through the GCS fan-out): the serve controller announces
+        autoscale decisions on ``serve_autoscale`` this way."""
+        await self.publish(p["channel"], p["message"])
+        return True
+
     # ---------------------------------------------------------------------- kv
     # All KV state lives in the native engine; puts/dels journal to the
     # C++ WAL inside the same native call (GIL released throughout).

@@ -15,6 +15,7 @@ hash-routed JS app from ``dashboard_client/``, no build step):
     GET /api/placement_groups  placement group table
     GET /api/summary/tasks     task counts by state
     GET /api/serve             serve applications/deployments status
+    GET /api/serve_autoscale   fired autoscale decisions (?key=app/dep)
     GET /api/metrics           aggregated cluster metrics
     GET /api/timeline          chrome-trace events (load into perfetto)
     GET /api/latency           flight-recorder per-stage task latency
@@ -146,6 +147,20 @@ def build_app():
             return web.json_response({"error": str(e)}, status=503)
 
     app.router.add_get("/api/serve", serve_status)
+
+    async def serve_autoscale(request):
+        import asyncio
+
+        key = request.query.get("key")
+        try:
+            events = await asyncio.to_thread(
+                state.list_serve_autoscale_events, key)
+            return web.json_response(_plain(events))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+
+    # fired autoscale decisions with causes (serve/dataplane/autoscaler)
+    app.router.add_get("/api/serve_autoscale", serve_autoscale)
 
     async def worker_stack(request):
         import asyncio

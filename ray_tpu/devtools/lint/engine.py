@@ -158,6 +158,10 @@ class Context:
     # loop_depth this survives into nested defs — RT011 fires on any
     # construction that re-runs per call rather than once at import
     func_depth: int = 0
+    # name of the innermost enclosing def (None at module/class scope;
+    # lambdas keep the enclosing def's name) — RT015 exempts one-time
+    # setup bodies like __init__ by name
+    func_name: str | None = None
 
     # -- reporting ----------------------------------------------------------
     def report(self, rule: Rule, node: ast.AST, message: str):
@@ -300,13 +304,16 @@ class Walker:
         saved_targets = ctx.for_targets
         saved_depth = ctx.loop_depth
         saved_async = ctx.in_async
+        saved_name = ctx.func_name
         ctx.for_targets = []  # a nested def body doesn't run per-iteration
         ctx.loop_depth = 0
         ctx.in_async = isinstance(node, ast.AsyncFunctionDef)
         ctx.func_depth += 1
+        ctx.func_name = node.name
         for stmt in node.body:
             self.walk(stmt)
         ctx.func_depth -= 1
+        ctx.func_name = saved_name
         ctx.for_targets = saved_targets
         ctx.loop_depth = saved_depth
         ctx.in_async = saved_async

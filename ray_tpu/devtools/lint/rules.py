@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT014: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT015: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -466,3 +466,47 @@ class ShardedRefMaterializedOnDriver(Rule):
                            "get_sharded() for device-local assembly or "
                            "consume it in a @remote(in_specs=...) task")
                 return
+
+
+@register
+class BatchQueueConfiguredPerCall(Rule):
+    id = "RT015"
+    summary = ("serve.batch configured inside a request-path function "
+               "body")
+    rationale = ("@serve.batch builds ONE coalescing queue per wrapped "
+                 "function: applying it (or calling serve.batch(fn, "
+                 "max_batch_size=..., batch_wait_timeout_s=...)) inside "
+                 "a handler body re-creates the wrapper — and therefore "
+                 "a fresh empty queue — on every request, so no two "
+                 "requests ever share a queue and batching silently "
+                 "degenerates to batch-size-1 calls; declare the "
+                 "batched method at class/module level")
+
+    #: one-time setup bodies: building a batch wrapper here (e.g. with
+    #: instance-derived knobs) creates ONE queue for the object's
+    #: lifetime — the llm.serving LLMServer shape — not one per request
+    _SETUP_FNS = ("__init__", "__post_init__", "reconfigure")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        # decorators/defaults are walked in the ENCLOSING scope (see
+        # engine._walk_function), so a class-level @serve.batch(...) on
+        # a method sits at func_depth 0 and stays clean; only a call
+        # evaluated inside some function body — per request — fires
+        if not ctx.func_depth or ctx.func_name in self._SETUP_FNS:
+            return
+        origin = ctx.imports.resolve(node.func)
+        if not (origin and origin[0] == "ray_tpu" and origin[-1] == "batch"
+                and ("serve" in origin[:-1] or "batching" in origin[:-1])):
+            return
+        knobs = [kw.arg for kw in node.keywords
+                 if kw.arg in ("max_batch_size", "batch_wait_timeout_s")]
+        detail = (f" (with {', '.join(knobs)} literals)"
+                  if knobs and all(
+                      isinstance(kw.value, ast.Constant)
+                      for kw in node.keywords if kw.arg in knobs)
+                  else "")
+        ctx.report(self, node,
+                   "serve.batch(...) evaluated inside a function body"
+                   f"{detail} re-creates the batch queue per call, "
+                   "defeating request coalescing; hoist the batched "
+                   "method to class/module level")

@@ -12,12 +12,24 @@ import dataclasses
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Queue-depth-driven replica autoscaling (ref: serve/config.py
-    AutoscalingConfig, _private/autoscaling_policy.py).
+    """SLO-feedback replica autoscaling with hysteresis (ref:
+    serve/config.py AutoscalingConfig + _private/autoscaling_policy.py;
+    policy implemented by serve/dataplane/autoscaler.py).
 
-    desired = ceil(total_ongoing_requests / target_ongoing_requests),
-    clamped to [min_replicas, max_replicas], applied only after the decision
-    has been stable for upscale_delay_s / downscale_delay_s.
+    Decisions read the MEAN (ongoing + handle-queued) count over
+    ``metrics_window_s`` — never an instantaneous probe — plus the
+    deployment's p99 vs its ``latency_slo_ms`` budget when one is set:
+
+    - upscale when ceil(smoothed / target_ongoing_requests) exceeds the
+      current count (stable for ``upscale_delay_s``), or immediately-ish
+      on a p99 SLO breach (> ``slo_upscale_ratio`` x budget) — a
+      multiplicative step up, bounded by ``max_replicas``.
+    - downscale only to a count that keeps survivors at or under
+      ``downscale_headroom`` x target (the hysteresis band), only while
+      p99 sits under ``slo_downscale_ratio`` x budget, only after
+      ``downscale_delay_s`` of stability AND ``cooldown_s`` since the
+      last scale event of either direction.
+    - scale-from-zero stays immediate (requests are blocked).
     """
 
     min_replicas: int = 1
@@ -26,12 +38,33 @@ class AutoscalingConfig:
     upscale_delay_s: float = 1.0
     downscale_delay_s: float = 5.0
     metrics_interval_s: float = 0.25
+    # --- SLO-feedback plane (serve/dataplane/autoscaler.py) ---
+    #: smoothing window for the ongoing-count mean (the flap fix: a
+    #: one-tick spike moves the average by dt/window, not to a new regime)
+    metrics_window_s: float = 2.0
+    #: downscale band: only shrink to counts keeping survivors at or
+    #: under this fraction of target_ongoing_requests
+    downscale_headroom: float = 0.7
+    #: minimum distance from the last scale event before a downscale
+    cooldown_s: float = 5.0
+    #: p99 > slo * this ratio => upscale (needs DeploymentConfig.latency_slo_ms)
+    slo_upscale_ratio: float = 1.0
+    #: p99 > slo * this ratio => downscales are forbidden
+    slo_downscale_ratio: float = 0.5
 
     def __post_init__(self):
         if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
             raise ValueError("need 0 <= min_replicas <= max_replicas, max >= 1")
         if self.target_ongoing_requests <= 0:
             raise ValueError("target_ongoing_requests must be > 0")
+        if self.metrics_window_s <= 0:
+            raise ValueError("metrics_window_s must be > 0")
+        if not 0 < self.downscale_headroom <= 1:
+            raise ValueError("downscale_headroom must be in (0, 1]")
+        if self.slo_downscale_ratio > self.slo_upscale_ratio:
+            raise ValueError(
+                "slo_downscale_ratio must be <= slo_upscale_ratio "
+                "(the band between them is the hysteresis gap)")
 
 
 @dataclasses.dataclass
@@ -81,6 +114,14 @@ class DeploymentConfig:
     retry_on: tuple = ()
     hedge_after_ms: float = 0.0
     max_queued_requests: int = -1
+    # --- data plane (serve/dataplane) ---
+    #: per-deployment latency budget, the ONE knob the data plane's
+    #: feedback loops close against: the AIMD batch controller grows
+    #: batch sizes while batch p99 stays under it, the autoscaler scales
+    #: on deployment p99 vs it, and projected-queue-delay admission
+    #: sheds work that cannot start inside it. None = no SLO: batching
+    #: stays fixed-size, the autoscaler falls back to queue depth alone.
+    latency_slo_ms: float | None = None
 
     def __post_init__(self):
         if self.max_request_retries < 0:
@@ -91,6 +132,8 @@ class DeploymentConfig:
             raise ValueError("hedge_after_ms must be >= 0 (0 = off)")
         if self.max_queued_requests < -1:
             raise ValueError("max_queued_requests must be >= -1")
+        if self.latency_slo_ms is not None and self.latency_slo_ms <= 0:
+            raise ValueError("latency_slo_ms must be > 0 (None = no SLO)")
         if isinstance(self.retry_on, str):
             self.retry_on = (self.retry_on,)
         else:
@@ -105,6 +148,10 @@ class DeploymentConfig:
             "retry_on": self.retry_on,
             "hedge_after_ms": self.hedge_after_ms,
             "max_queued_requests": self.max_queued_requests,
+            # handle-side admission control (dataplane/admission.py)
+            # projects queue delay from these two plus probed metrics
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "latency_slo_ms": self.latency_slo_ms,
         }
 
     def initial_replicas(self) -> int:

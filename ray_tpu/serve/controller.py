@@ -6,17 +6,29 @@ python/ray/serve/_private/controller.py:87) + DeploymentState reconciler
 (_private/autoscaling_state.py) + LongPollHost config fan-out
 (long_poll.py:222). One async actor: a reconcile loop drives replica sets
 toward target counts, health-checks replicas, polls their queue depth, and
-applies the queue-depth autoscaling policy; routers long-poll
-get_routing_info for membership changes.
+applies the SLO-feedback autoscaling policy (serve/dataplane/
+autoscaler.py): decisions read the smoothed ongoing window, the
+deployment's p99 from the flight-recorder latency namespace (the
+"serve" windows replicas publish via CoreClient.add_latency_source),
+and arrival rate — with hysteresis bands + cooldowns replacing the old
+memoryless ceil(total/target). Every fired decision publishes on the
+``serve_autoscale`` pubsub channel with its cause and lands in a
+bounded event history (state.list_serve_autoscale_events, dashboard);
+routers long-poll get_routing_info for membership changes.
 """
 from __future__ import annotations
 
 import asyncio
-import math
+import pickle
 import time
 import uuid
 
+from ray_tpu.serve.dataplane.autoscaler import ServeAutoscaler
+
 CONTROLLER_NAME = "SERVE::controller"
+
+#: bounded autoscale-decision history (actor method + ns="serve" kv)
+AUTOSCALE_EVENTS_CAP = 256
 
 
 class _DeploymentState:
@@ -27,15 +39,13 @@ class _DeploymentState:
         self.target_replicas: int = spec["config"].initial_replicas()
         self.replicas: dict[str, dict] = {}  # replica_id -> {handle, healthy}
         self.metrics: dict[str, int] = {}  # replica_id -> ongoing
+        self.totals: dict[str, int] = {}  # replica_id -> lifetime requests
         # demand reported by handle-side routers that cannot route (e.g.
         # scaled to zero): router_id -> (queued_count, monotonic_ts).
         # This is the scale-from-zero signal (ref: serve handle-side
         # queued-request metrics feeding autoscaling_state.py).
         self.handle_queued: dict[str, tuple[int, float]] = {}
         self.deleting = False
-        # autoscaling decision smoothing
-        self._pending_decision: int | None = None
-        self._pending_since: float = 0.0
         self._last_health_check: float = 0.0
 
     @property
@@ -52,6 +62,11 @@ class ServeController:
         self._changed: asyncio.Condition | None = None  # created on the loop
         self._loop_task = None
         self._stopping = False
+        # SLO-feedback autoscaling (serve/dataplane/autoscaler.py)
+        self._autoscaler = ServeAutoscaler()
+        self._autoscale_events: list[dict] = []
+        self._p99: dict[str, float] = {}  # "app/dep" -> fresh p99 ms
+        self._p99_fetched = 0.0
 
     # -------------------------------------------------------------- helpers
     async def _ensure_loop(self):
@@ -77,6 +92,7 @@ class ServeController:
                 if h is not None and getattr(h, "actor_id", None) == actor_id:
                     st.replicas.pop(rid, None)
                     st.metrics.pop(rid, None)
+                    st.totals.pop(rid, None)
                     asyncio.get_running_loop().create_task(self._bump_version())
                     return
 
@@ -109,7 +125,7 @@ class ServeController:
                     min(auto.max_replicas, existing.target_replicas))
             else:
                 existing.target_replicas = spec["config"].initial_replicas()
-            existing._pending_decision = None
+            self._autoscaler.forget(existing.key)
             await self._bump_version()
             return True
         if existing is not None and not existing.deleting:
@@ -165,7 +181,7 @@ class ServeController:
     async def get_status(self) -> dict:
         out: dict = {}
         for st in self._deployments.values():
-            out.setdefault(st.app_name, {})[st.name] = {
+            info = {
                 "target_replicas": st.target_replicas,
                 "replicas": [
                     {"replica_id": rid, "healthy": rec["healthy"]}
@@ -174,6 +190,17 @@ class ServeController:
                 "ongoing": sum(st.metrics.values()),
                 "deleting": st.deleting,
             }
+            slo = getattr(st.spec["config"], "latency_slo_ms", None)
+            if slo is not None:
+                info["latency_slo_ms"] = slo
+            p99 = self._p99.get(st.key)
+            if p99 is not None:
+                info["p99_ms"] = p99
+            for ev in reversed(self._autoscale_events):
+                if ev["key"] == st.key:
+                    info["last_autoscale"] = ev
+                    break
+            out.setdefault(st.app_name, {})[st.name] = info
         return out
 
     async def get_routing_info(self, app_name: str, name: str,
@@ -255,6 +282,7 @@ class ServeController:
                 await self._stop_replica(st, rid, rec, drain=True)
             st.replicas.clear()
             self._deployments.pop(st.key, None)
+            self._autoscaler.forget(st.key)
             await self._bump_version()
             return
 
@@ -307,6 +335,8 @@ class ServeController:
                     cfg.max_ongoing_requests,
                     cfg.user_config,
                     getattr(cfg, "max_queued_requests", -1),
+                    getattr(cfg, "latency_slo_ms", None),
+                    st.app_name,
                 )
             )
             st.replicas[rid] = {
@@ -342,6 +372,7 @@ class ServeController:
             rid = min(st.replicas, key=stop_rank)
             rec = st.replicas.pop(rid)
             st.metrics.pop(rid, None)
+            st.totals.pop(rid, None)
             await self._stop_replica(st, rid, rec, drain=True)
             await self._bump_version()
 
@@ -357,7 +388,7 @@ class ServeController:
             await self._probe_replicas(st)
 
         # 4. autoscaling decision
-        self._autoscale(st)
+        await self._autoscale(st)
 
     async def _alive_nodes(self) -> list[str] | None:
         from ray_tpu.core.api import get_core
@@ -399,6 +430,7 @@ class ServeController:
                     cfg.health_check_timeout_s + 1,
                 )
                 st.metrics[rid] = int(m["ongoing"])
+                st.totals[rid] = int(m.get("total", 0))  # arrival-rate feed
                 if rec.get("node_id") is None:
                     # record placement once, for SPREAD counts + compaction
                     try:
@@ -419,12 +451,16 @@ class ServeController:
                     rec["healthy"] = False
                     st.replicas.pop(rid, None)
                     st.metrics.pop(rid, None)
+                    st.totals.pop(rid, None)
                     await self._stop_replica(st, rid, rec, drain=False)
                     await self._bump_version()
 
         await asyncio.gather(*(probe(r, rec) for r, rec in list(st.replicas.items())))
 
-    def _autoscale(self, st: _DeploymentState):
+    async def _autoscale(self, st: _DeploymentState):
+        """One SLO-feedback autoscaling tick (policy lives in
+        serve/dataplane/autoscaler.py; this gathers signals, applies the
+        fired decision, and publishes it with its cause)."""
         cfg = st.spec["config"]
         auto = cfg.autoscaling_config
         if auto is None:
@@ -433,23 +469,96 @@ class ServeController:
         for rid, (_, ts) in list(st.handle_queued.items()):
             if now - ts > 3.0:  # stale reporter
                 st.handle_queued.pop(rid, None)
-        total = sum(st.metrics.values()) + sum(q for q, _ in st.handle_queued.values())
-        desired = math.ceil(total / auto.target_ongoing_requests)
-        desired = max(auto.min_replicas, min(auto.max_replicas, desired))
-        if desired == st.target_replicas:
-            st._pending_decision = None
+        slo_ms = getattr(cfg, "latency_slo_ms", None)
+        if slo_ms is not None:
+            await self._refresh_p99()
+        decision = self._autoscaler.decide(
+            st.key,
+            current=st.target_replicas,
+            auto=auto,
+            ongoing=float(sum(st.metrics.values())),
+            handle_queued=float(sum(q for q, _ in st.handle_queued.values())),
+            p99_ms=self._p99.get(st.key),
+            slo_ms=slo_ms,
+            lifetime_total=sum(st.totals.values()) if st.totals else None,
+        )
+        if decision is None:
             return
+        st.target_replicas = decision.to_replicas
+        self._autoscale_events.append(decision.to_dict())
+        del self._autoscale_events[:-AUTOSCALE_EVENTS_CAP]
+        await self._publish_autoscale(decision)
+
+    async def _refresh_p99(self):
+        """Deployment p99s from the ns="latency" kv namespace: every
+        replica worker publishes its recent serve request window there
+        (replica.py's "serve" latency source, the same plumbing the
+        flight recorder and the sharded plane use). Rate-limited to one
+        fetch per 0.5s across all deployments; stale windows (a dead
+        replica's last publish) are dropped by their embedded ts."""
+        from ray_tpu.core.api import get_core
+        from ray_tpu.utils.recorder import percentile
+
         now = time.monotonic()
-        if st._pending_decision != desired:
-            st._pending_decision = desired
-            st._pending_since = now
+        if now - self._p99_fetched < 0.5:
             return
-        delay = auto.upscale_delay_s if desired > st.target_replicas else auto.downscale_delay_s
-        if st.target_replicas == 0 and desired > 0:
-            delay = 0.0  # scale-from-zero: requests are blocked, act now
-        if now - st._pending_since >= delay:
-            st.target_replicas = desired
-            st._pending_decision = None
+        self._p99_fetched = now
+        try:
+            gcs = get_core().gcs
+            keys = await gcs.call("kv_keys", {"ns": "latency", "prefix": ""})
+            keys = [k for k in keys if k.endswith(".serve")]
+            merged: dict[str, list] = {}
+            if keys:
+                blobs = await gcs.call("kv_multi_get",
+                                       {"ns": "latency", "keys": keys})
+                wall = time.time()
+                for k in keys:
+                    blob = blobs.get(k)
+                    if not blob:
+                        continue
+                    snap = pickle.loads(blob)
+                    if wall - snap.get("ts", 0.0) > 60.0:
+                        continue  # dead publisher's leftover window
+                    for stage, vals in snap.get("stages", {}).items():
+                        if stage.startswith("serve_"):
+                            merged.setdefault(stage[6:], []).extend(vals)
+            self._p99 = {key: percentile(sorted(vals), 0.99) / 1e6
+                         for key, vals in merged.items() if vals}
+        except Exception:
+            # transient GCS error: keep the previous view — autoscaling
+            # on a slightly stale p99 beats flapping on a missing one
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "serve p99 refresh failed", exc_info=True)
+
+    async def _publish_autoscale(self, decision):
+        """Fan the decision out: the serve_autoscale pubsub channel
+        (push consumers: tests, dashboards, operators' tooling) and a
+        bounded ns="serve" kv history (pull consumers:
+        state.list_serve_autoscale_events)."""
+        from ray_tpu.core.api import get_core
+
+        try:
+            gcs = get_core().gcs
+            await gcs.call("publish", {"channel": "serve_autoscale",
+                                       "message": decision.to_dict()})
+            await gcs.call("kv_put", {
+                "ns": "serve", "key": "autoscale_events",
+                "value": pickle.dumps(self._autoscale_events)})
+        except Exception:
+            # telemetry only — the scale decision itself already applied
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "serve autoscale publish failed", exc_info=True)
+
+    async def get_autoscale_events(self, key: str | None = None) -> list[dict]:
+        """Bounded history of fired autoscale decisions (newest last);
+        ``key`` filters to one "app/deployment"."""
+        if key is None:
+            return list(self._autoscale_events)
+        return [e for e in self._autoscale_events if e["key"] == key]
 
     async def _stop_replica(self, st: _DeploymentState, rid: str, rec: dict, drain: bool):
         from ray_tpu.core.api import get_core

@@ -1,0 +1,71 @@
+"""Fast-lane router hop: same-node replica calls over the actor shm rings.
+
+The router's dispatch (`handle.py _call_replica`) is loop-resident, and
+the PR 8 actor fast lane deliberately refuses loop callers — its reply
+detours through the migrate queue's linger timer, which is pure added
+latency for a coroutine already parked on the loop. This module rides the
+loop-side variant instead (``CoreClient.fast_actor_submit_loop``): the
+reply thread resolves the router's future DIRECTLY with the raw
+(status, payload) tuple, one ``call_soon_threadsafe`` per reply batch.
+
+Semantics are the actor fast lane's, unchanged:
+
+- **per-replica templates**: the packed ``handle_request`` method key and
+  lane binding are frozen once per replica (`ReplicaLane`), the serve
+  twin of ``ActorCallTemplate``; rebound automatically when the lane
+  breaks and reattaches (replica restart).
+- **per-CALL RPC fallback**: pending/remote ref args, oversized
+  payloads, a missing/broken lane, or FIFO conflicts with queued RPC
+  calls route THAT call over the actor RPC plane — the lane survives,
+  and the retry/hedge/deadline machinery above sees one code path.
+- **same-node only**: rings are same-node by design; cross-node replicas
+  always take RPC. The routing layer does not need to know — submit
+  simply returns None where no lane exists.
+"""
+from __future__ import annotations
+
+from ray_tpu.config import get_config
+
+
+def fastlane_enabled() -> bool:
+    """Live read (A/B arms and tests flip ``Config.serve_fastlane``)."""
+    return bool(get_config().serve_fastlane)
+
+
+class ReplicaLane:
+    """Frozen per-replica fast-lane submission state for the router.
+
+    One per (router, replica_id), built lazily at the replica's first
+    routed request and dropped when the replica leaves the membership
+    table. Tracks how many calls rode the ring vs fell back to RPC —
+    the router aggregates these into ``lane_stats()`` (tests/bench use
+    them to prove the fast lane actually carried traffic).
+    """
+
+    __slots__ = ("actor_id", "_tmpl", "fast_calls", "rpc_calls")
+
+    METHOD = "handle_request"
+
+    def __init__(self, actor_id):
+        self.actor_id = actor_id
+        self._tmpl = None
+        self.fast_calls = 0
+        self.rpc_calls = 0
+
+    def submit(self, core, args: tuple):
+        """Try the ring: returns ``(task_id, future)`` (decode with
+        ``core.fast_actor_await``) or None → RPC path for this call."""
+        tmpl = self._tmpl
+        if tmpl is None or tmpl.core is not core:
+            tmpl = self._tmpl = core.actor_call_template(
+                self.actor_id, self.METHOD, 1, None)
+        out = core.fast_actor_submit_loop(
+            self.actor_id, self.METHOD, args, {}, tmpl)
+        if out is None:
+            self.rpc_calls += 1
+        else:
+            self.fast_calls += 1
+        return out
+
+    def stats(self) -> dict:
+        return {"fast_calls": self.fast_calls, "rpc_calls": self.rpc_calls}

@@ -57,7 +57,8 @@ class Deployment:
                 request_timeout_s: float | None = None,
                 retry_on: tuple | list | str | None = None,
                 hedge_after_ms: float | None = None,
-                max_queued_requests: int | None = None) -> "Deployment":
+                max_queued_requests: int | None = None,
+                latency_slo_ms: float | None = None) -> "Deployment":
         cfg = dataclasses.replace(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
@@ -81,6 +82,8 @@ class Deployment:
             cfg.hedge_after_ms = hedge_after_ms
         if max_queued_requests is not None:
             cfg.max_queued_requests = max_queued_requests
+        if latency_slo_ms is not None:
+            cfg.latency_slo_ms = latency_slo_ms
         cfg.__post_init__()  # re-validate + renormalize retry_on
         return Deployment(self._callable, name or self.name, cfg)
 
@@ -102,7 +105,8 @@ def deployment(cls_or_fn=None, *, name: str | None = None, num_replicas: int = 1
                request_timeout_s: float | None = None,
                retry_on: tuple | list | str = (),
                hedge_after_ms: float = 0.0,
-               max_queued_requests: int = -1):
+               max_queued_requests: int = -1,
+               latency_slo_ms: float | None = None):
     """@serve.deployment decorator (ref: serve/api.py deployment)."""
 
     def wrap(target):
@@ -123,6 +127,7 @@ def deployment(cls_or_fn=None, *, name: str | None = None, num_replicas: int = 1
             retry_on=retry_on,
             hedge_after_ms=hedge_after_ms,
             max_queued_requests=max_queued_requests,
+            latency_slo_ms=latency_slo_ms,
         )
         return Deployment(target, name or target.__name__, cfg)
 

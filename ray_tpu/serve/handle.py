@@ -49,6 +49,8 @@ import time
 
 from ray_tpu.serve import context as serve_context
 from ray_tpu.serve.controller import CONTROLLER_NAME
+from ray_tpu.serve.dataplane.admission import AdmissionController
+from ray_tpu.serve.dataplane.fastlane import ReplicaLane, fastlane_enabled
 from ray_tpu.serve.exceptions import (
     BackPressureError,
     RayServeException,
@@ -125,6 +127,16 @@ class _Router:
         # reported count was OURS, so scoring doesn't double-count it.
         self.remote_ongoing: dict[str, int] = {}
         self.inflight_at_probe: dict[str, int] = {}
+        # fast-lane bindings per replica (serve/dataplane/fastlane.py):
+        # same-node replicas ride the actor shm ring, per-call RPC
+        # fallback; dropped with the replica's other routing state
+        self.lanes: dict[str, ReplicaLane] = {}
+        # handle-side projected-delay admission (dataplane/admission.py):
+        # per-replica drain-rate view refreshed by the probe loop
+        self.admission: dict[str, AdmissionController] = {}
+        self.replica_queued: dict[str, int] = {}
+        self.admission_shed = 0  # requests refused at the proxy
+        self.rpc_routed = 0  # dispatches that took the actor RPC plane
         # resident multiplexed models per replica (affinity routing)
         self.models: dict[str, list] = {}
         # per-deployment request-FT policy, refreshed with routing info
@@ -176,6 +188,9 @@ class _Router:
                 self.remote_ongoing.pop(rid, None)
                 self.inflight_at_probe.pop(rid, None)
                 self.models.pop(rid, None)
+                self.lanes.pop(rid, None)
+                self.admission.pop(rid, None)
+                self.replica_queued.pop(rid, None)
 
     # ------------------------------------------------- fast death detection
     def _ensure_death_listener(self, core):
@@ -200,7 +215,8 @@ class _Router:
             self.replicas = [r for r in self.replicas
                              if r["replica_id"] != rid]
             for d in (self.handles, self.inflight, self.remote_ongoing,
-                      self.inflight_at_probe, self.models):
+                      self.inflight_at_probe, self.models, self.lanes,
+                      self.admission, self.replica_queued):
                 d.pop(rid, None)
 
     def _ensure_poll_loop(self):
@@ -272,12 +288,29 @@ class _Router:
                     try:
                         with self.lock:
                             local_now = self.inflight.get(rid, 0)
-                        ref = actor.get_metrics.remote()
+                        # unordered: a metrics probe must never park at
+                        # the fast->RPC drain barrier behind in-flight
+                        # ring traffic (it would stall the whole pump)
+                        ref = core.submit_actor_task(
+                            actor, "get_metrics", (), {}, unordered=True)
                         (m,) = await core.get_async([ref], 1.0)
                         with self.lock:
                             self.remote_ongoing[rid] = int(m.get("ongoing", 0))
                             self.inflight_at_probe[rid] = local_now
                             self.models[rid] = list(m.get("models", ()))
+                            # drain-rate view for proxy-side admission
+                            self.replica_queued[rid] = int(m.get("queued", 0))
+                            exec_ms = float(m.get("exec_ewma_ms", 0.0))
+                            ctrl = self.admission.get(rid)
+                            if ctrl is None:
+                                ctrl = self.admission[rid] = (
+                                    AdmissionController(1))
+                            # refreshed per probe, not frozen at first
+                            # sight: a redeploy can change the cap, and
+                            # the first probe may race the FT fetch
+                            ctrl.max_ongoing = max(1, int(self.ft.get(
+                                "max_ongoing_requests", 8) or 8))
+                            ctrl.exec_ewma_s = exec_ms / 1e3
                     except Exception:  # raylint: disable=RT012 — replica mid-restart: keep the stale value
                         pass
 
@@ -483,12 +516,76 @@ class _Router:
                 f"replica actor {chosen['actor_name']} gone")
         return chosen["replica_id"], actor
 
+    def _lane_for(self, rid: str, actor) -> ReplicaLane:
+        with self.lock:
+            lane = self.lanes.get(rid)
+            if lane is None or lane.actor_id != actor.actor_id:
+                lane = self.lanes[rid] = ReplicaLane(actor.actor_id)
+        return lane
+
+    def lane_stats(self) -> dict:
+        """Fast-lane vs RPC routing counters + proxy-side sheds (tests
+        and bench prove the ring actually carried traffic with these)."""
+        with self.lock:
+            return {
+                "fast_calls": sum(l.fast_calls for l in self.lanes.values()),
+                "rpc_calls": self.rpc_routed,
+                "admission_shed": self.admission_shed,
+            }
+
+    def _admission_shed_check(self, deadline: float | None, exclude: set):
+        """Proxy-side projected-delay admission: refuse (typed, the
+        proxies' existing 429/RESOURCE_EXHAUSTED mapping applies) when
+        EVERY candidate replica's projected queue delay — probed queue
+        depth over its probed drain rate — already exceeds the request's
+        remaining deadline. One replica with headroom (or no drain data
+        yet) admits; the replica-side check remains the precise gate."""
+        if deadline is None:
+            return
+        remaining = deadline - time.monotonic()
+        best = None
+        with self.lock:
+            rids = [r["replica_id"] for r in self.replicas]
+            if exclude:
+                kept = [r for r in rids if r not in exclude]
+                if kept:
+                    rids = kept
+            if not rids:
+                return  # membership wait owns this case
+            for rid in rids:
+                ctrl = self.admission.get(rid)
+                if ctrl is None or ctrl.exec_ewma_s <= 0.0:
+                    return  # no drain data: cannot justify a shed
+                # probed queue depth covers every caller AT probe time
+                # (including our own inflight then); only our requests
+                # dispatched SINCE the probe are unseen — adding raw
+                # inflight would double-count (the same subtraction the
+                # pow-2 score makes)
+                queued = (self.replica_queued.get(rid, 0)
+                          + max(0, self.inflight.get(rid, 0)
+                                - self.inflight_at_probe.get(rid, 0)))
+                delay = ctrl.projected_delay_s(queued)
+                best = delay if best is None else min(best, delay)
+        if best is not None and best > max(0.0, remaining):
+            self.admission_shed += 1
+            raise BackPressureError(
+                f"projected queue delay {best:.3f}s on every replica of "
+                f"{self.app_name}/{self.deployment_name} exceeds the "
+                f"remaining deadline ({max(0.0, remaining):.3f}s)",
+                retry_after_s=best)
+
     async def _call_replica(self, rid: str, actor, method: str, args: tuple,
                             kwargs: dict, model_id: str,
                             deadline: float | None, request_id: str):
         """One attempt on one replica: dispatch + await, bounded by the
         remaining deadline; the replica receives the remaining budget so
-        it can shed the request if it expires while queued."""
+        it can shed the request if it expires while queued.
+
+        Dispatch rides the actor shm ring when the replica is same-node
+        and the lane is live (serve/dataplane/fastlane.py) — the reply
+        resolves straight into this coroutine; anything the ring cannot
+        carry takes the actor RPC plane for THIS call only, marked
+        unordered so neither path ever parks behind the other."""
         from ray_tpu.core.ref import GetTimeoutError
 
         core = _core()
@@ -497,13 +594,32 @@ class _Router:
         with self.lock:
             self.inflight[rid] = self.inflight.get(rid, 0) + 1
         try:
-            ref = actor.handle_request.remote(
-                method, args, kwargs, model_id, timeout_s, request_id)
+            call_args = (method, args, kwargs, model_id, timeout_s,
+                         request_id)
             try:
-                (result,) = await core.get_async(
-                    [ref],
-                    None if deadline is None
-                    else max(0.05, deadline - time.monotonic()))
+                from ray_tpu.core.core_client import FastLaneDeclined
+
+                wait_s = (None if deadline is None
+                          else max(0.05, deadline - time.monotonic()))
+                if fastlane_enabled():
+                    lane = self._lane_for(rid, actor)
+                    out = lane.submit(core, call_args)
+                    if out is not None:
+                        try:
+                            return await core.fast_actor_await(
+                                out[0], out[1], wait_s)
+                        except FastLaneDeclined:
+                            # worker's method table went stale: never
+                            # executed — re-dispatch THIS call over RPC
+                            # (and un-count it from the ring: fast_calls
+                            # is the "traffic actually rode the lane"
+                            # evidence bench/tests assert on)
+                            lane.fast_calls -= 1
+                            lane.rpc_calls += 1
+                self.rpc_routed += 1
+                ref = core.submit_actor_task(
+                    actor, "handle_request", call_args, {}, unordered=True)
+                (result,) = await core.get_async([ref], wait_s)
             except GetTimeoutError:
                 raise RequestTimeoutError(
                     f"request deadline exceeded waiting on replica {rid} "
@@ -524,7 +640,13 @@ class _Router:
             actor = self.handles.get(rid)
         if actor is not None:
             try:
-                actor.cancel_request.remote(request_id)  # raylint: disable=RT003 — best-effort shed; the loser's result is discarded either way
+                # unordered: the shed marker must OVERTAKE the loser's
+                # own in-flight ring record — an ordered RPC would park
+                # at the fast->RPC drain barrier behind it and arrive
+                # after the copy it is meant to cancel already ran
+                _core().submit_actor_task(  # raylint: disable=RT003 — best-effort shed; the loser's result is discarded either way
+                    actor, "cancel_request", (request_id,), {},
+                    unordered=True)
             except Exception:  # raylint: disable=RT012 — replica may be gone; its copy dies with it
                 pass
 
@@ -544,11 +666,23 @@ class _Router:
         loop = asyncio.get_running_loop()
         primary = loop.create_task(self._call_replica(
             rid, actor, method, args, kwargs, model_id, deadline, request_id))
+        # race the primary against the hedge timer with ONE bare future +
+        # call_later instead of wait_for(shield(...)): that stack built
+        # two wrapper futures and timeout machinery per request, and at
+        # serve QPS the hedge arm is on every request while the hedge
+        # itself almost never fires
+        waiter = loop.create_future()
+        primary.add_done_callback(
+            lambda t: waiter.done() or waiter.set_result(True))
+        timer = loop.call_later(
+            hedge_ms / 1e3,
+            lambda: waiter.done() or waiter.set_result(False))
         try:
-            return await asyncio.wait_for(asyncio.shield(primary),
-                                          hedge_ms / 1e3)
-        except asyncio.TimeoutError:
-            pass  # slow primary: hedge below
+            primary_first = await waiter
+        finally:
+            timer.cancel()
+        if primary_first:
+            return primary.result()  # raises the attempt's error, as before
         alt = self._choose(model_id, exclude | {rid})
         if alt is None or alt["replica_id"] == rid:
             return await primary  # nowhere else to hedge
@@ -601,6 +735,7 @@ class _Router:
             # (or a redeploy may change it) between attempts
             idempotent = self._idempotent(method)
             try:
+                self._admission_shed_check(deadline, excluded)
                 rid, actor = await self._pick_replica(
                     model_id, excluded, deadline, hint)
                 return await self._dispatch(

@@ -19,6 +19,11 @@ contract):
   expired while queued is dropped at dequeue — executing it would burn
   MXU time on an answer nobody is waiting for (ref: Tail at Scale's
   "good enough soon beats perfect late").
+- **projected-delay admission** (serve/dataplane/admission.py): a
+  request whose PROJECTED queue wait — queue depth x the replica's
+  execution-time EWMA over its concurrency — already exceeds the
+  remaining deadline is refused at admission with ``BackPressureError``
+  instead of parking in a queue it can only time out of.
 - **hedge cancellation**: :meth:`cancel_request` marks a request id;
   a marked request still queued is shed before user code runs, so the
   losing copy of a hedged request costs a queue slot, not an execution.
@@ -33,6 +38,7 @@ import collections
 import concurrent.futures
 import contextvars
 import inspect
+import threading
 import time
 
 try:
@@ -42,11 +48,84 @@ except ImportError:  # pragma: no cover
 
 from ray_tpu.devtools import chaos
 from ray_tpu.serve import context as serve_context
+from ray_tpu.serve.dataplane.admission import AdmissionController
 from ray_tpu.serve.exceptions import (
     BackPressureError,
     RequestCancelledError,
     RequestTimeoutError,
 )
+
+# ---------------------------------------------------------------- latency
+# Per-process serve request-latency windows, published beside the flight
+# recorder's stage window via CoreClient.add_latency_source("serve"):
+# one stage per deployment (``serve_<app>/<dep>`` e2e ns samples), so
+# state.list_task_latency() grows per-deployment serve rows with zero
+# new API and the controller's SLO-feedback autoscaler reads its p99
+# signal from the same ns="latency" namespace as every other stage.
+# Module-level (not per-Replica) because add_latency_source is keyed by
+# suffix per process — co-located replicas share one merged window.
+_LAT_WINDOW = 512          # samples kept per deployment
+_LAT_FRESH_S = 30.0        # samples older than this never publish
+_lat_lock = threading.Lock()
+_lat_windows: dict[str, collections.deque] = {}
+_lat_count = 0
+_lat_published = -1
+_lat_pending = -1
+_lat_registered = False
+
+
+def _record_request_latency(key: str, dur_ns: int) -> None:
+    global _lat_count
+    with _lat_lock:
+        win = _lat_windows.get(key)
+        if win is None:
+            win = _lat_windows[key] = collections.deque(maxlen=_LAT_WINDOW)
+        win.append((time.time(), dur_ns))
+        _lat_count += 1
+
+
+def _serve_latency_snapshot():
+    """add_latency_source fn: {stages, count, ts} or None when idle.
+    ``ts`` lets the autoscaler ignore a window some dead replica left
+    behind in the kv namespace."""
+    global _lat_pending
+    with _lat_lock:
+        if _lat_count == _lat_published:
+            return None
+        cutoff = time.time() - _LAT_FRESH_S
+        stages = {f"serve_{key}": [ns for ts, ns in win if ts >= cutoff]
+                  for key, win in _lat_windows.items()}
+        stages = {k: v for k, v in stages.items() if v}
+        if not stages:
+            return None
+        _lat_pending = _lat_count
+        return {"count": _lat_count, "ts": time.time(), "stages": stages}
+
+
+def _serve_latency_confirm() -> None:
+    global _lat_published
+    _lat_published = _lat_pending
+
+
+def _ensure_latency_source() -> None:
+    global _lat_registered
+    if _lat_registered:
+        return
+    try:
+        from ray_tpu.core.api import get_core
+
+        get_core().add_latency_source("serve", _serve_latency_snapshot,
+                                      _serve_latency_confirm)
+        _lat_registered = True
+    except Exception:
+        # no core in this process (unit tests constructing Replica
+        # directly) or core still bootstrapping: stay unregistered so
+        # the next Replica construction retries — a sticky flag here
+        # would blind the autoscaler's p99 signal for the process life
+        import logging
+
+        logging.getLogger(__name__).debug(
+            "serve latency source not registered", exc_info=True)
 
 
 class HandleMarker:
@@ -65,7 +144,9 @@ class Replica:
     def __init__(self, serialized_cls: bytes, init_args: tuple, init_kwargs: dict,
                  deployment_name: str, replica_id: str, max_ongoing_requests: int,
                  user_config: dict | None = None,
-                 max_queued_requests: int = -1):
+                 max_queued_requests: int = -1,
+                 latency_slo_ms: float | None = None,
+                 app_name: str = "default"):
         from ray_tpu.serve.handle import DeploymentHandle
 
         cls = cloudpickle.loads(serialized_cls)
@@ -75,6 +156,9 @@ class Replica:
         self.replica_id = replica_id
         self.max_ongoing_requests = max_ongoing_requests
         self.max_queued_requests = max_queued_requests
+        self.latency_slo_ms = latency_slo_ms
+        self._lat_key = f"{app_name}/{deployment_name}"
+        self._admission = AdmissionController(max_ongoing_requests)
         self._ongoing = 0
         self._executing = 0
         self._queued = 0
@@ -94,6 +178,46 @@ class Replica:
         self.user = cls(*init_args, **init_kwargs) if isinstance(cls, type) else cls
         if user_config is not None:
             self._apply_user_config(user_config)
+        self._wire_batch_queues()
+        _ensure_latency_source()
+
+    def _wire_batch_queues(self):
+        """Hand the deployment's latency_slo_ms to @serve.batch methods
+        that didn't set their own — the AIMD controller then closes its
+        loop against the same budget the autoscaler and admission
+        control use. Stored ON THE INSTANCE (read at lazy queue
+        creation), never on the shared wrapper config: a by-reference
+        pickled class is one object per process, and mutating its
+        config would leak the first deployment's SLO into every
+        co-located deployment of the same class."""
+        if self.latency_slo_ms is None:
+            return
+        for name in dir(type(self.user)):
+            if getattr(getattr(type(self.user), name, None),
+                       "_is_serve_batch", False):
+                try:
+                    self.user.__rt_batch_slo__ = self.latency_slo_ms
+                except AttributeError:
+                    pass  # __slots__ user class: decorator budgets only
+                return
+
+    def _batch_stats(self) -> dict | None:
+        """Merged AIMD stats across the user's batch queues (get_metrics
+        -> controller/dashboard/bench)."""
+        out = None
+        for name in dir(type(self.user)):
+            fn = getattr(type(self.user), name, None)
+            queues = getattr(fn, "_batch_queues", None)
+            if not queues:
+                continue
+            for q in queues.values():
+                s = q.controller.stats()
+                if out is None:
+                    out = s
+                else:  # multiple batched methods: keep the busiest
+                    if s["batches"] > out["batches"]:
+                        out = s
+        return out
 
     @staticmethod
     def _resolve(arg, handle_cls):
@@ -111,9 +235,12 @@ class Replica:
         fn(user_config)
 
     # ------------------------------------------------------------- requests
-    def _admit(self):
+    def _admit(self, deadline: float | None = None):
         """Admission control: refuse (typed, retryable-elsewhere) rather
-        than queue past the declared bound."""
+        than queue past the declared bound — positionally
+        (max_queued_requests) or temporally (the projected queue delay
+        already eats the request's remaining deadline; shedding here
+        beats the deadline shed at dequeue by the whole queue wait)."""
         if (self.max_queued_requests >= 0
                 and self._executing >= self.max_ongoing_requests
                 and self._queued >= self.max_queued_requests):
@@ -124,6 +251,17 @@ class Replica:
                 # a slot frees when the oldest executing request finishes;
                 # the queue depth is the best local estimate of that wait
                 retry_after_s=0.05 * (1 + self._queued),
+            )
+        if (deadline is not None and self._queued > 0
+                and self._admission.would_breach(self._queued, deadline)):
+            self._refused += 1
+            self._admission.shed += 1
+            raise BackPressureError(
+                f"replica {self.replica_id}: projected queue delay "
+                f"{self._admission.projected_delay_s(self._queued):.3f}s "
+                f"exceeds the request's remaining deadline "
+                f"({max(0.0, deadline - time.monotonic()):.3f}s)",
+                retry_after_s=self._admission.projected_delay_s(self._queued),
             )
 
     def _check_shed(self, deadline: float | None, request_id: str):
@@ -149,10 +287,11 @@ class Replica:
                         replica=self.replica_id)
         if self._gate is None:
             self._gate = asyncio.Semaphore(self.max_ongoing_requests)
-        self._admit()
         # arrival-relative deadline: the router sends REMAINING budget so
         # cross-node clock domains never skew the absolute deadline
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        self._admit(deadline)
+        t_arrival = time.perf_counter_ns()
         self._ongoing += 1
         self._total += 1
         self._queued += 1
@@ -169,6 +308,7 @@ class Replica:
                 dequeued = True
                 self._check_shed(deadline, request_id)
                 self._executing += 1
+                t_exec = time.perf_counter_ns()
                 try:
                     # composed handle calls inside user code inherit the
                     # remaining budget through this contextvar
@@ -188,6 +328,12 @@ class Replica:
                         serve_context.reset_deadline(token)
                 finally:
                     self._executing -= 1
+                    done = time.perf_counter_ns()
+                    # exec EWMA feeds projected-delay admission; the e2e
+                    # (queue + exec) sample feeds the "serve" latency
+                    # window the SLO autoscaler reads its p99 from
+                    self._admission.observe_exec((done - t_exec) / 1e9)
+                    _record_request_latency(self._lat_key, done - t_arrival)
         finally:
             if not dequeued:  # cancelled while waiting on the gate
                 self._queued -= 1
@@ -240,17 +386,26 @@ class Replica:
     def get_metrics(self) -> dict:
         from ray_tpu.serve.multiplex import loaded_model_ids
 
-        return {
+        out = {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
             "queued": self._queued,
             "shed": self._shed,
             "refused": self._refused,
             "total": self._total,
+            # handle-side projected-delay admission reads these two
+            # (dataplane/admission.py): the router's view of this
+            # replica's drain rate
+            "exec_ewma_ms": self._admission.exec_ewma_s * 1e3,
+            "admission_shed": self._admission.shed,
             # resident multiplexed models: the router's affinity signal
             # (ref: multiplex model-id membership via long-poll)
             "models": loaded_model_ids(self.user),
         }
+        batch = self._batch_stats()
+        if batch is not None:
+            out["batch"] = batch
+        return out
 
     def check_health(self) -> bool:
         fn = getattr(self.user, "check_health", None)

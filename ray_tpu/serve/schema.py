@@ -31,12 +31,13 @@ class DeploymentSchema:
     num_replicas: int | None = None
     max_ongoing_requests: int | None = None
     autoscaling_config: dict | None = None
+    latency_slo_ms: float | None = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "DeploymentSchema":
         known = {k: d.get(k) for k in
                  ("name", "num_replicas", "max_ongoing_requests",
-                  "autoscaling_config")}
+                  "autoscaling_config", "latency_slo_ms")}
         unknown = set(d) - set(known)
         if unknown:
             raise ValueError(f"deployment {d.get('name')!r}: unknown "
@@ -195,6 +196,7 @@ def _with_overrides(bound, app: ServeApplicationSchema):
             num_replicas=o.num_replicas,
             max_ongoing_requests=o.max_ongoing_requests,
             autoscaling_config=o.autoscaling_config,
+            latency_slo_ms=o.latency_slo_ms,
         )
     return bound
 

@@ -403,6 +403,23 @@ def list_task_latency() -> dict[str, dict]:
     return out
 
 
+def list_serve_autoscale_events(key: str | None = None) -> list[dict]:
+    """Fired serve autoscale decisions (newest last), each carrying its
+    cause and the signals that produced it — {key, ts, from_replicas,
+    to_replicas, cause, ongoing_avg, arrival_rate, p99_ms, slo_ms}. The
+    controller appends every applied decision to a bounded ns="serve" kv
+    history (and pushes it live on the ``serve_autoscale`` pubsub
+    channel); ``key`` filters to one "app/deployment". Empty when no
+    autoscaled deployment has scaled."""
+    blob = _call("kv_get", {"ns": "serve", "key": "autoscale_events"})
+    if not blob:
+        return []
+    events = pickle.loads(blob)
+    if key is not None:
+        events = [e for e in events if e.get("key") == key]
+    return events
+
+
 def list_chaos_events(limit: int = 10000, log_dir: str | None = None) -> list[dict]:
     """Faults fired by the chaos subsystem (devtools/chaos), merged
     across every armed process on this host — each controller appends a

@@ -161,8 +161,12 @@ class ObjectID(BaseID):
     @classmethod
     def from_random(cls) -> "ObjectID":
         # ``put()`` objects: owner task id + random index space (high bit set
-        # to never collide with task returns).
-        return cls(os.urandom(_TASK_ID_SIZE) + struct.pack("<I", 1 << 31))
+        # to never collide with task returns). The task-id half rides the
+        # prefix+counter scheme, not a per-call os.urandom(16) — the serve
+        # router mints one of these per request (promise refs), and the
+        # urandom syscall measured ~288us under the intercepting sandbox
+        # (the same cost generate_actor shed in PR 8).
+        return cls(TaskID.generate().binary() + struct.pack("<I", 1 << 31))
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[:_TASK_ID_SIZE])

@@ -32,7 +32,7 @@ from __future__ import annotations
 # per-caller FIFO dispatch invariant. attach_fast_ring's actor reply is
 # now a dict carrying the actor's init-time method eligibility table —
 # see core/fastpath.py pack_actor_task/pack_reply.
-PROTOCOL_VERSION = (1, 8)
+PROTOCOL_VERSION = (1, 9)
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -61,6 +61,10 @@ CATALOG: dict[str, dict[str, dict]] = {
         "get_cluster": {"since": (1, 0), "fields": {"->": "[node info]"}},
         "drain_node": {"since": (1, 0), "fields": {"node_id": "hex"}},
         "subscribe": {"since": (1, 0), "fields": {"channels": "[str]"}},
+        "publish": {"since": (1, 9), "fields": {
+            "channel": "str — client-originated pubsub fan-out (the serve "
+                       "controller's serve_autoscale decisions)",
+            "message": "any"}},
         "kv_put": {"since": (1, 0), "fields": {
             "ns": "str", "key": "str", "value": "bytes", "overwrite": "bool"}},
         "kv_get": {"since": (1, 0), "fields": {"ns": "str", "key": "str"}},
@@ -115,6 +119,10 @@ CATALOG: dict[str, dict[str, dict]] = {
             "pg_id": "PGID", "bundle_index": "int"}},
         "return_bundle": {"since": (1, 0), "fields": {
             "pg_id": "PGID", "bundle_index": "int"}},
+        "list_bundles": {"since": (1, 9), "fields": {
+            "->": "[{pg_id, bundle_index, resources, committed, "
+                  "prepared_at}] — the PG-reservation audit surface "
+                  "(shipped in 1.8's PG-FT work, cataloged late)"}},
         "pull_object": {"since": (1, 0), "fields": {
             "object_id": "bytes", "owner_address": "(host, port)",
             "holders_hint": "[node_id bytes] optional (since (1, 6)): "

@@ -1,0 +1,57 @@
+"""RT015 fixture: serve.batch configured inside a request-path function
+body (re-creates the coalescing queue per call) vs. hoisted declarations."""
+from ray_tpu import serve
+from ray_tpu.serve import batch as serve_batch
+
+
+@serve.deployment
+class Hoisted:
+    # clean: class-level decorator — decorators are evaluated in the
+    # enclosing (class) scope, one queue for the deployment's lifetime
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.01)
+    async def handle(self, requests):
+        return [r * 2 for r in requests]
+
+
+@serve.batch(max_batch_size=4)
+async def module_level(requests):  # clean: module scope
+    return requests
+
+
+class SetupTime:
+    def __init__(self, max_batch_size):
+        # clean: one-time construction with instance-derived knobs —
+        # the queue lives for the object's lifetime (llm.serving shape)
+        self._batched = serve.batch(
+            max_batch_size=max_batch_size)(self._run)
+
+    async def _run(self, requests):
+        return requests
+
+
+class RebuildsPerCall:
+    async def _run(self, requests):
+        return requests
+
+    async def handle(self, request):
+        batched = serve.batch(self._run, max_batch_size=8, batch_wait_timeout_s=0.01)  # expect: RT015
+        return await batched(request)
+
+    async def handle_nested(self, request):
+        @serve.batch(max_batch_size=8)  # expect: RT015
+        async def run(requests):
+            return requests
+
+        return await run(request)
+
+    async def handle_bare_import(self, request):
+        batched = serve_batch(self._run, max_batch_size=2)  # expect: RT015
+        return await batched(request)
+
+    async def handle_no_knobs(self, request):
+        batched = serve.batch(self._run)  # expect: RT015
+        return await batched(request)
+
+    async def handle_suppressed(self, request):
+        batched = serve.batch(self._run, max_batch_size=8)  # raylint: disable=RT015 — test scaffolding
+        return await batched(request)
