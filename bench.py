@@ -572,6 +572,203 @@ def run_serve_bench(quick: bool) -> dict[str, float]:
     return out
 
 
+_TUNNEL_BENCH_CHILD = r"""
+import json, os, subprocess, sys, tempfile, threading, time
+import numpy as np
+from ray_tpu.core import api as _api
+from ray_tpu.core.core_client import CoreClient
+from ray_tpu.utils import rpc as _rpc
+
+mode = sys.argv[1]   # "tunnel" | "rpc" (RT_NODE_TUNNEL set by the parent)
+n = int(sys.argv[2])
+
+# two REAL raylet processes on this host (the forced-onto-the-tunnel
+# topology): driver attaches to A, actors/workers land on B via the
+# "bee" resource — every fast call crosses nodes
+procs = []
+addr_file = tempfile.mktemp(prefix="rt_tb_gcs_")
+procs.append(subprocess.Popen(
+    [sys.executable, "-m", "ray_tpu.core.gcs", "--address-file", addr_file],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+deadline = time.time() + 30
+while not os.path.exists(addr_file) and time.time() < deadline:
+    time.sleep(0.05)
+gcs_host, gcs_port = open(addr_file).read().strip().rsplit(":", 1)
+gcs_addr = (gcs_host, int(gcs_port))
+sess = f"tb{os.getpid()}"
+
+def spawn_raylet(tag, extra):
+    p = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.core.raylet",
+         "--gcs", f"{gcs_host}:{gcs_port}", "--session", f"{sess}{tag}",
+         *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    procs.append(p)
+    line = p.stdout.readline()  # "raylet <id> on host:port"
+    hp = line.strip().rsplit(" ", 1)[-1]
+    host, port = hp.rsplit(":", 1)
+    return (host, int(port))
+
+addr_a = spawn_raylet("a", ["--num-cpus", "2"])
+addr_b = spawn_raylet("b", ["--num-cpus", "4", "--resources", "bee=16"])
+
+io = _rpc.EventLoopThread()
+core = CoreClient(loop=io.loop)
+io.run(core.connect(gcs_addr, addr_a))
+_api._core = core
+
+import atexit
+def _cleanup():
+    for p in procs[::-1]:
+        try:
+            p.terminate()
+        except Exception:
+            pass
+atexit.register(_cleanup)
+
+class Echo:
+    def ping(self, i):
+        return i
+
+h = core.create_actor(Echo, (), {}, resources={"CPU": 0.5, "bee": 0.5})
+
+def get(refs, timeout=180):
+    # the public get: fast-lane refs resolve on THIS thread via
+    # fast_prepass (no loop task per ref), exactly what users pay
+    return _api.get(refs, timeout=timeout)
+
+assert get([core.submit_actor_task(h, "ping", (0,), {})])[0] == 0
+tmpl = core.actor_call_template(h.actor_id, "ping", 1, None)
+deadline = time.time() + 15
+while mode == "tunnel" and time.time() < deadline:
+    lane = core._fast_actor_lanes.get(h.actor_id)
+    if lane is not None and not lane.broken:
+        break
+    get([core.submit_actor_task(h, "ping", (0,), {}, _tmpl=tmpl)])
+    time.sleep(0.1)
+
+# warm both arms identically
+get([core.submit_actor_task(h, "ping", (i,), {}, _tmpl=tmpl)
+     for i in range(32)])
+
+# burst arm: fire n, await all — the coalescing shape (one frame per
+# burst window on the tunnel vs one pickled spec per call on RPC)
+best_burst = 0.0
+for _ in range(3):
+    t0 = time.perf_counter()
+    refs = [core.submit_actor_task(h, "ping", (i,), {}, _tmpl=tmpl)
+            for i in range(n)]
+    vals = get(refs)
+    wall = time.perf_counter() - t0
+    assert vals == list(range(n))
+    best_burst = max(best_burst, n / wall)
+# coalescing counters captured NOW: the closed-loop arm below sends
+# singles by design and would dilute the burst-phase avg_batch
+st_burst = core.tunnel_stats()
+
+# threaded closed-loop arm (4 callers, the serve request shape)
+per = max(1, n // 4)
+def loop_arm(k):
+    for i in range(k):
+        assert get([core.submit_actor_task(h, "ping", (i,), {},
+                                           _tmpl=tmpl)])[0] == i
+t0 = time.perf_counter()
+ths = [threading.Thread(target=loop_arm, args=(per,)) for _ in range(4)]
+for t in ths: t.start()
+for t in ths: t.join()
+closed = (per * 4) / (time.perf_counter() - t0)
+
+# cross-node batched pull: 64MB sealed on node B, adopted on A in one
+# pull_objects round trip per batch
+def produce(k):
+    import numpy as np
+    return np.ones(k, dtype=np.uint8)
+
+chunks = 8
+size = 64 * 1024 * 1024 // chunks
+prefs = [core.submit_task(produce, (size,), {},
+                          resources={"CPU": 1.0, "bee": 1.0})
+         for _ in range(chunks)]
+core._run_sync(core.wait_async(prefs, chunks, 180, False), 190)
+t0 = time.perf_counter()
+pvals = get(prefs, 180)
+pull_wall = time.perf_counter() - t0
+nbytes = sum(v.nbytes for v in pvals)
+assert nbytes == chunks * size
+
+st = core.tunnel_stats()
+print("RES=" + json.dumps({
+    "burst_calls_per_s": best_burst,
+    "closed_calls_per_s": closed,
+    "pull_gbps": nbytes / pull_wall / 1e9,
+    "avg_batch": st_burst["avg_batch"],
+    "tx_records": st["tx_records"],
+    "tx_frames": st["tx_frames"],
+}))
+_api._core = None
+try:
+    io.run(core.close(), timeout=15)
+except Exception:
+    pass
+io.stop()
+_cleanup()
+"""
+
+
+def run_tunnel_bench(quick: bool) -> dict[str, float]:
+    """Cross-node fast lane A/B (interleaved best-of): two raylets on
+    one host, driver on A, actor + task workers on B — every fast call
+    crosses nodes, so the node tunnel is the only fast lane in play.
+    The baseline arm (RT_NODE_TUNNEL=0) takes the per-call RPC path.
+    Emits ``tunnel_calls_per_s`` (+_rpc twin), the closed-loop twins,
+    ``tunnel_coalesce_avg_batch`` and ``cross_node_pull_gbps``."""
+    import subprocess
+
+    out: dict[str, float] = {}
+    n = 160 if quick else 600
+
+    def arm(mode: str) -> dict | None:
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "RT_NODE_TUNNEL": "1" if mode == "tunnel" else "0"}
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _TUNNEL_BENCH_CHILD, mode, str(n)],
+                env=env, capture_output=True, text=True, timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            print("tunnel bench arm timed out", file=sys.stderr)
+            return None
+        if proc.returncode != 0:
+            print(f"tunnel bench arm failed:\n{proc.stderr[-1500:]}",
+                  file=sys.stderr)
+            return None
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("RES=")]
+        return json.loads(line[-1][4:]) if line else None
+
+    rounds = 1 if quick else 3  # best-of interleaved (the r8 protocol)
+    best: dict[str, dict] = {}
+    for _ in range(rounds):  # interleaved A/B, best-of per arm
+        for mode in ("rpc", "tunnel"):
+            res = arm(mode)
+            if res is not None and (
+                    mode not in best
+                    or res["burst_calls_per_s"]
+                    > best[mode]["burst_calls_per_s"]):
+                best[mode] = res
+    if "tunnel" in best:
+        out["tunnel_calls_per_s"] = best["tunnel"]["burst_calls_per_s"]
+        out["tunnel_closed_calls_per_s"] = \
+            best["tunnel"]["closed_calls_per_s"]
+        out["tunnel_coalesce_avg_batch"] = best["tunnel"]["avg_batch"]
+        out["cross_node_pull_gbps"] = best["tunnel"]["pull_gbps"]
+    if "rpc" in best:
+        out["tunnel_calls_per_s_rpc"] = best["rpc"]["burst_calls_per_s"]
+        out["tunnel_closed_calls_per_s_rpc"] = \
+            best["rpc"]["closed_calls_per_s"]
+    return out
+
+
 _SHARDED_BENCH_CHILD = """
 import json, os, time
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
@@ -1409,6 +1606,44 @@ def write_benchvs(micro: dict, model: dict | None,
         "(0.1s handler, target_ongoing 2, upscale_delay 0.3s) and the "
         "SLO-feedback autoscaler converges within ~2 metric windows.",
         "",
+        "## Cross-node fast lane A/B (r12, two raylets on one host)",
+        "",
+        "The tunnel arm spawns a real GCS + TWO raylet subprocesses on "
+        "this host; the driver attaches to node A and the actor/workers "
+        "land on node B (resource fence), so every fast call crosses "
+        "nodes and rides the node tunnel (README § Cross-node fast "
+        "lane) — the SAME packed ring records the shm lanes use, "
+        "coalesced into multiplexed per-node-pair frames. The baseline "
+        "arm (RT_NODE_TUNNEL=0) is the per-call RPC path (pickled spec "
+        "+ frame + loop write per request, scatter-batched transport). "
+        "Interleaved alternating subprocess rounds, best-of per arm: "
+        f"`tunnel_calls_per_s` "
+        f"{micro.get('tunnel_calls_per_s', 0):,.0f}/s vs "
+        f"{micro.get('tunnel_calls_per_s_rpc', 0):,.0f}/s burst "
+        "(600-call fire-then-await, the coalescing shape), with "
+        f"`tunnel_coalesce_avg_batch` "
+        f"{micro.get('tunnel_coalesce_avg_batch', 0):,.1f} records per "
+        "tunnel frame during the burst — the win stacks submit-side "
+        "txbuf coalescing, worker-side one-executor-hop batch "
+        "execution, and caller-thread reply resolution "
+        "(`fast_prepass` drains tunnel completions without a loop "
+        "task per ref; routing gets through `_run_sync(get_async)` "
+        "instead measured 3× slower than the public `ray_tpu.get`). "
+        "The threaded CLOSED-loop twins "
+        f"({micro.get('tunnel_closed_calls_per_s', 0):,.0f}/s vs "
+        f"{micro.get('tunnel_closed_calls_per_s_rpc', 0):,.0f}/s) sit "
+        "near parity: a lone request's latency pays the tunnel's two "
+        "extra hops (driver→raylet→worker vs driver→worker direct) "
+        "with nothing to coalesce — the tunnel is a throughput plane, "
+        "and per-call RPC remains a fine road for isolated calls "
+        "(which is exactly the per-call fallback the lanes keep). "
+        f"`cross_node_pull_gbps` "
+        f"{micro.get('cross_node_pull_gbps', 0):,.2f} GB/s is a 64MB "
+        "8-object result set sealed on node B adopted on A through the "
+        "batched pull_objects path (chunked streaming through two "
+        "python raylets on a shared box; the per-oid directory lookups "
+        "it replaced were the latency term, not the byte pump).",
+        "",
         "## Placement-group 2PC A/B (r10, same-host interleaved)",
         "",
         "Pre/post the PG lifecycle rework (BundleTxn parallel "
@@ -1744,6 +1979,10 @@ def main():
             micro.update(run_serve_bench(args.quick))
         except Exception as e:
             print(f"serve bench failed: {e!r}", file=sys.stderr)
+        try:
+            micro.update(run_tunnel_bench(args.quick))
+        except Exception as e:
+            print(f"tunnel bench failed: {e!r}", file=sys.stderr)
         try:
             micro.update(run_sharded_bench(args.quick))
         except Exception as e:

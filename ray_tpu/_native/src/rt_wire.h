@@ -11,6 +11,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cstdint>
 #include <cstring>
 #include <string>
 
@@ -21,8 +22,25 @@ namespace wire {
 
 // Wire-schema version; must match ray_tpu/utils/schema.py PROTOCOL_VERSION
 // (tests/test_wire_schema.py cross-checks the two).
-constexpr int kProtocolMajor = 1;
-constexpr int kProtocolMinor = 9;
+constexpr int kProtocolMajor = 2;
+constexpr int kProtocolMinor = 0;
+
+// ---------------------------------------------------------------------
+// Fastpath record catalog (shm rings + node tunnels, core/fastpath.py).
+// Every prefix byte and reply-status flag a native peer may see on a
+// record stream MUST appear here AND in utils/schema.py
+// (RECORD_PREFIXES / RECORD_FLAGS) — tests/test_wire_schema.py parses
+// this block and asserts byte-for-byte parity in both directions, so a
+// shipped-but-uncataloged wire entry is a tier-1 failure by
+// construction.
+constexpr char kRecPrefixTaskPickle = 'P';   // task, C-pickled, no stamp
+constexpr char kRecPrefixTaskPacked = 'S';   // task, serialization.pack
+constexpr char kRecPrefixTaskPickleTs = 'Q'; // task, C-pickled + u64 stamp
+constexpr char kRecPrefixTaskPackedTs = 'R'; // task, packed + u64 stamp
+constexpr char kRecPrefixActorPickle = 'A';  // actor, C-pickled + seq hdr
+constexpr char kRecPrefixActorPacked = 'C';  // actor, packed + seq hdr
+constexpr uint32_t kReplyFlagStamped = 0x100;  // 16-byte stage stamp follows
+constexpr uint32_t kReplyFlagSeqed = 0x200;    // u32 echoed seq follows
 
 inline bool read_exact(int fd, void* buf, size_t n) {
   auto* p = (char*)buf;

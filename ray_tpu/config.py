@@ -111,6 +111,29 @@ class Config:
     #: Off switch for A/B (bench.py serve arm) and paranoia.
     serve_fastlane: bool = True
 
+    # --- cross-node node tunnel (core/tunnel.py; ref: Pathways'
+    # per-host dataflow channels — descriptors, not payloads, between
+    # persistent per-host endpoints) ---
+    #: route cross-node actor/serve/task calls over one persistent,
+    #: multiplexed connection per node pair carrying the SAME packed
+    #: wire records the shm rings use (coalesced frames instead of
+    #: per-call pickled RPC specs); per-call RPC fallback always kept.
+    #: Off switch for A/B (bench.py tunnel arm) and paranoia.
+    node_tunnel: bool = True
+    #: tunnel records above this many bytes do not ship their big args
+    #: inline: each oversized top-level value seals into the sender's
+    #: local shm arena and the record carries a (node, oid, nbytes)
+    #: descriptor the receiver adopts via ONE batched pull
+    tunnel_inline_max: int = 64 * 1024
+    #: bench/test hook: bind tunnel lanes even for same-node actors
+    #: (disables the same-node shm-ring shortcut so two raylets on one
+    #: host exercise the full tunnel path)
+    tunnel_force: bool = False
+    #: reconnect-with-backoff ceiling for a broken tunnel connection;
+    #: lanes break (per-call RPC fallback) the moment the tunnel drops
+    #: and revive once the redial lands
+    tunnel_reconnect_max_s: float = 5.0
+
     # --- native RPC mux (ref: grpc_server.h:88 completion-queue threads;
     # _native/src/mux.cc) ---
     #: serve control-plane RPC off a C++ epoll mux instead of asyncio

@@ -409,6 +409,19 @@ class CoreClient:
         self._fast_loop_waiters: dict[ObjectID, asyncio.Future] = {}
         self._fast_wake_q: list = []
         self._fast_wake_armed = False
+        # ---- cross-node node tunnels (core/tunnel.py) ----
+        # TunnelClient created lazily on first remote lane; tunnel actor
+        # lanes register in _fast_actor_lanes beside ring lanes and reuse
+        # the whole FastLane submit/reply/recovery machinery.
+        self._tunnels = None
+        # revival registry: actors that ever held a tunnel lane -> their
+        # node raylet address; the health loop re-attaches after a
+        # tunnel break once the redial lands (dropped on actor DEAD)
+        self._tunnel_actor_seen: dict[ActorID, tuple] = {}
+        # descriptor pins: task_id -> ObjectRefs minted for oversized
+        # tunnel args, held until the call's reply (or break) lands so
+        # the sealed shm copies can't be freed mid-pull
+        self._tunnel_pins: dict[TaskID, list] = {}
 
     # ----------------------------------------------------------- bootstrap
     async def connect(self, gcs_address: tuple[str, int], raylet_address: tuple[str, int]):
@@ -456,6 +469,7 @@ class CoreClient:
             actor_id = ActorID.from_hex(channel.split(":", 1)[1])
             self._actor_info[actor_id] = message
             if isinstance(message, dict) and message.get("state") == DEAD:
+                self._tunnel_actor_seen.pop(actor_id, None)
                 for cb in list(self._actor_death_listeners):
                     try:
                         cb(actor_id, message)
@@ -885,9 +899,13 @@ class CoreClient:
             # advisory: create() still retries under arena pressure
             log.debug("spill_now request failed", exc_info=True)
 
-    async def _register_location(self, oid: ObjectID):
-        holders = {self.node_id.binary()}
-        self._obj_locations.setdefault(oid, set()).add(self.node_id.binary())
+    async def _register_location(self, oid: ObjectID, holder: bytes | None = None):
+        """Write the object's holder set to the GCS directory. ``holder``
+        names the sealing node when it is NOT ours (tunnel completions:
+        the record's shm descriptor carries the executing node)."""
+        hb = holder or self.node_id.binary()
+        holders = {hb}
+        self._obj_locations.setdefault(oid, set()).add(hb)
         await self.gcs.call(
             "kv_put", {"ns": "obj_loc", "key": oid.hex(), "value": pickle.dumps(holders)}
         )
@@ -911,6 +929,37 @@ class CoreClient:
             else:
                 self._obj_locations.pop(oid, None)
         return ok
+
+    async def pull_objects_batch(self, hints: dict) -> dict:
+        """Batched multi-object pull through the local raylet (protocol
+        2.0 ``pull_objects``): ONE round trip fetches a whole
+        arg/KV-manifest set into the local store, with per-object holder
+        hints (location cache + caller knowledge) and exactly one GCS
+        ``kv_multi_get`` raylet-side for the unhinted miss-set.
+        ``hints``: {ObjectID: holder-node-id set (may be empty)}.
+        Returns {oid hex: bool}; failures fall back to the per-object
+        pull paths of the callers. Best effort — never raises."""
+        items = []
+        for oid, hint in hints.items():
+            if self.store is not None and self.store.contains(oid):
+                continue
+            merged = set(b for b in (hint or ()) if b)
+            merged |= self._obj_locations.get(oid, set())
+            items.append({"object_id": oid.binary(),
+                          "holders_hint": sorted(merged) or None})
+        if not items or self.raylet is None:
+            return {}
+        try:
+            res = await self.raylet.call("pull_objects",
+                                         {"objects": items})
+        except Exception:
+            log.debug("batched pull failed", exc_info=True)
+            return {}
+        for oid in hints:
+            if (res or {}).get(oid.hex()):
+                # the holder we now KNOW is our own node
+                self._obj_locations[oid] = {self.node_id.binary()}
+        return res or {}
 
     # ----------------------------------------------------------------- get
     async def get_async(self, refs: list[ObjectRef], timeout: float | None = None):
@@ -938,6 +987,21 @@ class CoreClient:
         # whose holder set is unknown, instead of one directory RPC per
         # ref inside the pulls below
         await self._prime_locations([refs[i] for i in pending])
+        # batched pull: every ready shm ref that is not local yet rides
+        # ONE pull_objects round trip (a cross-node KV-manifest set or
+        # multi-arg fetch lands in one RTT); _get_one then reads the
+        # local copies zero-copy, and misses keep their per-ref fallback
+        if self.store is not None:
+            need_pull = {}
+            for i in pending:
+                oid = refs[i].id
+                entry = self.memory_store.get(oid)
+                if (entry is not None and entry.ready.is_set()
+                        and entry.in_shm and oid not in need_pull
+                        and not self.store.contains(oid)):
+                    need_pull[oid] = self._obj_locations.get(oid, set())
+            if len(need_pull) >= 2:
+                await self.pull_objects_batch(need_pull)
         results = await asyncio.gather(
             *(self._get_one(refs[i], deadline) for i in pending),
             return_exceptions=True)
@@ -1557,13 +1621,15 @@ class CoreClient:
         kick = False
         undo = False
         framed = fastpath.frame_one(rec)
+        maxrec = lane.flush_max_records or cfg.fastpath_flush_max_records
+        maxbytes = lane.flush_max_bytes or cfg.fastpath_flush_max_bytes
         with lane.txlock:
             lane.txbuf.append(framed)
             lane.txbytes += len(framed)
-            if (defer and cfg.fastpath_flush_max_records > 1
+            if (defer and maxrec > 1
                     and len(lane.inflight) > len(lane.txbuf)
-                    and len(lane.txbuf) < cfg.fastpath_flush_max_records
-                    and lane.txbytes < cfg.fastpath_flush_max_bytes):
+                    and len(lane.txbuf) < maxrec
+                    and lane.txbytes < maxbytes):
                 status = 0
                 kick = len(lane.txbuf) == 1  # arm the linger backstop
             else:
@@ -1851,6 +1917,8 @@ class CoreClient:
 
         from ray_tpu.core import fastpath
 
+        if self.cfg.tunnel_force:
+            return  # bench/test: the tunnel lane owns even local actors
         existing = self._fast_actor_lanes.get(actor_id)
         if existing is not None:
             if not existing.broken and existing.worker.conn is conn:
@@ -1895,6 +1963,153 @@ class CoreClient:
         self._fast_actor_lanes[actor_id] = lane
         self._fast_lanes.append(lane)
         t.start()
+
+    # ------------------------------------- cross-node tunnels (core/tunnel.py)
+    def _tunnel_ok(self) -> bool:
+        return (self.cfg.node_tunnel and self.cfg.fastpath_enabled
+                and not self.client_mode and not self.cfg.tracing_enabled
+                and not self._closed)
+
+    def _tunnel_client(self):
+        if self._tunnels is None:
+            from ray_tpu.core import tunnel as _tunnel
+
+            self._tunnels = _tunnel.TunnelClient(self)
+        return self._tunnels
+
+    def tunnel_stats(self) -> dict:
+        """Tunnel coalescing counters (bench.py tunnel arm, tests);
+        zeros when no tunnel was ever dialed."""
+        if self._tunnels is None:
+            return {"tunnels": 0, "lanes": 0, "tx_frames": 0,
+                    "tx_records": 0, "rx_frames": 0, "rx_records": 0,
+                    "avg_batch": 0.0}
+        return self._tunnels.stats()
+
+    async def _tunnel_actor_attach(self, actor_id: ActorID, conn):
+        """Tunnel lane to a REMOTE actor's worker (the cross-node twin
+        of _fast_actor_attach): actor calls then ride coalesced
+        ring-format frames over the node tunnel instead of per-call
+        pickled RPC specs. Failure is silent — the RPC path serves the
+        actor and the health loop retries the bind."""
+        from types import SimpleNamespace
+
+        from ray_tpu.core import fastpath
+
+        existing = self._fast_actor_lanes.get(actor_id)
+        if existing is not None:
+            if not existing.broken:
+                # live — or RETIRED but still draining: force-breaking a
+                # draining lane would resubmit records the worker is
+                # still executing (double execution); the drain path
+                # closes it and pops the map entry, after which the
+                # health sweep lands back here for a fresh bind
+                return
+            self._fast_break_lane(existing)  # idempotent map cleanup
+        info = self._actor_info.get(actor_id)
+        if info is None or info.get("state") != ALIVE:
+            return
+        same = info.get("node_id") == self.node_id
+        if same and not self.cfg.tunnel_force:
+            return  # same-node: the shm ring lane owns this actor
+        if same:
+            addr = tuple(self.raylet_address)
+        else:
+            nid = info.get("node_id")
+            nid_hex = nid.hex() if hasattr(nid, "hex") else str(nid)
+            addr = await self._node_address(nid_hex)
+            if addr is None:
+                return
+        try:
+            bound = await self._tunnel_client().bind_lane(
+                tuple(addr), kind="actor", actor_id=actor_id.hex())
+        except Exception:
+            log.debug("tunnel actor bind failed", exc_info=True)
+            return
+        if bound is None:
+            return
+        tun, lane_id, ring, methods = bound
+        if (self._actor_conns.get(actor_id) is not conn
+                or self._fast_actor_lanes.get(actor_id) is not None):
+            ring.close_pair()
+            return
+        lane = fastpath.FastLane(
+            ring,
+            SimpleNamespace(conn=conn, fast_lane=None, idle_since=0.0,
+                            queued=0),
+            ("actor", actor_id))
+        lane.methods = methods
+        lane.drain_evt = asyncio.Event()
+        # widened coalescing: one tunnel frame amortizes over far more
+        # records than one ring wake — let bursts pack deeper
+        lane.flush_max_records = self.cfg.fastpath_flush_max_records * 8
+        lane.flush_max_bytes = self.cfg.fastpath_flush_max_bytes * 8
+        tun.register(lane_id, lane, ring)
+        self._fast_actor_lanes[actor_id] = lane
+        self._fast_lanes.append(lane)
+        self._tunnel_actor_seen[actor_id] = tuple(addr)
+
+    async def _tunnel_task_attach(self, key, state, w: _LeasedWorker):
+        """Tunnel lane to a remotely leased task worker (the cross-node
+        twin of _fast_attach): eligible submits then ride "Q"/"R"
+        records over the node tunnel, coalesced by the same txbuf
+        machinery the shm lanes use."""
+        from ray_tpu.core import fastpath
+
+        try:
+            bound = await self._tunnel_client().bind_lane(
+                tuple(w.raylet_address), kind="task",
+                worker_id=w.worker_id)
+        except Exception:
+            log.debug("tunnel task bind failed", exc_info=True)
+            return
+        if bound is None:
+            return
+        tun, lane_id, ring, _ = bound
+        if w not in state.workers or w.fast_lane is not None:
+            ring.close_pair()
+            return
+        lane = fastpath.FastLane(ring, w, key)
+        lane.flush_max_records = self.cfg.fastpath_flush_max_records * 8
+        lane.flush_max_bytes = self.cfg.fastpath_flush_max_bytes * 8
+        tun.register(lane_id, lane, ring)
+        w.fast_lane = lane
+        self._fast_lanes.append(lane)
+
+    def _tunnel_shrink_args(self, args, kwargs):
+        """Descriptor conversion for an oversized tunnel record: every
+        big top-level value (bytes / buffer-backed array) seals into the
+        LOCAL shm arena and its slot ships a (node, oid, nbytes)
+        TunnelArgRef instead — the receiver adopts the set via one
+        batched pull. Returns (args, kwargs, pin refs) or None when
+        nothing here is shrinkable (the call takes the RPC path, which
+        ships payloads through the object plane anyway)."""
+        from ray_tpu.core import fastpath
+
+        cap = self.cfg.tunnel_inline_max
+        pins: list = []
+
+        def conv(v):
+            n = getattr(v, "nbytes", None)
+            if n is None and isinstance(v, (bytes, bytearray, memoryview)):
+                n = len(v)
+            if not isinstance(n, int) or n <= cap:
+                return v
+            try:
+                ref = self.put_value(v, prefer_shm=True)
+            except Exception:
+                return v
+            pins.append(ref)
+            return fastpath.TunnelArgRef(
+                ref.id.binary(), tuple(self.address),
+                self.node_id.binary(), int(n))
+
+        args2 = tuple(conv(a) for a in args)
+        kwargs2 = ({k: conv(v) for k, v in kwargs.items()}
+                   if kwargs else kwargs)
+        if not pins:
+            return None
+        return args2, kwargs2, pins
 
     def actor_call_template(self, actor_id: ActorID, method: str,
                             num_returns, concurrency_group) -> ActorCallTemplate:
@@ -2001,19 +2216,39 @@ class CoreClient:
         # racing retire is caught by _fast_register_and_push under the cv
         seq = next(lane.seq_counter)
         lane.next_seq = seq + 1  # advisory mirror (stats/tests)
+        light = ("actor", actor_id, method, args, kwargs)
+        pins = None
         try:
             rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0, seq)
         except Exception:
             return None  # unpicklable args: RPC path for this call
+        if len(rec) > self.cfg.tunnel_inline_max \
+                and getattr(lane.ring, "tunnel", False):
+            # oversized args do NOT ride the tunnel: seal them locally
+            # and ship (node, oid, nbytes) descriptors; the worker
+            # adopts the set via one batched pull. light keeps the
+            # ORIGINAL args so break-lane recovery replays faithfully.
+            shrunk = self._tunnel_shrink_args(args, kwargs)
+            if shrunk is not None:
+                s_args, s_kwargs, pins = shrunk
+                try:
+                    rec = fastpath.pack_actor_task(
+                        tid, mkey, s_args, s_kwargs, t0, seq)
+                except Exception:
+                    return None
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
             return None  # big args belong in the object store
         gap_ns = now_ns - self._fast_last_submit
         self._fast_last_submit = now_ns
+        if pins:
+            self._tunnel_pins[task_id] = pins
         ref = self._fast_register_and_push(
-            lane, task_id, rec, ("actor", actor_id, method, args, kwargs),
+            lane, task_id, rec, light,
             defer=gap_ns < 2_000_000, t0=t0)
-        if ref is not None:
+        if ref is None:
+            self._tunnel_pins.pop(task_id, None)
+        else:
             metrics.actor_calls.inc()
         return ref
 
@@ -2082,13 +2317,28 @@ class CoreClient:
         mkey = tmpl.mkey if tmpl is not None else b"am:" + method.encode()
         seq = next(lane.seq_counter)
         lane.next_seq = seq + 1
+        pins = None
         try:
             rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0, seq)
         except Exception:
             return None  # unpicklable args: RPC path for this call
+        if len(rec) > self.cfg.tunnel_inline_max \
+                and getattr(lane.ring, "tunnel", False):
+            # cross-node serve payload above the inline cap: descriptor
+            # shipping (see _try_fast_actor_submit)
+            shrunk = self._tunnel_shrink_args(args, kwargs)
+            if shrunk is not None:
+                s_args, s_kwargs, pins = shrunk
+                try:
+                    rec = fastpath.pack_actor_task(
+                        tid, mkey, s_args, s_kwargs, t0, seq)
+                except Exception:
+                    return None
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
             return None  # big args belong in the object store
+        if pins:
+            self._tunnel_pins[task_id] = pins
         oid = ObjectID.for_task_return(task_id, 0)
         fut = self.loop.create_future()
         with self._fast_cv:
@@ -2102,6 +2352,7 @@ class CoreClient:
         if ok is None:
             with self._fast_cv:
                 self._fast_loop_waiters.pop(oid, None)
+            self._tunnel_pins.pop(task_id, None)
             return None
         metrics.actor_calls.inc()
         return task_id, fut
@@ -2302,6 +2553,11 @@ class CoreClient:
                 tid_b, status, payload, stamp, seq = fastpath.unpack_reply(rec)
                 task_id = TaskID(tid_b)
                 light = lane.inflight.pop(task_id, None)
+                if self._tunnel_pins:
+                    # descriptor pins (oversized tunnel args): the reply
+                    # landed, the receiver's pull is over — release the
+                    # sealed copies
+                    self._tunnel_pins.pop(task_id, None)
                 oid = ObjectID.for_task_return(task_id, 0)
                 ent = self._fast_oid_lane.pop(oid, None)
                 if self._fast_loop_waiters:
@@ -2471,13 +2727,16 @@ class CoreClient:
                     entry.packed = payload
                 elif status == fastpath.OK_SHM:
                     entry.in_shm = True
-                    # fast lanes are same-node: the completion record IS
-                    # the location registration for the cache (the GCS
-                    # directory write below stays the source of truth);
-                    # its size payload feeds the task event below
-                    result_bytes[oid] = fastpath.unpack_shm_size(payload)
-                    self._obj_locations.setdefault(oid, set()).add(
-                        self.node_id.binary())
+                    # the completion record IS the location registration
+                    # for the cache (the GCS directory write below stays
+                    # the source of truth): shm-ring lanes are same-node,
+                    # tunnel lanes carry the sealing node in the shm
+                    # descriptor (pack_shm_desc); its size payload feeds
+                    # the task event below
+                    size, holder = fastpath.unpack_shm_desc(payload)
+                    result_bytes[oid] = size
+                    holder = holder or self.node_id.binary()
+                    self._obj_locations.setdefault(oid, set()).add(holder)
                     if light is not None and light[0] not in ("actor",
                                                               "serve"):
                         # shm results can be evicted: keep real lineage
@@ -2491,7 +2750,8 @@ class CoreClient:
                         self._lineage[task_id] = self._fast_light_to_spec(
                             task_id, light, budget)
                         self._lineage_live[task_id] = {oid}
-                    self._bg.spawn(self._register_location(oid), self.loop)
+                    self._bg.spawn(self._register_location(oid, holder),
+                                   self.loop)
                 else:  # ERR
                     try:
                         entry.error = pickle.loads(payload)
@@ -2627,6 +2887,8 @@ class CoreClient:
                 for task_id in leftovers:
                     oid = ObjectID.for_task_return(task_id, 0)
                     self._fast_oid_lane.pop(oid, None)
+                    if self._tunnel_pins:
+                        self._tunnel_pins.pop(task_id, None)
                     fut = self._fast_loop_waiters.pop(oid, None)
                     if fut is not None:
                         # broken mid-flight: fast_actor_await raises
@@ -2676,7 +2938,11 @@ class CoreClient:
 
     async def _fast_health_loop(self):
         """Worker death with an empty loop (nobody mid-RPC to notice):
-        sweep lanes whose worker connection died and recover their tasks."""
+        sweep lanes whose worker connection died and recover their
+        tasks. Doubles as the tunnel-lane revival driver: actors that
+        lost their tunnel lane (tunnel break, raylet restart) re-bind
+        here once the redial lands — until then their calls ride the
+        per-call RPC fallback."""
         while not self._closed:
             await asyncio.sleep(2.0)
             for lane in list(self._fast_lanes):
@@ -2685,6 +2951,16 @@ class CoreClient:
                 w = lane.worker
                 if w.conn is None or w.conn._closed or lane.ring.is_closed(1):
                     self._fast_break_lane(lane)
+            if self._tunnel_ok() and self._tunnel_actor_seen:
+                for actor_id in list(self._tunnel_actor_seen):
+                    if actor_id in self._fast_actor_lanes:
+                        continue
+                    conn = self._actor_conns.get(actor_id)
+                    if conn is None or conn._closed:
+                        continue  # next RPC dial re-attaches anyway
+                    self._bg.spawn(
+                        self._tunnel_actor_attach(actor_id, conn),
+                        self.loop)
 
     def fast_prepass(self, refs, timeout: float | None) -> dict:
         """Blocking wait (user thread) for fast-path refs, resolved straight
@@ -2733,8 +3009,11 @@ class CoreClient:
                 break
             # Single-lane wait: become the reply-ring consumer ourselves —
             # the result then costs one thread wake (worker pump -> us)
-            # instead of three (pump -> sweeper -> cv -> us).
-            if steal_lane is not None and not steal_lane.broken:
+            # instead of three (pump -> sweeper -> cv -> us). Tunnel
+            # lanes have no ring to steal (replies arrive on the loop):
+            # they take the cv wait below, woken per reply batch.
+            if (steal_lane is not None and not steal_lane.broken
+                    and not getattr(steal_lane.ring, "tunnel", False)):
                 steal_lane.user_wants = time.monotonic()
                 if steal_lane.rx_lock.acquire(blocking=False):
                     try:
@@ -3363,11 +3642,18 @@ class CoreClient:
                     if (self.cfg.fastpath_enabled
                             and self.store is not None
                             and payload["language"] == "python"
-                            and pg_hex is None
-                            and tuple(raylet_addr)
-                            == tuple(self.raylet_address)):
-                        self._bg.spawn(
-                            self._fast_attach(key, state, w), self.loop)
+                            and pg_hex is None):
+                        same = (tuple(raylet_addr)
+                                == tuple(self.raylet_address))
+                        if same and not self.cfg.tunnel_force:
+                            self._bg.spawn(
+                                self._fast_attach(key, state, w), self.loop)
+                        elif self._tunnel_ok():
+                            # spilled-back / affinity lease on another
+                            # node: "Q"/"R" records ride the node tunnel
+                            self._bg.spawn(
+                                self._tunnel_task_attach(key, state, w),
+                                self.loop)
                     # arm the idle-return timer NOW: a lease granted after
                     # the backlog drained may never run a task, and the
                     # post-task timer alone would leak it (and its CPUs)
@@ -4166,6 +4452,12 @@ class CoreClient:
         if (self.cfg.fastpath_enabled and self.store is not None
                 and not self.cfg.tracing_enabled):
             self._bg.spawn(self._fast_actor_attach(actor_id, conn), self.loop)
+            if self._tunnel_ok():
+                # remote actor (or tunnel_force): bind a tunnel lane —
+                # the attach itself checks node identity and no-ops for
+                # same-node actors, whose shm ring lane wins
+                self._bg.spawn(self._tunnel_actor_attach(actor_id, conn),
+                               self.loop)
         return conn
 
     async def _refresh_actor(self, actor_id: ActorID):
@@ -4329,6 +4621,11 @@ class CoreClient:
             lane.ring.close(1)
             lane.ring.unlink()
         await self._bg.cancel_all()
+        if self._tunnels is not None:
+            try:
+                await self._tunnels.close()
+            except Exception:
+                log.debug("tunnel close failed", exc_info=True)
         # return all leases
         for key, state in self.sched_keys.items():
             for w in state.workers:

@@ -34,6 +34,16 @@ Anything that doesn't fit — generators, tasks with options, worker death
 mid-flight — falls back to the ordinary RPC path, which stays the single
 source of truth for scheduling semantics.
 
+Cross-node (protocol 2.0), the SAME packed records ride the node tunnel
+(core/tunnel.py): one persistent multiplexed connection per node pair
+carries coalesced frames of these records instead of per-call pickled
+RPC specs, with ``FastLane`` reused verbatim driver-side — a
+:class:`~ray_tpu.core.tunnel.TunnelRing` duck-types the ring face, so
+tx coalescing (txbuf + adaptive defer + linger), seq-matched
+out-of-order replies and break-lane recovery are one code path for shm
+and tunnel lanes. Payloads above ``tunnel_inline_max`` do not ride the
+tunnel: see :class:`TunnelArgRef` and :func:`pack_shm_desc`.
+
 Actor lanes (protocol 1.8) ride the same rings with three extras: records
 carry a per-lane call sequence number, replies echo it, and completions
 may stream back OUT of submission order — async-actor methods execute on
@@ -461,6 +471,50 @@ def unpack_shm_size(payload: bytes) -> int | None:
     return None
 
 
+def pack_shm_desc(size: int, node: bytes) -> bytes:
+    """OK_SHM payload for CROSS-NODE completions (protocol 2.0, tunnel
+    lanes): ``<Q size><16s holder node id>`` — the record itself is the
+    location registration, so the owner primes its cache with the node
+    that actually sealed the result and the later get() pulls straight
+    from it (descriptors, not payloads, ride the tunnel)."""
+    return struct.pack("<Q16s", size, node)
+
+
+def unpack_shm_desc(payload: bytes) -> tuple[int | None, bytes | None]:
+    """-> (size, holder node id | None). Plain size payloads (same-node
+    shm rings, pre-2.0 records) decode with node None."""
+    if len(payload) >= 24:
+        size, node = struct.unpack_from("<Q16s", payload)
+        return size, node
+    if len(payload) >= 8:
+        return struct.unpack_from("<Q", payload)[0], None
+    return None, None
+
+
+class TunnelArgRef:
+    """Descriptor for one oversized tunnel-record argument (protocol
+    2.0): the value was sealed into the SENDER's local shm arena and the
+    record carries only ``(oid, owner address, holder node, nbytes)`` —
+    the receiver adopts the bytes via one batched ``pull_objects`` round
+    trip (core/tunnel.py). The sender pins the minted ref until the
+    call's reply lands, so the sealed copy cannot be freed mid-pull."""
+
+    __slots__ = ("oid", "owner", "node", "nbytes")
+
+    def __init__(self, oid: bytes, owner, node: bytes | None, nbytes: int):
+        self.oid = oid
+        self.owner = tuple(owner) if owner else None
+        self.node = node
+        self.nbytes = nbytes
+
+    def __reduce__(self):
+        return (TunnelArgRef, (self.oid, self.owner, self.node,
+                               self.nbytes))
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"TunnelArgRef({self.oid.hex()[:12]}, {self.nbytes}B)"
+
+
 class FastLane:
     """Driver-side state for one leased worker's ring (submission side).
 
@@ -475,7 +529,8 @@ class FastLane:
                  "return_armed", "rx_lock", "user_wants", "resume_evt",
                  "retired", "txbuf", "txbytes", "txlock", "seq_counter",
                  "next_seq", "done_seq", "ooo_replies", "drain_evt",
-                 "drain_waiters", "methods")
+                 "drain_waiters", "methods", "flush_max_records",
+                 "flush_max_bytes")
 
     def __init__(self, ring: RingPair, worker, key):
         self.ring = ring
@@ -520,6 +575,11 @@ class FastLane:
         self.txbuf: list = []
         self.txbytes = 0
         self.txlock = threading.Lock()
+        # per-lane coalescing caps: None = the config defaults. Tunnel
+        # lanes widen these (a network frame amortizes over far more
+        # records than a same-node ring wake does).
+        self.flush_max_records = None
+        self.flush_max_bytes = None
         # actor lanes: permanent RPC downgrade. Since 1.8 this fires ONLY
         # on a worker-side NEED_SLOW (a method missing from the shipped
         # eligibility table — dynamically added, or a stale table);

@@ -172,10 +172,13 @@ class BundleTxn:
     async def _phase(self, point: str, method: str,
                      items: list[tuple[int, NodeInfo]],
                      into: dict[int, NodeInfo]) -> bool:
-        """Run one 2PC phase over ``items``. Multi-bundle fans out in
-        parallel (the RTTs overlap); a single bundle awaits directly —
-        the gather/Task wrapping costs ~70µs a phase on a small host,
-        most of a 1-bundle PG's create path."""
+        """Run one 2PC phase over ``items``. Bundles GROUP per node and
+        each node's group rides ONE batched RPC (prepare_bundles /
+        commit_bundles — one ledger pass raylet-side) since protocol
+        2.0; distinct nodes still fan out in parallel (the RTTs
+        overlap). A single bundle awaits directly — the gather/Task
+        wrapping costs ~70µs a phase on a small host, most of a
+        1-bundle PG's create path."""
         if len(items) == 1:
             index, node = items[0]
             try:
@@ -184,15 +187,68 @@ class BundleTxn:
                 ok = False
             (into if ok else self.failed)[index] = node
             return not self.failed
-        results = await asyncio.gather(
-            *(self._phase_one(point, method, i, n) for i, n in items),
-            return_exceptions=True)
-        for (index, node), ok in zip(items, results):
-            if ok is True:
-                into[index] = node
+        groups: dict = {}
+        for index, node in items:
+            groups.setdefault(node.node_id, []).append((index, node))
+        coros = []
+        for group in groups.values():
+            if len(group) == 1:
+                coros.append(self._phase_single(point, method, group[0],
+                                                into))
             else:
-                self.failed[index] = node
+                coros.append(self._phase_group(point, method, group, into))
+        if len(coros) == 1:
+            await coros[0]
+        else:
+            await asyncio.gather(*coros)
         return not self.failed
+
+    async def _phase_single(self, point: str, method: str, item, into):
+        index, node = item
+        try:
+            ok = await self._phase_one(point, method, index, node)
+        except Exception:
+            ok = False
+        (into if ok else self.failed)[index] = node
+
+    async def _phase_group(self, point: str, method: str,
+                           group: list, into) -> None:
+        """One node's multi-bundle phase leg: per-bundle chaos verdicts
+        first (an injected fault fails exactly that bundle, the rest
+        still ride the batch), then ONE batched raylet RPC."""
+        node = group[0][1]
+        send: list[int] = []
+        for index, _ in group:
+            if chaos.ENABLED:
+                try:
+                    act = chaos.point(point, pg=self.pg.pg_id.hex()[:12],
+                                      bundle=index,
+                                      node=node.node_id.hex()[:12])
+                except chaos.ChaosError:
+                    self.failed[index] = node
+                    continue
+                if act is not None and act.kind == "drop":
+                    self.failed[index] = node
+                    continue
+            send.append(index)
+        if not send:
+            return
+        try:
+            if method == "prepare_bundle":
+                rs = await self.gcs._node_call(
+                    node, "prepare_bundles",
+                    {"pg_id": self.pg.pg_id,
+                     "bundles": [(i, self.pg.bundles[i]) for i in send]})
+            else:
+                rs = await self.gcs._node_call(
+                    node, "commit_bundles",
+                    {"pg_id": self.pg.pg_id, "indices": send})
+        except Exception:
+            rs = None
+        for pos, index in enumerate(send):
+            ok = bool(rs and pos < len(rs) and rs[pos]
+                      and rs[pos].get("ok"))
+            (into if ok else self.failed)[index] = node
 
     async def prepare(self) -> bool:
         """Parallel phase 1. True iff every bundle reserved."""
@@ -255,6 +311,10 @@ class GcsServer:
         self.named_actors: dict[str, ActorID] = {}
         self.pgs: dict[PlacementGroupID, PlacementGroupInfo] = {}
         self._actor_spread_rr = 0  # SPREAD actor round-robin cursor
+        # per-raylet lease-request coalescer (_schedule_actor): concurrent
+        # actor creations targeting the same node in one loop tick ride
+        # ONE batched lease_workers RPC (one ledger pass raylet-side)
+        self._lease_batches: dict[tuple, list] = {}
         self.job_counter = 0
         self.task_events: list[dict] = []  # ring buffer of task lifecycle events
 
@@ -685,22 +745,20 @@ class GcsServer:
                         return
                     await asyncio.sleep(0.1)  # poll: placement may repair
                     continue
-                # leases go over a per-request connection, NOT the pooled
-                # one: a parked lease request must die with its requester
-                # (the raylet cancels waiters on disconnect)
+                # leases ride the batched lease_workers path (2.0):
+                # concurrent actor creations targeting the same raylet
+                # coalesce into ONE RPC and one ledger pass; the batched
+                # handler never parks (busy replies retry here), so no
+                # cancel-on-disconnect concern remains
                 lease = None
                 try:
-                    conn = await rpc.connect(*node.address)
-                    try:
-                        lease = await conn.call(
-                            "lease_worker",
-                            {"resources": resources,
-                             "for_actor": info.actor_id,
-                             "pg_id": pg_id, "bundle_index": bundle_index},
-                            timeout=max(1.0, deadline - time.monotonic()),
-                        )
-                    finally:
-                        await conn.close()
+                    lease = await self._lease_via_batch(
+                        node,
+                        {"resources": resources,
+                         "for_actor": info.actor_id,
+                         "pg_id": pg_id, "bundle_index": bundle_index},
+                        timeout=max(1.0, deadline - time.monotonic()),
+                    )
                 except (rpc.RpcError, OSError, asyncio.TimeoutError):
                     # chosen raylet died or stalled mid-grant: re-pick —
                     # node death will have updated self.nodes by the time
@@ -743,6 +801,71 @@ class GcsServer:
             info.death_cause = f"actor creation failed: {e!r}"
             await self.publish("actors", info.view())
             await self.publish(f"actor:{info.actor_id.hex()}", info.view())
+
+    async def _lease_via_batch(self, node: "NodeInfo", payload: dict,
+                               timeout: float):
+        """Coalesced actor-lease request: every request targeting the
+        same raylet address queued within one loop tick ships as ONE
+        ``lease_workers`` call (a serve scale-up creating N replicas
+        pays one RPC + one ledger pass instead of N). Goes over a
+        per-batch transient connection, like the old per-request dial."""
+        addr = tuple(node.address)
+        fut = asyncio.get_running_loop().create_future()
+        q = self._lease_batches.setdefault(addr, [])
+        q.append((payload, fut))
+        if len(q) == 1:
+            # flush NEXT tick so same-tick siblings can pile on
+            asyncio.get_running_loop().call_soon(
+                lambda: self._bg.spawn(self._flush_lease_batch(addr)))
+        return await asyncio.wait_for(fut, timeout)
+
+    async def _flush_lease_batch(self, addr: tuple) -> None:
+        batch = self._lease_batches.pop(addr, [])
+        if not batch:
+            return
+        payloads = [p for p, _ in batch]
+        replies = None
+        err: Exception | None = None
+        try:
+            conn = await rpc.connect(*addr, timeout=5)
+            try:
+                replies = await conn.call(
+                    "lease_workers", {"requests": payloads},
+                    timeout=self.cfg.worker_start_timeout_s + 10)
+            finally:
+                await conn.close()
+        except Exception as e:
+            err = e if isinstance(e, Exception) else rpc.RpcError(repr(e))
+        for i, (_, fut) in enumerate(batch):
+            rep = (replies[i] if replies is not None and i < len(replies)
+                   else None)
+            if fut.done():
+                # caller timed out/cancelled while the grant was in
+                # flight: nobody owns this lease now — return it, or the
+                # worker and its allocation leak (actor leases are not
+                # owner_bound, so no disconnect sweep reclaims them)
+                if rep and rep.get("granted"):
+                    self._bg.spawn(self._return_orphan_lease(addr, rep))
+                continue
+            if err is not None or rep is None:
+                fut.set_exception(
+                    err or rpc.RpcError("short lease_workers reply"))
+            else:
+                fut.set_result(rep)
+
+    async def _return_orphan_lease(self, addr: tuple, rep: dict) -> None:
+        """Best-effort return (kill: single-purpose actor worker) of a
+        batched lease whose requester gave up before the grant landed."""
+        try:
+            conn = await rpc.connect(*addr, timeout=5)
+            try:
+                await conn.call("return_lease",
+                                {"lease_id": rep["lease_id"], "kill": True},
+                                timeout=10)
+            finally:
+                await conn.close()
+        except Exception:
+            log.debug("orphan lease return failed", exc_info=True)
 
     def _pick_node(self, resources, pg_id=None, bundle_index=-1,
                    strategy=None) -> NodeInfo | None:

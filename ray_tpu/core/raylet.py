@@ -286,6 +286,12 @@ class Raylet:
         self._active_pulls: dict[ObjectID, asyncio.Future] = {}
         self._pull_admission = asyncio.Semaphore(4)
         self._transfer_pins: dict[tuple, bool] = {}  # (conn, oid) -> pinned
+        # node tunnel (core/tunnel.py): this raylet terminates its node's
+        # end of every driver<->node tunnel and routes record frames to
+        # local workers over cached raylet->worker connections
+        self._tunnel_ids = itertools.count(1)
+        self._tunnel_lanes: dict[int, dict] = {}   # lane -> routing entry
+        self._tunnel_worker_conns: dict[WorkerID, object] = {}
         self._stopping = False
         self._bg = aio.TaskGroup()
         self.memory_monitor = None
@@ -492,6 +498,7 @@ class Raylet:
 
     async def _on_worker_death(self, w: WorkerHandle):
         self.all_workers.pop(w.worker_id, None)
+        self._reap_tunnel_lanes_for_worker(w.worker_id)
         self.cgroups.release_worker(w.worker_id.hex())  # already exited
         if w in self.idle_workers:
             self.idle_workers.remove(w)
@@ -766,6 +773,12 @@ class Raylet:
                 if fut.done() and not fut.cancelled():
                     self._free_resources(resources, pg_key)
                 raise
+        return await self._grant_lease(conn, p, resources, pg_key)
+
+    async def _grant_lease(self, conn, p, resources, pg_key) -> dict:
+        """Shared grant tail (resources already allocated): pop/spawn a
+        worker, stamp the lease, build the reply. On failure the
+        allocation is returned."""
         if conn._closed:
             # requester died between grant and reply: give the slot back
             self._free_resources(resources, pg_key)
@@ -804,6 +817,59 @@ class Raylet:
             "node_id": self.node_id,
             "tpu_chips": tpu_chips,
         }
+
+    async def rpc_lease_workers(self, conn, p):
+        """Batched lease grants (protocol 2.0): allocate every fitting
+        request in ONE ledger pass, then pop/spawn the granted workers in
+        parallel. Non-fitting requests never park (a parked item would
+        hold its whole batch hostage): they reply spillback or
+        ``busy`` and the caller's retry loop (the GCS actor scheduler)
+        re-sends. One reply list, positionally matching ``requests``."""
+        requests = p["requests"]
+        out: list = [None] * len(requests)
+        granted: list = []
+        # one ledger pass: allocation order is batch order
+        for i, req in enumerate(requests):
+            resources = dict(req.get("resources") or {"CPU": 1.0})
+            if chaos.ENABLED:
+                # per-request verdict, absorbed per slot: an injected
+                # `error` must fail THIS request only — raising out of
+                # the handler here would abort batch-mates whose ledger
+                # allocations are already committed (a capacity leak)
+                try:
+                    act = chaos.point("raylet.lease_grant",
+                                      cpus=float(resources.get("CPU", 0.0)),
+                                      batch=len(requests))
+                except chaos.ChaosError as e:
+                    out[i] = {"granted": False, "busy": True,
+                              "error": f"chaos: {e}"}
+                    continue
+                if act is not None and act.kind == "drop":
+                    out[i] = {"granted": False, "busy": True,
+                              "error": "chaos: lease grant dropped"}
+                    continue
+            pg_key = None
+            if req.get("pg_id") is not None:
+                pg_key = (req["pg_id"], req.get("bundle_index", 0))
+            if self._try_allocate(resources, pg_key):
+                granted.append((i, resources, pg_key, req))
+            else:
+                spill = self._pick_spillback(resources, req)
+                out[i] = ({"granted": False, "spill_to": spill}
+                          if spill is not None
+                          else {"granted": False, "busy": True})
+
+        async def grant(i, resources, pg_key, req):
+            try:
+                out[i] = await self._grant_lease(conn, req, resources, pg_key)
+            except Exception as e:
+                out[i] = {"granted": False, "busy": True, "error": repr(e)}
+
+        if len(granted) == 1:
+            await grant(*granted[0])
+        elif granted:
+            await asyncio.gather(*(grant(*g) for g in granted))
+        return out
 
     def _apply_strategy(self, strategy: dict, resources: dict, p: dict):
         """Strategy-directed placement at the lease site (ref: raylet
@@ -928,6 +994,18 @@ class Raylet:
         self._demand_reports.pop(conn, None)
         for key in [k for k in self._transfer_pins if k[0] is conn]:
             self._release_transfer_pin(conn, key[1])
+        # tunnel lanes bound over this (driver) connection die with it;
+        # detach the workers so their lane state frees
+        victims = [(lane, ent) for lane, ent in self._tunnel_lanes.items()
+                   if ent["client"] is conn]
+        by_worker: dict[int, tuple] = {}
+        for lane, ent in victims:
+            self._tunnel_lanes.pop(lane, None)
+            if not ent["wconn"]._closed:
+                by_worker.setdefault(id(ent["wconn"]),
+                                     (ent["wconn"], []))[1].append(lane)
+        self._tunnel_send_grouped(by_worker, "tunnel_detach", "lanes")
+        # a failed send means the worker is gone too
         for resources, fut, pg_key, waiter_conn in self._lease_waiters:
             if waiter_conn is conn and not fut.done():
                 fut.cancel()
@@ -1011,6 +1089,19 @@ class Raylet:
 
     async def rpc_commit_bundle(self, conn, p):
         return {"ok": self.ledger.commit_bundle((p["pg_id"], p["bundle_index"]))}
+
+    async def rpc_prepare_bundles(self, conn, p):
+        """Batched 2PC phase 1 (protocol 2.0): every bundle this node
+        hosts for one PG reserves in a single ledger pass — one RPC per
+        node per phase instead of one per bundle. Per-bundle outcomes so
+        the GCS repairs exactly what failed."""
+        return [{"ok": self.ledger.prepare_bundle((p["pg_id"], idx), res)}
+                for idx, res in p["bundles"]]
+
+    async def rpc_commit_bundles(self, conn, p):
+        """Batched 2PC phase 2 — the commit twin of prepare_bundles."""
+        return [{"ok": self.ledger.commit_bundle((p["pg_id"], idx))}
+                for idx in p["indices"]]
 
     async def rpc_return_bundle(self, conn, p):
         self.ledger.return_bundle((p["pg_id"], p["bundle_index"]))
@@ -1333,6 +1424,196 @@ class Raylet:
             if ex2 is not None:
                 ex2.shutdown(wait=False)
 
+    # --------------------------------------------- node tunnel (core/tunnel.py)
+    def _find_tunnel_worker(self, p) -> "WorkerHandle | None":
+        """Resolve a bind target: explicit worker id, or the worker
+        hosting the named actor (actor leases stamp w.actor_id)."""
+        wid = p.get("worker_id")
+        if wid is not None:
+            return self.all_workers.get(WorkerID.from_hex(wid))
+        aid = p.get("actor_id")
+        if aid is None:
+            return None
+        for w in self.all_workers.values():
+            wa = w.actor_id
+            if wa is None:
+                continue
+            wa_hex = wa.hex() if hasattr(wa, "hex") else str(wa)
+            if wa_hex == aid:
+                return w
+        return None
+
+    async def _tunnel_worker_conn(self, w: "WorkerHandle"):
+        """Cached persistent raylet->worker connection for tunnel
+        traffic (one per worker, shared by every lane bound on it)."""
+        conn = self._tunnel_worker_conns.get(w.worker_id)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = await rpc.connect(*w.address, timeout=5)
+        conn.on_message = self._on_tunnel_worker_push
+        self._tunnel_worker_conns[w.worker_id] = conn
+        return conn
+
+    async def rpc_tunnel_bind(self, conn, p):
+        """Bind one tunnel lane: remote driver -> (this raylet) -> local
+        worker (protocol 2.0). The reply carries the raylet-assigned lane
+        id and, for actor lanes, the worker's method eligibility table.
+        The lane lives until the driver detaches, the driver's tunnel
+        connection drops, or the worker dies (-> tunnel_down push)."""
+        w = self._find_tunnel_worker(p)
+        if w is None or w.address is None or w.proc.poll() is not None:
+            return {"ok": False, "error": "no such worker"}
+        try:
+            wconn = await self._tunnel_worker_conn(w)
+            lane = next(self._tunnel_ids)
+            reply = await wconn.call(
+                "tunnel_attach", {"lane": lane, "kind": p.get("kind", "task")},
+                timeout=10)
+        except (rpc.RpcError, OSError, asyncio.TimeoutError):
+            return {"ok": False, "error": "worker unreachable"}
+        if not isinstance(reply, dict) or not reply.get("ok"):
+            return {"ok": False, "error": "worker refused"}
+        self._tunnel_lanes[lane] = {
+            "client": conn, "worker": w.worker_id, "wconn": wconn,
+        }
+        return {"ok": True, "lane": lane, "methods": reply.get("methods")}
+
+    @staticmethod
+    def _tunnel_send_grouped(groups: dict, method: str, key: str) -> list:
+        """One tunnel notify per connection. ``groups``: id(conn) ->
+        (conn, items); the payload is ``{key: items}``. Returns the
+        items of every connection whose send failed (dead link) so the
+        caller can reap/bounce exactly those — the one shared shape
+        behind every tunnel fan-out below."""
+        failed: list = []
+        for conn, items in groups.values():
+            try:
+                conn.send_nowait({"k": "n", "m": method, "p": {key: items}})
+            except (rpc.ConnectionLost, OSError):
+                failed.extend(items)
+        return failed
+
+    async def rpc_tunnel_frame(self, conn, p):
+        """Forward one driver frame's per-lane record chunks to their
+        workers (notify; no reply). Forwarding is synchronous within the
+        handler so frame order per lane is preserved end to end —
+        dispatch order is the caller's FIFO invariant. Lanes this raylet
+        does not know (worker died, stale bind) bounce back as a
+        tunnel_down push so the driver breaks exactly those lanes."""
+        by_worker: dict[int, tuple] = {}
+        dead: list = []
+        for lane, recs in p["frames"]:
+            ent = self._tunnel_lanes.get(lane)
+            if ent is None or ent["client"] is not conn:
+                dead.append(lane)
+                continue
+            wconn = ent["wconn"]
+            if wconn._closed:
+                dead.append(lane)
+                self._tunnel_lanes.pop(lane, None)
+                continue
+            by_worker.setdefault(id(wconn), (wconn, []))[1].append(
+                (lane, recs))
+        for lane, _ in self._tunnel_send_grouped(
+                by_worker, "tunnel_records", "frames"):
+            dead.append(lane)
+            self._tunnel_lanes.pop(lane, None)
+        if dead:
+            self._tunnel_send_grouped(
+                {0: (conn, dead)}, "tunnel_down", "lanes")
+            # driver gone too: its health sweep owns the break
+
+    async def rpc_tunnel_detach(self, conn, p):
+        """Driver closed lanes (notify): reap routing entries and tell
+        the workers so their lane state frees."""
+        by_worker: dict[int, tuple] = {}
+        for lane in p.get("lanes", ()):
+            ent = self._tunnel_lanes.pop(lane, None)
+            if ent is None or ent["wconn"]._closed:
+                continue
+            by_worker.setdefault(id(ent["wconn"]),
+                                 (ent["wconn"], []))[1].append(lane)
+        self._tunnel_send_grouped(by_worker, "tunnel_detach", "lanes")
+        # a failed send means the worker is gone: lane state died with it
+
+    def _on_tunnel_worker_push(self, msg):
+        """Reply frames from a worker: forward each lane's records to
+        the driver that bound the lane, coalesced per client connection."""
+        if msg.get("m") != "tunnel_replies":
+            return
+        by_client: dict[int, tuple] = {}
+        for lane, recs in msg["p"]["frames"]:
+            ent = self._tunnel_lanes.get(lane)
+            if ent is None:
+                continue
+            by_client.setdefault(id(ent["client"]),
+                                 (ent["client"], []))[1].append((lane, recs))
+        for lane, _ in self._tunnel_send_grouped(
+                by_client, "tunnel_frame", "frames"):
+            # driver gone: drop its lanes; workers are detached by the
+            # disconnect sweep
+            self._tunnel_lanes.pop(lane, None)
+
+    def _reap_tunnel_lanes_for_worker(self, worker_id: WorkerID):
+        """Worker died: push tunnel_down for its lanes so every bound
+        driver breaks them (per-call RPC fallback + revival later)."""
+        self._tunnel_worker_conns.pop(worker_id, None)
+        victims = [(lane, ent) for lane, ent in self._tunnel_lanes.items()
+                   if ent["worker"] == worker_id]
+        by_client: dict[int, tuple] = {}
+        for lane, ent in victims:
+            self._tunnel_lanes.pop(lane, None)
+            by_client.setdefault(id(ent["client"]),
+                                 (ent["client"], []))[1].append(lane)
+        self._tunnel_send_grouped(by_client, "tunnel_down", "lanes")
+        # a failed send means the driver is gone: nothing left to tell
+
+    async def rpc_pull_objects(self, conn, p):
+        """Batched multi-object pull (protocol 2.0): one round trip
+        fetches a whole arg/KV-manifest set into the local store. Hinted
+        objects skip the directory entirely; the UNHINTED miss-set costs
+        exactly ONE ``kv_multi_get`` (not one directory lookup per oid —
+        PR 3's completion-time priming, extended to the raylet path).
+        Returns {oid hex: bool}."""
+        out: dict[str, bool] = {}
+        todo: list = []
+        for item in p["objects"]:
+            oid = ObjectID(item["object_id"])
+            if self.store.contains(oid):
+                out[oid.hex()] = True
+                continue
+            todo.append((oid, set(item.get("holders_hint") or ())))
+        if not todo:
+            return out
+        no_hint = [oid for oid, hint in todo if not hint]
+        primed: dict[ObjectID, set] = {}
+        if no_hint:
+            try:
+                blobs = await self.gcs.call(
+                    "kv_multi_get",
+                    {"ns": "obj_loc", "keys": [o.hex() for o in no_hint]})
+            except (rpc.RpcError, OSError):
+                blobs = None
+            for oid in no_hint:
+                blob = (blobs or {}).get(oid.hex())
+                if blob:
+                    try:
+                        primed[oid] = set(pickle.loads(blob))
+                    except (pickle.UnpicklingError, TypeError, EOFError):
+                        pass  # torn directory blob: a cache miss
+
+        async def one(oid: ObjectID, hint: set) -> bool:
+            holders = hint | primed.get(oid, set())
+            if not holders and oid not in self._spilled:
+                return False  # nowhere to pull from, nothing spilled
+            return await self._pull_one_dedup(oid, sorted(holders))
+
+        results = await asyncio.gather(
+            *(one(oid, hint) for oid, hint in todo), return_exceptions=True)
+        for (oid, _), ok in zip(todo, results):
+            out[oid.hex()] = ok is True
+        return out
+
     async def rpc_pull_object(self, conn, p):
         """Pull an object into the local store from whichever node holds it.
         The caller may pass ``holders_hint`` (node ids from its
@@ -1343,6 +1624,12 @@ class Raylet:
         the same object coalesce onto one transfer (ref: pull_manager.h:49
         request dedup + admission control)."""
         oid = ObjectID(p["object_id"])
+        return await self._pull_one_dedup(oid, p.get("holders_hint"))
+
+    async def _pull_one_dedup(self, oid: ObjectID, holders_hint=None) -> bool:
+        """Dedup'd single-object pull: concurrent pulls of the same oid
+        (including batch-mates from pull_objects) coalesce onto one
+        transfer."""
         if self.store.contains(oid):
             return True
         if oid in self._spilled:  # restore beats a network pull
@@ -1354,7 +1641,7 @@ class Raylet:
         fut = asyncio.get_running_loop().create_future()
         self._active_pulls[oid] = fut
         try:
-            ok = await self._pull_object(oid, p.get("holders_hint"))
+            ok = await self._pull_object(oid, holders_hint)
             fut.set_result(ok)
             return ok
         except Exception as e:
@@ -1606,6 +1893,13 @@ class Raylet:
                 self.cfg.temp_dir, f"session_{self.session}", "rec"))
         except OSError:
             pass
+        for wconn in list(self._tunnel_worker_conns.values()):
+            try:
+                await wconn.close()
+            except Exception:
+                log.debug("tunnel worker conn close failed", exc_info=True)
+        self._tunnel_worker_conns.clear()
+        self._tunnel_lanes.clear()
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()

@@ -77,6 +77,11 @@ class Worker:
         self._group_sems: dict = {}
         # fast-path rings attached by drivers (see core/fastpath.py)
         self._fast_rings: list = []
+        # node-tunnel lanes attached through the raylet (core/tunnel.py):
+        # lane id -> state dict; records arrive as rpc_tunnel_records
+        # frames and replies coalesce back per loop tick
+        self._tunnel_lanes: dict[int, dict] = {}
+        self._tunnel_tasks: set = set()  # strong holds on dispatched execs
         # cached connections to drivers for result-ring spill (rpc_fast_result)
         self._spill_conns: dict[tuple, object] = {}
         # one-task-per-worker guard for NORMAL tasks: ring-pump inline
@@ -219,18 +224,29 @@ class Worker:
 
     async def _fetch_args(self, packed_args):
         out = []
+        ref_slots: list[int] = []
+        refs: list[ObjectRef] = []
         for a in packed_args:
             tag = a[0]
             if tag == "p":  # plain value
                 out.append(a[1])
             elif tag == "v":  # inlined serialized value
                 out.append(serialization.unpack(a[1]))
-            elif tag == "r":  # ref descriptor: fetch
+            elif tag == "r":  # ref descriptor: fetch (batched below)
                 oid = ObjectID(a[1])
-                ref = ObjectRef(oid, tuple(a[2]) if a[2] else None)
-                out.append(await self.core._get_one(ref, None))
+                ref_slots.append(len(out))
+                refs.append(ObjectRef(oid, tuple(a[2]) if a[2] else None))
+                out.append(None)
             else:
                 raise TaskError(f"bad arg tag {tag!r}")
+        if refs:
+            # one batched get over every ref arg: location priming and
+            # the raylet pull coalesce across the whole set (one
+            # pull_objects round trip for a multi-arg fetch) instead of
+            # one directory lookup + pull RPC per argument
+            vals = await self.core.get_async(refs, None)
+            for slot, v in zip(ref_slots, vals):
+                out[slot] = v
         return out
 
     async def _store_results(self, task_id, num_returns, values) -> list[dict]:
@@ -699,7 +715,7 @@ class Worker:
             stamp = b""
         rep = self._fast_pack_result(
             tid, ok, val, self.cfg.fastpath_inline_result_max, stamp,
-            seq=seq)
+            seq=seq, node=getattr(ring, "_desc_node", None))
         await self._fast_reply_one(ring, rep)
 
     async def _fast_reply_one(self, ring, rec: bytes):
@@ -731,6 +747,369 @@ class Worker:
                         log.debug("ooo result spill failed", exc_info=True)
                 deadline = loop.time() + 0.1
             await asyncio.sleep(0.002)
+
+    # -------------------------------------------- node tunnel (core/tunnel.py)
+    async def rpc_tunnel_attach(self, conn, p):
+        """The local raylet binds one tunnel lane onto this worker on
+        behalf of a remote driver (protocol 2.0). Records arrive as
+        ``tunnel_records`` frames — the SAME packed records the shm
+        rings carry — and replies coalesce back per loop tick through a
+        :class:`_TunnelSink`. Actor lanes ship the method eligibility
+        table exactly like ``attach_fast_ring`` does."""
+        lane = int(p["lane"])
+        st = {"lane": lane, "kind": p.get("kind", "task"), "conn": conn,
+              "downgraded": False, "reply_buf": [], "reply_armed": False,
+              "closed": False}
+        st["sink"] = _TunnelSink(self, st)
+        if st["kind"] == "actor":
+            # same verdict as attach_fast_ring: a pure-sync serial actor
+            # executes whole record batches INLINE on its executor thread
+            # (one handoff per batch, not two per call); async/threaded/
+            # grouped actors dispatch per record and reply out of order
+            table = getattr(self, "_actor_method_table", None)
+            st["dispatch_only"] = (
+                getattr(self, "_actor_max_concurrency", 1) > 1
+                or bool(self._group_execs)
+                or any(v[0] == "async" for v in (table or {}).values()))
+            self._tunnel_lanes[lane] = st
+            return {"ok": True, "methods": table}
+        self._tunnel_lanes[lane] = st
+        return {"ok": True}
+
+    async def rpc_tunnel_detach(self, conn, p):
+        for lane in p.get("lanes", ()):
+            st = self._tunnel_lanes.pop(lane, None)
+            if st is not None:
+                st["closed"] = True
+        return True
+
+    async def rpc_tunnel_records(self, conn, p):
+        """One tunnel frame's records for this worker (notify). Records
+        are dispatched in frame order — dispatch order IS the caller's
+        FIFO invariant, completion order is not (each call replies as it
+        finishes, seq-matched driver-side like ring completions).
+
+        Batch execution mirrors the ring pump's economics: a pure-sync
+        serial actor's batch (and any task-record batch) runs in ONE
+        executor hop and replies as one coalesced frame — per-record
+        thread handoffs were most of the tunnel's worker-side cost.
+        Records that need the loop (async/grouped methods, descriptor
+        args) dispatch per record instead."""
+        from ray_tpu.core import fastpath
+
+        loop = asyncio.get_running_loop()
+        t_pop = time.perf_counter_ns()
+        for lane, recs_b in p["frames"]:
+            st = self._tunnel_lanes.get(lane)
+            if st is None:
+                continue
+            st["conn"] = conn  # reply on the conn the records rode in on
+            recs = fastpath.unframe(recs_b)
+            if st["kind"] == "task":
+                try:
+                    self.executor.submit(self._tunnel_exec_task_batch,
+                                         st, recs, t_pop)
+                except RuntimeError:
+                    return  # executor shut down (worker exit)
+                continue
+            if not st.get("dispatch_only") and not st["downgraded"]:
+                chain = st.get("seq_chain")
+                if chain is not None and chain.done():
+                    chain = st["seq_chain"] = None
+                if chain is None \
+                        and not any(self._rec_has_desc(r) for r in recs):
+                    try:
+                        self.executor.submit(self._tunnel_exec_batch_sync,
+                                             st, recs, t_pop)
+                    except RuntimeError:
+                        return
+                else:
+                    # descriptor args force the loop's batched pull; a
+                    # serial actor's records still run strictly in
+                    # order — and so must every LATER frame while the
+                    # chain drains (a plain batch hopping straight to
+                    # the executor would overtake a record awaiting its
+                    # pull), so frames append to the chain until it
+                    # empties
+                    t = loop.create_task(
+                        self._tunnel_exec_seq(st, chain, recs, t_pop))
+                    st["seq_chain"] = t
+                    self._tunnel_tasks.add(t)
+                    t.add_done_callback(self._tunnel_tasks.discard)
+                continue
+            for rec in recs:
+                t = loop.create_task(self._tunnel_exec_one(st, rec, t_pop))
+                self._tunnel_tasks.add(t)
+                t.add_done_callback(self._tunnel_tasks.discard)
+
+    async def _tunnel_exec_seq(self, st, prev, recs, t_pop: int):
+        """Sequential batch leg for a SERIAL actor's records when some
+        carry descriptors: each record completes before the next
+        dispatches (and after the previous chained frame), preserving
+        the per-caller FIFO the serial executor would otherwise
+        provide."""
+        if prev is not None:
+            try:
+                await asyncio.shield(prev)
+            except Exception:
+                # the prior frame already replied its own errors; this
+                # await exists only for ordering
+                log.debug("chained tunnel frame failed", exc_info=True)
+        for rec in recs:
+            await self._tunnel_exec_one(st, rec, t_pop)
+
+    @staticmethod
+    def _tunnel_t_sub(t_sub: int, t_pop: int) -> int:
+        """Cross-host stamp guard: tunnel records may carry a submit
+        stamp from a DIFFERENT host's CLOCK_MONOTONIC base. When the
+        delta is implausible (>5 min) the stamp drops so stage samples
+        degrade to exec-only truth instead of clamped garbage;
+        same-host tunnels (one-host multi-raylet, in-process clusters)
+        keep exact stamps."""
+        return (t_sub if t_sub and abs(t_pop - t_sub) < 300_000_000_000
+                else 0)
+
+    @staticmethod
+    def _rec_has_desc(rec: bytes) -> bool:
+        """Cheap pre-check: only serialization.pack records ("C") can
+        carry TunnelArgRef descriptors — C-pickled "A" bodies are simple
+        immutables by construction."""
+        return rec[:1] == b"C" and b"TunnelArgRef" in rec
+
+    def _tunnel_exec_batch_sync(self, st, recs, t_pop: int):
+        """One tunnel batch of a pure-sync serial actor, ON the actor's
+        executor thread (the ring pump's inline shape: zero per-call
+        handoffs, state affinity identical to the RPC path). Replies
+        push as ONE coalesced frame."""
+        from ray_tpu.core import fastpath
+
+        inline_max = self.cfg.fastpath_inline_result_max
+        inst = self.actor_instance
+        node = self.node_id.binary()
+        replies = []
+        t_prev = time.perf_counter_ns()
+        for rec in recs:
+            tid, mkey, args, kwargs, t_sub, seq = \
+                fastpath.unpack_actor_task(rec)
+            t_sub = self._tunnel_t_sub(t_sub, t_pop)
+            mname = mkey[3:].decode()
+            verdict = None if st["downgraded"] or inst is None \
+                else self._actor_fast_verdict(mname)
+            if verdict is None or verdict[0] != "sync" or verdict[1]:
+                st["downgraded"] = True
+                replies.append(fastpath.pack_reply(
+                    tid, fastpath.NEED_SLOW, b"", seq=seq))
+                t_prev = time.perf_counter_ns()
+                continue
+            t_x0 = time.perf_counter_ns()
+            try:
+                if chaos.ENABLED:
+                    chaos.point("worker.exec", name=mname, fast=1)
+                m = getattr(inst, mname)
+                ok, val = True, m(*args, **(kwargs or {}))
+            except BaseException as e:  # noqa: BLE001 — reply on
+                ok, val = False, e
+            t_x1 = time.perf_counter_ns()
+            stamp = (fastpath.pack_stamp(max(0, t_pop - t_sub),
+                                         max(0, t_x0 - t_prev),
+                                         t_x1 - t_x0)
+                     if t_sub else b"")
+            t_prev = t_x1
+            replies.append(self._fast_pack_result(
+                tid, ok, val, inline_max, stamp, seq=seq, node=node))
+        if replies:
+            st["sink"].push_batch(fastpath.REP, fastpath.frame(replies))
+
+    def _tunnel_exec_task_batch(self, st, recs, t_pop: int):
+        """One tunnel batch of plain task records, ON the task executor
+        thread (records with descriptor args bounce to the loop path for
+        their async batched pull). Functions resolve through a local
+        cache; a miss bridges to the loop like the ring pump's loader."""
+        from ray_tpu.core import fastpath
+
+        inline_max = self.cfg.fastpath_inline_result_max
+        node = self.node_id.binary()
+        cache = getattr(self, "_tunnel_funcs", None)
+        if cache is None:
+            cache = self._tunnel_funcs = {}
+        loop = self.core.loop
+        replies = []
+        t_prev = t_pop  # rolling: each record's deser starts where the
+        #                 previous one ended, not at the frame pop (the
+        #                 ring pump's accounting — billing the whole
+        #                 batch's earlier exec to later records' deser
+        #                 would inflate deser p99 ~N-fold under burst)
+        for rec in recs:
+            if self._rec_has_desc(rec):
+                # descriptor args need the loop's batched pull
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._tunnel_exec_record_on_loop(st, rec, t_pop),
+                        loop)
+                except RuntimeError:
+                    return
+                t_prev = time.perf_counter_ns()
+                continue
+            tid, func_id, args, kwargs, t_sub = fastpath.unpack_task(rec)
+            t_sub = self._tunnel_t_sub(t_sub, t_pop)
+            fn = cache.get(func_id)
+            if fn is None:
+                try:
+                    fut = asyncio.run_coroutine_threadsafe(
+                        self._load_function(func_id), loop)
+                    fn = fut.result(15)
+                    cache[func_id] = fn  # only successes cache: a
+                    # transient load failure must not downgrade the
+                    # function to the RPC path for this worker's lifetime
+                except Exception:
+                    fn = None
+            if (fn is None or inspect.iscoroutinefunction(fn)
+                    or inspect.isgeneratorfunction(fn)
+                    or inspect.isasyncgenfunction(fn)):
+                replies.append(fastpath.pack_reply(
+                    tid, fastpath.NEED_SLOW, b""))
+                t_prev = time.perf_counter_ns()
+                continue
+            t_x0 = time.perf_counter_ns()
+            try:
+                with self._exec_mutex:
+                    if chaos.ENABLED:
+                        chaos.point("worker.exec",
+                                    name=getattr(fn, "__name__", "task"),
+                                    fast=1)
+                    ok, val = True, fn(*args, **(kwargs or {}))
+            except BaseException as e:  # noqa: BLE001 — reply on
+                ok, val = False, e
+            t_x1 = time.perf_counter_ns()
+            stamp = (fastpath.pack_stamp(max(0, t_pop - t_sub),
+                                         max(0, t_x0 - t_prev),
+                                         t_x1 - t_x0)
+                     if t_sub else b"")
+            t_prev = t_x1
+            replies.append(self._fast_pack_result(
+                tid, ok, val, inline_max, stamp, node=node))
+        if replies:
+            st["sink"].push_batch(fastpath.REP, fastpath.frame(replies))
+
+    async def _tunnel_exec_record_on_loop(self, st, rec: bytes,
+                                          t_pop: int):
+        """Loop-side hand-off for a task record the executor batch could
+        not run inline (descriptor args)."""
+        t = asyncio.get_running_loop().create_task(
+            self._tunnel_exec_one(st, rec, t_pop))
+        self._tunnel_tasks.add(t)
+        t.add_done_callback(self._tunnel_tasks.discard)
+
+    async def _resolve_tunnel_descs(self, args, kwargs):
+        """Adopt TunnelArgRef descriptors (oversized args the sender
+        sealed into ITS shm arena): ONE batched pull_objects round trip
+        through the local raylet for the whole set, then the values read
+        out of local shm. The sender pins the sealed copies until this
+        call's reply lands, so the pull can't race the free."""
+        from ray_tpu.core import fastpath
+
+        descs = [a for a in args if isinstance(a, fastpath.TunnelArgRef)]
+        if kwargs:
+            descs += [v for v in kwargs.values()
+                      if isinstance(v, fastpath.TunnelArgRef)]
+        if not descs:
+            return args, kwargs
+        hints = {}
+        for d in descs:
+            hints.setdefault(ObjectID(d.oid), set()).add(d.node)
+        await self.core.pull_objects_batch(hints)
+        refs = {d.oid: ObjectRef(ObjectID(d.oid), d.owner) for d in descs}
+        order = list(refs)
+        vals = await self.core.get_async([refs[o] for o in order], None)
+        got = dict(zip(order, vals))
+        args = tuple(got[a.oid] if isinstance(a, fastpath.TunnelArgRef)
+                     else a for a in args)
+        if kwargs:
+            kwargs = {k: got[v.oid]
+                      if isinstance(v, fastpath.TunnelArgRef) else v
+                      for k, v in kwargs.items()}
+        return args, kwargs
+
+    async def _tunnel_exec_one(self, st, rec: bytes, t_pop: int):
+        """Execute one tunnel record and push its reply through the
+        lane's sink. Actor records ride the exact dispatch path ring
+        records do (_fast_exec_dispatched: async methods on the loop,
+        sync methods on the actor's executor/group pool); task records
+        execute on the task executor under the one-task mutex."""
+        from ray_tpu.core import fastpath
+
+        sink = st["sink"]
+        if st["kind"] == "actor":
+            tid, mkey, args, kwargs, t_sub, seq = \
+                fastpath.unpack_actor_task(rec)
+            t_sub = self._tunnel_t_sub(t_sub, t_pop)
+            mname = mkey[3:].decode()
+            verdict = None
+            if not st["downgraded"] and self.actor_instance is not None:
+                verdict = self._actor_fast_verdict(mname)
+            if verdict is None or verdict[0] == "gen":
+                # sticky, like the ring pump: executing later records
+                # while an earlier one replays over RPC would reorder
+                # the caller's calls
+                st["downgraded"] = True
+                await self._fast_reply_one(sink, fastpath.pack_reply(
+                    tid, fastpath.NEED_SLOW, b"", seq=seq))
+                return
+            try:
+                args, kwargs = await self._resolve_tunnel_descs(args, kwargs)
+            except Exception as e:
+                await self._fast_reply_one(sink, fastpath.pack_reply(
+                    tid, fastpath.ERR, self._fast_pack_error(e), seq=seq))
+                return
+            await self._fast_exec_dispatched(
+                sink, tid, mname, verdict[0], verdict[1], args, kwargs,
+                t_sub, t_pop, seq)
+            return
+        # plain task record ("Q"/"R"/"P"/"S")
+        tid, func_id, args, kwargs, t_sub = fastpath.unpack_task(rec)
+        t_sub = self._tunnel_t_sub(t_sub, t_pop)
+        try:
+            fn = await self._load_function(func_id)
+        except Exception:
+            fn = None
+        if (fn is None or inspect.iscoroutinefunction(fn)
+                or inspect.isgeneratorfunction(fn)
+                or inspect.isasyncgenfunction(fn)):
+            # not fast-executable here: the driver resubmits over RPC
+            # with the full budget (NEED_SLOW is a migration, not a loss)
+            await self._fast_reply_one(sink, fastpath.pack_reply(
+                tid, fastpath.NEED_SLOW, b""))
+            return
+        try:
+            args, kwargs = await self._resolve_tunnel_descs(args, kwargs)
+        except Exception as e:
+            await self._fast_reply_one(sink, fastpath.pack_reply(
+                tid, fastpath.ERR, self._fast_pack_error(e)))
+            return
+
+        def run():
+            # one-task-per-worker, same as the ring pump's inline exec
+            with self._exec_mutex:
+                if chaos.ENABLED:
+                    chaos.point("worker.exec",
+                                name=getattr(fn, "__name__", "task"),
+                                fast=1)
+                return fn(*args, **(kwargs or {}))
+
+        t_x0 = time.perf_counter_ns()
+        try:
+            val = await self.core.loop.run_in_executor(self.executor, run)
+            ok = True
+        except BaseException as e:  # noqa: BLE001 — reply on
+            ok, val = False, e
+        t_x1 = time.perf_counter_ns()
+        stamp = (fastpath.pack_stamp(max(0, t_pop - t_sub),
+                                     max(0, t_x0 - t_pop), t_x1 - t_x0)
+                 if t_sub else b"")
+        rep = self._fast_pack_result(
+            tid, ok, val, self.cfg.fastpath_inline_result_max, stamp,
+            node=self.node_id.binary())
+        await self._fast_reply_one(sink, rep)
 
     def _fast_actor_pump_cycle(self, ring, state: dict):
         """ONE pump cycle, ON the actor's single executor thread: pop a
@@ -982,7 +1361,8 @@ class Worker:
     _FAST_ERR_MAX = 256 * 1024
 
     def _fast_pack_result(self, tid: bytes, ok: bool, val, inline_max: int,
-                          stamp: bytes = b"", seq: int | None = None):
+                          stamp: bytes = b"", seq: int | None = None,
+                          node: bytes | None = None):
         from ray_tpu.core import fastpath
 
         if not ok:
@@ -1003,10 +1383,13 @@ class Worker:
             if not self.core.store.contains(oid):  # retry may have stored it
                 self.core.store.put_raw(oid, payload)
             # size rides in the record: the owner's location cache is
-            # primed at completion time, no directory round-trip on get
-            return fastpath.pack_reply(tid, fastpath.OK_SHM,
-                                       fastpath.pack_shm_size(size), stamp,
-                                       seq)
+            # primed at completion time, no directory round-trip on get.
+            # Tunnel lanes (cross-node owner) additionally carry the
+            # sealing node id — the record IS the location registration
+            return fastpath.pack_reply(
+                tid, fastpath.OK_SHM,
+                fastpath.pack_shm_desc(size, node) if node is not None
+                else fastpath.pack_shm_size(size), stamp, seq)
         except Exception as e:
             return fastpath.pack_reply(tid, fastpath.ERR,
                                        self._fast_pack_error(e), stamp, seq)
@@ -1839,6 +2222,75 @@ class Worker:
 
     async def rpc_ping(self, conn, p):
         return {"pid": os.getpid(), "actor": self.actor_id}
+
+
+class _TunnelSink:
+    """Reply-side face of one worker tunnel lane: duck-types the reply
+    half of a ring for ``_fast_reply_one``/``_fast_pack_result`` — framed
+    completion records buffer here (any thread: the loop's dispatched
+    execs AND the executor's inline batches) and every reply landing in
+    the same loop tick coalesces into ONE ``tunnel_replies`` notify back
+    through the raylet (the worker-side half of the tunnel's frame
+    coalescing). ``_desc_node`` makes OK_SHM results carry this node's
+    id (the cross-node location descriptor)."""
+
+    __slots__ = ("_w", "_st", "_desc_node", "_lock")
+
+    def __init__(self, worker: "Worker", st: dict):
+        import threading as _threading
+
+        self._w = worker
+        self._st = st
+        self._desc_node = worker.node_id.binary()
+        self._lock = _threading.Lock()
+
+    def push_batch(self, which: int, framed: bytes, timeout_ms: int = 0) -> int:
+        st = self._st
+        if st.get("closed"):
+            return -7  # closed: the driver's break-lane recovery owns it
+        with self._lock:
+            st["reply_buf"].append(bytes(framed))
+            arm = not st["reply_armed"]
+            if arm:
+                st["reply_armed"] = True
+        if arm:
+            loop = self._w.core.loop
+            try:
+                import threading as _threading
+
+                if _threading.get_ident() == getattr(loop, "_thread_id",
+                                                     None):
+                    loop.call_soon(self._flush)
+                else:
+                    loop.call_soon_threadsafe(self._flush)
+            except RuntimeError:
+                return -7  # loop gone (worker exit)
+        return len(framed)
+
+    def push_raw(self, which: int, framed: bytes, timeout_ms: int = -1) -> int:
+        return 0 if self.push_batch(which, framed, timeout_ms) >= 0 else -7
+
+    def _flush(self):
+        st = self._st
+        with self._lock:
+            buf = st["reply_buf"]
+            if not buf:
+                st["reply_armed"] = False
+                return
+            st["reply_buf"] = []
+        data = buf[0] if len(buf) == 1 else b"".join(buf)
+        conn = st["conn"]
+        try:
+            conn.send_nowait({"k": "n", "m": "tunnel_replies",
+                              "p": {"frames": [(st["lane"], data)]}})
+        except Exception:
+            # raylet link gone: the driver discovers the break through
+            # the raylet (tunnel_down) or its health sweep; records are
+            # recovered by break-lane resubmission
+            st["closed"] = True
+            log.debug("tunnel reply push failed", exc_info=True)
+            return
+        self._w.core.loop.call_soon(self._flush)  # burst linger
 
 
 def _as_task_error(e: Exception) -> Exception:

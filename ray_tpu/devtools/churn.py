@@ -163,6 +163,15 @@ class SimRaylet:
         self.ledger.return_bundle((p["pg_id"], p["bundle_index"]))
         return {"ok": True}
 
+    async def rpc_prepare_bundles(self, conn, p):
+        """Batched 2PC phase 1 (protocol 2.0) — mirrors the real raylet."""
+        return [{"ok": self.ledger.prepare_bundle((p["pg_id"], idx), res)}
+                for idx, res in p["bundles"]]
+
+    async def rpc_commit_bundles(self, conn, p):
+        return [{"ok": self.ledger.commit_bundle((p["pg_id"], idx))}
+                for idx in p["indices"]]
+
     async def rpc_list_bundles(self, conn, p):
         return self._held_bundles()
 
@@ -189,6 +198,12 @@ class SimRaylet:
             "node_id": self.node_id,
             "tpu_chips": None,
         }
+
+    async def rpc_lease_workers(self, conn, p):
+        """Batched grants (protocol 2.0): one ledger pass, positional
+        replies — the path _schedule_actor's lease coalescer takes."""
+        return [await self.rpc_lease_worker(conn, req)
+                for req in p["requests"]]
 
     async def rpc_return_lease(self, conn, p):
         return True  # sim leases are not tracked per-id

@@ -32,6 +32,7 @@ adoption (the decode-death window the checked-in
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 
@@ -40,6 +41,8 @@ import numpy as np
 from ray_tpu.core.ref import ObjectRef
 from ray_tpu.devtools import chaos
 from ray_tpu.llm.disagg import telemetry
+
+log = logging.getLogger(__name__)
 
 
 class KVShipError(Exception):
@@ -215,6 +218,28 @@ def adopt_pages(manifest: KVPageManifest,
     t0 = time.perf_counter_ns()
     keys = sorted(pages[0].refs)
     flat = [p.refs[k] for p in pages for k in keys]
+    # cross-node adoption: prefetch the whole manifest's pages in ONE
+    # batched pull_objects round trip through the local raylet, hinted
+    # with each page's sealing node — the get below then reads every
+    # component zero-copy out of local shm (same-node manifests skip
+    # this entirely: everything is already local). Best effort; the get
+    # path keeps its per-ref pull/recovery fallbacks.
+    core = _core()
+    if core.store is not None:
+        hints: dict = {}
+        for p in pages:
+            for k in keys:
+                oid = p.refs[k].id
+                if not core.store.contains(oid):
+                    hints.setdefault(oid, set()).add(p.node)
+        if len(hints) >= 2:
+            try:
+                core._run_sync(core.pull_objects_batch(hints), timeout=60)
+            except Exception:
+                # loop-resident caller, or a stalled pull hitting the
+                # bridge timeout: strictly an optimization — the get
+                # below keeps its own per-ref pull/recovery fallbacks
+                log.debug("batched KV prefetch skipped", exc_info=True)
     vals = api.get(flat)
     nk = len(keys)
     by_page = [vals[i * nk:(i + 1) * nk] for i in range(len(pages))]

@@ -18,9 +18,16 @@ Semantics are the actor fast lane's, unchanged:
   payloads, a missing/broken lane, or FIFO conflicts with queued RPC
   calls route THAT call over the actor RPC plane — the lane survives,
   and the retry/hedge/deadline machinery above sees one code path.
-- **same-node only**: rings are same-node by design; cross-node replicas
-  always take RPC. The routing layer does not need to know — submit
-  simply returns None where no lane exists.
+- **cross-node via the node tunnel** (protocol 2.0): rings are
+  same-node by design, but a REMOTE replica binds a tunnel lane
+  (core/tunnel.py) registered in the same ``_fast_actor_lanes`` table —
+  its calls ride coalesced ring-format frames over the per-node-pair
+  tunnel (N queued requests in one loop tick ship as ONE frame, the
+  proxy-side request coalescing), with payloads above
+  ``tunnel_inline_max`` shipped as shm descriptors the replica adopts
+  via one batched pull. The routing layer does not need to know which
+  transport serves a replica — submit simply returns None where no
+  lane (ring or tunnel) exists, and that call takes RPC.
 """
 from __future__ import annotations
 
@@ -69,3 +76,11 @@ class ReplicaLane:
 
     def stats(self) -> dict:
         return {"fast_calls": self.fast_calls, "rpc_calls": self.rpc_calls}
+
+    def transport(self, core) -> str:
+        """Which plane currently serves this replica: "ring" (same-node
+        shm), "tunnel" (cross-node), or "rpc" (no lane)."""
+        lane = core._fast_actor_lanes.get(self.actor_id)
+        if lane is None or lane.broken or lane.retired:
+            return "rpc"
+        return "tunnel" if getattr(lane.ring, "tunnel", False) else "ring"

@@ -69,6 +69,13 @@ RESHARD = 14          # collective-backed spec redistribute completed
 PREFILL_QUEUE = 15    # request waited in a prefill worker's wave queue
 KV_SHIP = 16          # KV pages sealed to shm (prefill) or adopted (decode)
 DECODE_QUEUE = 17     # adopted request waited for a decode ring slot
+# Cross-node node tunnel (core/tunnel.py): one event per coalesced frame
+# in each direction — args are (records, bytes lo, bytes hi) so a trace
+# shows how many ring-format records each tunnel frame carried (the
+# coalescing evidence) and a postmortem shows the last frame a process
+# shipped/received before dying.
+TUNNEL_TX = 18        # driver: one coalesced record frame sent to a peer node
+TUNNEL_RX = 19        # driver: one reply record frame received from a peer node
 
 STAGE_NAMES = {
     SUBMIT: "submit", RING_PUSH: "ring_push", WORKER_POP: "worker_pop",
@@ -77,7 +84,8 @@ STAGE_NAMES = {
     DRIVER_APPLY: "driver_apply", W_TASK: "w_task", SAMPLE: "sample",
     CHAOS: "chaos", SHARD_SEAL: "shard_seal", SHARD_FETCH: "shard_fetch",
     RESHARD: "reshard", PREFILL_QUEUE: "prefill_queue", KV_SHIP: "kv_ship",
-    DECODE_QUEUE: "decode_queue",
+    DECODE_QUEUE: "decode_queue", TUNNEL_TX: "tunnel_tx",
+    TUNNEL_RX: "tunnel_rx",
 }
 
 # Reported latency stages (SAMPLE args, ns): both ring hops are covered —

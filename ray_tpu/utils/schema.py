@@ -32,7 +32,43 @@ from __future__ import annotations
 # per-caller FIFO dispatch invariant. attach_fast_ring's actor reply is
 # now a dict carrying the actor's init-time method eligibility table —
 # see core/fastpath.py pack_actor_task/pack_reply.
-PROTOCOL_VERSION = (1, 9)
+#
+# 2.0: cross-node fast lane (MAJOR: OK_SHM payloads and record argument
+# slots changed meaning). Node tunnels (core/tunnel.py) carry the shm
+# rings' packed records between node pairs: raylet tunnel_bind /
+# tunnel_frame / tunnel_detach + worker tunnel_attach / tunnel_records /
+# tunnel_detach route coalesced record frames driver <-> raylet <->
+# worker. OK_SHM reply payloads may carry <Q size><16s node> (the
+# sealing node's id — the record IS the location registration,
+# pack_shm_desc); record arguments may be TunnelArgRef descriptors
+# ((oid, owner, node, nbytes) — oversized values adopt via the new
+# batched pull_objects). Also batched control: raylet lease_workers,
+# prepare_bundles, commit_bundles. The record prefix/flag byte catalog
+# below (RECORD_PREFIXES / RECORD_FLAGS) is machine-checked against
+# _native/src/rt_wire.h so a shipped-but-uncataloged wire entry fails
+# tier-1 (PRs 10/11 both shipped one).
+PROTOCOL_VERSION = (2, 0)
+
+# ------------------------------------------------------ fastpath records
+# Every record prefix byte and reply-status flag the shm rings / node
+# tunnels ship (core/fastpath.py). rt_wire.h mirrors this catalog for
+# native peers; tests/test_wire_schema.py asserts byte-for-byte parity
+# in BOTH directions, so adding a prefix or flag on either side without
+# cataloging it here is a tier-1 failure.
+RECORD_PREFIXES: dict[str, dict] = {
+    "P": {"since": (1, 3), "doc": "task record, C-pickled body, no stamp"},
+    "S": {"since": (1, 3), "doc": "task record, serialization.pack body"},
+    "Q": {"since": (1, 7), "doc": "task record, C-pickled, u64 submit stamp"},
+    "R": {"since": (1, 7), "doc": "task record, packed, u64 submit stamp"},
+    "A": {"since": (1, 8), "doc": "actor record, C-pickled, <u32 seq, u64 t>"},
+    "C": {"since": (1, 8), "doc": "actor record, packed, <u32 seq, u64 t>"},
+}
+RECORD_FLAGS: dict[str, dict] = {
+    "STAMPED": {"value": 0x100, "since": (1, 7),
+                "doc": "reply carries a 16-byte worker stage stamp"},
+    "SEQED": {"value": 0x200, "since": (1, 8),
+              "doc": "reply echoes the submit record's u32 seq"},
+}
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -123,6 +159,36 @@ CATALOG: dict[str, dict[str, dict]] = {
             "->": "[{pg_id, bundle_index, resources, committed, "
                   "prepared_at}] — the PG-reservation audit surface "
                   "(shipped in 1.8's PG-FT work, cataloged late)"}},
+        "lease_workers": {"since": (2, 0), "fields": {
+            "requests": "[lease_worker payloads] — batched grants in ONE "
+                        "ledger pass; never parks (busy replies retry "
+                        "caller-side)",
+            "->": "[lease_worker replies], positional"}},
+        "prepare_bundles": {"since": (2, 0), "fields": {
+            "pg_id": "PGID", "bundles": "[(index, resources)] — one "
+                                        "batched 2PC phase-1 ledger pass",
+            "->": "[{ok}] positional"}},
+        "commit_bundles": {"since": (2, 0), "fields": {
+            "pg_id": "PGID", "indices": "[int] — batched 2PC phase 2",
+            "->": "[{ok}] positional"}},
+        "tunnel_bind": {"since": (2, 0), "fields": {
+            "kind": "actor | task",
+            "worker_id": "hex (task lanes)",
+            "actor_id": "hex (actor lanes; the raylet resolves the "
+                        "hosting worker)",
+            "->": "{ok, lane, methods?} — lane id multiplexing this "
+                  "binding over the node tunnel (core/tunnel.py)"}},
+        "tunnel_frame": {"since": (2, 0), "fields": {
+            "frames": "[(lane, framed record bytes)] — coalesced "
+                      "ring-format records (notify, both directions: "
+                      "driver->raylet submits, raylet->driver replies)"}},
+        "tunnel_detach": {"since": (2, 0), "fields": {
+            "lanes": "[lane ids] closed by the driver (notify)"}},
+        "pull_objects": {"since": (2, 0), "fields": {
+            "objects": "[{object_id, holders_hint}] — batched pull: one "
+                       "round trip per arg/KV-manifest set, ONE GCS "
+                       "kv_multi_get for the unhinted miss-set",
+            "->": "{oid hex: bool}"}},
         "pull_object": {"since": (1, 0), "fields": {
             "object_id": "bytes", "owner_address": "(host, port)",
             "holders_hint": "[node_id bytes] optional (since (1, 6)): "
@@ -198,6 +264,17 @@ CATALOG: dict[str, dict[str, dict]] = {
                   "the actor's init-time method eligibility table; the "
                   "driver routes gen/unknown methods to the RPC path per "
                   "call without a ring round trip"}},
+        "tunnel_attach": {"since": (2, 0), "fields": {
+            "lane": "int — raylet-assigned tunnel lane id",
+            "kind": "actor | task",
+            "->": "{ok, methods?} — actor lanes ship the method "
+                  "eligibility table like attach_fast_ring"}},
+        "tunnel_records": {"since": (2, 0), "fields": {
+            "frames": "[(lane, framed record bytes)] — submit records "
+                      "off the node tunnel (notify); replies return as "
+                      "tunnel_replies pushes on the same connection"}},
+        "tunnel_detach": {"since": (2, 0), "fields": {
+            "lanes": "[lane ids] to drop (notify)"}},
         "dump_stack": {"since": (1, 3), "fields": {}},
         "heap_profile": {"since": (1, 4), "fields": {
             "action": "start | snapshot | stop (tracemalloc control)",

@@ -46,6 +46,48 @@ def test_cpp_runtime_version_in_sync():
     assert (major, minor) == schema.PROTOCOL_VERSION
 
 
+def test_record_prefixes_and_flags_cataloged_everywhere():
+    """Wire-schema drift gate: every record prefix byte and reply-status
+    flag must agree byte-for-byte across rt_wire.h (native peers),
+    utils/schema.py (the catalog), and core/fastpath.py (the live
+    packers). PRs 10/11 both shipped wire entries the catalog missed;
+    this makes that class of bug impossible for the record plane."""
+    from ray_tpu.core import fastpath
+
+    text = open("ray_tpu/_native/src/rt_wire.h").read()
+    hdr_prefixes = set(re.findall(
+        r"constexpr char kRecPrefix\w+ = '(.)';", text))
+    assert hdr_prefixes, "rt_wire.h lost its record-prefix catalog"
+    assert hdr_prefixes == set(schema.RECORD_PREFIXES), (
+        f"record prefixes drifted: rt_wire.h={sorted(hdr_prefixes)} "
+        f"schema.py={sorted(schema.RECORD_PREFIXES)}")
+    hdr_flags = {name: int(val, 16) for name, val in re.findall(
+        r"constexpr uint32_t kReplyFlag(\w+) = (0x[0-9a-fA-F]+);", text)}
+    assert hdr_flags, "rt_wire.h lost its reply-flag catalog"
+    assert {k.upper(): v for k, v in hdr_flags.items()} == {
+        k: v["value"] for k, v in schema.RECORD_FLAGS.items()}, (
+        f"reply flags drifted: rt_wire.h={hdr_flags} "
+        f"schema.py={schema.RECORD_FLAGS}")
+    # the live packers must agree with the catalog too
+    assert fastpath.STAMPED == schema.RECORD_FLAGS["STAMPED"]["value"]
+    assert fastpath.SEQED == schema.RECORD_FLAGS["SEQED"]["value"]
+    # every cataloged prefix decodes through the live unpackers
+    for prefix in schema.RECORD_PREFIXES:
+        assert prefix in "PSQRAC"
+    # and the packers emit only cataloged prefixes
+    tid = b"\0" * 16
+    emitted = {
+        fastpath.pack_task(tid, b"f", (1,), None)[0:1],
+        fastpath.pack_task(tid, b"f", ({1, 2},), None)[0:1],
+        fastpath.pack_task(tid, b"f", (1,), None, 5)[0:1],
+        fastpath.pack_task(tid, b"f", ({1, 2},), None, 5)[0:1],
+        fastpath.pack_actor_task(tid, b"am:m", (1,), None, 0, 0)[0:1],
+        fastpath.pack_actor_task(tid, b"am:m", ({1},), None, 0, 0)[0:1],
+    }
+    assert emitted == {b"P", b"S", b"Q", b"R", b"A", b"C"}
+    assert {p.decode() for p in emitted} == set(schema.RECORD_PREFIXES)
+
+
 def test_handshake_accepts_current_and_rejects_major_mismatch():
     async def run():
         server = rpc.RpcServer("127.0.0.1", 0)
