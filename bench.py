@@ -274,6 +274,89 @@ def run_recorder_ab(quick: bool) -> dict[str, float]:
     return out
 
 
+# tracing A/B child: sync round trips on the task fast lane and the
+# actor ring lane — the exact record paths the 2.1 trace leg touches.
+# Closed-loop on purpose: per-CALL overhead is the unsampled claim.
+_TRACE_AB_CHILD = r"""
+import json, sys, time
+import ray_tpu
+
+rounds, per_round = int(sys.argv[1]), int(sys.argv[2])
+ray_tpu.init(num_cpus=4)
+
+@ray_tpu.remote
+def _leaf(i):
+    return i
+
+class _Echo:
+    def echo(self, x):
+        return x
+
+a = ray_tpu.remote(_Echo).remote()
+for i in range(200):  # warm: leases, lanes, jit of nothing, flush timers
+    ray_tpu.get(_leaf.remote(i))
+    ray_tpu.get(a.echo.remote(i))
+best_task = best_actor = float("inf")
+for r in range(rounds):
+    t0 = time.perf_counter()
+    for i in range(per_round):
+        ray_tpu.get(_leaf.remote(i))
+    best_task = min(best_task, (time.perf_counter() - t0) / per_round * 1e6)
+    t0 = time.perf_counter()
+    for i in range(per_round):
+        ray_tpu.get(a.echo.remote(i))
+    best_actor = min(best_actor, (time.perf_counter() - t0) / per_round * 1e6)
+print(json.dumps({"task_us": best_task, "actor_us": best_actor}))
+ray_tpu.shutdown()
+"""
+
+
+def run_tracing_bench(quick: bool) -> dict[str, float]:
+    """tracing_overhead_us: interleaved A/B/C over the fast-lane record
+    paths — tracing off / on-but-unsampled (rate 0: the one-branch wire
+    path every record pays) / sampled at 1% (the Dapper production
+    default). The headline is the UNSAMPLED task-lane delta, which must
+    stay within noise of the off arm (the tentpole's cost claim); the
+    sampled arm prices the spans + wire legs actually taken."""
+    import subprocess
+
+    rounds = 2 if quick else 3
+    inner_rounds, per_round = (2, 300) if quick else (3, 600)
+    arms = {
+        "off": {"RT_TRACING_ENABLED": "0"},
+        "unsampled": {"RT_TRACING_ENABLED": "1",
+                      "RT_TRACE_SAMPLE_RATE": "0.0"},
+        "sampled1": {"RT_TRACING_ENABLED": "1",
+                     "RT_TRACE_SAMPLE_RATE": "0.01"},
+    }
+    best: dict[str, dict[str, float]] = {k: {} for k in arms}
+    order = list(arms)
+    for r in range(rounds):
+        for arm in (order if r % 2 == 0 else order[::-1]):
+            env = {**os.environ, "JAX_PLATFORMS": "cpu", **arms[arm]}
+            proc = subprocess.run(
+                [sys.executable, "-c", _TRACE_AB_CHILD,
+                 str(inner_rounds), str(per_round)],
+                env=env, capture_output=True, text=True, timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode != 0:
+                print(f"tracing A/B arm {arm} failed:\n"
+                      f"{proc.stderr[-2000:]}", file=sys.stderr)
+                return {}
+            val = json.loads(proc.stdout.strip().splitlines()[-1])
+            for k, v in val.items():
+                best[arm][k] = min(best[arm].get(k, float("inf")), v)
+    out = {}
+    for k in ("task_us", "actor_us"):
+        for arm in arms:
+            out[f"tracing_{k[:-3]}_{arm}_us"] = round(best[arm][k], 1)
+    out["tracing_overhead_us"] = round(
+        best["unsampled"]["task_us"] - best["off"]["task_us"], 2)
+    out["tracing_sampled1_overhead_us"] = round(
+        best["sampled1"]["task_us"] - best["off"]["task_us"], 2)
+    return out
+
+
 def _chaos_point_overhead_us() -> dict[str, float]:
     """chaos_overhead_us: per-fault-point cost A/B — fault points
     compiled out (chaos disabled: the bare ``if chaos.ENABLED`` gate
@@ -1975,6 +2058,10 @@ def main():
             micro.update(run_chaos_bench(args.quick))
         except Exception as e:
             print(f"chaos bench failed: {e!r}", file=sys.stderr)
+        try:
+            micro.update(run_tracing_bench(args.quick))
+        except Exception as e:
+            print(f"tracing bench failed: {e!r}", file=sys.stderr)
         try:
             micro.update(run_serve_bench(args.quick))
         except Exception as e:

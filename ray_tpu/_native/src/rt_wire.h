@@ -23,7 +23,7 @@ namespace wire {
 // Wire-schema version; must match ray_tpu/utils/schema.py PROTOCOL_VERSION
 // (tests/test_wire_schema.py cross-checks the two).
 constexpr int kProtocolMajor = 2;
-constexpr int kProtocolMinor = 0;
+constexpr int kProtocolMinor = 1;
 
 // ---------------------------------------------------------------------
 // Fastpath record catalog (shm rings + node tunnels, core/fastpath.py).
@@ -41,6 +41,12 @@ constexpr char kRecPrefixActorPickle = 'A';  // actor, C-pickled + seq hdr
 constexpr char kRecPrefixActorPacked = 'C';  // actor, packed + seq hdr
 constexpr uint32_t kReplyFlagStamped = 0x100;  // 16-byte stage stamp follows
 constexpr uint32_t kReplyFlagSeqed = 0x200;    // u32 echoed seq follows
+constexpr uint32_t kReplyFlagTraced = 0x400;   // 25-byte trace leg follows
+// Record-side trace flag (2.1): bit 63 of the u64 t_submit field of
+// "Q"/"R"/"A"/"C" records — set = a 25-byte trace leg
+// (<16s trace_id><8s span_id><u8 sampled>) follows the record header.
+constexpr uint64_t kRecordTraceCtxBit = 1ULL << 63;
+constexpr size_t kTraceCtxLen = 25;
 
 inline bool read_exact(int fd, void* buf, size_t n) {
   auto* p = (char*)buf;

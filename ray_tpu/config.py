@@ -146,10 +146,32 @@ class Config:
     #: interpreter — measured 25-35% slower there, faster with spare cores
     native_mux_min_cpus: int = 4
 
-    # --- tracing (ref: util/tracing/tracing_helper.py span injection) ---
-    #: propagate span contexts through task specs and record spans into
-    #: the task-event pipeline (ray_tpu.state.list_spans / timeline)
+    # --- tracing (ref: util/tracing/tracing_helper.py span injection;
+    # Dapper-style wire context — see utils/tracing.py) ---
+    #: propagate span contexts through task specs AND the packed
+    #: fast-lane/tunnel records (wire 2.1 trace leg), record spans into
+    #: the task-event pipeline (state.list_spans / get_trace / timeline)
     tracing_enabled: bool = False
+    #: head-based sampling: fraction of ROOTS (serve requests, driver
+    #: .remote() calls with no active context) that start a sampled
+    #: trace; children inherit the decision from the wire leg. The
+    #: unsampled path is one contextvar read + one branch and ships no
+    #: trace bytes (bench.py tracing_overhead_us).
+    trace_sample_rate: float = 1.0
+    #: GCS trace assembler: max assembled traces retained. Eviction
+    #: protects the slowest ``trace_slow_keep`` fraction (the p99
+    #: outliers you debug) and drops the oldest of the rest.
+    trace_table_max: int = 512
+    #: per-trace span cap (a runaway span loop can't eat the table)
+    trace_spans_max: int = 512
+    #: fraction of the slowest traces exempt from age-based eviction
+    trace_slow_keep: float = 0.1
+    #: ns="latency" KV retention: entries not republished for this many
+    #: seconds (dead workers' leftover windows) are swept by the GCS
+    #: health loop; <= 0 disables the sweep
+    latency_retention_s: float = 600.0
+    #: GCS task-event ring cap (also bounds the span history riding it)
+    gcs_task_events_cap: int = 100_000
 
     # --- memory protection (ref: memory_monitor.h:52) ---
     #: fraction of system memory in use that triggers OOM killing;

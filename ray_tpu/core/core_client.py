@@ -379,6 +379,13 @@ class CoreClient:
         # cached flag (an attribute load) instead of calling
         # recorder.enabled() per task; the flush timer refreshes it
         self._rec_enabled = recorder.enabled()
+        # wire-level tracing (utils/tracing.py): cached flag for the same
+        # reason as _rec_enabled — the unsampled fast path pays ONE
+        # attribute load + branch. _trace_pending maps a sampled in-flight
+        # call's return oid to its submit-span info so reply-apply can
+        # stamp the wire-level call span (bounded: sampled traffic only).
+        self._trace_on = bool(self.cfg.tracing_enabled)
+        self._trace_pending: dict[ObjectID, tuple] = {}
         self._rec_published = -1  # stats.n at the last metrics publish
         self._lat_published = -1  # stats.n at the last latency kv_put
         # actor-call stage window: actor fast-lane replies store their raw
@@ -1548,20 +1555,31 @@ class CoreClient:
         # CLOCK_MONOTONIC the worker pops against, so pop - t0 IS the
         # submit-ring hop
         t0 = now_ns if self._rec_enabled else 0
+        # wire-level tracing (2.1): one branch when off/unsampled, a
+        # 25-byte leg + submit point span when sampled
+        trace = (self._trace_submit_leg(
+            task_id, getattr(fn, "__name__", "task"), "ring")
+            if self._trace_on else b"")
         try:
-            rec = fastpath.pack_task(tid, func_id, args, kwargs, t0)
+            rec = fastpath.pack_task(tid, func_id, args, kwargs, t0, trace)
         except Exception:
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None  # plain pickle can't carry it: cloudpickle path
         # cap also guards the pop buffer: a record the consumer can never
         # pop would wedge the ring (see rt_ring_pop_batch's kTooBig)
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None  # big args belong in the object store
         ref = self._fast_register_and_push(
             lane, task_id, rec,
             (fn, args, kwargs, resources, max_retries),
             defer=gap_ns < 2_000_000, t0=t0)
         if ref is None:
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None
         lane.worker.idle_since = time.monotonic()  # keep the lease warm
         metrics.tasks_submitted.inc()
@@ -1967,8 +1985,7 @@ class CoreClient:
     # ------------------------------------- cross-node tunnels (core/tunnel.py)
     def _tunnel_ok(self) -> bool:
         return (self.cfg.node_tunnel and self.cfg.fastpath_enabled
-                and not self.client_mode and not self.cfg.tracing_enabled
-                and not self._closed)
+                and not self.client_mode and not self._closed)
 
     def _tunnel_client(self):
         if self._tunnels is None:
@@ -2120,8 +2137,7 @@ class CoreClient:
         t.actor_id = actor_id
         t.method = method
         t.mkey = b"am:" + method.encode()
-        t.opts_ok = (num_returns == 1 and concurrency_group is None
-                     and not self.cfg.tracing_enabled)
+        t.opts_ok = num_returns == 1 and concurrency_group is None
         t.lane = None
         return t
 
@@ -2218,12 +2234,18 @@ class CoreClient:
         lane.next_seq = seq + 1  # advisory mirror (stats/tests)
         light = ("actor", actor_id, method, args, kwargs)
         pins = None
+        tunnel = getattr(lane.ring, "tunnel", False)
+        trace = (self._trace_submit_leg(
+            task_id, method, "tunnel" if tunnel else "ring")
+            if self._trace_on else b"")
         try:
-            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0, seq)
+            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0,
+                                           seq, trace)
         except Exception:
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None  # unpicklable args: RPC path for this call
-        if len(rec) > self.cfg.tunnel_inline_max \
-                and getattr(lane.ring, "tunnel", False):
+        if len(rec) > self.cfg.tunnel_inline_max and tunnel:
             # oversized args do NOT ride the tunnel: seal them locally
             # and ship (node, oid, nbytes) descriptors; the worker
             # adopts the set via one batched pull. light keeps the
@@ -2233,11 +2255,15 @@ class CoreClient:
                 s_args, s_kwargs, pins = shrunk
                 try:
                     rec = fastpath.pack_actor_task(
-                        tid, mkey, s_args, s_kwargs, t0, seq)
+                        tid, mkey, s_args, s_kwargs, t0, seq, trace)
                 except Exception:
+                    self._trace_pending.pop(
+                        ObjectID.for_task_return(task_id, 0), None)
                     return None
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None  # big args belong in the object store
         gap_ns = now_ns - self._fast_last_submit
         self._fast_last_submit = now_ns
@@ -2248,6 +2274,8 @@ class CoreClient:
             defer=gap_ns < 2_000_000, t0=t0)
         if ref is None:
             self._tunnel_pins.pop(task_id, None)
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
         else:
             metrics.actor_calls.inc()
         return ref
@@ -2282,13 +2310,12 @@ class CoreClient:
 
         Returns ``(task_id, future)`` or None — None means THIS call
         takes the RPC path (per-call fallback, the lane stays live): no
-        live lane, ineligible method, pending/remote ref args,
-        oversized record, or tracing. Decode the future with
-        :meth:`fast_actor_await`."""
+        live lane, ineligible method, pending/remote ref args, or an
+        oversized record. Sampled trace context rides the record's wire
+        leg (2.1), so these calls are no longer trace-invisible. Decode
+        the future with :meth:`fast_actor_await`."""
         from ray_tpu.core import fastpath
 
-        if self.cfg.tracing_enabled:
-            return None
         lane = tmpl.lane if tmpl is not None else None
         if lane is None or lane.broken or lane.retired:
             lane = self._fast_actor_lanes.get(actor_id)
@@ -2318,12 +2345,18 @@ class CoreClient:
         seq = next(lane.seq_counter)
         lane.next_seq = seq + 1
         pins = None
+        tunnel = getattr(lane.ring, "tunnel", False)
+        trace = (self._trace_submit_leg(
+            task_id, method, "tunnel" if tunnel else "ring")
+            if self._trace_on else b"")
         try:
-            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0, seq)
+            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0,
+                                           seq, trace)
         except Exception:
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None  # unpicklable args: RPC path for this call
-        if len(rec) > self.cfg.tunnel_inline_max \
-                and getattr(lane.ring, "tunnel", False):
+        if len(rec) > self.cfg.tunnel_inline_max and tunnel:
             # cross-node serve payload above the inline cap: descriptor
             # shipping (see _try_fast_actor_submit)
             shrunk = self._tunnel_shrink_args(args, kwargs)
@@ -2331,11 +2364,15 @@ class CoreClient:
                 s_args, s_kwargs, pins = shrunk
                 try:
                     rec = fastpath.pack_actor_task(
-                        tid, mkey, s_args, s_kwargs, t0, seq)
+                        tid, mkey, s_args, s_kwargs, t0, seq, trace)
                 except Exception:
+                    self._trace_pending.pop(
+                        ObjectID.for_task_return(task_id, 0), None)
                     return None
         if len(rec) > min(self.cfg.fastpath_record_max,
                           fastpath.POP_BUF_BYTES - 64):
+            self._trace_pending.pop(ObjectID.for_task_return(task_id, 0),
+                                    None)
             return None  # big args belong in the object store
         if pins:
             self._tunnel_pins[task_id] = pins
@@ -2353,6 +2390,7 @@ class CoreClient:
             with self._fast_cv:
                 self._fast_loop_waiters.pop(oid, None)
             self._tunnel_pins.pop(task_id, None)
+            self._trace_pending.pop(oid, None)
             return None
         metrics.actor_calls.inc()
         return task_id, fut
@@ -2473,6 +2511,13 @@ class CoreClient:
         re-executing its side effects. ``lost=False`` (NEED_SLOW
         migration: the worker declined without executing) keeps the full
         budget."""
+        tp = self._trace_pending.pop(
+            ObjectID.for_task_return(task_id, 0), None)
+        if tp is not None:
+            # the fast leg never completed: materialize its submit span
+            # now so the RPC replay's exec span has its parent, and keep
+            # the call in the SAME trace (one logical call, one trace)
+            self._trace_emit_submit_point(task_id, tp)
         if light[0] == "actor":
             _, actor_id, method, args, kwargs = light
             spec = {
@@ -2486,6 +2531,10 @@ class CoreClient:
                 "seq": None,
                 "concurrency_group": None,
             }
+            if tp is not None:  # sampled call: the RPC replay keeps the
+                # trace (same parent submit span — one logical call)
+                spec["trace_ctx"] = {"trace_id": tp[0],
+                                     "parent_span_id": tp[2]}
             self._actor_queues.setdefault(actor_id, []).append(spec)
             self._bg.spawn(self._ensure_actor_pump(actor_id), self.loop)
         else:
@@ -2502,6 +2551,9 @@ class CoreClient:
                     return
                 budget -= 1
             spec = self._fast_light_to_spec(task_id, light, budget)
+            if tp is not None:
+                spec["trace_ctx"] = {"trace_id": tp[0],
+                                     "parent_span_id": tp[2]}
             self._bg.spawn(self._submit_async(spec), self.loop)
 
     def _fast_reader(self, lane):
@@ -2548,9 +2600,11 @@ class CoreClient:
         drained = False
         wake = None  # loop-waiter resolutions (serve fast-lane router)
         retire_serve = None  # lane whose method table went stale
+        tspans = None  # sampled completions: wire-level call spans
         with self._fast_cv:
             for rec in recs:
-                tid_b, status, payload, stamp, seq = fastpath.unpack_reply(rec)
+                tid_b, status, payload, stamp, seq, trc = \
+                    fastpath.unpack_reply(rec)
                 task_id = TaskID(tid_b)
                 light = lane.inflight.pop(task_id, None)
                 if self._tunnel_pins:
@@ -2560,6 +2614,23 @@ class CoreClient:
                     self._tunnel_pins.pop(task_id, None)
                 oid = ObjectID.for_task_return(task_id, 0)
                 ent = self._fast_oid_lane.pop(oid, None)
+                if self._trace_pending and (
+                        trc or (status == fastpath.NEED_SLOW
+                                and light is not None
+                                and light[0] == "serve")):
+                    # sampled call: stamp the wire-level call span after
+                    # the cv drops (span emit is just a dict append, but
+                    # the cv guards hotter state than telemetry deserves).
+                    # Serve NEED_SLOWs pop too — their RPC re-dispatch
+                    # mints a fresh submit span, so the pending entry is
+                    # dead (tracked NEED_SLOWs keep theirs for
+                    # _fast_resubmit's trace_ctx handoff).
+                    tp = self._trace_pending.pop(oid, None)
+                    if (tp is not None and trc
+                            and status != fastpath.NEED_SLOW):
+                        if tspans is None:
+                            tspans = []
+                        tspans.append((oid, stamp, tp))
                 if self._fast_loop_waiters:
                     fut = self._fast_loop_waiters.pop(oid, None)
                     if fut is not None:
@@ -2632,6 +2703,8 @@ class CoreClient:
             self._fast_cv.notify_all()
         if wake:
             self._queue_loop_wakes(wake)
+        if tspans is not None:
+            self._trace_apply_replies(tspans)
         if retire_serve is not None:
             self._fast_retire_actor_lane(retire_serve)
         if drained:
@@ -2884,11 +2957,17 @@ class CoreClient:
                 lane.broken = True
                 leftovers = dict(lane.inflight)
                 lane.inflight.clear()
-                for task_id in leftovers:
+                for task_id, light in leftovers.items():
                     oid = ObjectID.for_task_return(task_id, 0)
                     self._fast_oid_lane.pop(oid, None)
                     if self._tunnel_pins:
                         self._tunnel_pins.pop(task_id, None)
+                    if self._trace_pending and light[0] == "serve":
+                        # untracked serve call dying with the lane: its
+                        # ::call span will never stamp (the router's RPC
+                        # replay mints a fresh submit span); tracked
+                        # entries stay for _fast_resubmit's ctx handoff
+                        self._trace_pending.pop(oid, None)
                     fut = self._fast_loop_waiters.pop(oid, None)
                     if fut is not None:
                         # broken mid-flight: fast_actor_await raises
@@ -3188,7 +3267,7 @@ class CoreClient:
         and every fast miss — falls through to submit_task, which stays
         the single source of truth for slow-path semantics and builds a
         spec byte-identical to a direct submit_task call."""
-        if (tmpl.fast_ok and not self.cfg.tracing_enabled):
+        if tmpl.fast_ok:
             ref = self._fast_submit_keyed(fn, tmpl.func_id, tmpl.sched_key,
                                           tmpl.resources, args, kwargs,
                                           max_retries=tmpl.max_retries)
@@ -3233,7 +3312,6 @@ class CoreClient:
                     and placement_group is None
                     and scheduling_node is None and runtime_env is None
                     and scheduling_strategy is None
-                    and not self.cfg.tracing_enabled
                     and name is None):
                 ref = self._try_fast_submit(
                     fn, args, kwargs, dict(resources or {"CPU": 1.0}),
@@ -3295,21 +3373,91 @@ class CoreClient:
     def _emit_submit_span(self, spec: dict, name: str) -> None:
         """Record a point span for the .remote() call and inject its id as
         the parent for the executing side's child span (ref:
-        tracing_helper.py:36-60 span-context injection into task specs)."""
+        tracing_helper.py:36-60 span-context injection into task specs).
+        Head-sampled: an unsampled root gets no span and no trace_ctx."""
         from ray_tpu.utils import tracing
 
-        parent = tracing.inject()
-        submit_id = tracing._gen_span_id()
-        now = time.time()
-        self.task_events.emit(
-            task_id=spec["task_id"].hex(), name=f"{name}.remote",
-            state="SPAN", span={
-                "trace_id": parent["trace_id"], "span_id": submit_id,
-                "parent_span_id": parent.get("parent_span_id"),
-                "name": f"{name}.remote", "start_ts": now, "end_ts": now,
-            })
+        parent = tracing.submit_context()
+        if parent is None:
+            return  # unsampled request: ship nothing, record nothing
+        tid_hex = spec["task_id"].hex()
+        submit_id = tracing.emit_point(
+            f"{name}.remote", parent,
+            lambda s: self.task_events.emit(
+                task_id=tid_hex, name=s["name"], state="SPAN", span=s),
+            stage="wire", transport="rpc")
         spec["trace_ctx"] = {"trace_id": parent["trace_id"],
                              "parent_span_id": submit_id}
+
+    def _trace_submit_leg(self, task_id: TaskID, name: str,
+                          transport: str) -> bytes:
+        """Wire trace leg for one fast-lane submit (b"" = unsampled:
+        the caller ships nothing). Sampled: mints the submit and call
+        span ids, registers them keyed by the return oid, and returns
+        the packed 25-byte context whose span_id is the CALL span — so
+        the worker's exec span nests INSIDE the wire-level call span
+        (the call span's self-time is then pure transport, never
+        double-billing exec). NOTHING is emitted yet: both spans land
+        at reply-apply, so a declined submit (RPC fallback) leaves no
+        orphan markers and the fallback's own spans are the record."""
+        from ray_tpu.utils import tracing
+
+        ctx = tracing.submit_context()
+        if ctx is None:
+            return b""
+        submit_id = tracing._gen_span_id()
+        call_id = tracing._gen_span_id()
+        pending = self._trace_pending
+        if len(pending) > 4096:  # replies that never came (broken lanes)
+            pending.pop(next(iter(pending)), None)
+        oid = ObjectID.for_task_return(task_id, 0)
+        pending[oid] = (ctx["trace_id"], ctx.get("parent_span_id"),
+                        submit_id, call_id, name, time.time(), transport)
+        return tracing.pack_ctx(ctx["trace_id"], call_id, True)
+
+    def _trace_emit_submit_point(self, task_id: TaskID, tp) -> None:
+        """Materialize the deferred submit point span (reply-apply, or
+        an RPC resubmission that inherits the pending context)."""
+        trace_id, parent0, submit_id, _, name, t_submit, transport = tp
+        self.task_events.emit(
+            task_id=task_id.hex(), name=f"{name}.remote", state="SPAN",
+            span={
+                "trace_id": trace_id, "span_id": submit_id,
+                "parent_span_id": parent0, "name": f"{name}.remote",
+                "start_ts": t_submit, "end_ts": t_submit,
+                "stage": "wire", "transport": transport,
+            })
+
+    def _trace_apply_replies(self, tspans: list) -> None:
+        """Reply-apply leg of wire tracing: for each sampled completion,
+        materialize the submit point span and the ``<name>::call`` wire
+        span (submit wall -> apply wall, span id PRE-MINTED at submit —
+        the worker's ::run span is its child) with the stage stamp as
+        attributes — the queue-vs-exec-vs-wire truth TraceCriticalPath
+        consumes."""
+        from ray_tpu.core import fastpath
+
+        now = time.time()
+        for oid, stamp, tp in tspans:
+            trace_id, _, submit_id, call_id, name, t_submit, transport = tp
+            task_id = oid.task_id()
+            self._trace_emit_submit_point(task_id, tp)
+            span = {
+                "trace_id": trace_id,
+                "span_id": call_id,
+                "parent_span_id": submit_id,
+                "name": f"{name}::call",
+                "start_ts": t_submit, "end_ts": now,
+                "stage": "wire", "transport": transport,
+            }
+            if stamp is not None:
+                ring_ns, deser_ns, exec_ns = fastpath.unpack_stamp(stamp)
+                span["ring_us"] = ring_ns / 1e3
+                span["deser_us"] = deser_ns / 1e3
+                span["exec_us"] = exec_ns / 1e3
+            self.task_events.emit(
+                task_id=task_id.hex(), name=span["name"],
+                state="SPAN", span=span)
 
     def _call_on_loop(self, coro):
         """Run a coroutine (or apply a deleted-ref notice, passed as a bare
@@ -4449,8 +4597,7 @@ class CoreClient:
                     "subscribe", {"channel": f"actor:{actor_id.hex()}"})
             except (rpc.RpcError, OSError):
                 self._subscribed_actors.discard(actor_id)  # retry next connect
-        if (self.cfg.fastpath_enabled and self.store is not None
-                and not self.cfg.tracing_enabled):
+        if self.cfg.fastpath_enabled and self.store is not None:
             self._bg.spawn(self._fast_actor_attach(actor_id, conn), self.loop)
             if self._tunnel_ok():
                 # remote actor (or tunnel_force): bind a tunnel lane —

@@ -328,7 +328,7 @@ def _simple(x, depth: int = 2) -> bool:
 
 
 def pack_task(task_id: bytes, func_id: bytes, args, kwargs,
-              t_ns: int = 0) -> bytes:
+              t_ns: int = 0, trace: bytes = b"") -> bytes:
     """Two-tier arg encoding. Simple immutables take the C pickler (the
     submission hot path — a Python-level reducer hook here measured ~2x on
     the whole bench); anything else goes through serialization.pack, whose
@@ -341,30 +341,49 @@ def pack_task(task_id: bytes, func_id: bytes, args, kwargs,
     ``perf_counter_ns`` at submit: CLOCK_MONOTONIC is system-wide on
     Linux and fast lanes are same-node, so the worker's pop-time minus
     this stamp IS the submit-ring hop. Stamped records use the "Q"/"R"
-    prefixes; un-stamped "P"/"S" stay decodable (recorder off)."""
+    prefixes; un-stamped "P"/"S" stay decodable (recorder off).
+
+    ``trace`` (protocol 2.1) is a packed 25-byte trace leg
+    (tracing.pack_ctx) riding behind the stamp: its presence is flagged
+    by TRACE_BIT in the stamp's top bit (perf_counter_ns can't reach
+    bit 63 for ~292 years, so the bit is free), which keeps unsampled
+    records byte-identical to 1.7 ones. A traced record always uses the
+    stamped prefixes — t_ns=0 still decodes as "no recorder stamp"."""
     if _simple(args) and (not kwargs or _simple(kwargs)):
         body = pickle.dumps((task_id, func_id, args, kwargs), protocol=5)
+        if trace:
+            return (b"Q" + struct.pack("<Q", t_ns | TRACE_BIT) + trace
+                    + body)
         if t_ns:
             return b"Q" + struct.pack("<Q", t_ns) + body
         return b"P" + body
     body = serialization.pack((task_id, func_id, args, kwargs))
+    if trace:
+        return b"R" + struct.pack("<Q", t_ns | TRACE_BIT) + trace + body
     if t_ns:
         return b"R" + struct.pack("<Q", t_ns) + body
     return b"S" + body
 
 
 def unpack_task(rec: bytes):
-    """-> (task_id, func_id, args, kwargs, t_submit_ns) — 0 when the
-    record carries no recorder stamp."""
+    """-> (task_id, func_id, args, kwargs, t_submit_ns, trace) — t 0
+    when the record carries no recorder stamp, trace b"" when it
+    carries no trace leg (decode with tracing.unpack_ctx)."""
     kind = rec[:1]
     if kind == b"P":
-        return (*pickle.loads(rec[1:]), 0)
+        return (*pickle.loads(rec[1:]), 0, b"")
     if kind == b"S":
-        return (*serialization.unpack(rec[1:]), 0)
+        return (*serialization.unpack(rec[1:]), 0, b"")
     (t_ns,) = struct.unpack_from("<Q", rec, 1)
+    off = 9
+    trace = b""
+    if t_ns & TRACE_BIT:
+        t_ns &= ~TRACE_BIT
+        trace = rec[off:off + TRACE_LEN]
+        off += TRACE_LEN
     if kind == b"Q":
-        return (*pickle.loads(rec[9:]), t_ns)
-    return (*serialization.unpack(rec[9:]), t_ns)
+        return (*pickle.loads(rec[off:]), t_ns, trace)
+    return (*serialization.unpack(rec[off:]), t_ns, trace)
 
 
 # reply-status flag bit: a 16-byte stage stamp follows the header
@@ -378,6 +397,18 @@ STAMPED = 0x100
 # reply as each method finishes) while ring order stays the per-caller
 # FIFO *dispatch* invariant.
 SEQED = 0x200
+# reply-status flag bit (protocol 2.1): a 25-byte trace leg
+# (tracing.pack_ctx: <16s trace_id><8s span_id><B sampled>) follows the
+# header after the stamp/seq legs. Traced replies ECHO the submit
+# record's context, so the driver's reply-apply can stamp the wire-level
+# call span for untracked (serve fast-lane) calls without a lookup.
+TRACED = 0x400
+# record-side trace flag (protocol 2.1): bit 63 of the u64 t_submit
+# field of "Q"/"R"/"A"/"C" records — set = a 25-byte trace leg follows
+# the record header. Mirrored as kRecordTraceCtxBit in rt_wire.h and
+# machine-checked by tests/test_wire_schema.py.
+TRACE_BIT = 1 << 63
+TRACE_LEN = 25  # struct <16s8sB> — tracing._WIRE
 _STAMP = struct.Struct("<IIQ")  # ring_ns (sat), deser_ns (sat), exec_ns
 _SEQ = struct.Struct("<I")
 _AHDR = struct.Struct("<IQ")    # actor record header: seq, t_submit_ns
@@ -385,31 +416,43 @@ _U32_MAX = 0xFFFFFFFF
 
 
 def pack_actor_task(task_id: bytes, mkey: bytes, args, kwargs,
-                    t_ns: int, seq: int) -> bytes:
+                    t_ns: int, seq: int, trace: bytes = b"") -> bytes:
     """Actor-lane task record (protocol 1.8). Same two-tier arg encoding
     as :func:`pack_task` ("A" = C pickler, "C" = serialization.pack), but
     the header always carries the per-lane call sequence number plus the
     submit stamp (0 when the recorder is off) — the seq is what lets
     async-actor completions stream back out of ring order while the
-    driver still accounts each call exactly once."""
+    driver still accounts each call exactly once. ``trace`` (2.1) rides
+    behind the header, flagged by TRACE_BIT exactly like task records."""
     if _simple(args) and (not kwargs or _simple(kwargs)):
         body = pickle.dumps((task_id, mkey, args, kwargs), protocol=5)
+        if trace:
+            return b"A" + _AHDR.pack(seq, t_ns | TRACE_BIT) + trace + body
         return b"A" + _AHDR.pack(seq, t_ns) + body
     body = serialization.pack((task_id, mkey, args, kwargs))
+    if trace:
+        return b"C" + _AHDR.pack(seq, t_ns | TRACE_BIT) + trace + body
     return b"C" + _AHDR.pack(seq, t_ns) + body
 
 
 def unpack_actor_task(rec: bytes):
-    """-> (task_id, mkey, args, kwargs, t_submit_ns, seq). Pre-1.8 actor
-    records ("P"/"S"/"Q"/"R") decode with seq=None."""
+    """-> (task_id, mkey, args, kwargs, t_submit_ns, seq, trace).
+    Pre-1.8 actor records ("P"/"S"/"Q"/"R") decode with seq=None;
+    untraced records decode with trace=b""."""
     kind = rec[:1]
-    if kind == b"A":
+    if kind in (b"A", b"C"):
         seq, t_ns = _AHDR.unpack_from(rec, 1)
-        return (*pickle.loads(rec[13:]), t_ns, seq)
-    if kind == b"C":
-        seq, t_ns = _AHDR.unpack_from(rec, 1)
-        return (*serialization.unpack(rec[13:]), t_ns, seq)
-    return (*unpack_task(rec), None)
+        off = 13
+        trace = b""
+        if t_ns & TRACE_BIT:
+            t_ns &= ~TRACE_BIT
+            trace = rec[off:off + TRACE_LEN]
+            off += TRACE_LEN
+        if kind == b"A":
+            return (*pickle.loads(rec[off:]), t_ns, seq, trace)
+        return (*serialization.unpack(rec[off:]), t_ns, seq, trace)
+    t = unpack_task(rec)
+    return (*t[:5], None, t[5])
 
 
 def pack_stamp(ring_ns: int, deser_ns: int, exec_ns: int) -> bytes:
@@ -433,31 +476,41 @@ def unpack_stamp(stamp: bytes) -> tuple[int, int, int]:
 
 
 def pack_reply(task_id: bytes, status: int, payload: bytes,
-               stamp: bytes = b"", seq: int | None = None) -> bytes:
+               stamp: bytes = b"", seq: int | None = None,
+               trace: bytes = b"") -> bytes:
+    if stamp:
+        status |= STAMPED
+    tail = stamp
     if seq is not None:
         status |= SEQED
-        tail = (stamp + _SEQ.pack(seq)) if stamp else _SEQ.pack(seq)
-        if stamp:
-            status |= STAMPED
+        tail += _SEQ.pack(seq)
+    if trace:
+        status |= TRACED
+        tail += trace
+    if tail:
         return struct.pack("<16sI", task_id, status) + tail + payload
-    if stamp:
-        return struct.pack("<16sI", task_id, status | STAMPED) + stamp + payload
     return struct.pack("<16sI", task_id, status) + payload
 
 
 def unpack_reply(rec: bytes):
-    """-> (task_id, status, payload, stamp | None, seq | None)."""
+    """-> (task_id, status, payload, stamp | None, seq | None, trace) —
+    trace b"" unless the reply echoes a submit record's trace leg."""
     task_id, status = struct.unpack_from("<16sI", rec)
     off = 20
     stamp = None
     seq = None
+    trace = b""
     if status & STAMPED:
         stamp = rec[off:off + 16]
         off += 16
     if status & SEQED:
         (seq,) = _SEQ.unpack_from(rec, off)
         off += 4
-    return task_id, status & ~(STAMPED | SEQED), rec[off:], stamp, seq
+    if status & TRACED:
+        trace = rec[off:off + TRACE_LEN]
+        off += TRACE_LEN
+    return (task_id, status & ~(STAMPED | SEQED | TRACED), rec[off:],
+            stamp, seq, trace)
 
 
 def pack_shm_size(size: int) -> bytes:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import pickle
 import random
 import sys
 import time
@@ -317,6 +318,19 @@ class GcsServer:
         self._lease_batches: dict[tuple, list] = {}
         self.job_counter = 0
         self.task_events: list[dict] = []  # ring buffer of task lifecycle events
+        # trace assembler (utils/tracing.py wire context): span rows
+        # riding report_task_events fold into per-trace buckets here, so
+        # one request's causal tree is ONE lookup (rpc_get_trace) instead
+        # of a scan over the whole event ring. Bounded by
+        # cfg.trace_table_max with SLOW-TRACE retention: eviction
+        # protects the slowest cfg.trace_slow_keep fraction (the p99
+        # outliers tracing exists to explain) and drops the oldest of
+        # the rest. Volatile (like task_events): not journaled.
+        self.traces: dict[str, dict] = {}
+        self._trace_cp_done: set[str] = set()  # critical path computed
+        # ns="latency" retention (satellite): last-touch stamps per key;
+        # the health loop sweeps entries dead publishers left behind
+        self._latency_touched: dict[str, float] = {}
 
         # pubsub: channel -> {Connection}
         self.subs: dict[str, set[rpc.Connection]] = {}
@@ -381,6 +395,8 @@ class GcsServer:
         ok = self.kvstore.put(ns, p["key"], p["value"],
                               overwrite=p.get("overwrite", True),
                               journal=journal)
+        if ns == "latency":  # retention clock (see _latency_sweep)
+            self._latency_touched[p["key"]] = time.monotonic()
         self.mark_dirty()
         if journal:
             await self._commit_barrier()
@@ -1223,13 +1239,196 @@ class GcsServer:
 
     # -------------------------------------------------- task events / timeline
     async def rpc_report_task_events(self, conn, p):
-        self.task_events.extend(p["events"])
-        if len(self.task_events) > 100_000:
-            del self.task_events[: len(self.task_events) - 100_000]
+        events = p["events"]
+        self.task_events.extend(events)
+        cap = getattr(self.cfg, "gcs_task_events_cap", 100_000)
+        if len(self.task_events) > cap:
+            del self.task_events[: len(self.task_events) - cap]
+        for ev in events:
+            if ev.get("state") == "SPAN":
+                self._trace_ingest(ev)
         return True
 
     async def rpc_get_task_events(self, conn, p):
-        return list(self.task_events)
+        events = self.task_events
+        if p.get("span_only"):
+            events = [e for e in events if e.get("state") == "SPAN"]
+        offset = int(p.get("offset") or 0)
+        limit = p.get("limit")
+        if offset:
+            events = events[:-offset] if offset < len(events) else []
+        if limit is not None:
+            events = events[-int(limit):]
+        return list(events)
+
+    # ------------------------------------------------- trace assembler
+    def _trace_ingest(self, ev: dict) -> None:
+        """Fold one span row into its trace bucket (report ingest)."""
+        span = ev.get("span") or {}
+        trace_id = span.get("trace_id")
+        if not trace_id:
+            return
+        row = {**span,
+               "task_id": ev.get("task_id"),
+               "worker_id": ev.get("worker_id"),
+               "node_id": ev.get("node_id"),
+               "pid": ev.get("pid")}
+        tr = self.traces.get(trace_id)
+        if tr is None:
+            if len(self.traces) >= max(2, self.cfg.trace_table_max):
+                self._trace_evict()
+            tr = self.traces[trace_id] = {
+                "spans": [], "start_ts": row.get("start_ts", 0.0),
+                "end_ts": row.get("end_ts", 0.0),
+                "touched": time.monotonic()}
+        if len(tr["spans"]) < max(8, self.cfg.trace_spans_max):
+            tr["spans"].append(row)
+        tr["start_ts"] = min(tr["start_ts"], row.get("start_ts", tr["start_ts"]))
+        tr["end_ts"] = max(tr["end_ts"], row.get("end_ts", tr["end_ts"]))
+        tr["touched"] = time.monotonic()
+        # NOTE: _trace_cp_done stays sticky — a straggler span landing
+        # after the critical-path pass joins the assembled trace (the
+        # get_trace view recomputes live) but must not re-OBSERVE the
+        # whole stage set into the histogram (metrics are once per trace)
+
+    def _trace_evict(self) -> None:
+        """Slow-trace retention: protect the slowest ``trace_slow_keep``
+        fraction (by root wall duration), evict the OLDEST of the rest —
+        the p99 outlier you will be paged about at 3am survives, the
+        10,000 identical fast requests around it are sampled by age."""
+        items = list(self.traces.items())
+        keep = max(1, int(len(items) * self.cfg.trace_slow_keep))
+        by_dur = sorted(items, key=lambda kv: kv[1]["end_ts"] - kv[1]["start_ts"],
+                        reverse=True)
+        protected = {tid for tid, _ in by_dur[:keep]}
+        evictable = [(tid, tr) for tid, tr in items if tid not in protected]
+        if not evictable:
+            evictable = items
+        victim = min(evictable, key=lambda kv: kv[1]["touched"])[0]
+        self.traces.pop(victim, None)
+        self._trace_cp_done.discard(victim)
+
+    def _trace_view(self, trace_id: str, tr: dict,
+                    with_spans: bool) -> dict:
+        spans = tr["spans"]
+        procs = {(s.get("node_id"), s.get("pid")) for s in spans}
+        view = {
+            "trace_id": trace_id,
+            "start_ts": tr["start_ts"],
+            "end_ts": tr["end_ts"],
+            "dur_ms": max(0.0, tr["end_ts"] - tr["start_ts"]) * 1e3,
+            "n_spans": len(spans),
+            "procs": len(procs),
+        }
+        # root name: earliest parentless span — O(n), no critical-path
+        # interval math (list_traces runs this per trace per poll)
+        ids = {s.get("span_id") for s in spans}
+        roots = [s for s in spans if s.get("parent_span_id") not in ids]
+        if roots:
+            view["root_name"] = min(
+                roots, key=lambda s: s.get("start_ts", 0.0)).get("name")
+        if with_spans:
+            from ray_tpu.utils.tracing import TraceCriticalPath
+
+            view["spans"] = sorted(spans,
+                                   key=lambda s: s.get("start_ts", 0.0))
+            view["critical_path"] = TraceCriticalPath.compute(spans)
+        return view
+
+    async def rpc_get_trace(self, conn, p):
+        tr = self.traces.get(p["trace_id"])
+        if tr is None:
+            return None
+        return self._trace_view(p["trace_id"], tr, with_spans=True)
+
+    async def rpc_list_traces(self, conn, p):
+        rows = [self._trace_view(tid, tr, with_spans=False)
+                for tid, tr in self.traces.items()]
+        rows.sort(key=lambda r: r["start_ts"], reverse=True)
+        offset = int(p.get("offset") or 0)
+        limit = int(p.get("limit") or 1000)
+        return rows[offset:offset + limit]
+
+    _CP_BOUNDS = (10.0, 100.0, 1e3, 1e4, 1e5, 1e6, 1e7)
+
+    def _trace_metrics_tick(self) -> None:
+        """Critical-path pass over QUIESCED traces (no new span for >2
+        flush intervals): attribute each sampled request's latency to
+        queue/exec/wire/pull once and publish the
+        ``rt_request_critical_path_us`` histogram into the volatile
+        ns="metrics" kv beside the workers' snapshots (the dashboard and
+        prometheus_metrics merge it for free). Cells are HAND-ROLLED
+        per-stage, never the process-global metrics registry: an
+        in-process GCS (the default ``ray_tpu.init()`` topology) shares
+        that registry with the driver, whose own flush already publishes
+        it — re-publishing the shared snapshot under a second key would
+        double-count every driver metric."""
+        from ray_tpu.utils.tracing import TraceCriticalPath
+
+        cells = getattr(self, "_cp_cells", None)
+        if cells is None:
+            cells = self._cp_cells = {}
+        quiet = time.monotonic() - 2.0 * max(
+            0.5, self.cfg.task_events_report_interval_s)
+        fresh = False
+        for trace_id, tr in list(self.traces.items()):
+            if trace_id in self._trace_cp_done or tr["touched"] > quiet:
+                continue
+            self._trace_cp_done.add(trace_id)
+            cp = TraceCriticalPath.compute(tr["spans"])
+            if cp is None:
+                continue
+            fresh = True
+            for stage, us in cp["stages"].items():
+                if us <= 0:
+                    continue
+                cell = cells.setdefault(
+                    stage, {"counts": [0] * (len(self._CP_BOUNDS) + 1),
+                            "sum": 0.0})
+                i = 0
+                while i < len(self._CP_BOUNDS) and us > self._CP_BOUNDS[i]:
+                    i += 1
+                cell["counts"][i] += 1
+                cell["sum"] += us
+        if fresh:
+            snap = {"metrics": {"rt_request_critical_path_us": {
+                "type": "histogram",
+                "boundaries": list(self._CP_BOUNDS),
+                "samples": [{"tags": {"stage": st}, **cell}
+                            for st, cell in cells.items()],
+            }}}
+            try:
+                self.kvstore.put("metrics", "gcs", pickle.dumps(snap),
+                                 overwrite=True, journal=False)
+            except Exception:
+                log.debug("trace metrics publish failed", exc_info=True)
+
+    def _latency_sweep(self) -> None:
+        """ns="latency" retention (cfg.latency_retention_s): windows a
+        dead worker last published live forever otherwise — an idle
+        long-lived cluster accumulates one leftover window per departed
+        worker. Keys re-put recently stay; the rest are deleted."""
+        keep_s = self.cfg.latency_retention_s
+        if keep_s <= 0:
+            return
+        now = time.monotonic()
+        try:
+            keys = self.kvstore.keys("latency", "")
+        except Exception:
+            return
+        for k in keys:
+            touched = self._latency_touched.get(k)
+            if touched is None:
+                # first sight (e.g. GCS restart): start the clock now
+                self._latency_touched[k] = now
+            elif now - touched > keep_s:
+                self.kvstore.delete("latency", k)
+                self._latency_touched.pop(k, None)
+        # drop stamps for keys already gone
+        live = set(keys)
+        for k in list(self._latency_touched):
+            if k not in live:
+                self._latency_touched.pop(k, None)
 
     # -------------------------------------------------------------- lifecycle
     def _on_disconnect(self, conn):
@@ -1263,6 +1462,11 @@ class GcsServer:
                 for info in list(self.nodes.values()):
                     if info.alive:
                         await self._audit_node_bundles(info)
+                self._latency_sweep()
+            # trace critical-path pass over quiesced traces (cheap: only
+            # traces that stopped growing since the last tick)
+            if self.traces:
+                self._trace_metrics_tick()
             # restored ALIVE actors whose node never re-registered after a
             # GCS restart are dead, not merely unobserved
             restored_at = getattr(self, "_restored_at", None)

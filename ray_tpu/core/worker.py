@@ -94,6 +94,12 @@ class Worker:
         self._exec_mutex = _threading.Lock()
         # actor-lane W_TASK sampling counter (see _fast_actor_exec_batch)
         self._rec_wt_n = 0
+        # wire tracing (utils/tracing.py): cached like the driver's
+        # _trace_on — gates the per-record UNSAMPLED suppression (head
+        # sampling is per request: an untraced record under tracing-on
+        # means the submitter decided unsampled, so nested .remote()
+        # calls from its user code must not re-draw a fresh root)
+        self._trace_on = bool(self.cfg.tracing_enabled)
 
     async def start(self):
         # Apply the forced-CPU backend (tests / single-chip hosts) BEFORE
@@ -583,7 +589,7 @@ class Worker:
         replies = []
         dispatch_items = []
         for rec in recs:
-            tid, mkey, args, kwargs, t_sub, seq = \
+            tid, mkey, args, kwargs, t_sub, seq, trc = \
                 fastpath.unpack_actor_task(rec)
             mname = mkey[3:].decode()  # b"am:<method>"
             verdict = None if state["downgraded"] or inst is None \
@@ -608,7 +614,7 @@ class Worker:
                 # handed to the loop in ONE wake per batch below; each
                 # coroutine replies when its call ends
                 dispatch_items.append((tid, mname, kind, group, args,
-                                       kwargs, t_sub, t_pop, seq))
+                                       kwargs, t_sub, t_pop, seq, trc))
                 t_prev = time.perf_counter_ns()
                 continue
             t_x0 = time.perf_counter_ns()
@@ -616,7 +622,11 @@ class Worker:
                 if chaos.ENABLED:
                     chaos.point("worker.exec", name=mname, fast=1)
                 m = getattr(inst, mname)
-                ok, val = True, m(*args, **kwargs)
+                if self._trace_on:  # sampled: exec span; else suppress
+                    with self._fast_exec_span(trc, tid, mname, "ring"):
+                        ok, val = True, m(*args, **kwargs)
+                else:
+                    ok, val = True, m(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — reply on
                 ok, val = False, e
             t_x1 = time.perf_counter_ns()
@@ -627,7 +637,7 @@ class Worker:
             replies.append(self._fast_pack_result(
                 tid, ok, val, inline_max,
                 fastpath.pack_stamp(ring_ns, deser_ns, exec_ns)
-                if t_sub else b"", seq=seq))
+                if t_sub else b"", seq=seq, trace=trc))
             if rec_r is not None:
                 # same 1-in-16 W_TASK sampling as the normal pump (the
                 # counter lives on self: batches don't reset it)
@@ -668,7 +678,8 @@ class Worker:
             t.add_done_callback(pending.discard)
 
     async def _fast_exec_dispatched(self, ring, tid, mname, kind, group,
-                                    args, kwargs, t_sub, t_pop, seq):
+                                    args, kwargs, t_sub, t_pop, seq,
+                                    trc=b"", transport="ring"):
         """Loop-side execution of one dispatched actor ring record: async
         methods run on the loop (group semaphore honored), sync methods
         of threaded/grouped actors on the right pool — exactly where the
@@ -677,6 +688,8 @@ class Worker:
         from ray_tpu.core import fastpath
 
         inst = self.actor_instance
+        span = (self._fast_exec_span(trc, tid, mname, transport)
+                if self._trace_on else None)
         t_x0 = time.perf_counter_ns()
         try:
             if chaos.ENABLED:
@@ -689,6 +702,9 @@ class Worker:
                 raise TaskError(
                     f"concurrency group {group!r} not declared on this "
                     f"actor (declared: {sorted(self._group_execs)})")
+            if span is not None:
+                span.__enter__()  # CM protocol inline: the exit must
+                # run before the reply packs, exceptions included
             if kind == "async":
                 sem = self._group_sems.get(group) if group else None
                 if sem is not None:
@@ -699,11 +715,27 @@ class Worker:
             else:
                 executor = (self._group_execs[group] if group
                             else self.executor)
-                val = await asyncio.get_running_loop().run_in_executor(
-                    executor, lambda: m(*args, **kwargs))
+                if span is not None:
+                    # run_in_executor does NOT copy contextvars (unlike
+                    # asyncio.to_thread): carry the span context — or
+                    # the UNSAMPLED suppression — into the pool thread
+                    # so nested .remote() calls from a threaded/grouped
+                    # sync method chain (or stay suppressed) correctly
+                    import contextvars as _cv
+
+                    cctx = _cv.copy_context()
+                    val = await asyncio.get_running_loop().run_in_executor(
+                        executor, lambda: cctx.run(m, *args, **kwargs))
+                else:
+                    val = await asyncio.get_running_loop().run_in_executor(
+                        executor, lambda: m(*args, **kwargs))
             ok = True
+            if span is not None:
+                span.__exit__(None, None, None)
         except BaseException as e:  # noqa: BLE001 — reply on
             ok, val = False, e
+            if span is not None and span._token is not None:
+                span.__exit__(type(e), e, None)
         t_x1 = time.perf_counter_ns()
         if t_sub:
             # the dispatch hop (pump -> loop/pool) rides the deserialize
@@ -715,7 +747,7 @@ class Worker:
             stamp = b""
         rep = self._fast_pack_result(
             tid, ok, val, self.cfg.fastpath_inline_result_max, stamp,
-            seq=seq, node=getattr(ring, "_desc_node", None))
+            seq=seq, node=getattr(ring, "_desc_node", None), trace=trc)
         await self._fast_reply_one(ring, rep)
 
     async def _fast_reply_one(self, ring, rec: bytes):
@@ -889,7 +921,7 @@ class Worker:
         replies = []
         t_prev = time.perf_counter_ns()
         for rec in recs:
-            tid, mkey, args, kwargs, t_sub, seq = \
+            tid, mkey, args, kwargs, t_sub, seq, trc = \
                 fastpath.unpack_actor_task(rec)
             t_sub = self._tunnel_t_sub(t_sub, t_pop)
             mname = mkey[3:].decode()
@@ -906,7 +938,11 @@ class Worker:
                 if chaos.ENABLED:
                     chaos.point("worker.exec", name=mname, fast=1)
                 m = getattr(inst, mname)
-                ok, val = True, m(*args, **(kwargs or {}))
+                if self._trace_on:  # sampled: exec span; else suppress
+                    with self._fast_exec_span(trc, tid, mname, "tunnel"):
+                        ok, val = True, m(*args, **(kwargs or {}))
+                else:
+                    ok, val = True, m(*args, **(kwargs or {}))
             except BaseException as e:  # noqa: BLE001 — reply on
                 ok, val = False, e
             t_x1 = time.perf_counter_ns()
@@ -916,7 +952,8 @@ class Worker:
                      if t_sub else b"")
             t_prev = t_x1
             replies.append(self._fast_pack_result(
-                tid, ok, val, inline_max, stamp, seq=seq, node=node))
+                tid, ok, val, inline_max, stamp, seq=seq, node=node,
+                trace=trc))
         if replies:
             st["sink"].push_batch(fastpath.REP, fastpath.frame(replies))
 
@@ -950,7 +987,8 @@ class Worker:
                     return
                 t_prev = time.perf_counter_ns()
                 continue
-            tid, func_id, args, kwargs, t_sub = fastpath.unpack_task(rec)
+            tid, func_id, args, kwargs, t_sub, trc = \
+                fastpath.unpack_task(rec)
             t_sub = self._tunnel_t_sub(t_sub, t_pop)
             fn = cache.get(func_id)
             if fn is None:
@@ -977,7 +1015,13 @@ class Worker:
                         chaos.point("worker.exec",
                                     name=getattr(fn, "__name__", "task"),
                                     fast=1)
-                    ok, val = True, fn(*args, **(kwargs or {}))
+                    if self._trace_on:  # sampled: span; else suppress
+                        with self._fast_exec_span(
+                                trc, tid, getattr(fn, "__name__", "task"),
+                                "tunnel"):
+                            ok, val = True, fn(*args, **(kwargs or {}))
+                    else:
+                        ok, val = True, fn(*args, **(kwargs or {}))
             except BaseException as e:  # noqa: BLE001 — reply on
                 ok, val = False, e
             t_x1 = time.perf_counter_ns()
@@ -987,7 +1031,7 @@ class Worker:
                      if t_sub else b"")
             t_prev = t_x1
             replies.append(self._fast_pack_result(
-                tid, ok, val, inline_max, stamp, node=node))
+                tid, ok, val, inline_max, stamp, node=node, trace=trc))
         if replies:
             st["sink"].push_batch(fastpath.REP, fastpath.frame(replies))
 
@@ -1040,7 +1084,7 @@ class Worker:
 
         sink = st["sink"]
         if st["kind"] == "actor":
-            tid, mkey, args, kwargs, t_sub, seq = \
+            tid, mkey, args, kwargs, t_sub, seq, trc = \
                 fastpath.unpack_actor_task(rec)
             t_sub = self._tunnel_t_sub(t_sub, t_pop)
             mname = mkey[3:].decode()
@@ -1063,10 +1107,10 @@ class Worker:
                 return
             await self._fast_exec_dispatched(
                 sink, tid, mname, verdict[0], verdict[1], args, kwargs,
-                t_sub, t_pop, seq)
+                t_sub, t_pop, seq, trc, "tunnel")
             return
         # plain task record ("Q"/"R"/"P"/"S")
-        tid, func_id, args, kwargs, t_sub = fastpath.unpack_task(rec)
+        tid, func_id, args, kwargs, t_sub, trc = fastpath.unpack_task(rec)
         t_sub = self._tunnel_t_sub(t_sub, t_pop)
         try:
             fn = await self._load_function(func_id)
@@ -1094,6 +1138,11 @@ class Worker:
                     chaos.point("worker.exec",
                                 name=getattr(fn, "__name__", "task"),
                                 fast=1)
+                if self._trace_on:  # sampled: span; else suppress
+                    with self._fast_exec_span(
+                            trc, tid, getattr(fn, "__name__", "task"),
+                            "tunnel"):
+                        return fn(*args, **(kwargs or {}))
                 return fn(*args, **(kwargs or {}))
 
         t_x0 = time.perf_counter_ns()
@@ -1108,7 +1157,7 @@ class Worker:
                  if t_sub else b"")
         rep = self._fast_pack_result(
             tid, ok, val, self.cfg.fastpath_inline_result_max, stamp,
-            node=self.node_id.binary())
+            node=self.node_id.binary(), trace=trc)
         await self._fast_reply_one(sink, rep)
 
     def _fast_actor_pump_cycle(self, ring, state: dict):
@@ -1243,7 +1292,7 @@ class Worker:
                 while True:
                     for rec in recs:
                         try:
-                            tid, func_id, args, kwargs, t_sub = (
+                            tid, func_id, args, kwargs, t_sub, trc = (
                                 fastpath.unpack_task(rec))
                         except Exception:
                             # undecodable record: without its task id there
@@ -1287,7 +1336,17 @@ class Worker:
                                 chaos.point(
                                     "worker.exec", fast=1,
                                     name=getattr(fn, "__name__", "task"))
-                            ok, val = True, fn(*args, **kwargs)
+                            if self._trace_on:  # (2.1) sampled: child
+                                # exec span; unsampled: suppression —
+                                # both keep the contextvar right for
+                                # nested .remote() from user code
+                                with self._fast_exec_span(
+                                        trc, tid,
+                                        getattr(fn, "__name__", "task"),
+                                        "ring"):
+                                    ok, val = True, fn(*args, **kwargs)
+                            else:
+                                ok, val = True, fn(*args, **kwargs)
                         except BaseException as e:  # noqa: BLE001 — reply on
                             ok, val = False, e
                         finally:
@@ -1307,7 +1366,7 @@ class Worker:
                         else:
                             stamp = b""
                         replies.append(self._fast_pack_result(
-                            tid, ok, val, inline_max, stamp))
+                            tid, ok, val, inline_max, stamp, trace=trc))
                         if rec_r is not None:
                             wt_n += 1
                             if not (wt_n & 15):
@@ -1362,19 +1421,20 @@ class Worker:
 
     def _fast_pack_result(self, tid: bytes, ok: bool, val, inline_max: int,
                           stamp: bytes = b"", seq: int | None = None,
-                          node: bytes | None = None):
+                          node: bytes | None = None, trace: bytes = b""):
         from ray_tpu.core import fastpath
 
         if not ok:
             return fastpath.pack_reply(tid, fastpath.ERR,
-                                       self._fast_pack_error(val), stamp, seq)
+                                       self._fast_pack_error(val), stamp,
+                                       seq, trace)
         try:
             meta, buffers = serialization.dumps_with_buffers(val)
             size = serialization.total_size(meta, buffers)
             if size <= inline_max:
                 return fastpath.pack_reply(
                     tid, fastpath.OK, _pack_bytes(meta, buffers, size),
-                    stamp, seq)
+                    stamp, seq, trace)
             # big result: place it in the node's arena under the return oid
             # (same-node owner reads it directly; location registration is
             # the owner's migration step)
@@ -1389,10 +1449,11 @@ class Worker:
             return fastpath.pack_reply(
                 tid, fastpath.OK_SHM,
                 fastpath.pack_shm_desc(size, node) if node is not None
-                else fastpath.pack_shm_size(size), stamp, seq)
+                else fastpath.pack_shm_size(size), stamp, seq, trace)
         except Exception as e:
             return fastpath.pack_reply(tid, fastpath.ERR,
-                                       self._fast_pack_error(e), stamp, seq)
+                                       self._fast_pack_error(e), stamp,
+                                       seq, trace)
 
     def _fast_pack_error(self, exc) -> bytes:
         payload = cloudpickle.dumps(_as_task_error(exc))
@@ -1658,11 +1719,15 @@ class Worker:
                         name=spec.get("name") or spec.get("method", "task"))
         tc = spec.get("trace_ctx")
         if not tc:
+            if self._trace_on:  # unsampled request: inherit the decision
+                with _TraceSuppress():
+                    return fn(*args, **kwargs)
             return fn(*args, **kwargs)
         from ray_tpu.utils import tracing
 
         name = spec.get("name") or spec.get("method", "task")
-        with tracing.span(f"{name}::run", tc, self._span_sink(spec)):
+        with tracing.span(f"{name}::run", tc, self._span_sink(spec),
+                          stage="exec", transport="rpc"):
             return fn(*args, **kwargs)
 
     async def _traced_acall(self, spec, coro_fn, args, kwargs):
@@ -1672,11 +1737,15 @@ class Worker:
                         name=spec.get("name") or spec.get("method", "task"))
         tc = spec.get("trace_ctx")
         if not tc:
+            if self._trace_on:  # unsampled request: inherit the decision
+                with _TraceSuppress():
+                    return await coro_fn(*args, **kwargs)
             return await coro_fn(*args, **kwargs)
         from ray_tpu.utils import tracing
 
         name = spec.get("name") or spec.get("method", "task")
-        with tracing.span(f"{name}::run", tc, self._span_sink(spec)):
+        with tracing.span(f"{name}::run", tc, self._span_sink(spec),
+                          stage="exec", transport="rpc"):
             return await coro_fn(*args, **kwargs)
 
     def _span_sink(self, spec):
@@ -1686,6 +1755,37 @@ class Worker:
                 span=s, worker_id=self.worker_id.hex(),
                 node_id=self.node_id.hex(), pid=os.getpid())
         return sink
+
+    def _fast_span_sink(self, tid: bytes):
+        """Span sink for fast-lane records (raw task-id bytes instead of
+        a spec dict) — built only for SAMPLED records, so the allocation
+        never rides the unsampled path."""
+        def sink(s):
+            self.core.task_events.emit(
+                task_id=tid.hex(), name=s["name"], state="SPAN",
+                span=s, worker_id=self.worker_id.hex(),
+                node_id=self.node_id.hex(), pid=os.getpid())
+        return sink
+
+    def _fast_exec_span(self, trc: bytes, tid: bytes, name: str,
+                        transport: str):
+        """Child span around one sampled fast-lane record's execution:
+        the record's wire leg is the parent (the driver's pre-minted
+        ::call span, so exec nests inside the wire interval), the
+        contextvar activates so nested ``.remote()`` calls from user
+        code chain into the same trace across any number of processes.
+
+        For an UNTRACED record under tracing-on (trc empty: the
+        submitter decided unsampled), returns a suppression guard
+        instead — nested submits inherit the unsampled decision rather
+        than re-drawing a root mid-request."""
+        from ray_tpu.utils import tracing
+
+        if not trc:
+            return _TraceSuppress()
+        return tracing.span(f"{name}::run", tracing.unpack_ctx(trc),
+                            self._fast_span_sink(tid), stage="exec",
+                            transport=transport)
 
     def _exec_actor_run_thread(self, specs):
         out = []
@@ -2222,6 +2322,33 @@ class Worker:
 
     async def rpc_ping(self, conn, p):
         return {"pid": os.getpid(), "actor": self.actor_id}
+
+
+class _TraceSuppress:
+    """Guard installing tracing.UNSAMPLED around one UNTRACED record's
+    execution when tracing is enabled cluster-wide: head sampling is per
+    request, so nested ``.remote()`` calls from an unsampled request's
+    user code inherit the decision instead of re-drawing a fresh root
+    mid-request. Duck-types the span interface the dispatch path's
+    manual enter/exit handling expects (``_token``)."""
+
+    __slots__ = ("_token",)
+
+    def __init__(self):
+        self._token = None
+
+    def __enter__(self):
+        from ray_tpu.utils import tracing
+
+        self._token = tracing.suppress()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        from ray_tpu.utils import tracing
+
+        tracing.deactivate(self._token)
+        self._token = None
+        return False
 
 
 class _TunnelSink:

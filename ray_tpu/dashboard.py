@@ -16,6 +16,9 @@ hash-routed JS app from ``dashboard_client/``, no build step):
     GET /api/summary/tasks     task counts by state
     GET /api/serve             serve applications/deployments status
     GET /api/serve_autoscale   fired autoscale decisions (?key=app/dep)
+    GET /api/slo_burn          SLO burn-rate alerts (?key=app/dep)
+    GET /api/traces            assembled request traces (newest first)
+    GET /api/trace/{id}        one trace as a waterfall + critical path
     GET /api/metrics           aggregated cluster metrics
     GET /api/timeline          chrome-trace events (load into perfetto)
     GET /api/latency           flight-recorder per-stage task latency
@@ -161,6 +164,56 @@ def build_app():
 
     # fired autoscale decisions with causes (serve/dataplane/autoscaler)
     app.router.add_get("/api/serve_autoscale", serve_autoscale)
+
+    async def slo_burn(request):
+        import asyncio
+
+        key = request.query.get("key")
+        try:
+            events = await asyncio.to_thread(state.list_slo_burn_events, key)
+            return web.json_response(_plain(events))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+
+    # SLO error-budget burn-rate alerts (serve/dataplane/slo.py)
+    app.router.add_get("/api/slo_burn", slo_burn)
+
+    async def traces(request):
+        import asyncio
+
+        try:
+            rows = await asyncio.to_thread(
+                state.list_traces,
+                int(request.query.get("limit", 100)),
+                int(request.query.get("offset", 0)))
+            return web.json_response(_plain(rows))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+
+    async def trace_waterfall(request):
+        """One assembled trace as a waterfall: spans sorted by start,
+        each with its offset/duration relative to the trace start plus
+        the critical-path stage attribution — render directly, or feed
+        the spans to any OTel-style viewer."""
+        import asyncio
+
+        trace_id = request.match_info["trace_id"]
+        try:
+            tr = await asyncio.to_thread(state.get_trace, trace_id)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+        if tr is None:
+            return web.json_response({"error": "unknown trace"}, status=404)
+        t0 = tr.get("start_ts", 0.0)
+        for s in tr.get("spans", []):
+            s["offset_ms"] = max(0.0, (s.get("start_ts", t0) - t0) * 1e3)
+            s["dur_ms"] = max(
+                0.0, (s.get("end_ts", 0.0) - s.get("start_ts", 0.0)) * 1e3)
+        return web.json_response(_plain(tr))
+
+    # trace assembler surfaces (state.get_trace / list_traces)
+    app.router.add_get("/api/traces", traces)
+    app.router.add_get("/api/trace/{trace_id}", trace_waterfall)
 
     async def worker_stack(request):
         import asyncio

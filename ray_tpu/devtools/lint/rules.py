@@ -510,3 +510,51 @@ class BatchQueueConfiguredPerCall(Rule):
                    f"{detail} re-creates the batch queue per call, "
                    "defeating request coalescing; hoist the batched "
                    "method to class/module level")
+
+
+@register
+class SpanContextRederivedInLoop(Rule):
+    id = "RT016"
+    summary = ("fresh trace context constructed inside a request-path "
+               "loop body")
+    rationale = ("tracing.span(name, None, ...) / tracing.inject() / "
+                 "tracing.submit_context() START a trace when no context "
+                 "is given: inside a loop body each iteration mints a "
+                 "NEW root (fresh trace_id, fresh head-sampling draw), "
+                 "so one logical request shatters into N single-span "
+                 "traces the assembler can never stitch — the RT011 "
+                 "metric-in-loop shape, applied to spans. Capture the "
+                 "context ONCE outside the loop (tracing.current() / "
+                 "submit_context()) and pass it to every per-item span, "
+                 "the way the worker pumps batch-stamp their records")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if not ctx.loop_depth:
+            return
+        origin = ctx.imports.resolve(node.func)
+        if not (origin and origin[0] == "ray_tpu"
+                and "tracing" in origin[:-1]):
+            return
+        leaf = origin[-1]
+        if leaf in ("inject", "submit_context"):
+            ctx.report(self, node,
+                       f"tracing.{leaf}() in a loop body re-derives the "
+                       "trace context per iteration (a fresh ROOT trace "
+                       "each time the contextvar is unset); hoist the "
+                       "capture above the loop and reuse it")
+            return
+        if leaf != "span":
+            return
+        # the trace_ctx argument (2nd positional): missing or a literal
+        # None means "start a fresh trace here" — per iteration
+        tc = node.args[1] if len(node.args) >= 2 else None
+        if tc is None:
+            for kw in node.keywords:
+                if kw.arg == "trace_ctx":
+                    tc = kw.value
+        if tc is None or (isinstance(tc, ast.Constant) and tc.value is None):
+            ctx.report(self, node,
+                       "tracing.span(...) opened in a loop body without "
+                       "a trace context starts a NEW trace per "
+                       "iteration; capture the parent context once "
+                       "outside the loop and pass it explicitly")

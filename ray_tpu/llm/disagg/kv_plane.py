@@ -156,13 +156,17 @@ def _chaos_kv_ship(phase: str, **ctx):
 
 
 def ship_pages(kpool, vpool, page_ids, token_ids, *, page_size: int,
-               kv_dtype: str = "native") -> KVPageManifest:
+               kv_dtype: str = "native",
+               trace_ctx=None) -> KVPageManifest:
     """Seal the KV pages ``page_ids`` (pool row indices, prompt order)
     into the local shm arena and return their manifest.
 
     ``token_ids`` are the prompt tokens the pages cover. Runs where the
     pool lives (the prefill worker); the driver only ever sees the
-    returned manifest.
+    returned manifest. ``trace_ctx`` (an owning request's captured
+    (trace_id, span_id)) tags the seal as a ``pull``-stage span in the
+    request's trace when sampled — wave-coalesced callers capture it at
+    enqueue, direct callers inherit the ambient context.
     """
     core = _core()
     node = core.node_id.binary() if core.node_id is not None else None
@@ -187,7 +191,8 @@ def ship_pages(kpool, vpool, page_ids, token_ids, *, page_size: int,
     m = KVPageManifest(token_ids=tuple(int(t) for t in token_ids),
                        page_size=int(page_size), kv_dtype=kv_dtype,
                        pages=entries)
-    telemetry.record(telemetry.KV_SHIP, time.perf_counter_ns() - t0, shipped)
+    telemetry.record(telemetry.KV_SHIP, time.perf_counter_ns() - t0,
+                     shipped, trace_ctx=trace_ctx)
     telemetry.count(pages_shipped=len(entries), kv_array_bytes=shipped,
                     kv_driver_bytes=manifest_nbytes(m))
     return m
@@ -256,7 +261,8 @@ def adopt_pages(manifest: KVPageManifest,
 
     k_stack, v_stack = stack("k"), stack("v")
     dm = manifest_nbytes(manifest) + (manifest_nbytes(extra) if extra else 0)
-    telemetry.record(telemetry.KV_SHIP, time.perf_counter_ns() - t0, fetched)
+    telemetry.record(telemetry.KV_SHIP, time.perf_counter_ns() - t0,
+                     fetched)  # adopt runs in the request's context
     telemetry.count(pages_adopted=len(pages), adoptions=1,
                     kv_array_bytes=fetched, kv_driver_bytes=dm)
     return k_stack, v_stack

@@ -65,6 +65,10 @@ class _Job:
     prefix: KVPageManifest | None
     fut: asyncio.Future
     t_enq: int = field(default_factory=time.perf_counter_ns)
+    # owning request's (trace_id, span_id), captured ONCE at enqueue —
+    # the wave loop runs outside the request's context, so batch-stamped
+    # telemetry (queue span, page-seal span) carries this instead
+    tctx: tuple | None = None
 
 
 class PrefillWorker:
@@ -144,7 +148,8 @@ class PrefillWorker:
         if self._task is None or self._task.done():
             self._task = loop.create_task(self._wave_loop())
         job = _Job(tokens, float(temperature), aid, prefix,
-                   loop.create_future())
+                   loop.create_future(),
+                   tctx=telemetry.capture_trace_ctx())
         self._pending.append(job)
         self._arrived.set()
         return await job.fut
@@ -208,7 +213,7 @@ class PrefillWorker:
         sfx: dict[tuple[int, int], list[_Job]] = {}
         for job in wave:
             telemetry.record(telemetry.PREFILL_QUEUE,
-                             t_dispatch - job.t_enq)
+                             t_dispatch - job.t_enq, trace_ctx=job.tctx)
             if job.prefix is None:
                 Tp_pad = -(-len(job.tokens) // self.PS) * self.PS
                 full.setdefault(Tp_pad, []).append(job)
@@ -234,7 +239,7 @@ class PrefillWorker:
             try:
                 m = ship_pages(self.kpool, self.vpool, pages_of[j],
                                job.tokens, page_size=self.PS,
-                               kv_dtype=self.kv_dtype)
+                               kv_dtype=self.kv_dtype, trace_ctx=job.tctx)
             except Exception as e:  # noqa: BLE001 — per-job failure
                 job.fut.set_exception(e)
                 continue

@@ -67,7 +67,8 @@ class DisaggLLMServer:
                  prefix_cache_bytes: int = 64 << 20,
                  prefill_n_pages: int | None = None,
                  max_wave: int = 8, wave_wait_s: float = 0.004,
-                 max_attempts: int = 3, decode_max_restarts: int = 2):
+                 max_attempts: int = 3, decode_max_restarts: int = 2,
+                 pool_resources: dict | None = None):
         import ray_tpu
 
         self.PS = page_size
@@ -81,8 +82,13 @@ class DisaggLLMServer:
                         lora_rank=lora_rank)
         # prefill pool: async actors with enough concurrency for calls to
         # coalesce into padded waves; staging pools freed per wave
+        # pool placement (e.g. {"bee": 0.25} / TPU-host resources): pin
+        # the pool workers beside their replica so pool hops ride the
+        # same-node shm rings and KV adoption stays zero-copy
+        pool_opts = ({"resources": dict(pool_resources)}
+                     if pool_resources else {})
         pf_cls = ray_tpu.remote(PrefillWorker).options(
-            max_concurrency=max(16, 4 * max_wave))
+            max_concurrency=max(16, 4 * max_wave), **pool_opts)
         self.prefill_pool = [
             pf_cls.remote(model_config, params, params_fn,
                           page_size=page_size,
@@ -94,7 +100,7 @@ class DisaggLLMServer:
         # in-flight requests re-adopt elsewhere meanwhile)
         dw_cls = ray_tpu.remote(DecodeWorker).options(
             max_concurrency=max(16, 2 * max_batch),
-            max_restarts=decode_max_restarts)
+            max_restarts=decode_max_restarts, **pool_opts)
         self.decode_pool = [
             dw_cls.remote(model_config, params, params_fn,
                           max_batch=max_batch, page_size=page_size,
@@ -108,6 +114,8 @@ class DisaggLLMServer:
         self._capacity = n_pages - 1  # page 0 is the junk page
         self._pf_rr = itertools.count()
         self._dw_rr = itertools.count()
+        # frozen per-(pool actor, method) fast-lane templates (_pool_call)
+        self._pool_tmpls: dict = {}
         self.duplicate_prefills = 0
         self.decode_retries = 0
         self.backpressured = 0
@@ -128,6 +136,41 @@ class DisaggLLMServer:
             if free >= n_need and free > best_free:
                 best, best_free = i, free
         return best
+
+    async def _pool_call(self, handle, method: str, args: tuple,
+                         kwargs: dict):
+        """Pool hop on the LOOP-side actor fast lane — the serve
+        router's mechanism (``fast_actor_submit_loop``) composed inward
+        (ROADMAP items 2/4): same-node pool workers ride the shm rings,
+        cross-node ones the node tunnel, with per-call RPC fallback for
+        anything the lane cannot carry. A broken lane surfaces as
+        ``ConnectionLost``, which :func:`_is_worker_death` already
+        classifies — the scheduler's own re-adopt/re-prefill retry owns
+        replay, and both legs are idempotent by construction (re-prefill
+        recomputes, re-adopt re-reads sealed pages). Sampled trace
+        context rides the record's wire leg either way."""
+        from ray_tpu.core import api as _api
+        from ray_tpu.core.core_client import FastLaneDeclined
+
+        core = _api.get_core()
+        try:
+            on_core = asyncio.get_running_loop() is core.loop
+        except RuntimeError:
+            on_core = False
+        if on_core and getattr(core.cfg, "fastpath_enabled", False):
+            key = (handle.actor_id, method)
+            tmpl = self._pool_tmpls.get(key)
+            if tmpl is None:
+                tmpl = self._pool_tmpls[key] = core.actor_call_template(
+                    handle.actor_id, method, 1, None)
+            out = core.fast_actor_submit_loop(handle.actor_id, method,
+                                              args, kwargs, tmpl)
+            if out is not None:
+                try:
+                    return await core.fast_actor_await(out[0], out[1])
+                except FastLaneDeclined:
+                    pass  # stale method table: RPC below, lane survives
+        return await getattr(handle, method).remote(*args, **kwargs)
 
     def _backpressure(self, n_need: int):
         from ray_tpu.serve.exceptions import BackPressureError
@@ -208,11 +251,12 @@ class DisaggLLMServer:
                             t_first = time.perf_counter_ns()
                             telemetry.record(telemetry.TTFT,
                                              t_first - t_arr)
-                    out = await self.decode_pool[widx].\
-                        decode_adopted.remote(
-                            toks, manifest, extra, first,
-                            max_tokens=mt, temperature=temp,
-                            adapter=adapter)
+                    with telemetry.traced("disagg::decode"):
+                        out = await self._pool_call(
+                            self.decode_pool[widx], "decode_adopted",
+                            (toks, manifest, extra, first),
+                            dict(max_tokens=mt, temperature=temp,
+                                 adapter=adapter))
                     return self._finish(toks, out, manifest, extra,
                                         prefix_m, t_arr, t_first, widx,
                                         attempt)
@@ -254,14 +298,17 @@ class DisaggLLMServer:
         prefix_m = self.cache.lookup(toks, max_tokens=len(toks) - 1)
         pf = self.prefill_pool[next(self._pf_rr) % len(self.prefill_pool)]
         try:
-            if prefix_m is not None:
-                sm, first = await pf.prefill.remote(
-                    toks[prefix_m.n_tokens:], temperature=temp,
-                    adapter=adapter, prefix=prefix_m)
-                return prefix_m, sm, first, prefix_m
-            m, first = await pf.prefill.remote(
-                toks, temperature=temp, adapter=adapter)
-            return m, None, first, None
+            with telemetry.traced("disagg::prefill"):
+                if prefix_m is not None:
+                    sm, first = await self._pool_call(
+                        pf, "prefill", (toks[prefix_m.n_tokens:],),
+                        dict(temperature=temp, adapter=adapter,
+                             prefix=prefix_m))
+                    return prefix_m, sm, first, prefix_m
+                m, first = await self._pool_call(
+                    pf, "prefill", (toks,),
+                    dict(temperature=temp, adapter=adapter))
+                return m, None, first, None
         except BaseException:
             self.cache.release(prefix_m)
             raise
@@ -325,14 +372,17 @@ class DisaggLLMServer:
 def build_disagg_deployment(model_config, *, params=None, params_fn=None,
                             num_replicas: int = 1, num_tpus: float = 0.0,
                             name: str = "DisaggLLMServer",
-                            max_ongoing_requests: int = 64, **kw):
+                            max_ongoing_requests: int = 64,
+                            ray_actor_options: dict | None = None, **kw):
     """Bound serve application around the disaggregated stack. Route
     with ``handle.options(routing_hint=prefix_hint(tokens)).remote(...)``
     so requests sharing a cacheable prefix land on the replica already
-    holding its pages."""
+    holding its pages. ``ray_actor_options`` (e.g. ``{"resources":
+    {"tpu-host": 1}}``) pins the REPLICA; pair it with
+    ``pool_resources`` so its prefill/decode pools land beside it."""
     from ray_tpu import serve
 
-    opts: dict = {}
+    opts: dict = dict(ray_actor_options or {})
     if num_tpus:
         opts["num_tpus"] = num_tpus
     dep = serve.deployment(

@@ -23,6 +23,7 @@ the object plane instead.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from ray_tpu.utils import metrics, recorder
@@ -53,8 +54,23 @@ _counters = {"kv_driver_bytes": 0, "kv_array_bytes": 0,
 _registered_core = None
 
 
-def record(stage: str, dur_ns: int, nbytes: int = 0) -> None:
-    """One disagg stage event (ms-scale ops: inline histogram observe)."""
+# request-trace stage class per disagg stage (TraceCriticalPath's
+# vocabulary): queue waits vs page movement; ttft/tpot are derived
+# request metrics, not operations — they get no span
+_SPAN_STAGE = {PREFILL_QUEUE: "queue", DECODE_QUEUE: "queue",
+               KV_SHIP: "pull"}
+
+
+def record(stage: str, dur_ns: int, nbytes: int = 0,
+           trace_ctx=None) -> None:
+    """One disagg stage event (ms-scale ops: inline histogram observe).
+
+    When the owning request is SAMPLED (ambient trace context, or an
+    explicitly captured ``trace_ctx`` (trace_id, span_id) tuple for
+    wave-coalesced work running outside the request's context), the
+    event additionally lands as a retro span in the request's trace —
+    so a disagg request's waterfall shows its queue waits and KV-page
+    movement beside the prefill/decode exec spans."""
     global _count
     dur_ns = max(0, int(dur_ns))
     with _lock:
@@ -72,7 +88,62 @@ def record(stage: str, dur_ns: int, nbytes: int = 0) -> None:
                        a0=min(dur_ns, 0xFFFFFFFF),
                        a1=nbytes & 0xFFFFFFFF,
                        a2=(nbytes >> 32) & 0xFFFFFFFF)
+    span_stage = _SPAN_STAGE.get(stage)
+    if span_stage is not None:
+        from ray_tpu.utils import tracing
+
+        if tracing.enabled():
+            ctx = trace_ctx or tracing.current()
+            sink = _span_sink()
+            if ctx is not None and sink is not None:
+                tracing.emit_retro(
+                    f"disagg::{stage}",
+                    {"trace_id": ctx[0], "parent_span_id": ctx[1]},
+                    sink, dur_ns / 1e9, stage=span_stage, nbytes=nbytes)
     _maybe_register()
+
+
+def capture_trace_ctx():
+    """The ambient (trace_id, span_id) when this request is sampled, or
+    None — captured ONCE where a request enters a coalescing queue (the
+    prefill wave, the decode ring) so batch-stamped telemetry can keep
+    attributing work to the right trace outside the request's context
+    (the raylint RT016 shape: never re-derive per loop iteration)."""
+    from ray_tpu.utils import tracing
+
+    if not tracing.enabled():
+        return None
+    return tracing.current()
+
+
+def traced(name: str, stage: str = "exec"):
+    """Child span around one disagg leg when the ambient request is
+    sampled; a no-op context manager otherwise. Used by the scheduler
+    for the prefill/adopt/decode legs of a request."""
+    from ray_tpu.utils import tracing
+
+    if not tracing.enabled():
+        return contextlib.nullcontext()
+    ctx = tracing.current()
+    sink = _span_sink()
+    if ctx is None or sink is None:
+        return contextlib.nullcontext()
+    return tracing.span(name, {"trace_id": ctx[0], "parent_span_id": ctx[1]},
+                        sink, stage=stage)
+
+
+def _span_sink():
+    """Span rows ride the same task-event flush everything else uses."""
+    from ray_tpu.core import api
+
+    core = api._core
+    if core is None:
+        return None
+
+    def sink(s):
+        core.task_events.emit(name=s["name"], state="SPAN", span=s,
+                              worker_id=core.worker_id.hex())
+    return sink
 
 
 def count(**deltas: int) -> None:

@@ -67,6 +67,15 @@ class ServeController:
         self._autoscale_events: list[dict] = []
         self._p99: dict[str, float] = {}  # "app/dep" -> fresh p99 ms
         self._p99_fetched = 0.0
+        # SLO burn-rate monitoring (serve/dataplane/slo.py): fed the
+        # per-deployment breach fraction each tick from the same merged
+        # latency windows the p99 comes from; alerts fan out on the
+        # slo_burn pubsub channel + bounded kv history
+        from ray_tpu.serve.dataplane.slo import SLOBurnMonitor
+
+        self._slo_monitor = SLOBurnMonitor()
+        self._slo_burn_events: list[dict] = []
+        self._lat_windows: dict[str, list] = {}  # "app/dep" -> raw ns
 
     # -------------------------------------------------------------- helpers
     async def _ensure_loop(self):
@@ -390,6 +399,9 @@ class ServeController:
         # 4. autoscaling decision
         await self._autoscale(st)
 
+        # 5. SLO error-budget burn (same signals, one channel over)
+        await self._slo_tick(st)
+
     async def _alive_nodes(self) -> list[str] | None:
         from ray_tpu.core.api import get_core
 
@@ -524,6 +536,7 @@ class ServeController:
                             merged.setdefault(stage[6:], []).extend(vals)
             self._p99 = {key: percentile(sorted(vals), 0.99) / 1e6
                          for key, vals in merged.items() if vals}
+            self._lat_windows = merged  # raw ns windows: burn monitor
         except Exception:
             # transient GCS error: keep the previous view — autoscaling
             # on a slightly stale p99 beats flapping on a missing one
@@ -531,6 +544,49 @@ class ServeController:
 
             logging.getLogger(__name__).debug(
                 "serve p99 refresh failed", exc_info=True)
+
+    async def _slo_tick(self, st: _DeploymentState):
+        """One burn-rate observation + alert check for one deployment
+        (deployments without a latency_slo_ms have no latency SLO to
+        burn). Fired alerts ride the ``slo_burn`` pubsub channel and a
+        bounded ns="serve" kv history — the autoscale fan-out shape."""
+        cfg = st.spec["config"]
+        slo_ms = getattr(cfg, "latency_slo_ms", None)
+        if slo_ms is None:
+            return
+        await self._refresh_p99()  # also refreshes _lat_windows
+        window = self._lat_windows.get(st.key)
+        if not window:
+            return
+        slo_ns = float(slo_ms) * 1e6
+        breach = sum(1 for v in window if v > slo_ns) / len(window)
+        self._slo_monitor.observe(st.key, breach)
+        alert = self._slo_monitor.check(st.key, float(slo_ms))
+        if alert is None:
+            return
+        self._slo_burn_events.append(alert.to_dict())
+        del self._slo_burn_events[:-AUTOSCALE_EVENTS_CAP]
+        from ray_tpu.core.api import get_core
+
+        try:
+            gcs = get_core().gcs
+            await gcs.call("publish", {"channel": "slo_burn",
+                                       "message": alert.to_dict()})
+            await gcs.call("kv_put", {
+                "ns": "serve", "key": "slo_burn_events",
+                "value": pickle.dumps(self._slo_burn_events)})
+        except Exception:
+            # telemetry only — the alert history republishes next edge
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "slo burn publish failed", exc_info=True)
+
+    async def get_slo_burn_events(self, key: str | None = None) -> list[dict]:
+        """Bounded history of fired burn-rate alerts (newest last)."""
+        if key is None:
+            return list(self._slo_burn_events)
+        return [e for e in self._slo_burn_events if e.get("key") == key]
 
     async def _publish_autoscale(self, decision):
         """Fan the decision out: the serve_autoscale pubsub channel

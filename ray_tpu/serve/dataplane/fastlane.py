@@ -49,7 +49,8 @@ class ReplicaLane:
     them to prove the fast lane actually carried traffic).
     """
 
-    __slots__ = ("actor_id", "_tmpl", "fast_calls", "rpc_calls")
+    __slots__ = ("actor_id", "_tmpl", "fast_calls", "rpc_calls",
+                 "traced_calls")
 
     METHOD = "handle_request"
 
@@ -58,10 +59,17 @@ class ReplicaLane:
         self._tmpl = None
         self.fast_calls = 0
         self.rpc_calls = 0
+        # sampled requests whose wire trace leg rode this lane (2.1):
+        # the proof the fast lane is no longer trace-invisible
+        self.traced_calls = 0
 
     def submit(self, core, args: tuple):
         """Try the ring: returns ``(task_id, future)`` (decode with
-        ``core.fast_actor_await``) or None → RPC path for this call."""
+        ``core.fast_actor_await``) or None → RPC path for this call.
+        A sampled request's trace context (the router's root/attempt
+        span, ambient in the routing coroutine) rides the record's wire
+        leg — ``fast_actor_submit_loop`` captures the contextvar itself,
+        so trace-on no longer forces these calls onto the RPC plane."""
         tmpl = self._tmpl
         if tmpl is None or tmpl.core is not core:
             tmpl = self._tmpl = core.actor_call_template(
@@ -72,10 +80,16 @@ class ReplicaLane:
             self.rpc_calls += 1
         else:
             self.fast_calls += 1
+            if getattr(core, "_trace_on", False):
+                from ray_tpu.utils import tracing
+
+                if tracing.current() is not None:
+                    self.traced_calls += 1
         return out
 
     def stats(self) -> dict:
-        return {"fast_calls": self.fast_calls, "rpc_calls": self.rpc_calls}
+        return {"fast_calls": self.fast_calls, "rpc_calls": self.rpc_calls,
+                "traced_calls": self.traced_calls}
 
     def transport(self, core) -> str:
         """Which plane currently serves this replica: "ring" (same-node

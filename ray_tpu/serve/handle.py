@@ -109,6 +109,20 @@ def _retry_backoff_s(attempt: int) -> float:
     return base * random.uniform(0.5, 1.5)
 
 
+def _serve_span_sink(core):
+    """Router span rows ride the driver's task-event flush."""
+    def sink(s):
+        core.task_events.emit(name=s["name"], state="SPAN", span=s)
+    return sink
+
+
+def _trace_mod():
+    """The tracing module when tracing is on, else None (one gate)."""
+    from ray_tpu.utils import tracing
+
+    return tracing if tracing.enabled() else None
+
+
 class _Router:
     """Shared per-(app, deployment) routing state; thread-safe because
     .remote() may be called from the driver thread or any actor loop."""
@@ -585,10 +599,34 @@ class _Router:
         and the lane is live (serve/dataplane/fastlane.py) — the reply
         resolves straight into this coroutine; anything the ring cannot
         carry takes the actor RPC plane for THIS call only, marked
-        unordered so neither path ever parks behind the other."""
-        from ray_tpu.core.ref import GetTimeoutError
+        unordered so neither path ever parks behind the other.
 
+        When the request is sampled, the attempt runs inside an
+        ``attempt::<rid>`` child span — a HEDGE loser's cancellation
+        lands in that span's ``error`` field, so a hedged request's
+        trace shows exactly which copy won and which was shed."""
         core = _core()
+        if getattr(core, "_trace_on", False):
+            from ray_tpu.utils import tracing
+
+            cur = tracing.current()
+            if cur is not None:
+                with tracing.span(
+                        f"attempt::{rid}",
+                        {"trace_id": cur[0], "parent_span_id": cur[1]},
+                        _serve_span_sink(core), stage="wire",
+                        replica=rid):
+                    return await self._call_replica_inner(
+                        core, rid, actor, method, args, kwargs, model_id,
+                        deadline, request_id)
+        return await self._call_replica_inner(
+            core, rid, actor, method, args, kwargs, model_id, deadline,
+            request_id)
+
+    async def _call_replica_inner(self, core, rid: str, actor, method: str,
+                                  args: tuple, kwargs: dict, model_id: str,
+                                  deadline: float | None, request_id: str):
+        from ray_tpu.core.ref import GetTimeoutError
         timeout_s = (None if deadline is None
                      else max(0.0, deadline - time.monotonic()))
         with self.lock:
@@ -714,15 +752,70 @@ class _Router:
                 if not t.done():
                     self._cancel_loser(t, t_rid, request_id)
 
+    def _trace_root(self, method: str):
+        """Root span for one serve request when tracing is on and the
+        request is sampled (head-based: the decision is made HERE, where
+        the trace starts; composed handle calls inherit the caller's
+        sampled context instead of re-deciding). None = unsampled."""
+        from ray_tpu.utils import tracing
+
+        if not tracing.enabled():
+            return None
+        if tracing.is_suppressed():
+            return None  # a composed call inside an unsampled request
+        parent = tracing.current()
+        if parent is None and not tracing.sample():
+            return None
+        ctx = (None if parent is None
+               else {"trace_id": parent[0], "parent_span_id": parent[1]})
+        return tracing.span(
+            f"serve::{self.app_name}/{self.deployment_name}.{method}",
+            ctx, _serve_span_sink(_core()), stage="wire")
+
     async def route_async(self, method: str, args: tuple, kwargs: dict,
                           model_id: str = "", hint: str = "",
                           _inherited_deadline: float | None = None):
         """Loop-thread path: full async routing with the retry/deadline/
-        hedge machinery; returns the result."""
+        hedge machinery; returns the result.
+
+        A sampled request runs inside a ROOT span that survives retries
+        and hedges (one request = one trace, whatever replays happened
+        inside it), and its request_id IS the trace id — the id in the
+        serving logs is the id you hand to ``state.get_trace()``."""
         self._ensure_poll_loop()
         await self._ensure_ft()
         deadline = self._compute_deadline(_inherited_deadline)
-        request_id = f"{self._router_id}-{next(self._req_counter)}"
+        root = self._trace_root(method)
+        if root is None:
+            request_id = f"{self._router_id}-{next(self._req_counter)}"
+            if _trace_mod() is not None:
+                # head decision is per REQUEST: suppress downstream
+                # re-draws (a replica-hop submit re-sampling would mint
+                # orphan partial traces for "unsampled" requests)
+                tok = _trace_mod().suppress()
+                try:
+                    return await self._route_attempts(
+                        method, args, kwargs, model_id, hint, deadline,
+                        request_id)
+                finally:
+                    _trace_mod().deactivate(tok)
+            return await self._route_attempts(
+                method, args, kwargs, model_id, hint, deadline, request_id)
+        with root:
+            # the trace-STARTING request's id IS the trace id (the id in
+            # the serving logs is the id you hand to state.get_trace);
+            # a COMPOSED call inside that trace gets a root-span-scoped
+            # suffix — two downstream calls sharing one trace must not
+            # share a request_id (replica-side cancel marks key on it)
+            rid = (root.trace_id if root.parent_span_id is None
+                   else f"{root.trace_id}.{root.span_id}")
+            root.attributes["request_id"] = rid
+            return await self._route_attempts(
+                method, args, kwargs, model_id, hint, deadline, rid)
+
+    async def _route_attempts(self, method: str, args: tuple, kwargs: dict,
+                              model_id: str, hint: str,
+                              deadline: float | None, request_id: str):
         excluded: set[str] = set()
         attempt = 0
         while True:

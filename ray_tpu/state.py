@@ -188,22 +188,67 @@ def list_task_events(limit: int = 10000) -> list[dict]:
     return _call("get_task_events")[-limit:]
 
 
-def list_spans(trace_id: str | None = None, limit: int = 10000) -> list[dict]:
+def list_spans(trace_id: str | None = None, limit: int = 1000,
+               offset: int = 0) -> list[dict]:
     """Trace spans recorded through the task-event pipeline (ref:
     tracing_helper.py spans; enable with Config.tracing_enabled). Each row:
     trace_id / span_id / parent_span_id / name / start_ts / end_ts plus
-    the task id and executing worker/node."""
-    out = []
-    for ev in _call("get_task_events"):
-        span = ev.get("span")
-        if not span:
-            continue
-        if trace_id is not None and span.get("trace_id") != trace_id:
-            continue
-        out.append({**span, "task_id": ev.get("task_id"),
-                    "worker_id": ev.get("worker_id"),
-                    "node_id": ev.get("node_id")})
-    return out[-limit:]
+    the task id and executing worker/node.
+
+    Paginated newest-last: ``limit``/``offset`` are applied SERVER-side
+    over the bounded span stream (``offset`` skips that many of the
+    newest rows), so a long-lived cluster never ships its whole event
+    ring per call. For one request's assembled tree prefer
+    :func:`get_trace` — the GCS indexes spans per trace at ingest."""
+    if trace_id is not None:
+        # one trace: the assembler's bucket is the cheap, complete answer
+        tr = _call("get_trace", {"trace_id": trace_id})
+        spans = (tr or {}).get("spans", [])
+        if offset:
+            spans = spans[:-offset] if offset < len(spans) else []
+        return spans[-limit:]
+    events = _call("get_task_events",
+                   {"span_only": True, "limit": limit, "offset": offset})
+    return [{**ev["span"], "task_id": ev.get("task_id"),
+             "worker_id": ev.get("worker_id"),
+             "node_id": ev.get("node_id")}
+            for ev in events if ev.get("span")]
+
+
+def get_trace(trace_id: str) -> dict | None:
+    """One assembled request trace from the GCS trace table:
+    ``{trace_id, spans (start-sorted, each with worker/node/pid),
+    start_ts, end_ts, dur_ms, n_spans, procs, critical_path}`` —
+    ``critical_path`` is the TraceCriticalPath pass attributing the
+    request's wall time to queue / exec / wire / pull self-time plus the
+    latest-finishing span chain. None for an unknown (or evicted)
+    trace id; eviction keeps the slowest ``Config.trace_slow_keep``
+    fraction, so p99 outliers outlive the table cap."""
+    return _call("get_trace", {"trace_id": trace_id})
+
+
+def list_traces(limit: int = 100, offset: int = 0) -> list[dict]:
+    """Assembled-trace summaries, newest first: ``{trace_id, root_name,
+    start_ts, end_ts, dur_ms, n_spans, procs}`` (span bodies stay
+    GCS-side; fetch one with :func:`get_trace`)."""
+    return _call("list_traces", {"limit": limit, "offset": offset})
+
+
+def list_slo_burn_events(key: str | None = None) -> list[dict]:
+    """SLO error-budget burn-rate alerts fired by the serve controller's
+    ``SLOBurnMonitor`` (newest last): ``{key, ts, severity (page|warn|
+    ok), burn_fast, burn_slow, breach_fraction, slo_ms, budget}`` — the
+    multiwindow alert fires when BOTH the fast and slow windows burn
+    budget above their thresholds (pushed live on the ``slo_burn``
+    pubsub channel beside ``serve_autoscale``). ``key`` filters to one
+    "app/deployment"."""
+    blob = _call("kv_get", {"ns": "serve", "key": "slo_burn_events"})
+    if not blob:
+        return []
+    events = pickle.loads(blob)
+    if key is not None:
+        events = [e for e in events if e.get("key") == key]
+    return events
 
 
 def list_actors(filters=None, limit: int = 1000) -> list[dict]:

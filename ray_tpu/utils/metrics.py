@@ -163,6 +163,12 @@ task_stage_us = Gauge(
     tag_keys=("stage", "q"))
 recorder_samples = Gauge(
     "rt_recorder_samples", "per-task latency samples recorded (lifetime)")
+# NOTE: rt_request_critical_path_us (the GCS trace assembler's per-stage
+# request-latency histogram) is deliberately NOT declared here: the GCS
+# hand-rolls its cells (core/gcs.py _trace_metrics_tick) because an
+# in-process GCS shares this process-global registry with the driver,
+# and publishing the shared snapshot under a second kv key would
+# double-count every driver metric.
 # Native shm transport counters (ring.cc RingStats / store.cc StoreStats),
 # summed over live lanes and set at flush time.
 fastpath_ring = Gauge(

@@ -47,7 +47,17 @@ from __future__ import annotations
 # below (RECORD_PREFIXES / RECORD_FLAGS) is machine-checked against
 # _native/src/rt_wire.h so a shipped-but-uncataloged wire entry fails
 # tier-1 (PRs 10/11 both shipped one).
-PROTOCOL_VERSION = (2, 0)
+# 2.1: wire-level trace context (Dapper-style — utils/tracing.py).
+# "Q"/"R"/"A"/"C" records may carry a 25-byte trace leg
+# (<16s trace_id><8s span_id><u8 sampled>) behind their header, flagged
+# by TRACE_CTX_BIT (bit 63 of the u64 t_submit field — free for ~292
+# years of CLOCK_MONOTONIC); seq-echoed replies may echo the leg
+# (status flag 0x400, after the stamp/seq legs), so the driver's
+# reply-apply stamps the wire-level call span for untracked serve
+# fast-lane calls without a lookup. Unsampled records are byte-identical
+# to 2.0 ones. Also: GCS get_trace / list_traces (the trace assembler),
+# get_task_events limit/offset/span_only pagination.
+PROTOCOL_VERSION = (2, 1)
 
 # ------------------------------------------------------ fastpath records
 # Every record prefix byte and reply-status flag the shm rings / node
@@ -68,7 +78,16 @@ RECORD_FLAGS: dict[str, dict] = {
                 "doc": "reply carries a 16-byte worker stage stamp"},
     "SEQED": {"value": 0x200, "since": (1, 8),
               "doc": "reply echoes the submit record's u32 seq"},
+    "TRACED": {"value": 0x400, "since": (2, 1),
+               "doc": "reply echoes the submit record's 25-byte trace "
+                      "leg (after the stamp/seq legs)"},
 }
+# Record-side trace flag (2.1): bit 63 of the u64 t_submit field of
+# "Q"/"R"/"A"/"C" records — set = a 25-byte trace leg follows the
+# record header. Mirrored by rt_wire.h kRecordTraceCtxBit/kTraceCtxLen
+# and asserted against core/fastpath.py by tests/test_wire_schema.py.
+TRACE_CTX_BIT = 1 << 63
+TRACE_CTX_LEN = 25
 
 # service -> method -> {"since": (major, minor), "fields": {...}}
 # field values document type + meaning; "->" entries are the reply shape.
@@ -115,7 +134,22 @@ CATALOG: dict[str, dict[str, dict]] = {
         "list_placement_groups": {"since": (1, 0), "fields": {}},
         "report_task_events": {"since": (1, 0), "fields": {"events": "[dict]"}},
         "get_task_events": {"since": (1, 0), "fields": {
-            "job_id": "hex | None", "limit": "int"}},
+            "job_id": "hex | None", "limit": "int",
+            "offset": "int (since (2, 1)) — newest-last pagination "
+                      "window over the bounded event ring",
+            "span_only": "bool (since (2, 1)) — only state='SPAN' rows "
+                         "(state.list_spans pagination)"}},
+        "get_trace": {"since": (2, 1), "fields": {
+            "trace_id": "hex — one assembled trace from the bounded "
+                        "trace table (span rows folded per trace_id on "
+                        "report_task_events ingest)",
+            "->": "{trace_id, spans: [span dict], start_ts, end_ts, "
+                  "critical_path: TraceCriticalPath.compute()} | None"}},
+        "list_traces": {"since": (2, 1), "fields": {
+            "limit": "int", "offset": "int — newest first",
+            "->": "[{trace_id, root_name, start_ts, dur_ms, n_spans, "
+                  "procs, sealed}] — slow-trace retention keeps the p99 "
+                  "outliers past the table cap"}},
     },
     # -------------------------------------------------------------- raylet
     # (ref: node_manager.proto NodeManagerService)

@@ -1,0 +1,70 @@
+"""RT016 fixture: fresh trace context constructed inside a loop body."""
+import ray_tpu.utils.tracing
+from ray_tpu.utils import tracing
+from ray_tpu.utils.tracing import span as open_span
+
+
+def sink(s):
+    pass
+
+
+def request_loop(records):
+    # the designed shape: ONE context capture above the loop, explicit
+    # ctx on every per-item span (the worker pump's batch-stamp idiom)
+    ctx = tracing.submit_context()
+    for rec in records:
+        if ctx is not None:
+            with tracing.span("item", ctx, sink):
+                handle(rec)
+
+
+def shattered_loop(records):
+    for rec in records:
+        with tracing.span("item", None, sink):  # expect: RT016
+            handle(rec)
+
+
+def rederived_loop(records):
+    while records:
+        ctx = tracing.inject()  # expect: RT016
+        with tracing.span("item", ctx, sink):
+            handle(records.pop())
+
+
+def resampled_loop(records):
+    for rec in records:
+        ctx = tracing.submit_context()  # expect: RT016
+        if ctx is not None:
+            with tracing.span("item", ctx, sink):
+                handle(rec)
+
+
+def qualified_form(records):
+    for rec in records:
+        with ray_tpu.utils.tracing.span("item", None, sink):  # expect: RT016
+            handle(rec)
+
+
+def bare_import_form(records):
+    for rec in records:
+        with open_span("item", trace_ctx=None, sink=sink):  # expect: RT016
+            handle(rec)
+
+
+def root_outside_loop(records):
+    # a root OUTSIDE any loop is a deliberate trace start — clean
+    with tracing.span("request", None, sink):
+        for rec in records:
+            handle(rec)
+
+
+def explicit_ctx_in_loop(records, ctx):
+    # explicit non-None context per item: the batch-stamp shape — clean
+    for rec in records:
+        with tracing.span("item", {"trace_id": ctx[0],
+                                   "parent_span_id": ctx[1]}, sink):
+            handle(rec)
+
+
+def handle(rec):
+    return rec

@@ -1,0 +1,342 @@
+"""End-to-end request tracing across the fast planes (wire 2.1).
+
+Covers the tentpole contracts: trace context riding node-tunnel frames
+(driver -> tunnel worker, same trace_id), the GCS trace assembler
+(bounded table, slow-trace retention, per-trace critical path), span
+pagination, the SLO burn-rate monitor's multiwindow semantics, and the
+acceptance path — a disagg-LLM request through serve (router -> prefill
+-> KV adopt -> decode) assembling into ONE trace with >= 6 causally
+linked spans across >= 3 processes including a node-tunnel hop and a
+shm-ring hop.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import LlamaConfig, llama_init
+
+PS = 8
+
+
+def _tiny_cfg():
+    return LlamaConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                       n_kv_heads=4, d_ff=256, max_seq_len=512,
+                       dtype="float32")
+
+
+def _tiny_params():
+    import jax
+
+    return llama_init(jax.random.PRNGKey(0), _tiny_cfg())
+
+
+@pytest.fixture(scope="module")
+def xnode():
+    """Two-node in-process cluster with tracing on at rate 1.0: driver
+    on node A, node B ("bee") hosts the remote actors — the shape from
+    test_node_tunnel.py, traced."""
+    from ray_tpu.config import Config, set_config
+
+    cfg = Config.from_env()
+    cfg.tracing_enabled = True
+    cfg.trace_sample_rate = 1.0
+    set_config(cfg)
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.cluster import Cluster
+    from ray_tpu.core.core_client import CoreClient
+    from ray_tpu.utils import rpc as _rpc
+
+    io = _rpc.EventLoopThread()
+    cluster = Cluster(io=io)
+    node_a = cluster.add_node(num_cpus=2.0)
+    cluster.add_node(num_cpus=6.0, resources={"bee": 16.0})
+    core = CoreClient(loop=io.loop)
+    io.run(core.connect(cluster.gcs_address, node_a.server.address))
+    old = _api._core
+    _api._core = core
+    yield core, cluster, io
+    _api._core = old
+    try:
+        io.run(core.close(), timeout=15)
+    except Exception:
+        pass
+    cluster.shutdown()
+    io.stop()
+    set_config(Config.from_env())
+
+
+def _get(core, refs, timeout=120):
+    one = not isinstance(refs, list)
+    vals = core._run_sync(
+        core.get_async([refs] if one else refs, timeout), timeout + 10)
+    return vals[0] if one else vals
+
+
+class _Probe:
+    def echo(self, x):
+        return x
+
+
+def _wait_tunnel_lane(core, actor_id, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        lane = core._fast_actor_lanes.get(actor_id)
+        if lane is not None and not lane.broken and not lane.retired:
+            assert getattr(lane.ring, "tunnel", False), \
+                "cross-node actor got a non-tunnel lane"
+            return lane
+        time.sleep(0.1)
+    raise AssertionError("tunnel lane never attached")
+
+
+# ---------------------------------------------- tunnel-plane propagation
+def test_tunnel_records_carry_trace_context(xnode):
+    """Same trace_id driver -> tunnel worker: the 25-byte leg rides the
+    coalesced tunnel frame, the remote exec span reports
+    transport='tunnel', and results are byte-identical to the RPC road
+    with tracing enabled."""
+    from ray_tpu import state
+    from ray_tpu.utils import tracing
+
+    core, cluster, io = xnode
+    h = core.create_actor(_Probe, (), {},
+                          resources={"CPU": 0.25, "bee": 0.25})
+    assert _get(core, core.submit_actor_task(h, "echo", (1,), {})) == 1
+    _wait_tunnel_lane(core, h.actor_id)
+    tmpl = core.actor_call_template(h.actor_id, "echo", 1, None)
+    arr = np.arange(512, dtype=np.float64) * 2.5
+    with tracing.span("tunnel_root", None, lambda s: None) as root:
+        before = core.tunnel_stats()["tx_records"]
+        fast = _get(core, core.submit_actor_task(h, "echo", (arr,), {},
+                                                 _tmpl=tmpl))
+        assert core.tunnel_stats()["tx_records"] > before
+        slow = _get(core, core.submit_actor_task(h, "echo", (arr,), {},
+                                                 unordered=True))
+    assert fast.tobytes() == slow.tobytes() == arr.tobytes()
+    deadline = time.time() + 30
+    runs = []
+    while time.time() < deadline:
+        spans = [s for s in state.list_spans(limit=4000)
+                 if s.get("trace_id") == root.trace_id]
+        runs = [s for s in spans if s.get("name") == "echo::run"
+                and s.get("transport") == "tunnel"]
+        if runs:
+            break
+        time.sleep(0.3)
+    assert runs, "no tunnel-transport exec span ever arrived"
+    # the remote worker executed in a DIFFERENT process, same trace
+    assert runs[-1]["trace_id"] == root.trace_id
+    assert runs[-1].get("worker_id") != core.worker_id.hex()
+
+
+# ------------------------------------------------------- assembler units
+def _span_row(trace_id, span_id, parent, name, t0, t1, **kw):
+    return {"state": "SPAN", "task_id": None,
+            "span": {"trace_id": trace_id, "span_id": span_id,
+                     "parent_span_id": parent, "name": name,
+                     "start_ts": t0, "end_ts": t1, **kw}}
+
+
+def test_trace_table_bounded_with_slow_trace_retention():
+    """Past trace_table_max the assembler evicts the OLDEST of the fast
+    traces; the slowest (p99-outlier) fraction always survives."""
+    from ray_tpu.config import Config
+    from ray_tpu.core.gcs import GcsServer
+
+    gcs = GcsServer()
+    cfg = Config.from_env()
+    cfg.trace_table_max = 16
+    cfg.trace_slow_keep = 0.2
+    gcs.cfg = cfg
+
+    async def run():
+        # trace 0 is SLOW (3s); the rest are 1ms each, oldest first
+        for i in range(40):
+            dur = 3.0 if i == 0 else 0.001
+            tid = f"{i:032x}"
+            await gcs.rpc_report_task_events(None, {"events": [
+                _span_row(tid, f"{i:016x}", None, f"req{i}",
+                          100.0 + i, 100.0 + i + dur)]})
+        assert len(gcs.traces) <= 16
+        slow = await gcs.rpc_get_trace(None, {"trace_id": f"{0:032x}"})
+        assert slow is not None, "slow outlier was evicted"
+        assert slow["dur_ms"] == pytest.approx(3000.0)
+        # bounded: most fast traces are gone, the newest one survives
+        assert await gcs.rpc_get_trace(
+            None, {"trace_id": f"{39:032x}"}) is not None
+        gone = [i for i in range(1, 40)
+                if f"{i:032x}" not in gcs.traces]
+        assert len(gone) >= 24  # 40 ingested, table capped at 16
+        rows = await gcs.rpc_list_traces(None, {"limit": 100})
+        assert len(rows) == len(gcs.traces)
+        assert rows[0]["start_ts"] >= rows[-1]["start_ts"]  # newest first
+        # pagination
+        page = await gcs.rpc_list_traces(None, {"limit": 5, "offset": 5})
+        assert len(page) == 5 and page[0] == rows[5]
+
+    asyncio.run(run())
+
+
+def test_span_pagination_and_assembled_critical_path():
+    """get_task_events span_only/limit/offset pagination + one
+    assembled trace's critical path attributing queue/exec/wire/pull."""
+    from ray_tpu.core.gcs import GcsServer
+
+    gcs = GcsServer()
+    tid = "ab" * 16
+
+    async def run():
+        rows = [
+            _span_row(tid, "01" * 8, None, "serve::app/dep.call",
+                      10.0, 10.010, stage="wire"),
+            _span_row(tid, "02" * 8, "01" * 8, "handle_request::run",
+                      10.001, 10.009, stage="exec", transport="tunnel"),
+            _span_row(tid, "03" * 8, "02" * 8, "disagg::prefill_queue",
+                      10.002, 10.004, stage="queue"),
+            _span_row(tid, "04" * 8, "02" * 8, "disagg::kv_ship",
+                      10.004, 10.007, stage="pull"),
+        ]
+        await gcs.rpc_report_task_events(
+            None, {"events": rows + [{"state": "RUNNING", "task_id": "t"}]})
+        spans = await gcs.rpc_get_task_events(
+            None, {"span_only": True, "limit": 2})
+        assert len(spans) == 2 and all(e["state"] == "SPAN" for e in spans)
+        offset = await gcs.rpc_get_task_events(
+            None, {"span_only": True, "limit": 2, "offset": 1})
+        # offset drops the newest row, limit keeps the newest remaining
+        assert [e["span"]["span_id"] for e in offset] == ["02" * 8,
+                                                          "03" * 8]
+        tr = await gcs.rpc_get_trace(None, {"trace_id": tid})
+        assert tr["n_spans"] == 4
+        cp = tr["critical_path"]
+        assert cp["root_name"] == "serve::app/dep.call"
+        st = cp["stages"]
+        # self times: queue 2ms, pull 3ms, exec 8-5=3ms, wire 10-8=2ms
+        assert st["queue"] == pytest.approx(2000, rel=0.01)
+        assert st["pull"] == pytest.approx(3000, rel=0.01)
+        assert st["exec"] == pytest.approx(3000, rel=0.01)
+        assert st["wire"] == pytest.approx(2000, rel=0.01)
+        assert cp["total_us"] == pytest.approx(10000, rel=0.01)
+
+    asyncio.run(run())
+
+
+def test_latency_kv_retention_sweep():
+    """ns='latency' entries a dead publisher left behind are swept once
+    they outlive latency_retention_s; fresh entries stay."""
+    from ray_tpu.config import Config
+    from ray_tpu.core.gcs import GcsServer
+
+    gcs = GcsServer()
+    cfg = Config.from_env()
+    cfg.latency_retention_s = 5.0
+    gcs.cfg = cfg
+    gcs.kvstore.put("latency", "dead", b"x", overwrite=True, journal=False)
+    gcs.kvstore.put("latency", "live", b"y", overwrite=True, journal=False)
+    now = time.monotonic()
+    gcs._latency_touched["dead"] = now - 100.0
+    gcs._latency_touched["live"] = now
+    gcs._latency_sweep()
+    assert gcs.kvstore.get("latency", "dead") is None
+    assert gcs.kvstore.get("latency", "live") == b"y"
+
+
+def test_slo_burn_monitor_multiwindow():
+    """A short spike trips the fast window but NOT the slow one (no
+    page); a sustained breach pages once; recovery emits the ok edge."""
+    from ray_tpu.serve.dataplane.slo import SLOBurnMonitor
+
+    m = SLOBurnMonitor(slo_target=0.99, fast_window_s=10.0,
+                       slow_window_s=100.0, cooldown_s=0.0)
+    t = 1000.0
+    # 100s of clean traffic, then a 3s spike: the fast window burns way
+    # past the page threshold but the slow window stays under warn — no
+    # alert (the multiwindow AND is exactly the anti-blip gate)
+    for i in range(100):
+        m.observe("a/b", 0.0, t + i)
+    for i in range(100, 103):
+        m.observe("a/b", 1.0, t + i)
+    assert m.burn("a/b", 10.0, t + 103) > m.page_burn
+    assert m.burn("a/b", 100.0, t + 103) < m.warn_burn
+    assert m.check("a/b", 25.0, t + 103) is None  # slow window gates
+    # sustained: both windows burn -> page fires exactly once
+    for i in range(103, 300):
+        m.observe("a/b", 1.0, t + i)
+    alert = m.check("a/b", 25.0, t + 300)
+    assert alert is not None and alert.severity == "page"
+    assert alert.burn_fast >= m.page_burn and alert.burn_slow >= m.page_burn
+    assert m.check("a/b", 25.0, t + 301) is None  # edge-triggered
+    # recovery: clean traffic long enough to drain both windows
+    for i in range(300, 500):
+        m.observe("a/b", 0.0, t + i)
+    rec = m.check("a/b", 25.0, t + 500)
+    assert rec is not None and rec.severity == "ok"
+
+
+# ------------------------------------------------- disagg-LLM acceptance
+def test_disagg_serve_request_assembles_one_trace(xnode):
+    """Acceptance: a disagg-LLM request through serve — router ->
+    prefill -> KV adopt -> decode — assembles into ONE trace via
+    state.get_trace() with >= 6 causally-linked spans across >= 3
+    processes, with at least one node-tunnel hop (router -> remote
+    replica) and one shm-ring hop (replica -> same-node pool worker)."""
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.llm.disagg.scheduler import build_disagg_deployment
+
+    core, cluster, io = xnode
+    app = build_disagg_deployment(
+        _tiny_cfg(), params_fn=_tiny_params, num_replicas=1,
+        name="DisaggTrace",
+        # replica on node B: every routed request crosses the tunnel;
+        # pools beside it on B: pool hops ride the shm rings
+        ray_actor_options={"resources": {"bee": 0.5}},
+        pool_resources={"bee": 0.25},
+        n_prefill=1, n_decode=1, max_batch=4, page_size=PS, n_pages=64,
+        max_seq_len=128, wave_wait_s=0.001)
+    h = serve.run(app, name="dtrace", timeout_s=300)
+    prompt = list(range(1, 20))
+    # warm: replica + pool leases, lanes, jit compiles (untraced requests
+    # would also be fine — rate is 1.0, so all of these are sampled)
+    out = ray_tpu.get(h.remote({"prompt_tokens": prompt, "max_tokens": 4}),
+                      timeout=300)
+    assert len(out["completion_tokens"]) == 4
+    deadline = time.time() + 120
+    good = None
+    while time.time() < deadline and good is None:
+        res = ray_tpu.get(
+            h.remote({"prompt_tokens": prompt, "max_tokens": 4}),
+            timeout=300)
+        assert len(res["completion_tokens"]) == 4
+        time.sleep(1.5)  # let every process's 1Hz flush land
+        for row in state.list_traces(limit=20):
+            if "DisaggTrace" not in (row.get("root_name") or ""):
+                continue
+            tr = state.get_trace(row["trace_id"])
+            if tr is None:
+                continue
+            spans = tr["spans"]
+            transports = {s.get("transport") for s in spans}
+            ids = {s["span_id"] for s in spans}
+            linked = [s for s in spans if s.get("parent_span_id") in ids]
+            if (tr["n_spans"] >= 6 and tr["procs"] >= 3
+                    and "tunnel" in transports and "ring" in transports
+                    and len(linked) >= 5):
+                good = tr
+                break
+    assert good is not None, [
+        (r.get("root_name"), r["n_spans"], r["procs"])
+        for r in state.list_traces(limit=20)]
+    names = {s["name"] for s in good["spans"]}
+    # the causal tree covers the whole disagg path
+    assert any(n.startswith("serve::") for n in names), names
+    assert "handle_request::run" in names, names
+    assert any("prefill" in n for n in names), names
+    assert any(n in ("disagg::decode", "decode_adopted::run")
+               for n in names), names
+    cp = good["critical_path"]
+    assert cp is not None and cp["stages"]["exec"] > 0
+    serve.delete("dtrace")

@@ -94,3 +94,177 @@ def test_actor_call_spans(rt):
 def test_timeline_carries_spans(rt):
     rows = state.timeline()
     assert any(r.get("cat") == "span" for r in rows)
+
+
+def test_unsampled_root_suppresses_downstream_draws():
+    """Head sampling is per REQUEST: a root that lost the draw installs
+    the UNSAMPLED sentinel, so downstream submits inside it must NOT
+    re-draw (each stray draw would mint an orphan partial trace)."""
+    from ray_tpu.config import get_config
+    from ray_tpu.utils import tracing
+
+    cfg = get_config()
+    old = (cfg.tracing_enabled, cfg.trace_sample_rate)
+    cfg.tracing_enabled, cfg.trace_sample_rate = True, 1.0
+    try:
+        tok = tracing.suppress()
+        try:
+            assert tracing.is_suppressed()
+            assert tracing.current() is None
+            # rate 1.0 would sample EVERY fresh root — suppression wins
+            assert tracing.submit_context() is None
+        finally:
+            tracing.deactivate(tok)
+        assert not tracing.is_suppressed()
+        assert tracing.submit_context() is not None
+    finally:
+        cfg.tracing_enabled, cfg.trace_sample_rate = old
+
+
+# ------------------------------------------------- wire-level propagation
+def _root_span():
+    """A driver-side root span (sink discarded: the assertions below
+    compare CHILD spans against its ids, the root itself is ambient)."""
+    from ray_tpu.utils import tracing
+
+    return tracing.span("test_root", None, lambda s: None)
+
+
+def test_task_fast_lane_carries_trace_over_shm_ring(rt):
+    """Same trace_id driver -> ring worker: the wire leg (2.1) rides the
+    packed record, the worker's exec span reports transport='ring', and
+    the driver's reply-apply stamps the ::call wire span."""
+    from ray_tpu.utils import tracing
+
+    @ray_tpu.remote
+    def ring_leaf(x):
+        return x * 3
+
+    # warm: first call leases a worker + attaches the lane over RPC
+    for i in range(12):
+        assert ray_tpu.get(ring_leaf.remote(i), timeout=120) == i * 3
+    deadline = time.time() + 60
+    run = None
+    with _root_span() as root:
+        while time.time() < deadline:
+            assert ray_tpu.get(ring_leaf.remote(7), timeout=120) == 21
+            spans = state.list_spans(trace_id=root.trace_id) or [
+                s for s in state.list_spans(limit=2000)
+                if s.get("trace_id") == root.trace_id]
+            runs = [s for s in spans if s.get("name") == "ring_leaf::run"
+                    and s.get("transport") == "ring"]
+            if runs:
+                run = runs[-1]
+                break
+            time.sleep(0.3)
+    assert run is not None, "no ring-transport exec span ever appeared"
+    assert run["trace_id"] == root.trace_id
+    # causal chain: exec span nests INSIDE the pre-minted ::call wire
+    # span, whose parent is the submit point span under the root
+    # (driver and worker flush on independent 1Hz timers — wait for both
+    # halves of the call's spans to land)
+    deadline = time.time() + 30
+    calls = submit = []
+    while time.time() < deadline:
+        spans = [s for s in state.list_spans(limit=4000)
+                 if s.get("trace_id") == root.trace_id]
+        calls = [s for s in spans
+                 if s["span_id"] == run["parent_span_id"]]
+        submit = ([s for s in spans
+                   if s["span_id"] == calls[0]["parent_span_id"]]
+                  if calls else [])
+        if calls and submit:
+            break
+        time.sleep(0.3)
+    assert calls and calls[0]["name"] == "ring_leaf::call"
+    # the driver-side wire span carries the stamp-derived stage attrs
+    assert "exec_us" in calls[0]
+    assert submit and submit[0]["name"] == "ring_leaf.remote"
+    assert submit[0]["parent_span_id"] == root.span_id
+    # unsampled-vs-sampled byte identity: the traced fast call and the
+    # RPC path produce the same value (the leg rides the header only)
+    assert ray_tpu.get(ring_leaf.remote(5), timeout=120) == 15
+
+
+def test_actor_lane_trace_with_per_call_rpc_fallback_midstream(rt):
+    """A mixed stream — fast ring calls around a per-call RPC fallback
+    (pending ref arg) — stays ONE trace: every exec span links to the
+    root, with both ring and rpc transports represented."""
+    import numpy as np
+
+    @ray_tpu.remote
+    class Echo:
+        def echo(self, x):
+            return x
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(0.8)
+        return 41
+
+    h = Echo.remote()
+    core = ray_tpu.core.api.get_core()
+    # warm until the actor ring lane attaches
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        assert ray_tpu.get(h.echo.remote(0), timeout=120) == 0
+        lane = core._fast_actor_lanes.get(h.actor_id)
+        if lane is not None and not lane.broken and not lane.retired:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("actor ring lane never attached")
+    arr = np.arange(16, dtype=np.float64)
+    with _root_span() as root:
+        r1 = h.echo.remote(1)                       # ring
+        pending = slow_value.remote()
+        r2 = h.echo.remote(pending)                 # pending ref -> RPC
+        r3 = h.echo.remote(arr)                     # ring again
+        assert ray_tpu.get(r1, timeout=120) == 1
+        assert ray_tpu.get(r2, timeout=120) == 41
+        got = ray_tpu.get(r3, timeout=120)
+    assert got.tobytes() == arr.tobytes()  # byte-identical through the leg
+    deadline = time.time() + 30
+    transports = set()
+    while time.time() < deadline:
+        spans = [s for s in state.list_spans(limit=4000)
+                 if s.get("trace_id") == root.trace_id]
+        transports = {s.get("transport") for s in spans
+                      if s.get("name") == "echo::run"}
+        if {"ring", "rpc"} <= transports:
+            break
+        time.sleep(0.3)
+    assert {"ring", "rpc"} <= transports, transports
+    # every echo exec span of the stream belongs to the ONE root trace
+    runs = [s for s in spans if s.get("name") == "echo::run"]
+    assert len(runs) >= 3
+    assert {s["trace_id"] for s in runs} == {root.trace_id}
+
+
+def test_unsampled_requests_ship_no_spans(rt):
+    """trace_sample_rate=0: tracing stays on but roots never sample —
+    no new spans appear and results are unchanged (the one-branch
+    unsampled path)."""
+    from ray_tpu.config import get_config
+
+    @ray_tpu.remote
+    def quiet_leaf(x):
+        return x + 1
+
+    assert ray_tpu.get(quiet_leaf.remote(1), timeout=120) == 2
+    cfg = get_config()
+    old = cfg.trace_sample_rate
+    cfg.trace_sample_rate = 0.0
+    try:
+        time.sleep(1.5)  # drain in-flight flushes
+        before = len(state.list_spans(limit=5000))
+        for i in range(20):
+            assert ray_tpu.get(quiet_leaf.remote(i), timeout=120) == i + 1
+        time.sleep(2.0)  # two flush intervals
+        after = len(state.list_spans(limit=5000))
+        new = [s for s in state.list_spans(limit=5000)[before:]
+               if "quiet_leaf" in (s.get("name") or "")]
+        assert not new, new
+        assert after - before <= 2  # stray non-quiet_leaf flushes only
+    finally:
+        cfg.trace_sample_rate = old

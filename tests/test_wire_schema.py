@@ -68,9 +68,19 @@ def test_record_prefixes_and_flags_cataloged_everywhere():
         k: v["value"] for k, v in schema.RECORD_FLAGS.items()}, (
         f"reply flags drifted: rt_wire.h={hdr_flags} "
         f"schema.py={schema.RECORD_FLAGS}")
+    # record-side trace flag (2.1): bit + leg length must agree across
+    # rt_wire.h, the catalog and the live packers
+    trace_bit = int(re.search(
+        r"kRecordTraceCtxBit = 1ULL << (\d+);", text).group(1))
+    assert (1 << trace_bit) == schema.TRACE_CTX_BIT == fastpath.TRACE_BIT
+    trace_len = int(re.search(r"kTraceCtxLen = (\d+);", text).group(1))
+    assert trace_len == schema.TRACE_CTX_LEN == fastpath.TRACE_LEN
+    from ray_tpu.utils import tracing
+    assert tracing.WIRE_LEN == fastpath.TRACE_LEN
     # the live packers must agree with the catalog too
     assert fastpath.STAMPED == schema.RECORD_FLAGS["STAMPED"]["value"]
     assert fastpath.SEQED == schema.RECORD_FLAGS["SEQED"]["value"]
+    assert fastpath.TRACED == schema.RECORD_FLAGS["TRACED"]["value"]
     # every cataloged prefix decodes through the live unpackers
     for prefix in schema.RECORD_PREFIXES:
         assert prefix in "PSQRAC"
@@ -86,6 +96,46 @@ def test_record_prefixes_and_flags_cataloged_everywhere():
     }
     assert emitted == {b"P", b"S", b"Q", b"R", b"A", b"C"}
     assert {p.decode() for p in emitted} == set(schema.RECORD_PREFIXES)
+
+
+def test_trace_leg_round_trips_and_untraced_records_unchanged():
+    """2.1 trace legs: traced records/replies round-trip the 25-byte
+    context; untraced ones stay byte-identical to the 2.0 layout."""
+    from ray_tpu.core import fastpath
+    from ray_tpu.utils import tracing
+
+    tid = b"\x11" * 16
+    leg = tracing.pack_ctx("ab" * 16, "cd" * 8, True)
+    assert len(leg) == fastpath.TRACE_LEN
+    for pack, unpack, extra in (
+            (fastpath.pack_task, fastpath.unpack_task, ()),
+            (lambda *a, **k: fastpath.pack_actor_task(a[0], a[1], a[2],
+                                                      a[3], a[4], 9, **k),
+             fastpath.unpack_actor_task, (9,))):
+        for args in ((1, 2), ({1, 2},)):  # C-pickle + packed bodies
+            plain = pack(tid, b"f", args, None, 5)
+            traced = pack(tid, b"f", args, None, 5, trace=leg)
+            got_p = unpack(plain)
+            got_t = unpack(traced)
+            assert got_p[:4] == got_t[:4] == (tid, b"f", args, None)
+            assert got_p[4] == got_t[4] == 5  # stamp survives the flag bit
+            assert got_p[-1] == b"" and got_t[-1] == leg
+            ctx = tracing.unpack_ctx(got_t[-1])
+            assert ctx == {"trace_id": "ab" * 16,
+                           "parent_span_id": "cd" * 8, "sampled": True}
+    # traced-but-unstamped: t=0 still means "no recorder stamp"
+    rec = fastpath.pack_task(tid, b"f", (1,), None, 0, trace=leg)
+    assert fastpath.unpack_task(rec)[4] == 0
+    assert fastpath.unpack_task(rec)[5] == leg
+    # replies: every leg combination round-trips
+    for stamp in (b"", b"\x01" * 16):
+        for seq in (None, 3):
+            for trace in (b"", leg):
+                rep = fastpath.pack_reply(tid, fastpath.OK, b"pay",
+                                          stamp, seq, trace)
+                t, st, pay, s, q, tr = fastpath.unpack_reply(rep)
+                assert (t, st, pay) == (tid, fastpath.OK, b"pay")
+                assert s == (stamp or None) and q == seq and tr == trace
 
 
 def test_handshake_accepts_current_and_rejects_major_mismatch():
