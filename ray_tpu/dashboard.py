@@ -22,6 +22,7 @@ hash-routed JS app from ``dashboard_client/``, no build step):
     GET /api/metrics           aggregated cluster metrics
     GET /api/timeline          chrome-trace events (load into perfetto)
     GET /api/latency           flight-recorder per-stage task latency
+    GET /api/llm               LLM decode-plane panel (disagg stages + spec gauges)
     GET /api/worker_deaths     worker postmortems (recorder event dumps)
     GET /api/workers/{id}/stack  live stack dump (py-spy role)
     GET /api/workers/{id}/heap   tracemalloc heap profile
@@ -125,6 +126,10 @@ def build_app():
     # postmortems (see utils/recorder.py, state.list_task_latency)
     app.router.add_get(
         "/api/latency", _json(lambda: _plain(state.list_task_latency())))
+    # LLM decode-plane panel: disagg stage windows (incl. speculative
+    # tokens_per_step / spec_accept_rate) + rt_llm_* gauges
+    app.router.add_get(
+        "/api/llm", _json(lambda: _plain(state.list_llm_metrics())))
     app.router.add_get(
         "/api/worker_deaths",
         _json(lambda: _plain(state.list_worker_deaths())))

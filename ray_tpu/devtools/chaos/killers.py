@@ -77,22 +77,34 @@ class ProcessKiller:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def strike_once(self) -> None:
+        """One synchronous seeded strike — progress-paced chaos. A
+        wall-clock cadence couples the fault schedule to host speed (a
+        loaded box takes N× longer per unit of work, so the same
+        interval lands N× more kills per task attempt — the seeded run
+        stops being the same experiment); callers that need a
+        deterministic schedule strike at workload milestones instead and
+        draw victims off the same seeded stream."""
+        if self.max_kills and len(self.kills) >= self.max_kills:
+            return
+        for _ in range(self.burst):
+            try:
+                if self.target == "raylet":
+                    self._kill_raylet()
+                else:
+                    self._kill_worker()
+            except Exception:
+                log.debug("killer strike failed", exc_info=True)
+
     # ------------------------------------------------------------------ loop
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             if self.max_kills and len(self.kills) >= self.max_kills:
                 return
-            for _ in range(self.burst):
-                try:
-                    if self.target == "raylet":
-                        self._kill_raylet()
-                    else:
-                        self._kill_worker()
-                except Exception:
-                    # chaos races real teardown by design (a victim can
-                    # die between choice and kill); the strike is skipped,
-                    # never escalated into a test-harness crash
-                    log.debug("killer strike failed", exc_info=True)
+            # chaos races real teardown by design (a victim can die
+            # between choice and kill); strike_once skips the strike,
+            # never escalates into a test-harness crash
+            self.strike_once()
 
     def _kill_raylet(self) -> None:
         victims = [r for r in self.cluster.raylets if r is not self._head]

@@ -512,7 +512,7 @@ def _instantiate(select: Iterable[str] | None = None,
 def lint_source(source: str, path: str = "<string>", *,
                 select=None, ignore=None) -> list[Finding]:
     """Lint one source string; returns unsuppressed findings, sorted."""
-    import ray_tpu.devtools.lint.rules  # noqa: F401  (registers RT001-RT012)
+    import ray_tpu.devtools.lint.rules  # noqa: F401  (registers RT001-RT017)
 
     try:
         tree = ast.parse(source, filename=path)

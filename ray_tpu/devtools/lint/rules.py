@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT015: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT017: ray_tpu-semantic anti-patterns.
 
 Each rule is a Rule subclass registered with @register; hooks receive
 (node, ctx) from the engine's single AST walk. See engine.rule_table()
@@ -510,6 +510,88 @@ class BatchQueueConfiguredPerCall(Rule):
                    f"{detail} re-creates the batch queue per call, "
                    "defeating request coalescing; hoist the batched "
                    "method to class/module level")
+
+
+@register
+class HostSyncInDecodeLoop(Rule):
+    id = "RT017"
+    summary = ("host-device sync inside a request-path loop body")
+    rationale = ("the fused-scan decode loop exists to keep K steps on "
+                 "device per host round trip; a block_until_ready() or "
+                 "np.asarray()/float()/int() on a device array inside "
+                 "the loop body forces a dispatch-sync-dispatch pattern "
+                 "that serializes the pipeline — one sync per ITERATION "
+                 "where the engine budget is one per BLOCK. Sync once "
+                 "after the loop (or per coalesced block, like "
+                 "_emit_spec_block's single np.asarray), and keep the "
+                 "(token, position) carry on device between dispatches")
+
+    def __init__(self):
+        self._device: set[str] = set()
+
+    def on_functiondef(self, node: ast.FunctionDef, ctx: Context):
+        # per-function forward flow, the RT014 binding idiom: names
+        # bound from jax-origin calls are device arrays until rebound
+        self._device.clear()
+
+    on_asyncfunctiondef = on_functiondef
+
+    def _uses_jax(self, ctx: Context) -> bool:
+        return any(origin and origin[0] == "jax"
+                   for origin in ctx.imports.bindings.values())
+
+    def on_assign(self, node: ast.Assign, ctx: Context):
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            return
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Call):
+            origin = ctx.imports.resolve(node.value.func)
+            if origin and origin[0] == "jax":
+                self._device.add(name)
+                return
+        self._device.discard(name)
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        if not ctx.loop_depth:
+            return
+        # leg 1: .block_until_ready() — a device-array method (and the
+        # jax.block_until_ready free function); the attribute form is
+        # unresolvable through imports, so gate on the module actually
+        # importing jax to keep unrelated code clean
+        func = node.func
+        if ((isinstance(func, ast.Attribute)
+             and func.attr == "block_until_ready"
+             and self._uses_jax(ctx))
+                or ctx.imports.resolve(func) == ("jax",
+                                                 "block_until_ready")):
+            ctx.report(self, node,
+                       "block_until_ready() in a loop body syncs the "
+                       "host to the device every iteration; sync once "
+                       "per fused block (or after the loop) instead")
+            return
+        # leg 2: host materialization of a name bound from a jax call —
+        # np.asarray/np.array (the NUMPY root; jnp.asarray stays on
+        # device) or the float()/int() builtins
+        if not self._device:
+            return
+        origin = ctx.imports.resolve(func)
+        numpy_op = (origin[-1] if origin and origin[0] == "numpy"
+                    and origin[-1] in ("asarray", "array") else None)
+        builtin = (func.id if isinstance(func, ast.Name)
+                   and func.id in ("float", "int")
+                   and ctx.imports.resolve(func) is None else None)
+        if numpy_op is None and builtin is None:
+            return
+        for arg in node.args:
+            if isinstance(arg, ast.Name) and arg.id in self._device:
+                fn = f"np.{numpy_op}" if numpy_op else f"{builtin}"
+                ctx.report(self, node,
+                           f"{fn}({arg.id}) on a device array in a loop "
+                           "body is a host-device sync per iteration — "
+                           "the fused-scan throughput killer; batch the "
+                           "transfer once per block/after the loop")
+                return
 
 
 @register

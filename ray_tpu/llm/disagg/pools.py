@@ -362,7 +362,8 @@ class DecodeWorker:
                  n_pages: int = 256, max_seq_len: int = 512,
                  eos_id: int | None = None, kv_dtype: str | None = None,
                  lora_adapters: dict | None = None, lora_rank: int = 8,
-                 max_waiting: int = 256):
+                 max_waiting: int = 256, spec_enable: bool = False,
+                 spec_k: int = 4, spec_ngram: int = 2, spec_drafter=None):
         from ray_tpu.utils.device import configure_jax
 
         configure_jax()
@@ -371,7 +372,9 @@ class DecodeWorker:
             params, model_config, max_batch=max_batch, page_size=page_size,
             n_pages=n_pages, max_seq_len=max_seq_len, eos_id=eos_id,
             lora_adapters=lora_adapters, lora_rank=lora_rank,
-            max_waiting=max_waiting, kv_dtype=kv_dtype)
+            max_waiting=max_waiting, kv_dtype=kv_dtype,
+            spec_enable=spec_enable, spec_k=spec_k, spec_ngram=spec_ngram,
+            spec_drafter=spec_drafter)
 
     async def decode_adopted(self, token_ids, manifest: KVPageManifest,
                              extra: KVPageManifest | None = None,
@@ -412,16 +415,24 @@ class DecodeWorker:
                 telemetry.record(telemetry.DECODE_QUEUE,
                                  time.perf_counter_ns() - t_submit)
             out.append(tok)
+        # refresh the decode-plane signals (tokens-in-flight gauge +
+        # spec windows) on the way out — every completed request keeps
+        # the scheduler's and the dashboard's numbers fresh
+        telemetry.publish_decode_signals(self.engine)
         return out
 
     def headroom(self) -> dict:
+        telemetry.publish_decode_signals(self.engine)
         return self.engine.headroom()
 
     def engine_stats(self) -> dict:
         return {"steps": self.engine.steps,
                 "tokens_out": self.engine.tokens_out,
                 "waiting": len(self.engine.waiting),
-                "free_pages": len(self.engine.free_pages)}
+                "free_pages": len(self.engine.free_pages),
+                "tokens_in_flight": self.engine.tokens_in_flight(),
+                **{k: v for k, v in self.engine.spec_stats().items()
+                   if k != "blocks"}}
 
     def disagg_counters(self) -> dict:
         return telemetry.counters()

@@ -33,7 +33,10 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
+import pickle
 import time
+import uuid
 
 from ray_tpu.core.ref import (
     ActorError,
@@ -44,6 +47,16 @@ from ray_tpu.llm.disagg import telemetry
 from ray_tpu.llm.disagg.kv_plane import KVPageManifest, KVShipError
 from ray_tpu.llm.disagg.pools import DecodeWorker, PrefillWorker
 from ray_tpu.llm.disagg.prefix_cache import PrefixCache
+
+log = logging.getLogger(__name__)
+
+#: GCS kv namespace of the cross-replica decode registry
+#: (``decode_share_group``): each DisaggLLMServer replica publishes its
+#: decode workers' handles + live signals under
+#: ``<group>/<replica-uuid>`` so siblings can steal onto idle rings
+_SHARE_NS = "llm_decode"
+#: a sibling record older than this is a dead replica, not a target
+_SHARE_TTL_S = 5.0
 
 
 def _is_worker_death(e: BaseException) -> bool:
@@ -68,7 +81,11 @@ class DisaggLLMServer:
                  prefill_n_pages: int | None = None,
                  max_wave: int = 8, wave_wait_s: float = 0.004,
                  max_attempts: int = 3, decode_max_restarts: int = 2,
-                 pool_resources: dict | None = None):
+                 pool_resources: dict | None = None,
+                 spec_enable: bool = False, spec_k: int = 4,
+                 spec_ngram: int = 2, spec_drafter=None,
+                 decode_share_group: str | None = None,
+                 signal_refresh_s: float = 0.2):
         import ray_tpu
 
         self.PS = page_size
@@ -105,37 +122,196 @@ class DisaggLLMServer:
             dw_cls.remote(model_config, params, params_fn,
                           max_batch=max_batch, page_size=page_size,
                           n_pages=n_pages, max_seq_len=max_seq_len,
-                          eos_id=eos_id, **model_kw)
+                          eos_id=eos_id, spec_enable=spec_enable,
+                          spec_k=spec_k, spec_ngram=spec_ngram,
+                          spec_drafter=spec_drafter, **model_kw)
             for i in range(n_decode)]
         # optimistic in-flight page estimate per decode worker — the
-        # admission-control signal (refreshed implicitly: reservations
-        # are returned in the same finally that awaited the decode)
+        # admission-control floor (refreshed implicitly: reservations
+        # are returned in the same finally that awaited the decode) —
+        # plus a tokens-in-flight ledger and the decode workers' LIVE
+        # signals (tokens_in_flight/free_pages probed by _signal_loop):
+        # admission ranks workers by decode tokens still owed, not by
+        # request counts
         self._est_pages = [0] * n_decode
+        self._est_tokens = [0] * n_decode
+        self._signals: list[dict | None] = [None] * n_decode
         self._capacity = n_pages - 1  # page 0 is the junk page
         self._pf_rr = itertools.count()
         self._dw_rr = itertools.count()
         # frozen per-(pool actor, method) fast-lane templates (_pool_call)
         self._pool_tmpls: dict = {}
+        # cross-replica decode batching (decode_share_group): sibling
+        # replicas' decode workers, flattened as key -> {handle, signal}
+        self._share_group = decode_share_group
+        self.signal_refresh_s = float(signal_refresh_s)
+        self._uuid = uuid.uuid4().hex[:12]
+        self._foreign: dict[str, dict] = {}
+        self._sig_task = None
+        self._last_req_ts = 0.0
         self.duplicate_prefills = 0
         self.decode_retries = 0
         self.backpressured = 0
         self.requests = 0
+        self.decode_tokens = [0] * n_decode  # per-ring traffic proof
+        self.stolen = 0          # requests decoded on a sibling replica
+        self.stolen_tokens = 0
 
     # ------------------------------------------------------------ routing
+    def _worker_load(self, i: int) -> int:
+        """Decode tokens still owed by worker ``i``: the live probed
+        tokens_in_flight plus our own picks the probe hasn't seen yet
+        (the router's inflight-at-probe subtraction, run against the
+        token ledger instead of request counts)."""
+        sig = self._signals[i]
+        if sig is not None and time.monotonic() - sig["ts"] < 2.0:
+            unseen = max(0, self._est_tokens[i] - sig["est_at_tokens"])
+            return sig["tokens_in_flight"] + unseen
+        return self._est_tokens[i]
+
+    def _worker_free_pages(self, i: int) -> int:
+        """Free-page headroom for worker ``i``: the optimistic ledger,
+        tightened by the live signal when fresh (a shared ring — steal
+        traffic from sibling replicas — burns pages our ledger never
+        saw)."""
+        free = self._capacity - self._est_pages[i]
+        sig = self._signals[i]
+        if sig is not None and time.monotonic() - sig["ts"] < 2.0:
+            unseen = max(0, self._est_pages[i] - sig["est_at_pages"])
+            free = min(free, sig["free_pages"] - unseen)
+        return free
+
     def _pick_decode(self, n_need: int, exclude: set[int]) -> int | None:
-        """Headroom-first pick: the worker with the most estimated free
-        pages that can seat the request; round-robin start for tie
+        """Signal-first pick: among workers with page headroom, take the
+        one owing the FEWEST decode tokens (tokens-in-flight + page
+        headroom are the admission signals — a ring full of nearly-done
+        requests outranks a shallow queue of long generations, which
+        request counts get backwards); round-robin start for tie
         spread. None = no pool-wide headroom (backpressure)."""
         start = next(self._dw_rr) % len(self.decode_pool)
-        best, best_free = None, -1
+        best, best_load = None, None
         for off in range(len(self.decode_pool)):
             i = (start + off) % len(self.decode_pool)
             if i in exclude:
                 continue
-            free = self._capacity - self._est_pages[i]
-            if free >= n_need and free > best_free:
-                best, best_free = i, free
+            if self._worker_free_pages(i) < n_need:
+                continue
+            load = self._worker_load(i)
+            if best_load is None or load < best_load:
+                best, best_load = i, load
         return best
+
+    def _pick_foreign(self, n_need: int,
+                      exclude: set[str]) -> tuple[str, object] | None:
+        """Idlest sibling-replica decode worker with page headroom (the
+        work-stealing leg): returns (key, actor handle) or None. The
+        signals come from the sibling's own probe loop via the GCS
+        registry — stale entries age out at discovery."""
+        best = best_load = None
+        for key, ent in self._foreign.items():
+            if key in exclude:
+                continue
+            sig = ent.get("signal") or {}
+            if sig.get("free_pages", 0) < n_need:
+                continue
+            load = sig.get("tokens_in_flight", 0)
+            if best_load is None or load < best_load:
+                best, best_load = (key, ent["handle"]), load
+        return best
+
+    # ---------------------------------------------------- decode signals
+    def _ensure_signal_loop(self):
+        """Lazy-start the probe loop (and retire it after 3s idle — the
+        router's probe-pause idiom); restarted by the next request."""
+        self._last_req_ts = time.monotonic()
+        if self._sig_task is None or self._sig_task.done():
+            self._sig_task = asyncio.get_running_loop().create_task(
+                self._signal_loop())
+
+    async def _signal_loop(self):
+        try:
+            while time.monotonic() - self._last_req_ts < 3.0:
+                for i, w in enumerate(self.decode_pool):
+                    # snapshot the ledgers BEFORE the probe: anything we
+                    # admit while the probe is in flight is "unseen"
+                    est_t, est_p = self._est_tokens[i], self._est_pages[i]
+                    try:
+                        hr = await self._pool_call(w, "headroom", (), {})
+                    except Exception:
+                        continue  # dead/restarting worker: keep stale
+                    self._signals[i] = {
+                        "tokens_in_flight": int(
+                            hr.get("tokens_in_flight", 0)),
+                        "free_pages": int(hr.get("free_pages", 0)),
+                        "est_at_tokens": est_t, "est_at_pages": est_p,
+                        "ts": time.monotonic()}
+                await self._share_sync()
+                await asyncio.sleep(self.signal_refresh_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.debug("decode signal loop died", exc_info=True)
+
+    async def _gcs(self, method: str, payload: dict):
+        from ray_tpu.core import api as _api
+
+        core = _api.get_core()
+        try:
+            on_core = asyncio.get_running_loop() is core.loop
+        except RuntimeError:
+            on_core = False
+        if on_core:
+            return await core.gcs.call(method, payload)
+        return await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+            core.gcs.call(method, payload), core.loop))
+
+    async def _share_sync(self):
+        """Publish our decode workers' handles + live signals to the
+        share-group registry and refresh the sibling view. Handles
+        pickle through the GCS kv like any actor arg; a steal then rides
+        ``_pool_call`` (shm ring same-node, node tunnel cross-node)
+        unchanged."""
+        if not self._share_group:
+            return
+        try:
+            rec = {"handles": list(self.decode_pool),
+                   "signals": [self._signals[i] or {}
+                               for i in range(len(self.decode_pool))],
+                   "ts": time.time()}
+            me = f"{self._share_group}/{self._uuid}"
+            await self._gcs("kv_put", {"ns": _SHARE_NS, "key": me,
+                                       "value": pickle.dumps(rec)})
+            keys = await self._gcs("kv_keys", {
+                "ns": _SHARE_NS, "prefix": f"{self._share_group}/"})
+            keys = [k for k in (keys or []) if k != me]
+            foreign: dict[str, dict] = {}
+            if keys:
+                blobs = await self._gcs("kv_multi_get",
+                                        {"ns": _SHARE_NS, "keys": keys})
+                for k, blob in (blobs or {}).items():
+                    try:
+                        sib = pickle.loads(blob)
+                    except Exception:
+                        continue
+                    if time.time() - sib.get("ts", 0) > _SHARE_TTL_S:
+                        continue
+                    for j, h in enumerate(sib.get("handles", ())):
+                        foreign[f"{k}#{j}"] = {
+                            "handle": h,
+                            "signal": (sib.get("signals") or [{}] * (j + 1)
+                                       )[j] or {}}
+            self._foreign = foreign
+        except Exception:
+            log.debug("decode share-group sync failed", exc_info=True)
+
+    def __serve_load__(self) -> float:
+        """The serve router's user-load probe hook: this replica's
+        decode tokens-in-flight in request-equivalents, so the router's
+        pow-2 choice (and handle-side admission) sees decode-plane
+        pressure instead of raw request counts."""
+        total = sum(self._worker_load(i)
+                    for i in range(len(self.decode_pool)))
+        return total / max(1, self.default_max_tokens)
 
     async def _pool_call(self, handle, method: str, args: tuple,
                          kwargs: dict):
@@ -198,12 +374,14 @@ class DisaggLLMServer:
         adapter = request.get("model")
         t_arr = time.perf_counter_ns()
         self.requests += 1
+        self._ensure_signal_loop()
         n_need = -(-(len(toks) + mt) // self.PS)
         if n_need > self._capacity:
             raise ValueError(
                 f"request needs {n_need} KV pages but decode pools hold "
                 f"{self._capacity}")
         excluded: set[int] = set()
+        f_excluded: set[str] = set()
         prefix_m = None   # pinned cache manifest (release on every exit)
         manifest = extra = first = None
         t_first = None
@@ -211,19 +389,31 @@ class DisaggLLMServer:
         try:
             for attempt in range(self.max_attempts + 1):
                 widx = self._pick_decode(n_need, excluded)
-                if widx is None and excluded:
+                fkey = fhandle = None
+                if widx is None:
+                    # no local headroom: a queued-but-unadmitted request
+                    # migrates to an idle SIBLING replica's decode ring
+                    # (decode_share_group) — the same manifest re-adopts
+                    # there, so the steal costs zero duplicate prefill
+                    # FLOPs and rides _pool_call's fast lanes unchanged
+                    picked = self._pick_foreign(n_need, f_excluded)
+                    if picked is not None:
+                        fkey, fhandle = picked
+                if widx is None and fhandle is None and excluded:
                     # every worker burned by THIS request: let it retry
                     # anywhere (a restarted worker may be back) rather
                     # than dead-ending with headroom elsewhere
                     excluded.clear()
                     widx = self._pick_decode(n_need, excluded)
-                if widx is None:
+                if widx is None and fhandle is None:
                     self._backpressure(n_need)
                 # reserve at PICK time, not after the prefill: concurrent
                 # requests admitting against a zero estimate would all
                 # pass and spend prefill work the decode pools cannot
                 # seat — the exact waste admission control exists to stop
-                self._est_pages[widx] += n_need
+                if widx is not None:
+                    self._est_pages[widx] += n_need
+                    self._est_tokens[widx] += mt
                 try:
                     if manifest is None:
                         try:
@@ -252,14 +442,22 @@ class DisaggLLMServer:
                             telemetry.record(telemetry.TTFT,
                                              t_first - t_arr)
                     with telemetry.traced("disagg::decode"):
+                        target = (self.decode_pool[widx]
+                                  if widx is not None else fhandle)
                         out = await self._pool_call(
-                            self.decode_pool[widx], "decode_adopted",
+                            target, "decode_adopted",
                             (toks, manifest, extra, first),
                             dict(max_tokens=mt, temperature=temp,
                                  adapter=adapter))
+                    if widx is not None:
+                        self.decode_tokens[widx] += len(out)
+                    else:
+                        self.stolen += 1
+                        self.stolen_tokens += len(out)
                     return self._finish(toks, out, manifest, extra,
-                                        prefix_m, t_arr, t_first, widx,
-                                        attempt)
+                                        prefix_m, t_arr, t_first,
+                                        widx if widx is not None
+                                        else f"steal:{fkey}", attempt)
                 except Exception as e:  # noqa: BLE001 — decode leg
                     last_err = e
                     if isinstance(e, (KVShipError, ObjectLostError)):
@@ -273,18 +471,27 @@ class DisaggLLMServer:
                         # decode worker died holding the request; the
                         # pages survive in the prefill arenas — re-adopt
                         # the SAME manifest elsewhere
-                        excluded.add(widx)
+                        if widx is not None:
+                            excluded.add(widx)
+                        else:
+                            f_excluded.add(fkey)
+                            self._foreign.pop(fkey, None)
                         self.decode_retries += 1
                         continue
                     from ray_tpu.serve.exceptions import BackPressureError
 
                     if isinstance(e, BackPressureError):
                         # headroom estimate was stale for this worker
-                        excluded.add(widx)
+                        if widx is not None:
+                            excluded.add(widx)
+                        else:
+                            f_excluded.add(fkey)
                         continue
                     raise
                 finally:
-                    self._est_pages[widx] -= n_need
+                    if widx is not None:
+                        self._est_pages[widx] -= n_need
+                        self._est_tokens[widx] -= mt
             raise last_err
         finally:
             self.cache.release(prefix_m)
@@ -360,11 +567,35 @@ class DisaggLLMServer:
             "decode_retries": self.decode_retries,
             "backpressured": self.backpressured,
             "est_pages": list(self._est_pages),
+            "est_tokens": list(self._est_tokens),
+            "decode_tokens": list(self.decode_tokens),
+            "decode_signals": [dict(s) if s else None
+                               for s in self._signals],
+            "stolen": self.stolen,
+            "stolen_tokens": self.stolen_tokens,
+            "foreign_workers": sorted(self._foreign),
             "prefix_cache": self.cache.stats(),
             "kv_plane": ledger,
         }
 
+    def stage_windows(self) -> dict:
+        """This replica's bounded TTFT/TPOT stage windows (ns values) —
+        the serve-driven bench reads its percentiles through the
+        deployment because the windows live in the replica process."""
+        return {"ttft": telemetry.stage_window(telemetry.TTFT),
+                "tpot": telemetry.stage_window(telemetry.TPOT)}
+
     async def shutdown(self):
+        if self._sig_task is not None:
+            self._sig_task.cancel()
+            self._sig_task = None
+        if self._share_group:
+            try:
+                await self._gcs("kv_del", {
+                    "ns": _SHARE_NS,
+                    "key": f"{self._share_group}/{self._uuid}"})
+            except Exception:
+                log.debug("share-group deregister failed", exc_info=True)
         refs = [w.stop.remote() for w in self.decode_pool]
         await asyncio.gather(*refs, return_exceptions=True)
 

@@ -33,7 +33,14 @@ KV_SHIP = "kv_ship"
 DECODE_QUEUE = "decode_queue"
 TTFT = "ttft"
 TPOT = "tpot"
-STAGES = (PREFILL_QUEUE, KV_SHIP, DECODE_QUEUE, TTFT, TPOT)
+# speculative-decoding block metrics (scaled integers riding the same
+# ns-valued windows: tokens_per_step is stored in MILLI-tokens/step and
+# spec_accept_rate in rate×1e6, so the generic µs percentile columns of
+# state.list_task_latency() read as tokens/step and rate×1e3)
+TOKENS_PER_STEP = "tokens_per_step"
+SPEC_ACCEPT = "spec_accept_rate"
+STAGES = (PREFILL_QUEUE, KV_SHIP, DECODE_QUEUE, TTFT, TPOT,
+          TOKENS_PER_STEP, SPEC_ACCEPT)
 
 # ttft/tpot are request-level derived metrics: they live in the latency
 # window + Prometheus but not in the per-op recorder ring
@@ -144,6 +151,28 @@ def _span_sink():
         core.task_events.emit(name=s["name"], state="SPAN", span=s,
                               worker_id=core.worker_id.hex())
     return sink
+
+
+def publish_decode_signals(engine) -> None:
+    """Drain one engine's per-block speculative log into the stage
+    windows and refresh the decode-plane gauges — called by the decode
+    worker after each request and from ``headroom()`` probes, so the
+    scheduler's admission signal, Prometheus, the dashboard LLM panel
+    and the bench all read the SAME numbers."""
+    st = engine.spec_stats(drain=True)
+    for n_steps, emitted, proposed, accepted in st["blocks"]:
+        record(TOKENS_PER_STEP, emitted * 1000 // max(1, n_steps))
+        if proposed:
+            record(SPEC_ACCEPT, accepted * 1_000_000 // proposed)
+        count(spec_proposed=proposed, spec_accepted=accepted,
+              spec_steps=n_steps, spec_tokens=emitted)
+    metrics.llm_decode_tokens_in_flight.set(engine.tokens_in_flight())
+    if st["spec_proposed"]:
+        metrics.llm_spec_accept_rate.set(st["spec_accept_rate"])
+    win = stage_window(TOKENS_PER_STEP)
+    if win:
+        metrics.llm_tokens_per_step.set(
+            sum(win[-64:]) / len(win[-64:]) / 1000.0)
 
 
 def count(**deltas: int) -> None:

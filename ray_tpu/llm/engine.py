@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.devtools import chaos
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.ops.basic import rms_norm, rope, rope_freqs
 
@@ -324,6 +325,197 @@ def paged_prefill_suffix(params, loras, aids, tokens, pages, kpool, vpool,
     return toks, kpool, vpool
 
 
+# --------------------------------------------------------------- speculative
+def _ngram_propose(hist, pos, k: int, m: int):
+    """Self-drafting prompt-lookup (Leviathan-style speculative decoding
+    with the request's OWN history as the drafter): find the most recent
+    earlier occurrence of the trailing ``m``-gram in ``hist`` and
+    propose the ``k`` tokens that followed it. Pure device math — the
+    drafter lives INSIDE the fused scan, so a spec block never pays a
+    host round trip to draft.
+
+    hist: [B, H] token history; positions ``0..pos`` are valid and
+    ``hist[b, pos[b]]`` is the pending input token. Returns
+    (drafts [B, k], draft_len [B]) with draft_len 0 where no match."""
+    B, H = hist.shape
+    n_win = H - m + 1
+    gidx = pos[:, None] - (m - 1) + jnp.arange(m)[None, :]
+    pattern = jnp.take_along_axis(hist, jnp.clip(gidx, 0, H - 1), axis=1)
+    # all H-m+1 windows of width m as m shifted views: wins[b, i, t] =
+    # hist[b, i + t] — one [B, n_win, m] compare finds every candidate
+    wins = jnp.stack([hist[:, t:t + n_win] for t in range(m)], axis=-1)
+    match = jnp.all(wins == pattern[:, None, :], axis=-1)     # [B, n_win]
+    ends = jnp.arange(n_win) + (m - 1)                        # window end j
+    valid = (ends[None, :] < pos[:, None]) & (pos[:, None] >= m)
+    # a match at j proposes the pos-j tokens that FOLLOWED it, capped at
+    # k — so prefer the most recent match with a full k followers (on
+    # periodic text the nearest match sits at pos-1 and would draft just
+    # ONE token), falling back to the nearest match otherwise
+    hit = match & valid
+    j_full = jnp.max(jnp.where(hit & (ends[None, :] <= pos[:, None] - k),
+                               ends[None, :], -1), axis=1)
+    j_any = jnp.max(jnp.where(hit, ends[None, :], -1), axis=1)
+    j = jnp.where(j_full >= 0, j_full, j_any)
+    found = j >= 0
+    dl = jnp.where(found, jnp.minimum(k, pos - j), 0).astype(jnp.int32)
+    didx = j[:, None] + 1 + jnp.arange(k)[None, :]
+    drafts = jnp.take_along_axis(hist, jnp.clip(didx, 0, H - 1), axis=1)
+    return drafts, dl
+
+
+def _spec_verify_body(params, loras, aids, inputs, positions, page_tables,
+                      kpool, vpool, temps, key, cfg: LlamaConfig):
+    """One fused multi-position forward over ``T = k+1`` decode
+    positions per slot — the ``paged_prefill_suffix`` shape run at the
+    decode batch: token j of a slot sits at absolute position
+    ``positions[b, j]``, its KV lands in the slot's pages through the
+    page table, and its attention window (gathered exactly like decode)
+    covers everything at or before it — including the sibling draft
+    positions written THIS step, which is precisely the speculative
+    verification semantics (draft j attends drafts 1..j-1).
+
+    Returns (greedy [B, T] target tokens per position, next0 [B] the
+    position-0 token with sampling applied for temps > 0 rows, kpool,
+    vpool)."""
+    B, T = inputs.shape
+    L, P, PS, KV, hd = _kv_shape(kpool)
+    MAXP = page_tables.shape[1]
+    cos, sin = rope_freqs(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    rows = jnp.take_along_axis(page_tables, positions // PS, axis=1)
+    offs = positions % PS
+    key_idx = jnp.arange(MAXP * PS)
+    mask = key_idx[None, None, :] <= positions[:, :, None]  # [B,T,MAXP*PS]
+    Dq = cfg.n_heads * hd
+    Dkv = KV * hd
+    x = params["tok"]["embedding"][inputs]  # [B, T, D]
+    for i in range(cfg.n_layers):
+        layer = params[f"layers_{i}"]
+        h = rms_norm(x, layer["attn_norm"]["scale"])
+        wqkv = jnp.concatenate(
+            [layer["wq"]["kernel"], layer["wk"]["kernel"],
+             layer["wv"]["kernel"]], axis=1)
+        qkv = h @ wqkv
+        q = (qkv[..., :Dq] + _lora_delta(h, loras, "wq", aids)
+             ).reshape(B, T, cfg.n_heads, hd)
+        kk = qkv[..., Dq:Dq + Dkv].reshape(B, T, KV, hd)
+        v = (qkv[..., Dq + Dkv:] + _lora_delta(h, loras, "wv", aids)
+             ).reshape(B, T, KV, hd)
+        q = rope(q, cos, sin, positions)
+        kk = rope(kk, cos, sin, positions)
+        kpool = _kv_write(kpool, i, rows, offs, kk)
+        vpool = _kv_write(vpool, i, rows, offs, v)
+        kb = _kv_read(kpool, i, page_tables, B, MAXP, PS, KV, hd, kk.dtype)
+        vb = _kv_read(vpool, i, page_tables, B, MAXP, PS, KV, hd, v.dtype)
+        att = _gqa_attn(q, kb, vb, mask)
+        x = x + att.reshape(B, T, -1) @ layer["wo"]["kernel"]
+        hf = rms_norm(x, layer["ffn_norm"]["scale"])
+        w_gu = jnp.concatenate(
+            [layer["w_gate"]["kernel"], layer["w_up"]["kernel"]], axis=1)
+        gu = hf @ w_gu
+        ff = gu.shape[-1] // 2
+        x = x + (jax.nn.silu(gu[..., :ff]) * gu[..., ff:]
+                 ) @ layer["w_down"]["kernel"]
+    x = rms_norm(x, params["norm"]["scale"])
+    logits = x @ params["lm_head"]["kernel"]  # [B, T, V]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sampled():
+        s = jax.random.categorical(
+            key, logits[:, 0] / jnp.maximum(temps, 1e-6)[:, None]
+        ).astype(jnp.int32)
+        return jnp.where(temps > 0, s, greedy[:, 0])
+
+    next0 = jax.lax.cond(jnp.any(temps > 0), sampled, lambda: greedy[:, 0])
+    return greedy, next0, kpool, vpool
+
+
+def _spec_verify_accept(params, loras, aids, tok, pos, drafts, dl,
+                        page_tables, kpool, vpool, active, temps, key,
+                        cfg: LlamaConfig):
+    """Verify ``drafts`` against the target in ONE fused forward and
+    apply the greedy accept rule: accept the longest draft prefix the
+    target agrees with, then take the target's own token at the first
+    disagreement (or the bonus token after a full accept). Emission is
+    token-identical to the non-speculative greedy engine by
+    construction — every emitted token IS the target's argmax given the
+    same prefix. Rejected tail positions hold junk KV that the next
+    step's inputs legitimately overwrite (write-before-read per layer),
+    so rollback is pure position arithmetic: no pool copy.
+
+    Returns (out [B, k+1] emission candidates, n_emit [B], n_acc [B],
+    new_tok [B], new_pos [B], kpool, vpool)."""
+    B, k = drafts.shape
+    inputs = jnp.concatenate([tok[:, None], drafts], axis=1)
+    positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+    greedy, next0, kpool, vpool = _spec_verify_body(
+        params, loras, aids, inputs, positions, page_tables, kpool, vpool,
+        temps, key, cfg)
+    okm = (drafts == greedy[:, :-1]) & (jnp.arange(k)[None, :] < dl[:, None])
+    n_acc = jnp.sum(jnp.cumprod(okm.astype(jnp.int32), axis=1), axis=1)
+    out = jnp.concatenate([next0[:, None], greedy[:, 1:]], axis=1)
+    n_emit = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+    new_tok = jnp.where(
+        active, jnp.take_along_axis(out, n_acc[:, None], axis=1)[:, 0], 0)
+    return out, n_emit, n_acc, new_tok, pos + n_acc + 1, kpool, vpool
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "k", "ngram"),
+         donate_argnums=(5, 7, 8))
+def paged_decode_spec(params, loras, aids, tokens, seq_lens, hist,
+                      page_tables, kpool, vpool, active, spec_ok, temps,
+                      key, cfg: LlamaConfig, n_steps: int, k: int,
+                      ngram: int):
+    """``n_steps`` SPECULATIVE decode steps as one device program: each
+    scan step drafts ``k`` tokens per slot with the on-device n-gram
+    matcher, verifies all of them in one fused multi-position forward,
+    and advances each slot by ``n_acc + 1`` positions — so one host
+    round trip can emit up to ``n_steps * (k + 1)`` tokens instead of
+    ``n_steps``. The (token, position, history) carry chains on device
+    between blocks exactly like ``paged_decode_multi``'s; slots where
+    ``spec_ok`` is False (sampled rows, per-request opt-out) run with
+    draft_len 0, i.e. plain one-token decode — a mixed spec/plain wave
+    is one program, one compiled bucket per (n_steps, k).
+
+    Returns (toks [S, B, k+1], n_emit [S, B], n_prop [S, B], tok, pos,
+    hist, kpool, vpool); the host emits the first ``n_emit[s, b]``
+    tokens of each row and discards the rest (the rollback)."""
+    def step(carry, s):
+        tok, pos, hist, kpool, vpool = carry
+        drafts, dl = _ngram_propose(hist, pos, k, ngram)
+        dl = jnp.where(spec_ok, dl, 0)
+        out, n_emit, n_acc, tok, pos, kpool, vpool = _spec_verify_accept(
+            params, loras, aids, tok, pos, drafts, dl, page_tables,
+            kpool, vpool, active, temps, jax.random.fold_in(key, s), cfg)
+        # record the emitted tokens into the history so the NEXT step's
+        # n-gram drafter sees them (indices past n_acc drop out-of-bounds)
+        B, H = hist.shape
+        widx = pos[:, None] - n_acc[:, None] + jnp.arange(k + 1)[None, :]
+        widx = jnp.where(jnp.arange(k + 1)[None, :] <= n_acc[:, None],
+                         widx, H)
+        hist = hist.at[jnp.arange(B)[:, None], widx].set(out, mode="drop")
+        return (tok, pos, hist, kpool, vpool), (out, n_emit, dl)
+
+    (tok, pos, hist, kpool, vpool), (toks, n_emit, n_prop) = jax.lax.scan(
+        step, (tokens, seq_lens, hist, kpool, vpool), jnp.arange(n_steps))
+    return toks, n_emit, n_prop, tok, pos, hist, kpool, vpool
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"), donate_argnums=(7, 8))
+def paged_decode_verify(params, loras, aids, tokens, seq_lens, drafts,
+                        page_tables, kpool, vpool, draft_lens, active,
+                        temps, key, cfg: LlamaConfig, k: int):
+    """One speculative step with HOST-provided drafts — the drafter-hook
+    path (``spec_drafter=``: a real small model, a custom matcher). Same
+    verify/accept as the fused scan, but one step per dispatch since the
+    host drafter needs the accepted tokens back before proposing the
+    next window. Returns (toks [B, k+1], n_emit [B], n_prop [B], tok,
+    pos, kpool, vpool)."""
+    out, n_emit, n_acc, tok, pos, kpool, vpool = _spec_verify_accept(
+        params, loras, aids, tokens, seq_lens, drafts, draft_lens,
+        page_tables, kpool, vpool, active, temps, key, cfg)
+    return out, n_emit, draft_lens, tok, pos, kpool, vpool
+
+
 def make_lora_stack(cfg: LlamaConfig, adapters: dict[str, dict], rank: int):
     """Stack named adapters into gatherable arrays. Index 0 is the base
     model (zero delta). adapters: name -> {"wq_a": [D,r], "wq_b": [r,O],
@@ -393,6 +585,11 @@ class _Request:
     # adopted from a prefill worker's KVPageManifest — admission scatters
     # the stacks into this engine's pool instead of running a prefill
     prefilled: tuple | None = None
+    # speculative decoding: this request's draft state rides here — the
+    # opt-in flag (greedy-only; sampled rows always decode plain) plus
+    # its slice of the engine's token-history mirror (the drafter's
+    # context), which _reserve_slot/_emit_spec_block maintain
+    spec: bool = False
 
 
 class EngineFull(Exception):
@@ -409,7 +606,9 @@ class ContinuousBatchingEngine:
                  lora_adapters: dict[str, dict] | None = None,
                  lora_rank: int = 8, max_waiting: int = 256,
                  block_buckets: tuple[int, ...] = (4, 8, 16, 32, 64),
-                 kv_dtype: str | None = None):
+                 kv_dtype: str | None = None, spec_enable: bool = False,
+                 spec_k: int = 4, spec_ngram: int = 2,
+                 spec_drafter=None):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
@@ -452,9 +651,28 @@ class ContinuousBatchingEngine:
         self._task = None
         self._rng = jax.random.PRNGKey(0)
         self.error: BaseException | None = None  # fatal loop failure
+        # speculative decoding (README § Speculative decoding): greedy
+        # requests draft spec_k tokens per step (on-device n-gram
+        # matcher over spec_ngram-grams, or the spec_drafter hook) and
+        # the target verifies them in one fused multi-position forward
+        self.spec_enable = bool(spec_enable)
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        self.spec_drafter = spec_drafter
+        # token-history mirror [B, max_seq_len]: hist[i, :seq_lens[i]+1]
+        # holds slot i's known tokens (prompt + emitted + pending input)
+        # — the drafter's context, and the rebuild source for the
+        # device-resident hist carry at admission points
+        self.hist = np.zeros((self.B, self.MAXP * page_size), np.int32)
         # counters for benchmarks / tests
         self.steps = 0
         self.tokens_out = 0
+        self.spec_steps = 0      # speculative verify steps run
+        self.spec_proposed = 0   # draft tokens proposed (live spec rows)
+        self.spec_accepted = 0   # draft tokens the target accepted
+        # bounded per-block log the disagg telemetry drains:
+        # (n_steps, emitted, proposed, accepted) per synced spec block
+        self._block_log: collections.deque = collections.deque(maxlen=256)
 
     # ----------------------------------------------------------- public API
     async def start(self):
@@ -480,8 +698,12 @@ class ContinuousBatchingEngine:
         self.slot_req = [None] * self.B
 
     def submit(self, prompt_tokens: list[int], *, max_tokens: int = 32,
-               temperature: float = 0.0, adapter: str | None = None) -> int:
-        """Queue a request; returns its id. Tokens arrive on stream()."""
+               temperature: float = 0.0, adapter: str | None = None,
+               spec: bool | None = None) -> int:
+        """Queue a request; returns its id. Tokens arrive on stream().
+        ``spec`` overrides the engine's ``spec_enable`` default for this
+        request (greedy requests only; sampled rows decode plain either
+        way)."""
         if self.error is not None:
             raise RuntimeError("engine loop died") from self.error
         if len(self.waiting) >= self.max_waiting:
@@ -500,7 +722,8 @@ class ContinuousBatchingEngine:
             raise ValueError(f"unknown LoRA adapter {adapter!r} "
                              f"(loaded: {sorted(self.lora_index)})")
         req = _Request(next(self._req_ids), list(prompt_tokens),
-                       int(max_tokens), float(temperature), aid)
+                       int(max_tokens), float(temperature), aid,
+                       spec=self.spec_enable if spec is None else bool(spec))
         self._reqs[req.req_id] = req
         self.waiting.append(req)
         self._wake.set()
@@ -509,7 +732,8 @@ class ContinuousBatchingEngine:
     def submit_prefilled(self, prompt_tokens: list[int], k_stack, v_stack,
                          first_token: int, *, max_tokens: int = 32,
                          temperature: float = 0.0,
-                         adapter: str | None = None) -> int:
+                         adapter: str | None = None,
+                         spec: bool | None = None) -> int:
         """Queue a request whose prompt KV was ALREADY produced elsewhere
         (a disaggregated prefill worker): admission scatters the adopted
         page stacks (``[L, n_pages, PS, KV, hd]`` arrays, or ``{"q","s"}``
@@ -538,7 +762,8 @@ class ContinuousBatchingEngine:
             raise ValueError(f"unknown LoRA adapter {adapter!r} "
                              f"(loaded: {sorted(self.lora_index)})")
         req = _Request(next(self._req_ids), list(prompt_tokens),
-                       int(max_tokens), float(temperature), aid)
+                       int(max_tokens), float(temperature), aid,
+                       spec=self.spec_enable if spec is None else bool(spec))
         req.prefilled = (k_stack, v_stack, int(first_token))
         self._reqs[req.req_id] = req
         self.waiting.append(req)
@@ -562,12 +787,40 @@ class ContinuousBatchingEngine:
         return ship_pages(self.kpool, self.vpool, page_ids, req.prompt,
                           page_size=self.PS, kv_dtype=self.kv_dtype)
 
+    def tokens_in_flight(self) -> int:
+        """Decode tokens this engine still owes: remaining scheduled
+        tokens of resident requests plus everything waiting — the
+        cross-replica batching admission signal (a ring full of
+        nearly-done requests drains fast; a shallow queue of long
+        generations does not; request COUNTS can't tell them apart)."""
+        live = sum(max(0, r.max_tokens - r.emitted)
+                   for r in self.slot_req if r is not None and not r.cancelled)
+        return live + sum(max(0, r.max_tokens - r.emitted)
+                          for r in self.waiting if not r.cancelled)
+
+    def spec_stats(self, drain: bool = False) -> dict:
+        """Speculative-decoding counters + the per-block log. With
+        ``drain`` the log is consumed (the disagg telemetry's exactly-
+        once feed into the tokens_per_step / spec_accept_rate windows);
+        without it this is a pure read."""
+        blocks = list(self._block_log)
+        if drain:
+            self._block_log.clear()
+        return {"spec_steps": self.spec_steps,
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "spec_accept_rate": (self.spec_accepted
+                                     / max(1, self.spec_proposed)),
+                "blocks": blocks}
+
     def headroom(self) -> dict:
         """Admission-control snapshot for the disagg scheduler: free KV
-        pages and decode slots, plus the queue depth."""
+        pages and decode slots, queue depth, and the decode
+        tokens-in-flight signal."""
         return {"free_pages": len(self.free_pages),
                 "free_slots": sum(r is None for r in self.slot_req),
                 "waiting": len(self.waiting),
+                "tokens_in_flight": self.tokens_in_flight(),
                 "n_pages": self.n_pages, "page_size": self.PS,
                 "max_batch": self.B, "kv_dtype": self.kv_dtype}
 
@@ -652,6 +905,11 @@ class ContinuousBatchingEngine:
         self.seq_lens[slot] = Tp
         self.temps[slot] = req.temperature
         self.aids[slot] = req.adapter
+        if self.spec_enable:
+            # drafter context: the prompt (first token lands at
+            # _admit_wave emission, generated tokens at spec emission)
+            self.hist[slot, :] = 0
+            self.hist[slot, :Tp] = req.prompt
         return slot
 
     _WAVE_BUCKETS = (1, 2, 4, 8, 16)
@@ -665,6 +923,8 @@ class ContinuousBatchingEngine:
             first = np.asarray(first)  # ONE sync per group
             for j, req in enumerate(reqs):
                 self.next_tok[req.slot] = int(first[j])
+                if self.spec_enable:
+                    self.hist[req.slot, len(req.prompt)] = int(first[j])
                 self._emit(req, int(first[j]))
         return bool(groups)
 
@@ -823,7 +1083,12 @@ class ContinuousBatchingEngine:
                 self._emit(req, tok)
 
     async def _loop_inner(self):
-        if self.eos_id is None:
+        if self.spec_enable:
+            # accepted counts are data-dependent: completion steps are
+            # unknowable at dispatch, so spec mode always drives the
+            # reactive-shaped loop (planned mode needs a schedule)
+            await self._loop_spec()
+        elif self.eos_id is None:
             await self._loop_planned()
         else:
             await self._loop_reactive()
@@ -996,4 +1261,223 @@ class ContinuousBatchingEngine:
                 drain()
                 carry = None
             # hand the loop to consumers/admitters every block
+            await asyncio.sleep(0)
+
+    # ------------------------------------------------------- speculative loop
+    _SPEC_BUCKETS = (1, 2, 4)
+
+    def _spec_inflight_steps(self, pending) -> list[int]:
+        """Per-slot spec steps already dispatched but not yet synced."""
+        steps = [0] * self.B
+        for entry in pending:
+            S, snap = entry[0], entry[4]
+            for i, rq in enumerate(snap):
+                if rq is not None and self.slot_req[i] is rq:
+                    steps[i] += S
+        return steps
+
+    def _pick_spec_block(self, deficits: list[int]) -> int:
+        """Fused spec-steps bucket: sized to the smallest GUARANTEED
+        remaining need (each step advances >= 1 token), so a finishing
+        request frees its slot without riding out a long block. Buckets
+        stop at 4: a spec step can emit up to k+1 tokens, and the
+        optimistic dispatch gate stops issuing blocks once in-flight
+        steps COULD satisfy every request — a coarser bucket would turn
+        that possibility into up to a whole wasted block of verifies."""
+        want = max(1, min(deficits))
+        for b in self._SPEC_BUCKETS:
+            if want <= b:
+                return b
+        return self._SPEC_BUCKETS[-1]
+
+    def _host_drafts(self, spec_ok):
+        """Drafter-hook path: ask ``spec_drafter(context, pos, k)`` for
+        up to k draft tokens per live greedy slot. ``context`` is the
+        slot's token history through the pending input (a numpy view),
+        ``pos`` its length minus one — the small-model-on-TPU hook rides
+        here."""
+        k = self.spec_k
+        drafts = np.zeros((self.B, k), np.int32)
+        dlens = np.zeros(self.B, np.int32)
+        for i, req in enumerate(self.slot_req):
+            if req is None or not spec_ok[i]:
+                continue
+            n = int(self.seq_lens[i])
+            got = list(self.spec_drafter(self.hist[i, :n + 1], n, k))[:k]
+            drafts[i, :len(got)] = got
+            dlens[i] = len(got)
+        return drafts, dlens
+
+    def _emit_spec_block(self, entry) -> None:
+        """Host-side emission of one synced speculative block: per step
+        and slot, emit the first ``n_emit`` candidate tokens (the
+        accepted drafts plus the target's correction/bonus token) and
+        discard the rest — the rejected tail's rollback is exactly this
+        truncation plus the seq_lens arithmetic (the junk KV those
+        positions hold is overwritten when they are legitimately
+        decoded)."""
+        S, toks, n_emit, n_prop, snapshot, spec_snap = entry
+        toks = np.asarray(toks)      # [S, B, k+1]; ONE sync per block
+        n_emit = np.asarray(n_emit)  # [S, B]
+        n_prop = np.asarray(n_prop)
+        self.steps += S
+        self.spec_steps += S
+        emitted = proposed = accepted = 0
+        H = self.hist.shape[1]
+        for s in range(S):
+            for i, req in enumerate(snapshot):
+                if req is None:
+                    continue
+                ne = int(n_emit[s, i])
+                if ne <= 0:
+                    continue
+                live = self.slot_req[i] is req
+                if live:
+                    base = int(self.seq_lens[i])
+                    self.seq_lens[i] += ne
+                if spec_snap[i] and not req.cancelled:
+                    proposed += int(n_prop[s, i])
+                    accepted += ne - 1
+                for j in range(ne):
+                    if req.cancelled:
+                        break  # finished/cancelled mid-block: discard
+                    tok = int(toks[s, i, j])
+                    if live:
+                        self.next_tok[i] = tok
+                        if base + j + 1 < H:
+                            self.hist[i, base + j + 1] = tok
+                    emitted += 1
+                    self._emit(req, tok)
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self._block_log.append((S, emitted, proposed, accepted))
+
+    async def _loop_spec(self):
+        """Speculative driver (reactive shape, README § Speculative
+        decoding): with the on-device n-gram drafter the whole
+        draft→verify→accept cycle lives inside ``paged_decode_spec``'s
+        scan, the (token, position, history) carry chains on device, and
+        blocks pipeline 2-deep exactly like ``_loop_reactive``. With a
+        host ``spec_drafter`` hook each dispatch is one verify step and
+        syncs immediately — the drafter needs the accepted tokens before
+        it can propose the next window."""
+        pending: list = []
+        carry = None  # (tok_dev, lens_dev, hist_dev) between blocks
+        # device uploads of the per-slot tables (page_tables/aids/temps/
+        # active/spec_ok): these only change at admission/free points,
+        # exactly where carry resets — hoisting them out of the dispatch
+        # keeps the per-block host cost at one RNG split + one append
+        # (spec blocks are smaller than plain blocks, so per-dispatch
+        # overhead multiplies faster here)
+        statics = None
+        k = self.spec_k
+        host_draft = callable(self.spec_drafter)
+
+        def drain():
+            while pending:
+                self._emit_spec_block(pending.pop(0))
+
+        while self._running:
+            for i, req in enumerate(self.slot_req):
+                if req is not None and req.cancelled and req.slot >= 0:
+                    if pending:
+                        break  # free only with no block in flight
+                    self._free_slot(i)
+            if self.waiting and any(r is None for r in self.slot_req):
+                drain()  # admission changes device-visible state
+                for i, req in enumerate(self.slot_req):
+                    if req is not None and req.cancelled:
+                        self._free_slot(i)
+                if self._admit_wave():
+                    carry = None
+            active = np.array([r is not None for r in self.slot_req])
+            if not active.any():
+                drain()
+                carry = None
+                self._wake.clear()
+                try:
+                    await asyncio.wait_for(self._wake.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            # optimistic dispatch gate: a spec step emits 1..k+1 tokens,
+            # so in-flight blocks COULD have satisfied a request long
+            # before the 1-token lower bound says so. Once every live
+            # request's optimistic bound (emitted + (k+1) x in-flight
+            # steps) covers its budget, SYNC the oldest block instead of
+            # dispatching — at high accept rates this is what keeps the
+            # loop from verifying junk a finished request will discard;
+            # when acceptance was actually low the sync corrects the
+            # bound from real emissions and dispatch resumes.
+            inflight = self._spec_inflight_steps(pending)
+            deficits = [r.max_tokens - r.emitted - (k + 1) * inflight[i]
+                        for i, r in enumerate(self.slot_req)
+                        if r is not None and not r.cancelled]
+            if not deficits or max(deficits) <= 0:
+                if pending:
+                    self._emit_spec_block(pending.pop(0))
+                else:
+                    self._wake.clear()
+                    try:
+                        await asyncio.wait_for(self._wake.wait(),
+                                               timeout=0.05)
+                    except asyncio.TimeoutError:
+                        pass
+                if any(r is not None and r.cancelled
+                       for r in self.slot_req):
+                    drain()
+                    carry = None
+                await asyncio.sleep(0)
+                continue
+            self._rng, sub = jax.random.split(self._rng)
+            if carry is None:
+                carry = (jnp.asarray(self.next_tok.copy()),
+                         jnp.asarray(self.seq_lens.copy()),
+                         jnp.asarray(self.hist.copy()))
+                statics = None
+            if statics is None:
+                spec_ok = np.array([
+                    r is not None and not r.cancelled and r.spec
+                    and r.temperature <= 0 for r in self.slot_req])
+                statics = (jnp.asarray(self.aids.copy()),
+                           jnp.asarray(self.page_tables.copy()),
+                           jnp.asarray(active),
+                           jnp.asarray(spec_ok),
+                           jnp.asarray(self.temps.copy()),
+                           spec_ok)
+            aids_d, pt_d, act_d, sok_d, tmp_d, spec_ok = statics
+            tok_d, lens_d, hist_d = carry
+            if host_draft:
+                drafts, dlens = self._host_drafts(spec_ok)
+                (toks, n_emit, n_prop, tok_d, lens_d, self.kpool,
+                 self.vpool) = paged_decode_verify(
+                    self.params, self.loras, aids_d, tok_d, lens_d,
+                    jnp.asarray(drafts), pt_d, self.kpool, self.vpool,
+                    jnp.asarray(dlens), act_d, tmp_d, sub, self.cfg, k)
+                self._emit_spec_block((1, toks[None], n_emit[None],
+                                       n_prop[None], list(self.slot_req),
+                                       spec_ok))
+                carry = None  # host state is authoritative per step
+            else:
+                S = self._pick_spec_block([d for d in deficits if d > 0])
+                if chaos.ENABLED:
+                    # "llm.spec_block": fires once per fused speculative
+                    # block — a seeded kill here dies MID-speculative-
+                    # window (accepted-but-unsynced tokens in flight),
+                    # the recovery window tests/plans/spec_decode_kill
+                    # exercises
+                    chaos.point("llm.spec_block", steps=S, k=k)
+                (toks, n_emit, n_prop, tok_d, lens_d, hist_d, self.kpool,
+                 self.vpool) = paged_decode_spec(
+                    self.params, self.loras, aids_d, tok_d, lens_d,
+                    hist_d, pt_d, self.kpool, self.vpool, act_d, sok_d,
+                    tmp_d, sub, self.cfg, S, k, self.spec_ngram)
+                carry = (tok_d, lens_d, hist_d)
+                pending.append((S, toks, n_emit, n_prop,
+                                list(self.slot_req), spec_ok))
+                if len(pending) >= 2:
+                    self._emit_spec_block(pending.pop(0))
+            if any(r is not None and r.cancelled for r in self.slot_req):
+                drain()
+                carry = None
             await asyncio.sleep(0)

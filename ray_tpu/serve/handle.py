@@ -141,6 +141,12 @@ class _Router:
         # reported count was OURS, so scoring doesn't double-count it.
         self.remote_ongoing: dict[str, int] = {}
         self.inflight_at_probe: dict[str, int] = {}
+        # deployment-reported load (__serve_load__ probe field, in
+        # ongoing-request equivalents): decode-plane pressure — the
+        # disagg LLM scheduler's tokens-in-flight — folded into the
+        # pow-2 score so the router admits on the decode signal, not
+        # on request counts alone
+        self.replica_load: dict[str, float] = {}
         # fast-lane bindings per replica (serve/dataplane/fastlane.py):
         # same-node replicas ride the actor shm ring, per-call RPC
         # fallback; dropped with the replica's other routing state
@@ -205,6 +211,7 @@ class _Router:
                 self.lanes.pop(rid, None)
                 self.admission.pop(rid, None)
                 self.replica_queued.pop(rid, None)
+                self.replica_load.pop(rid, None)
 
     # ------------------------------------------------- fast death detection
     def _ensure_death_listener(self, core):
@@ -230,7 +237,8 @@ class _Router:
                              if r["replica_id"] != rid]
             for d in (self.handles, self.inflight, self.remote_ongoing,
                       self.inflight_at_probe, self.models, self.lanes,
-                      self.admission, self.replica_queued):
+                      self.admission, self.replica_queued,
+                      self.replica_load):
                 d.pop(rid, None)
 
     def _ensure_poll_loop(self):
@@ -310,6 +318,8 @@ class _Router:
                         (m,) = await core.get_async([ref], 1.0)
                         with self.lock:
                             self.remote_ongoing[rid] = int(m.get("ongoing", 0))
+                            self.replica_load[rid] = float(
+                                m.get("user_load", 0.0))
                             self.inflight_at_probe[rid] = local_now
                             self.models[rid] = list(m.get("models", ()))
                             # drain-rate view for proxy-side admission
@@ -492,11 +502,15 @@ class _Router:
 
             def score(r):
                 # remote count minus the share that was OURS at probe time
-                # (it is already in `inflight`), plus current local inflight
+                # (it is already in `inflight`), plus current local
+                # inflight, plus the deployment's own probed load signal
+                # (__serve_load__ — decode tokens-in-flight for the
+                # disagg LLM deployment)
                 rid = r["replica_id"]
                 others = max(0, self.remote_ongoing.get(rid, 0)
                              - self.inflight_at_probe.get(rid, 0))
-                return others + self.inflight.get(rid, 0)
+                return (others + self.inflight.get(rid, 0)
+                        + self.replica_load.get(rid, 0.0))
 
             return a if score(a) <= score(b) else b
 

@@ -386,9 +386,21 @@ class Replica:
     def get_metrics(self) -> dict:
         from ray_tpu.serve.multiplex import loaded_model_ids
 
+        # deployment-defined load signal (__serve_load__, in ongoing-
+        # request equivalents): lets a deployment whose real pressure
+        # lives below the request count — the disagg LLM scheduler's
+        # decode tokens-in-flight — steer the router's pow-2 choice
+        user_load = 0.0
+        fn = getattr(self.user, "__serve_load__", None)
+        if fn is not None:
+            try:
+                user_load = float(fn())
+            except Exception:  # raylint: disable=RT012 — probe must never fail metrics
+                pass
         out = {
             "replica_id": self.replica_id,
             "ongoing": self._ongoing,
+            "user_load": user_load,
             "queued": self._queued,
             "shed": self._shed,
             "refused": self._refused,

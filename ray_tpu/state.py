@@ -448,6 +448,26 @@ def list_task_latency() -> dict[str, dict]:
     return out
 
 
+_LLM_STAGES = ("prefill_queue", "kv_ship", "decode_queue", "ttft", "tpot",
+               "tokens_per_step", "spec_accept_rate")
+
+
+def list_llm_metrics() -> dict:
+    """LLM decode-plane panel: the disagg serving stage percentiles
+    (``prefill_queue``/``kv_ship``/``decode_queue``/``ttft``/``tpot``
+    plus the speculative ``tokens_per_step`` and ``spec_accept_rate``
+    windows — scaled integers, see llm/disagg/telemetry.py) and every
+    per-process ``rt_llm_*`` gauge (decode tokens-in-flight, accept
+    rate, tokens/step). The scheduler's admission, the serve router's
+    ``__serve_load__`` probe, the bench and this panel all read the
+    same numbers."""
+    stages = {k: v for k, v in list_task_latency().items()
+              if k in _LLM_STAGES}
+    gauges = {name: m for name, m in cluster_metrics().items()
+              if name.startswith("rt_llm_")}
+    return {"stages": stages, "gauges": gauges}
+
+
 def list_serve_autoscale_events(key: str | None = None) -> list[dict]:
     """Fired serve autoscale decisions (newest last), each carrying its
     cause and the signals that produced it — {key, ts, from_replicas,

@@ -163,6 +163,20 @@ task_stage_us = Gauge(
     tag_keys=("stage", "q"))
 recorder_samples = Gauge(
     "rt_recorder_samples", "per-task latency samples recorded (lifetime)")
+# --- LLM decode-plane signals (llm/disagg/telemetry.py) ---------------------
+# Published per decode-worker process; the disagg scheduler and serve
+# router admit on tokens-in-flight + page headroom instead of request
+# counts (cross-replica decode batching), and the spec-decode gauges are
+# the same numbers the bench's A/B arm reports.
+llm_decode_tokens_in_flight = Gauge(
+    "rt_llm_decode_tokens_in_flight",
+    "decode tokens still owed by this process's LLM engine")
+llm_spec_accept_rate = Gauge(
+    "rt_llm_spec_accept_rate",
+    "speculative-decode draft acceptance rate (lifetime ratio)")
+llm_tokens_per_step = Gauge(
+    "rt_llm_tokens_per_step",
+    "tokens emitted per fused decode step (recent-block mean)")
 # NOTE: rt_request_critical_path_us (the GCS trace assembler's per-stage
 # request-latency histogram) is deliberately NOT declared here: the GCS
 # hand-rolls its cells (core/gcs.py _trace_metrics_tick) because an

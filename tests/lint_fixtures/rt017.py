@@ -1,0 +1,83 @@
+"""RT017 fixture: host-device sync inside a request-path loop body."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def decode_step(tok):
+    return jnp.asarray(tok) + 1
+
+
+def per_step_sync(tokens):
+    # the anti-pattern: one block_until_ready per decode iteration
+    out = []
+    for t in tokens:
+        r = decode_step(t)
+        r.block_until_ready()  # expect: RT017
+        out.append(r)
+    return out
+
+
+def free_function_form(tokens):
+    for t in tokens:
+        r = decode_step(t)
+        jax.block_until_ready(r)  # expect: RT017
+        out = r
+    return out
+
+
+def per_step_materialize(tokens):
+    out = []
+    for t in tokens:
+        r = jnp.multiply(t, 2)
+        out.append(np.asarray(r))  # expect: RT017
+    return out
+
+
+def per_step_scalar_pull(tokens):
+    total = 0
+    while tokens:
+        logit = jnp.asarray(tokens.pop())
+        total += float(logit)  # expect: RT017
+    return total
+
+
+def int_pull_in_loop(tokens):
+    out = []
+    for t in tokens:
+        nxt = jax.numpy.argmax(jnp.asarray(t))
+        out.append(int(nxt))  # expect: RT017
+    return out
+
+
+def batched_sync_after_loop(tokens):
+    # the designed shape: dispatch the whole block, ONE sync at the end
+    blocks = []
+    for t in tokens:
+        blocks.append(decode_step(t))
+    stacked = jnp.stack(blocks)
+    return np.asarray(stacked)  # sync once per block — clean
+
+
+def sync_outside_loop(tokens):
+    r = jnp.asarray(tokens)
+    r.block_until_ready()  # no loop: a deliberate fence — clean
+    return r
+
+
+def host_array_in_loop(rows):
+    # np.asarray on a HOST-bound name in a loop is not a device sync
+    out = []
+    for row in rows:
+        arr = np.ones(4)
+        out.append(np.asarray(arr))  # clean: host array
+    return out
+
+
+def rebound_name_is_clean(tokens):
+    out = []
+    for t in tokens:
+        r = decode_step(t)
+        r = [1, 2, 3]  # rebound to a host value before the pull
+        out.append(np.asarray(r))  # clean: not a device array anymore
+    return out

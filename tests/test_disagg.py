@@ -386,6 +386,14 @@ def test_scheduler_backpressure_before_prefill(tiny):
     s.decode_pool = [object(), object()]
     s._capacity = 7
     s._est_pages = [6, 7]  # nearly full
+    s._est_tokens = [0, 0]
+    s._signals = [None, None]
+    s._foreign = {}
+    s._share_group = None
+    s._sig_task = None
+    s._last_req_ts = 0.0
+    s.signal_refresh_s = 0.2
+    s._pool_tmpls = {}
     import itertools
 
     s._dw_rr = itertools.count()

@@ -24,7 +24,7 @@ PACKAGE = os.path.join(REPO, "ray_tpu")
 
 ALL_RULES = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
              "RT007", "RT008", "RT009", "RT010", "RT011", "RT012",
-             "RT013", "RT014", "RT015", "RT016"]
+             "RT013", "RT014", "RT015", "RT016", "RT017"]
 
 _EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+)")
 
