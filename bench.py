@@ -96,6 +96,25 @@ def lint_findings() -> int | None:
         return None
 
 
+def lint_flow_findings() -> tuple[int | None, float | None]:
+    """(unsuppressed interprocedural findings over ray_tpu/, wall
+    seconds for the pass) — the `ray_tpu lint --flow` self-check gate
+    (RT020-RT023), surfaced with its cost so call-graph growth that
+    pushes the pass toward the tier-1 ceiling shows up in BENCHVS before
+    it times out CI. (None, None) on a flow-pass crash."""
+    try:
+        from ray_tpu.devtools.lint import flow
+
+        pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "ray_tpu")
+        t0 = time.monotonic()
+        n = len(flow.analyze_paths([pkg]))
+        return n, round(time.monotonic() - t0, 3)
+    except Exception as e:
+        print(f"raylint flow gate failed: {e!r}", file=sys.stderr)
+        return None, None
+
+
 def _stage_latency_results(prefix: str = "") -> dict[str, float]:
     """Per-stage fast-lane percentiles via state.list_task_latency()
     (published on the ~1s flush timer: poll briefly for the freshest
@@ -1750,7 +1769,9 @@ def run_disagg_bench(quick: bool) -> dict:
 def write_benchvs(micro: dict, model: dict | None,
                   llm: dict | None = None,
                   findings: int | None = None,
-                  degraded: bool = False) -> None:
+                  degraded: bool = False,
+                  flow_findings: int | None = None,
+                  flow_s: float | None = None) -> None:
     lines = [
         "# BENCHVS — ours vs reference (BASELINE.md, Ray 2.46.0 release metrics)",
         "",
@@ -1772,6 +1793,15 @@ def write_benchvs(micro: dict, model: dict | None,
             f"`lint_findings={findings}` — raylint static-analysis gate "
             "(`python -m ray_tpu lint ray_tpu/`, see README § Static "
             "analysis); 0 is the tier-1 requirement.",
+            "",
+        ]
+    if flow_findings is not None:
+        lines += [
+            f"`lint_flow_findings={flow_findings}` `lint_flow_s={flow_s}` "
+            "— interprocedural hot-path effect gate (`python -m ray_tpu "
+            "lint --flow ray_tpu/`, RT020-RT023); 0 findings is the "
+            "tier-1 requirement and the pass must stay under its 60s "
+            "self-check ceiling.",
             "",
         ]
     lines += [
@@ -2357,7 +2387,9 @@ def main():
     # static-analysis gate, surfaced alongside the perf numbers: nonzero
     # means tests/test_lint.py::test_self_check is failing too
     findings = lint_findings()
+    flow_findings, flow_s = lint_flow_findings()
     stored_findings = findings
+    stored_flow, stored_flow_s = flow_findings, flow_s
     try:
         with open(out_path) as f:
             prev = json.load(f)
@@ -2366,9 +2398,14 @@ def main():
                 raw[key] = prev.get(key)
         if stored_findings is None:  # lint crash: keep last known gate state
             stored_findings = prev.get("lint_findings")
+        if stored_flow is None:
+            stored_flow = prev.get("lint_flow_findings")
+            stored_flow_s = prev.get("lint_flow_s")
     except (OSError, json.JSONDecodeError):
         pass
     raw["lint_findings"] = stored_findings
+    raw["lint_flow_findings"] = stored_flow
+    raw["lint_flow_s"] = stored_flow_s
     # host-health gate: a degraded box must not rewrite the perf record
     memcpy = (raw["micro"] or {}).get("host_memcpy_gbps")
     degraded = memcpy is not None and memcpy < HOST_MEMCPY_FLOOR_GBPS
@@ -2385,10 +2422,13 @@ def main():
 
     if findings is not None:
         print(f"lint_findings={findings}")
+    if flow_findings is not None:
+        print(f"lint_flow_findings={flow_findings} lint_flow_s={flow_s}")
 
     if raw["micro"]:
         write_benchvs(raw["micro"], raw["model"], raw["llm_engine"],
-                      findings=findings, degraded=degraded)
+                      findings=findings, degraded=degraded,
+                      flow_findings=flow_findings, flow_s=flow_s)
 
     value = micro.get(HEADLINE)
     if value is not None:

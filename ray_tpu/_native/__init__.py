@@ -35,7 +35,9 @@ def _source_files() -> list[str]:
 def _source_hash() -> str:
     h = hashlib.sha256()
     for path in _source_files():
-        with open(path, "rb") as f:
+        # one-time lazy build: get_lib() caches the CDLL, so this
+        # file read never recurs per call
+        with open(path, "rb") as f:  # raylint: disable=RT020 -- one-time build
             h.update(f.read())
     return h.hexdigest()[:16]
 
@@ -46,12 +48,12 @@ def _build() -> str:
     so_path = os.path.join(_BUILD_DIR, f"libray_tpu_native_{tag}.so")
     if os.path.exists(so_path):
         return so_path
-    tmp = so_path + f".tmp{os.getpid()}"
+    tmp = so_path + f".tmp{os.getpid()}"  # raylint: disable=RT021 -- once per rebuild, not per call
     cmd = [
         "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
         "-o", tmp, *_source_files(), "-lpthread", "-lrt",
     ]
-    subprocess.run(cmd, check=True, capture_output=True)
+    subprocess.run(cmd, check=True, capture_output=True)  # raylint: disable=RT020 -- one-time compile behind the get_lib() cache
     os.replace(tmp, so_path)  # atomic: concurrent builders race safely
     return so_path
 

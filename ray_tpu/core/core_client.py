@@ -1449,7 +1449,10 @@ class CoreClient:
         from ray_tpu.core import fastpath
 
         self._fast_ring_seq += 1
-        name = f"rt_fp_{os.getpid()}_{self._fast_ring_seq}"
+        # once per worker LEASE (lane attach), not per record; the pid
+        # must be read live for fork-safe shm naming (a cached pid
+        # would collide post-fork)
+        name = f"rt_fp_{os.getpid()}_{self._fast_ring_seq}"  # raylint: disable=RT021 -- per-lease
         try:
             ring = fastpath.RingPair.create(name, self.cfg.fastpath_ring_bytes)
         except Exception:
@@ -3402,7 +3405,10 @@ class CoreClient:
         orphan markers and the fallback's own spans are the record."""
         from ray_tpu.utils import tracing
 
-        ctx = tracing.submit_context()
+        # the head-sampling gate itself: returns None (no alloc
+        # downstream) for unsampled requests, and a sampled submit
+        # minting its trace leg IS the product
+        ctx = tracing.submit_context()  # raylint: disable=RT023 -- sampling gate
         if ctx is None:
             return b""
         submit_id = tracing._gen_span_id()

@@ -427,7 +427,7 @@ class Worker:
                 fut = asyncio.run_coroutine_threadsafe(
                     self._send_spilled_results(owner, list(recs)),
                     self.core.loop)
-                fut.result(30)
+                fut.result(30)  # raylint: disable=RT020 -- ring-full spill backstop: the pump MUST backpressure here
                 return 0
             except Exception:
                 # ambiguous failure (e.g. timeout with the RPC still in
@@ -995,7 +995,9 @@ class Worker:
                 try:
                     fut = asyncio.run_coroutine_threadsafe(
                         self._load_function(func_id), loop)
-                    fn = fut.result(15)
+                    # function-cache miss: first call per func_id
+                    # only, amortized to zero
+                    fn = fut.result(15)  # raylint: disable=RT020 -- cache miss
                     cache[func_id] = fn  # only successes cache: a
                     # transient load failure must not downgrade the
                     # function to the RPC path for this worker's lifetime
@@ -1261,7 +1263,7 @@ class Worker:
             try:
                 fut = asyncio.run_coroutine_threadsafe(
                     self._load_function(func_id), loop)
-                fn = fut.result(15)
+                fn = fut.result(15)  # raylint: disable=RT020 -- cache miss: once per func_id, amortized
             except Exception:
                 fast_funcs[func_id] = False
                 return False

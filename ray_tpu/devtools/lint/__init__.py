@@ -13,6 +13,16 @@ Rules (see `ray_tpu lint --rules` for rationale):
   RT006 collective call order diverging across branches
   RT007 bare except swallowing errors around get()/wait()
   RT008 time.sleep in a remote task without max_retries
+  ...
+  RT018 wire prefix/flag literal absent from the schema catalog
+
+The interprocedural pass (`ray_tpu lint --flow`, flow.py) adds
+RT020-RT023: it builds a package-wide call graph, infers per-function
+effects (blocking / syscall / host-sync / alloc — effects.py), and
+reports any forbidden effect REACHABLE from a hot-path root (event-loop
+callbacks, fast-lane pumps, tunnel exec paths, serve handlers, jit/scan
+regions) with the full call chain. Pre-existing findings live in
+`.raylint_baseline.json` so the gate stays adoptable.
 
 Suppress a deliberate finding with `# raylint: disable=RT003  -- reason`
 on the offending line, or file-wide with `# raylint: disable-file=RT003`.

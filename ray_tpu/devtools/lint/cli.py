@@ -30,6 +30,16 @@ def add_lint_parser(sub):
                    help="skip these rules")
     p.add_argument("--rules", action="store_true",
                    help="print the rule table and exit")
+    p.add_argument("--flow", action="store_true",
+                   help="also run the interprocedural pass (RT020-RT023: "
+                        "call-graph reachability of blocking/syscall/"
+                        "host-sync/alloc effects from hot-path roots)")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="flow-finding baseline file (default: "
+                        ".raylint_baseline.json in the cwd when present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current flow findings to the baseline "
+                        "file and exit 0")
     p.set_defaults(fn=cmd_lint)
     return p
 
@@ -55,7 +65,31 @@ def cmd_lint(args) -> int:
         findings = engine.lint_paths(args.paths,
                                      select=_split(args.select),
                                      ignore=_split(args.ignore))
-    except (ValueError, OSError) as e:
+        if args.flow or args.write_baseline:
+            from ray_tpu.devtools.lint import flow
+
+            baseline = args.baseline
+            if baseline is None and not args.write_baseline \
+                    and os.path.isfile(flow.BASELINE_NAME):
+                baseline = flow.BASELINE_NAME
+            if args.write_baseline:
+                out = args.baseline or flow.BASELINE_NAME
+                flow.write_baseline(out, flow.analyze_paths(args.paths))
+                print(f"raylint: baseline written to {out}")
+                return 0
+            flow_findings = flow.analyze_paths(args.paths,
+                                               baseline=baseline)
+            sel, ign = _split(args.select), _split(args.ignore)
+            if sel:
+                flow_findings = [f for f in flow_findings
+                                 if f.rule_id in sel]
+            if ign:
+                flow_findings = [f for f in flow_findings
+                                 if f.rule_id not in ign]
+            findings = sorted(
+                findings + flow_findings,
+                key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    except (ValueError, OSError, KeyError) as e:
         print(f"raylint: {e}", file=sys.stderr)
         return 2
     if args.format == "json":

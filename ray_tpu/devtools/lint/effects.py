@@ -1,0 +1,289 @@
+"""raylint effect lattice: per-function intrinsic effect inference.
+
+Each function gets a set of *intrinsic* effects — costs its own body
+pays on every call — which `flow.py` then propagates to fixpoint through
+the package call graph. The lattice is the distilled history of this
+repo's hot-path bugs:
+
+  blocking   sleep, lock-wait, blocking ray_tpu.get, file/socket I/O,
+             subprocess waits, timed future.result() — anything that
+             parks the calling thread (the PR 9 class: a blocking shm
+             read on the event loop's default executor deadlocked the
+             whole process)
+  syscall    a syscall paid once per call — os.urandom / getpid /
+             uuid4 / secrets (the PR 8/11 class: ~288µs of urandom per
+             request in the submit path)
+  host-sync  a host-device synchronization — block_until_ready(),
+             jax.device_get, np.asarray/float()/int()/.item() on a name
+             bound from a jax call (the PR 14/RT017 class: one sync per
+             iteration where the fused-scan budget is one per block)
+  alloc      registry-churning construction — metrics Counter/Gauge/
+             Histogram, fresh trace contexts, serve.batch wrappers,
+             queue objects (the RT011/RT015/RT016 class)
+
+Detection is deliberately shallow per function: one AST walk with the
+same import-table name resolution the rule engine uses, plus RT017's
+forward-flow map of jax-bound names. Depth comes from propagation, not
+from per-site cleverness.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+# ------------------------------------------------------------ the lattice
+BLOCKING = "blocking"
+SYSCALL = "syscall"
+HOST_SYNC = "host-sync"
+ALLOC = "alloc"
+
+ALL_EFFECTS = frozenset({BLOCKING, SYSCALL, HOST_SYNC, ALLOC})
+
+# ------------------------------------------------------- context roots
+# Root kinds and the effects forbidden on anything reachable from them.
+# Rules map 1:1 onto effects (RT020=blocking, RT021=syscall,
+# RT022=host-sync, RT023=alloc); a rule fires for a root only when the
+# root kind forbids that rule's effect.
+ROOT_FORBIDS: dict[str, frozenset] = {
+    # a callback handed to loop.call_soon/_threadsafe/call_later runs ON
+    # the event loop: blocking it stalls every coroutine in the process
+    "event-loop": frozenset({BLOCKING}),
+    # the shm fast-lane pumps: per-record cost IS the product
+    "fast-pump": frozenset({BLOCKING, SYSCALL, ALLOC}),
+    # tunnel record-exec paths: the cross-node fast lane's pump twins
+    "tunnel-exec": frozenset({BLOCKING, SYSCALL, ALLOC}),
+    # serve request handlers: per-request cost at serve QPS
+    "serve-handler": frozenset({BLOCKING, SYSCALL, ALLOC}),
+    # functions traced by jax.jit / lax.scan|while_loop|fori_loop: a
+    # host sync inside the region serializes the fused dispatch
+    "jit-region": frozenset({HOST_SYNC}),
+}
+
+# functions that are roots by NAME (leaf qualname match), colored from
+# the production system's actual hot paths
+NAMED_ROOTS: dict[str, str] = {
+    "_fast_pump": "fast-pump",
+    "fast_actor_submit_loop": "fast-pump",
+    "_tunnel_exec_seq": "tunnel-exec",
+    "_tunnel_exec_batch_sync": "tunnel-exec",
+    "_tunnel_exec_task_batch": "tunnel-exec",
+    "_tunnel_exec_one": "tunnel-exec",
+    "_tunnel_exec_record_on_loop": "tunnel-exec",
+    "rpc_tunnel_frame": "tunnel-exec",
+    "handle_request": "serve-handler",
+    "handle_request_streaming": "serve-handler",
+}
+
+# ------------------------------------------------------------ edge masks
+# Effects that PROPAGATE caller-ward across each call-edge kind. The
+# executor distinctions encode the repo's own fix idioms: shipping work
+# to a PRIVATE pool (PR 9's _store_executor) is the cure for blocking,
+# so nothing propagates back; the loop's DEFAULT executor is shared with
+# the loop's own machinery, so blocking submitted there still starves it.
+EDGE_MASKS: dict[str, frozenset] = {
+    "call": ALL_EFFECTS,
+    "remote": ALL_EFFECTS,        # .remote() dispatch: callee runs per call
+    "task": ALL_EFFECTS,          # create_task/ensure_future: runs on loop
+    "call_soon": ALL_EFFECTS,     # call_soon[_threadsafe]/call_later
+    "default-executor": frozenset({BLOCKING}),  # run_in_executor(None, f)
+    "executor": frozenset(),      # private pool submit: isolation by design
+    "thread": frozenset(),        # Thread(target=...): its own thread
+}
+
+# rule id -> effect it polices
+RULE_EFFECT = {
+    "RT020": BLOCKING,
+    "RT021": SYSCALL,
+    "RT022": HOST_SYNC,
+    "RT023": ALLOC,
+}
+EFFECT_RULE = {v: k for k, v in RULE_EFFECT.items()}
+
+
+# ----------------------------------------------------------- effect sites
+@dataclass(frozen=True)
+class EffectSite:
+    """One intrinsic effect occurrence inside a function body."""
+    effect: str
+    detail: str   # e.g. "os.urandom()" — line-stable, used in baseline keys
+    line: int
+    col: int
+
+
+_SYSCALLS = {
+    ("os", "urandom"), ("os", "getpid"), ("os", "getppid"),
+    ("uuid", "uuid1"), ("uuid", "uuid4"),
+    ("secrets", "token_bytes"), ("secrets", "token_hex"),
+    ("secrets", "token_urlsafe"),
+}
+_SUBPROCESS = {"run", "call", "check_call", "check_output"}
+_BLOCKING_ORIGINS = {
+    ("time", "sleep"),
+    ("os", "fsync"), ("os", "fdatasync"),
+    ("socket", "create_connection"),
+    ("shutil", "copyfile"), ("shutil", "copytree"),
+}
+_HOST_SYNC_NUMPY = {"asarray", "array"}
+_QUEUE_CTORS = {("queue", "Queue"), ("queue", "SimpleQueue"),
+                ("asyncio", "Queue")}
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+
+
+def _is_framework_get(origin) -> bool:
+    """Blocking ray_tpu.get: the public api / client entry points, not an
+    unrelated in-package helper that happens to be named get."""
+    if not origin or origin[0] != "ray_tpu" or origin[-1] != "get":
+        return False
+    return len(origin) == 2 or "api" in origin[:-1] or "client" in origin[:-1]
+
+
+class EffectScanner:
+    """Scans ONE function body (nested defs excluded — they are their own
+    graph nodes; lambdas included — their deferred bodies are attributed
+    to the enclosing function) and yields EffectSites.
+
+    `imports` is any object with a `resolve(node) -> tuple|None` method
+    (engine.ImportTable or flow.ModuleImports); `uses_jax` gates the
+    attribute-shape host-sync legs the import table can't resolve.
+    """
+
+    def __init__(self, imports, uses_jax: bool):
+        self.imports = imports
+        self.uses_jax = uses_jax
+        self.sites: list[EffectSite] = []
+        # RT017's forward-flow idiom: names bound from jax-origin calls
+        self._jax_bound: set[str] = set()
+
+    # -- public -------------------------------------------------------------
+    def scan(self, fn: ast.AST) -> list[EffectSite]:
+        for stmt in fn.body:
+            self._walk(stmt)
+        return self.sites
+
+    # -- walk ---------------------------------------------------------------
+    def _walk(self, node: ast.AST):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate graph nodes
+        if isinstance(node, ast.Assign):
+            self._track_jax_binding(node)
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._walk(child)
+
+    def _track_jax_binding(self, node: ast.Assign):
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Call):
+            origin = self.imports.resolve(node.value.func)
+            if origin and origin[0] == "jax":
+                self._jax_bound.add(name)
+                return
+        self._jax_bound.discard(name)
+
+    # -- detectors ----------------------------------------------------------
+    def _add(self, node: ast.AST, effect: str, detail: str):
+        self.sites.append(EffectSite(effect, detail,
+                                     getattr(node, "lineno", 0),
+                                     getattr(node, "col_offset", 0)))
+
+    def _check_call(self, node: ast.Call):
+        func = node.func
+        origin = self.imports.resolve(func)
+
+        # ---- syscall-per-call
+        if origin and tuple(origin[-2:]) in _SYSCALLS:
+            self._add(node, SYSCALL, f"{'.'.join(origin)}()")
+            return
+
+        # ---- blocking
+        if origin:
+            if tuple(origin[-2:]) in _BLOCKING_ORIGINS:
+                self._add(node, BLOCKING, f"{'.'.join(origin)}()")
+                return
+            if origin[0] == "subprocess" and origin[-1] in _SUBPROCESS:
+                self._add(node, BLOCKING, f"subprocess.{origin[-1]}()")
+                return
+            if _is_framework_get(origin):
+                self._add(node, BLOCKING, "ray_tpu.get()")
+                return
+        if (isinstance(func, ast.Name) and func.id == "open"
+                and self.imports.resolve(func) is None):
+            self._add(node, BLOCKING, "open()")
+            return
+        if isinstance(func, ast.Attribute) and origin is None:
+            # timed future.result(t): the concurrent.futures blocking-wait
+            # idiom (argless .result() on a done asyncio future is the
+            # normal callback shape and stays clean)
+            if func.attr == "result" and node.args:
+                self._add(node, BLOCKING, ".result(timeout)")
+                return
+            # argless lock.acquire() / thread.join(): unbounded waits
+            if func.attr in ("acquire", "join") and not node.args \
+                    and not node.keywords:
+                self._add(node, BLOCKING, f".{func.attr}()")
+                return
+
+        # ---- host-device sync
+        if ((isinstance(func, ast.Attribute)
+             and func.attr == "block_until_ready" and self.uses_jax)
+                or (origin and tuple(origin[-2:]) ==
+                    ("jax", "block_until_ready"))
+                or origin == ("jax", "block_until_ready")):
+            self._add(node, HOST_SYNC, "block_until_ready()")
+            return
+        if origin and origin[0] == "jax" and origin[-1] == "device_get":
+            self._add(node, HOST_SYNC, "jax.device_get()")
+            return
+        if self._jax_bound:
+            numpy_op = (origin[-1] if origin and origin[0] == "numpy"
+                        and origin[-1] in _HOST_SYNC_NUMPY else None)
+            builtin = (func.id if isinstance(func, ast.Name)
+                       and func.id in ("float", "int")
+                       and origin is None else None)
+            if numpy_op or builtin:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in self._jax_bound:
+                        fn = f"np.{numpy_op}" if numpy_op else builtin
+                        self._add(node, HOST_SYNC, f"{fn}({arg.id})")
+                        return
+            if (isinstance(func, ast.Attribute) and func.attr == "item"
+                    and not node.args
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in self._jax_bound):
+                self._add(node, HOST_SYNC, f"{func.value.id}.item()")
+                return
+
+        # ---- alloc-heavy construction
+        if origin and origin[0] == "ray_tpu":
+            if origin[-1] in _METRIC_CTORS and "metrics" in origin[:-1]:
+                self._add(node, ALLOC, f"metrics.{origin[-1]}()")
+                return
+            if "tracing" in origin[:-1]:
+                leaf = origin[-1]
+                if leaf in ("inject", "submit_context"):
+                    self._add(node, ALLOC, f"tracing.{leaf}()")
+                    return
+                if leaf == "span" and self._span_fresh_root(node):
+                    self._add(node, ALLOC, "tracing.span(fresh root)")
+                    return
+            if origin[-1] == "batch" and ("serve" in origin[:-1]
+                                          or "batching" in origin[:-1]):
+                self._add(node, ALLOC, "serve.batch()")
+                return
+        if origin and tuple(origin[-2:]) in _QUEUE_CTORS:
+            self._add(node, ALLOC, f"{'.'.join(origin)}()")
+            return
+
+    @staticmethod
+    def _span_fresh_root(node: ast.Call) -> bool:
+        """tracing.span with a missing/None trace_ctx mints a new root."""
+        tc = node.args[1] if len(node.args) >= 2 else None
+        if tc is None:
+            for kw in node.keywords:
+                if kw.arg == "trace_ctx":
+                    tc = kw.value
+        return tc is None or (isinstance(tc, ast.Constant)
+                              and tc.value is None)

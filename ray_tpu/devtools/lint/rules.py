@@ -1,12 +1,15 @@
-"""raylint rules RT001-RT017: ray_tpu-semantic anti-patterns.
+"""raylint rules RT001-RT018 + flow-rule registrations RT020-RT023.
 
-Each rule is a Rule subclass registered with @register; hooks receive
-(node, ctx) from the engine's single AST walk. See engine.rule_table()
-for the ID/summary/rationale table rendered by `ray_tpu lint --rules`.
+Each AST rule is a Rule subclass registered with @register; hooks
+receive (node, ctx) from the engine's single AST walk. See
+engine.rule_table() for the ID/summary/rationale table rendered by
+`ray_tpu lint --rules`. RT020-RT023 are registered here for the rule
+table but fire from the interprocedural pass (flow.py), not from hooks.
 """
 from __future__ import annotations
 
 import ast
+import os
 
 from ray_tpu.devtools.lint.engine import (
     Context,
@@ -17,7 +20,7 @@ from ray_tpu.devtools.lint.engine import (
 
 # RT004: below this many elements an inline argument is cheap enough that
 # copying it into the task spec beats a store round-trip
-LARGE_ARRAY_ELEMENTS = 16384
+LARGE_ARRAY_ELEMENTS = 16384  # raylint: disable=RT018 -- array-size threshold, not a wire flag (RT018 sees this file's lazy schema import)
 
 
 @register
@@ -640,3 +643,201 @@ class SpanContextRederivedInLoop(Rule):
                        "a trace context starts a NEW trace per "
                        "iteration; capture the parent context once "
                        "outside the loop and pass it explicitly")
+
+
+# ---------------------------------------------------- RT018: schema drift
+# the wire-bearing core modules: a raw record-prefix / status-flag literal
+# in these files (or any file importing the fastpath/tunnel/schema
+# modules) must exist in utils/schema.py's catalogs, or it is the PR
+# 10/11 shipped-but-uncataloged bug class
+_WIRE_FILES = {"fastpath.py", "tunnel.py", "worker.py", "raylet.py",
+               "core_client.py"}
+_WIRE_IMPORTS = {("ray_tpu", "core", "fastpath"),
+                 ("ray_tpu", "core", "tunnel"),
+                 ("ray_tpu", "utils", "schema")}
+# candidate flag literals: power-of-two ints in the reply-flag byte range
+_FLAG_LO, _FLAG_HI = 0x100, 0x8000
+
+_catalog_cache: tuple | None = None
+
+
+def _wire_catalog() -> tuple:
+    """(prefix chars, flag values) from utils/schema.py — imported lazily
+    (pure-data module) so the linter stays importable standalone."""
+    global _catalog_cache
+    if _catalog_cache is None:
+        from ray_tpu.utils import schema
+
+        _catalog_cache = (
+            frozenset(schema.RECORD_PREFIXES),
+            frozenset(f["value"] for f in schema.RECORD_FLAGS.values()),
+        )
+    return _catalog_cache
+
+
+def _is_prefix_literal(node: ast.AST) -> str | None:
+    """The single-uppercase-ASCII bytes literal shape (b"Q") wire record
+    prefixes are written as."""
+    if (isinstance(node, ast.Constant) and isinstance(node.value, bytes)
+            and len(node.value) == 1 and node.value.isalpha()
+            and node.value.isupper()):
+        return node.value.decode("ascii")
+    return None
+
+
+@register
+class WireSchemaLiteralDrift(Rule):
+    id = "RT018"
+    summary = ("wire record prefix / status-flag literal absent from the "
+               "utils/schema.py catalog")
+    rationale = ("every record prefix byte and reply status flag on the "
+                 "wire must be cataloged in schema.RECORD_PREFIXES / "
+                 "RECORD_FLAGS — the catalog is what test_wire_schema.py "
+                 "machine-checks against the native header, so an "
+                 "uncataloged literal ships a wire entry the version "
+                 "gate and the docs never heard of (PRs 10 and 11 each "
+                 "shipped one and paid a debugging cycle); add the "
+                 "catalog row in the same commit as the literal")
+
+    def __init__(self):
+        self._scoped: bool | None = None
+
+    def _in_scope(self, ctx: Context) -> bool:
+        if self._scoped is None:
+            parts = os.path.normpath(ctx.path).split(os.sep)
+            self._scoped = (
+                (len(parts) >= 2 and parts[-2] == "core"
+                 and parts[-1] in _WIRE_FILES)
+                or any(origin[:3] in _WIRE_IMPORTS
+                       for origin in ctx.imports.bindings.values()))
+        return self._scoped
+
+    def _check_prefix(self, node: ast.AST, ctx: Context):
+        ch = _is_prefix_literal(node)
+        if ch is None:
+            return
+        prefixes, _ = _wire_catalog()
+        if ch not in prefixes:
+            ctx.report(self, node,
+                       f'record prefix b"{ch}" is not in '
+                       "schema.RECORD_PREFIXES — catalog the new record "
+                       "type (with its since-version) before it ships")
+
+    # prefix bytes appear in frame construction (b"Q" + header + body)
+    def on_binop(self, node: ast.BinOp, ctx: Context):
+        if not self._in_scope(ctx):
+            return
+        if isinstance(node.op, ast.Add):
+            for side in (node.left, node.right):
+                self._check_prefix(side, ctx)
+            return
+        # flag literals appear in bitwise composition (status | 0x800)
+        if isinstance(node.op, (ast.BitOr, ast.BitAnd)):
+            for side in (node.left, node.right):
+                self._check_flag_literal(side, ctx)
+
+    def _check_flag_literal(self, node: ast.AST, ctx: Context):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, int)
+                and _FLAG_LO <= node.value <= _FLAG_HI
+                and node.value & (node.value - 1) == 0
+                and node.value not in _wire_catalog()[1]):
+            ctx.report(self, node,
+                       f"status flag {node.value:#x} is not in "
+                       "schema.RECORD_FLAGS — catalog the flag "
+                       "(value + since-version) before it ships")
+
+    # ...and in augmented form (status |= 0x800, status &= 0x800)
+    def on_augassign(self, node: ast.AugAssign, ctx: Context):
+        if not self._in_scope(ctx):
+            return
+        if isinstance(node.op, (ast.BitOr, ast.BitAnd)):
+            self._check_flag_literal(node.value, ctx)
+
+    # ...and in dispatch (kind == b"Q", kind in (b"A", b"C"))
+    def on_compare(self, node: ast.Compare, ctx: Context):
+        if not self._in_scope(ctx):
+            return
+        for comp in (node.left, *node.comparators):
+            self._check_prefix(comp, ctx)
+            if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                for elt in comp.elts:
+                    self._check_prefix(elt, ctx)
+
+    # module-level NAMED_FLAG = 0x800 defining an uncataloged flag
+    def on_assign(self, node: ast.Assign, ctx: Context):
+        if not self._in_scope(ctx) or ctx.func_depth:
+            return
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            return
+        name = node.targets[0].id
+        if not (name.isupper() and not name.startswith("_")):
+            return
+        v = node.value
+        if (isinstance(v, ast.Constant) and isinstance(v.value, int)
+                and _FLAG_LO <= v.value <= _FLAG_HI
+                and v.value & (v.value - 1) == 0):
+            _, flags = _wire_catalog()
+            if v.value not in flags:
+                ctx.report(self, node,
+                           f"{name} = {v.value:#x} defines a status flag "
+                           "absent from schema.RECORD_FLAGS — catalog it "
+                           "(value + since-version) in the same commit")
+
+
+# ------------------------------------------- RT020-RT023: flow-pass rules
+# Registered so `--rules` documents them and select/ignore validate, but
+# they carry no on_* hooks: findings come from the interprocedural pass
+# (ray_tpu.devtools.lint.flow, `ray_tpu lint --flow`), which reports the
+# full root -> ... -> effect-site call chain per finding.
+@register
+class BlockingReachableFromHotRoot(Rule):
+    id = "RT020"
+    summary = ("blocking call reachable from an event-loop / hot-path "
+               "root (flow pass)")
+    rationale = ("a sleep, lock-wait, blocking get, file/socket read, or "
+                 "subprocess wait anywhere in the call graph of an event-"
+                 "loop callback or fast-lane pump parks the thread every "
+                 "other callback shares — the PR 9 class, where one "
+                 "blocking shm read on the default executor deadlocked "
+                 "the whole process; RT001/RT010 catch the textually-"
+                 "local case, this rule catches it any number of helper "
+                 "hops away")
+
+
+@register
+class SyscallReachableFromHotRoot(Rule):
+    id = "RT021"
+    summary = ("per-call syscall reachable from a fast-lane / serve root "
+               "(flow pass)")
+    rationale = ("os.urandom / getpid / uuid4 / secrets cost a syscall "
+                 "per invocation: on the submit fast path or a serve "
+                 "handler that is a fixed per-record tax (PR 8/11 "
+                 "measured ~288µs of urandom per request) — hoist the "
+                 "entropy/identity read out of the hot path or cache it "
+                 "per worker")
+
+
+@register
+class HostSyncReachableFromJitRegion(Rule):
+    id = "RT022"
+    summary = ("host-device sync reachable from a jit/scan-traced region "
+               "(flow pass)")
+    rationale = ("block_until_ready / device_get / np.asarray / float() "
+                 "on a jax value reachable from a function traced by "
+                 "jax.jit or lax.scan serializes the fused dispatch into "
+                 "per-step round-trips — RT017's idiom (the PR 14 decode-"
+                 "loop regression) generalized across helper calls")
+
+
+@register
+class AllocReachableFromHotRoot(Rule):
+    id = "RT023"
+    summary = ("registry-churning construction reachable from a hot root "
+               "(flow pass)")
+    rationale = ("metrics Counter/Gauge/Histogram, fresh trace roots, "
+                 "serve.batch wrappers, and queue objects are build-once "
+                 "objects: constructing one anywhere under a fast-lane "
+                 "pump or serve handler churns registries and allocators "
+                 "per record — the RT011/RT015/RT016 class, caught "
+                 "through call hops")

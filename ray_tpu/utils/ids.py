@@ -119,7 +119,9 @@ class TaskID(BaseID):
         if cls._gen_counter is None:
             with cls._gen_lock:
                 if cls._gen_counter is None:
-                    cls._gen_prefix = os.urandom(cls.SIZE - 8)
+                    # ONE urandom per process (double-checked init);
+                    # per-call ids come from the counter below
+                    cls._gen_prefix = os.urandom(cls.SIZE - 8)  # raylint: disable=RT021 -- init-once
                     cls._gen_counter = itertools.count()
         n = next(cls._gen_counter) % (1 << 56)
         return cls(cls._gen_prefix + n.to_bytes(7, "little") + mark)

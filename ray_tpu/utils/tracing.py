@@ -87,7 +87,7 @@ def _gen_trace_id() -> str:
     if _trace_counter is None:
         with _gen_lock:
             if _trace_counter is None:
-                _trace_prefix = os.urandom(9)
+                _trace_prefix = os.urandom(9)  # raylint: disable=RT021 -- one-time prefix init, counter per call
                 _trace_counter = itertools.count()
     n = next(_trace_counter) % (1 << 56)
     return (_trace_prefix + n.to_bytes(7, "little")).hex()
@@ -98,7 +98,7 @@ def _gen_span_id() -> str:
     if _span_counter is None:
         with _gen_lock:
             if _span_counter is None:
-                _span_prefix = os.urandom(4)
+                _span_prefix = os.urandom(4)  # raylint: disable=RT021 -- one-time prefix init, counter per call
                 _span_counter = itertools.count()
     n = next(_span_counter) % (1 << 32)
     return (_span_prefix + n.to_bytes(4, "little")).hex()

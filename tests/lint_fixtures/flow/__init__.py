@@ -1,0 +1,5 @@
+"""Flow-pass fixture package: effects hidden behind call hops.
+
+Analyzed by flow.analyze_paths in tests — NOT an AST-rule fixture, so no
+`# expect:` markers; the tests assert on the chains the pass reports.
+"""

@@ -1,0 +1,17 @@
+"""Intermediate hops: module functions and a method between root and sink."""
+from . import sinks
+
+
+def stamp_record(rec: bytes) -> bytes:
+    rid = sinks.read_entropy()
+    return rid + rec
+
+
+class Emitter:
+    def emit(self, rec: bytes):
+        counted = self.count(rec)
+        return counted
+
+    def count(self, rec: bytes):
+        sinks.make_counter()
+        return rec

@@ -1,0 +1,36 @@
+"""Colored roots with effects hidden behind call hops.
+
+_fast_pump is a named fast-pump root; _on_ring_doorbell becomes an
+event-loop root at its call_soon_threadsafe registration site; work
+shipped to a PRIVATE executor pool is isolation by design and stays
+clean.
+"""
+import time
+
+from . import helpers
+from .helpers import Emitter
+
+
+def _fast_pump(ring):
+    emitter = Emitter()
+    for rec in ring:
+        stamped = helpers.stamp_record(rec)   # 2 hops to os.urandom
+        emitter.emit(stamped)                 # method hops to Counter()
+    return None
+
+
+def _poll_disk():
+    time.sleep(0.5)
+
+
+def _on_ring_doorbell(n):
+    _poll_disk()
+
+
+def arm_doorbell(loop):
+    loop.call_soon_threadsafe(_on_ring_doorbell, 1)
+
+
+def ship_to_private_pool(pool, rec):
+    # blocking work on a PRIVATE pool: the fix idiom, must stay clean
+    return pool.submit(helpers.stamp_record, rec)

@@ -1,0 +1,29 @@
+"""Historical bug 3 (PR 14 / RT017): host-device sync in the fused scan.
+
+A helper called from the lax.scan decode body materialized a device
+value with float(), forcing one host round-trip per step where the
+fused-scan budget is one per block. The flow pass must color the scan
+body as a jit region and follow the helper hops:
+_decode_step -> _track_loss -> _loss_to_host -> float(jax value).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+
+def _loss_to_host(logits):
+    loss = jnp.mean(logits)
+    return float(loss)
+
+
+def _track_loss(logits):
+    return _loss_to_host(logits)
+
+
+def _decode_step(carry, tok):
+    logits = carry + tok
+    _track_loss(logits)
+    return logits, tok
+
+
+def decode(carry, tokens):
+    return lax.scan(_decode_step, carry, tokens)

@@ -1,0 +1,17 @@
+"""Effect leaves: the functions that actually pay the cost."""
+import os
+import time
+
+
+def read_entropy() -> bytes:
+    return os.urandom(16)
+
+
+def nap():
+    time.sleep(0.01)
+
+
+def make_counter():
+    from ray_tpu.utils import metrics
+
+    return metrics.Counter("records_total")

@@ -1,0 +1,44 @@
+"""RT018 fixture: wire prefix/flag literals vs the schema catalog.
+
+In scope because it imports the fastpath module (wire-bearing code)."""
+import struct
+
+from ray_tpu.core import fastpath  # noqa: F401
+
+# module-level flag definitions: cataloged values clean, new ones fire
+STAMPED_ALIAS = 0x100
+NEWFLAG = 0x800  # expect: RT018
+NOT_A_FLAG = 0x300          # not a power of two: clean
+TOO_BIG = 0x10000           # outside the reply-flag byte range: clean
+_INTERNAL = 0x2000          # leading underscore: not a wire name, clean
+
+
+def pack_record(body: bytes, t_ns: int) -> bytes:
+    good = b"Q" + struct.pack("<Q", t_ns) + body
+    bad = b"Z" + struct.pack("<Q", t_ns) + body  # expect: RT018
+    lower = b"x" + body     # not the prefix shape (lowercase): clean
+    return good + bad + lower
+
+
+def dispatch(rec: bytes):
+    kind = rec[:1]
+    if kind == b"Q":
+        return "stamped"
+    if kind == b"X":  # expect: RT018
+        return "mystery"
+    if kind in (b"A", b"C"):
+        return "actor"
+    if kind in (b"A", b"Y"):  # expect: RT018
+        return "drifted"
+    return None
+
+
+def set_flags(status: int) -> int:
+    status |= 0x400          # TRACED: cataloged, clean
+    status |= 0x1000  # expect: RT018
+    masked = status & 0x200  # SEQED: cataloged, clean
+    return masked
+
+
+# a bare literal outside any wire context (no concat/compare/flag op)
+JUST_BYTES = b"Z"

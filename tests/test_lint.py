@@ -12,19 +12,26 @@ import os
 import re
 import subprocess
 import sys
+import time
 
 import pytest
 
-from ray_tpu.devtools.lint import engine, lint_paths, lint_source
+from ray_tpu.devtools.lint import engine, flow, lint_paths, lint_source
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 FIXTURES = os.path.join(HERE, "lint_fixtures")
+FLOW_FIXTURES = os.path.join(FIXTURES, "flow")
 PACKAGE = os.path.join(REPO, "ray_tpu")
 
-ALL_RULES = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+# AST-pass rules: each has a tests/lint_fixtures/rtNNN.py fixture
+AST_RULES = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
              "RT007", "RT008", "RT009", "RT010", "RT011", "RT012",
-             "RT013", "RT014", "RT015", "RT016", "RT017"]
+             "RT013", "RT014", "RT015", "RT016", "RT017", "RT018"]
+# flow-pass rules: registered for the table, fired by flow.analyze_paths
+# (covered by the lint_fixtures/flow/ package below, not rtNNN.py files)
+FLOW_RULES = ["RT020", "RT021", "RT022", "RT023"]
+ALL_RULES = AST_RULES + FLOW_RULES
 
 _EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+)")
 
@@ -41,7 +48,7 @@ def _expected_markers(path: str) -> set:
 
 
 # ------------------------------------------------------------ rule fixtures
-@pytest.mark.parametrize("rule_id", ALL_RULES)
+@pytest.mark.parametrize("rule_id", AST_RULES)
 def test_rule_fixture(rule_id):
     """Each rule fires on exactly its fixture's marked lines: positives
     found, negatives silent, suppressed lines dropped."""
@@ -229,10 +236,179 @@ def test_cli_nonexistent_path_exits_two():
     assert "no such file" in proc.stderr
 
 
+# --------------------------------------------------------------- flow pass
+def _flow_findings():
+    return flow.analyze_paths([FLOW_FIXTURES])
+
+
+def _by_rule(findings, rule_id):
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+def test_flow_effect_two_hops_deep():
+    """os.urandom two module-function hops below a named fast-pump root
+    is found, with every hop named in the chain."""
+    fs = [f for f in _by_rule(_flow_findings(), "RT021")
+          if "flow.hot:_fast_pump" in f.chain[0]]
+    assert len(fs) == 1
+    chain = fs[0].chain
+    assert len(chain) == 4  # root, 2 hops, sink
+    assert "stamp_record" in chain[1]
+    assert "read_entropy" in chain[2]
+    assert "os.urandom()" in chain[3]
+
+
+def test_flow_effect_through_method_call():
+    """Alloc behind Emitter().emit -> self.count -> make_counter: class
+    instantiation tracking plus self-method resolution."""
+    fs = _by_rule(_flow_findings(), "RT023")
+    assert len(fs) == 1
+    chain = fs[0].chain
+    assert "Emitter.emit" in chain[1]
+    assert "Emitter.count" in chain[2]
+    assert "metrics.Counter()" in chain[-1]
+
+
+def test_flow_effect_through_call_soon_threadsafe():
+    """A callback registered via loop.call_soon_threadsafe becomes an
+    event-loop root; blocking one helper hop below it fires RT020."""
+    fs = [f for f in _by_rule(_flow_findings(), "RT020")
+          if "_on_ring_doorbell" in f.chain[0]]
+    assert len(fs) == 1
+    assert "event-loop root" in fs[0].chain[0]
+    assert "time.sleep()" in fs[0].chain[-1]
+
+
+def test_flow_private_executor_submit_is_clean():
+    """pool.submit(...) to a private pool is the fix idiom: nothing it
+    runs propagates back (no finding rooted at ship_to_private_pool)."""
+    assert not any("ship_to_private_pool" in f.chain[0]
+                   for f in _flow_findings())
+
+
+# the three historical bugs, reintroduced as fixtures: the analyzer must
+# name the full chain with >= 2 call hops (acceptance criterion)
+def test_flow_regression_urandom_in_submit():
+    fs = [f for f in _by_rule(_flow_findings(), "RT021")
+          if "regress_urandom" in f.path]
+    assert len(fs) == 1
+    chain = fs[0].chain
+    assert len(chain) - 2 >= 2  # call hops between root and sink
+    assert "fast_actor_submit_loop" in chain[0]
+    assert "_pack_submit" in chain[1]
+    assert "_fresh_task_id" in chain[2]
+    assert "os.urandom()" in chain[3]
+
+
+def test_flow_regression_blocking_get_on_default_executor():
+    fs = [f for f in _by_rule(_flow_findings(), "RT020")
+          if "regress_executor_get" in f.path]
+    assert len(fs) == 1
+    chain = fs[0].chain
+    assert len(chain) - 2 >= 2
+    assert "_apply_update" in chain[0] and "event-loop root" in chain[0]
+    assert "_fetch_state" in chain[1] and "default-executor" in chain[1]
+    assert "_pull_value" in chain[2]
+    assert "ray_tpu.get()" in chain[3]
+
+
+def test_flow_regression_host_sync_in_scan():
+    fs = [f for f in _by_rule(_flow_findings(), "RT022")
+          if "regress_hostsync" in f.path]
+    assert len(fs) == 1
+    chain = fs[0].chain
+    assert len(chain) - 2 >= 2
+    assert "_decode_step" in chain[0] and "jit-region root" in chain[0]
+    assert "_track_loss" in chain[1]
+    assert "_loss_to_host" in chain[2]
+    assert "float(loss)" in chain[3]
+
+
+def test_flow_findings_deterministic():
+    first = _flow_findings()
+    second = _flow_findings()
+    assert [f.as_dict() for f in first] == [f.as_dict() for f in second]
+
+
+def test_flow_json_carries_chain_with_stable_key_order():
+    rows = json.loads(engine.to_json(_flow_findings()))
+    assert rows
+    for row in rows:
+        assert list(row) == ["rule", "path", "line", "col", "message",
+                             "chain"]
+        assert isinstance(row["chain"], list) and len(row["chain"]) >= 2
+
+
+def test_flow_baseline_round_trip(tmp_path):
+    """write_baseline captures every finding; a re-run against the file
+    reports zero; removing an entry resurfaces exactly that finding."""
+    fs = _flow_findings()
+    assert fs
+    base = tmp_path / "baseline.json"
+    flow.write_baseline(str(base), fs)
+    assert flow.analyze_paths([FLOW_FIXTURES], baseline=str(base)) == []
+    data = json.loads(base.read_text())
+    dropped = data["entries"].pop()
+    base.write_text(json.dumps(data))
+    kept = flow.analyze_paths([FLOW_FIXTURES], baseline=str(base))
+    assert [f.key for f in kept] == [dropped["key"]]
+
+
+def test_flow_missing_baseline_path_errors(tmp_path):
+    """A typo'd --baseline must error, not silently un-suppress nothing
+    (the green-gate failure mode)."""
+    with pytest.raises(OSError):
+        flow.analyze_paths([FLOW_FIXTURES],
+                           baseline=str(tmp_path / "nope.json"))
+
+
+def test_flow_sink_line_suppression(tmp_path):
+    """# raylint: disable=RT021 on the effect-site line drops every chain
+    landing on that sink."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(
+        "import os\n"
+        "def _gen():\n"
+        "    return os.urandom(8)  # raylint: disable=RT021 -- amortized\n"
+        "def _fast_pump(ring):\n"
+        "    return [_gen() for _ in ring]\n")
+    assert flow.analyze_paths([str(pkg)]) == []
+
+
+def test_flow_cli(tmp_path):
+    proc = _run_cli(FLOW_FIXTURES, "--flow", "--format", "json")
+    assert proc.returncode == 1, proc.stderr
+    rows = json.loads(proc.stdout)
+    flow_rows = [r for r in rows if r["rule"] in FLOW_RULES]
+    assert flow_rows
+    for row in flow_rows:
+        assert list(row) == ["rule", "path", "line", "col", "message",
+                             "chain"]
+    # --write-baseline then --flow --baseline: gate goes green
+    base = tmp_path / "b.json"
+    wb = _run_cli(FLOW_FIXTURES, "--write-baseline",
+                  "--baseline", str(base))
+    assert wb.returncode == 0, wb.stderr
+    clean = _run_cli(FLOW_FIXTURES, "--flow", "--baseline", str(base),
+                     "--select", ",".join(FLOW_RULES), "--format", "json")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert json.loads(clean.stdout) == []
+
+
 # -------------------------------------------------------------- self-check
 def test_self_check():
     """ray_tpu/ lints clean: every violation fixed or explicitly
     suppressed. This is the permanent CI gate — a new anti-pattern
-    anywhere in the package fails this test."""
+    anywhere in the package fails this test. The flow pass runs with a
+    0-unsuppressed-findings budget and a wall-clock ceiling so the
+    interprocedural gate stays cheap enough for tier-1."""
     findings = lint_paths([PACKAGE])
     assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+    t0 = time.monotonic()
+    flow_findings = flow.analyze_paths([PACKAGE])
+    elapsed = time.monotonic() - t0
+    assert flow_findings == [], \
+        "\n" + "\n".join(f.render() for f in flow_findings)
+    assert elapsed < 60, f"flow self-check took {elapsed:.1f}s (ceiling 60s)"
