@@ -1514,7 +1514,8 @@ print("RES=" + json.dumps(asyncio.run(go())))
 """
 
 
-def _run_llm_child(child_src: str, label: str, quick: bool) -> dict:
+def _run_llm_child(child_src: str, label: str, quick: bool,
+                   extra_args: tuple = ()) -> dict:
     """Shared runner for the LLM bench children (disagg/spec/serve-llm):
     one CPU-pinned subprocess, a RES= json line out, failures logged
     and swallowed so one arm can't sink the others."""
@@ -1522,7 +1523,8 @@ def _run_llm_child(child_src: str, label: str, quick: bool) -> dict:
 
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", child_src, "1" if quick else "0"],
+            [sys.executable, "-c", child_src, "1" if quick else "0",
+             *extra_args],
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
             capture_output=True, text=True, timeout=1800)
     except subprocess.TimeoutExpired:
@@ -1766,6 +1768,169 @@ def run_disagg_bench(quick: bool) -> dict:
     return _run_llm_child(_DISAGG_BENCH_CHILD, "disagg", quick)
 
 
+_TIERING_BENCH_CHILD = r"""
+import asyncio, json, sys, time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.config import get_config
+from ray_tpu.llm.disagg.scheduler import DisaggLLMServer
+from ray_tpu.models.llama import LlamaConfig
+
+quick = sys.argv[1] == "1"
+# "ab" = the 5x-under A/B (spill vs drop) + restore-bandwidth leg;
+# "2"/"10" = a single spill arm at that under-provision factor. Sweep
+# factors run as separate child invocations: actor-pool churn past two
+# servers in one driver starves leases (pre-existing, see ROADMAP).
+MODE = sys.argv[2] if len(sys.argv) > 2 else "ab"
+# The r9 disagg model/page shape, but the workload is G distinct
+# shared-prefix tenants whose combined radix-tree working set is held
+# 2x/5x/10x ABOVE the prefix-cache arena budget. Every round replays
+# every tenant: the spill arm keeps evicted prefixes on tier-1 and
+# restores them through the batched pull path; the drop arm (tiering
+# off) re-prefills each evicted tenant from scratch.
+cfg = LlamaConfig(vocab_size=512, d_model=256, n_layers=4, n_heads=8,
+                  n_kv_heads=4, d_ff=512, max_seq_len=512, dtype="float32")
+PS, n_pages, max_seq, max_batch = 16, 256, 512, 8
+PREFIX_PAGES = 24  # 384-token shared system prompt per tenant
+G = 4 if quick else 8
+rng = np.random.default_rng(18)
+tenants = [list(map(int, rng.integers(1, cfg.vocab_size, PREFIX_PAGES * PS)))
+           for _ in range(G)]
+# fixed tails: every round replays the identical request set so prefix
+# pages can hit across rounds
+tails = {(i, j): list(map(int, rng.integers(1, cfg.vocab_size, PS // 2)))
+         for i in range(G) for j in range(2)}
+# analytic working set: fp32 KV bytes/token = 2 sides x layers x
+# kv_heads x head_dim x 4B (matches ship_pages' manifest nbytes)
+tok_bytes = 2 * cfg.n_layers * cfg.n_kv_heads * (cfg.d_model // cfg.n_heads) * 4
+WS = G * PREFIX_PAGES * PS * tok_bytes
+
+ray_tpu.init(num_cpus=8)
+
+
+async def run_arm(spill, factor):
+    get_config().prefix_cache_spill = spill
+    get_config().spill_cold_after_s = 0.0
+    s = DisaggLLMServer(cfg, n_prefill=2, n_decode=2, max_batch=max_batch,
+                        page_size=PS, n_pages=n_pages, max_seq_len=max_seq,
+                        prefix_cache_bytes=max(1, WS // factor),
+                        max_wave=8, wave_wait_s=0.004)
+
+    async def round_():
+        t0 = time.perf_counter()
+        outs = await asyncio.gather(
+            *(s({"prompt_tokens": tenants[i] + tails[(i, j)],
+                 "max_tokens": 8})
+              for i in range(G) for j in range(2)),
+            return_exceptions=True)
+        errs = [o for o in outs if isinstance(o, Exception)]
+        for e in errs[:3]:
+            print("ERR", type(e).__name__, e, file=sys.stderr, flush=True)
+        toks = sum(len(o["completion_tokens"]) for o in outs
+                   if not isinstance(o, Exception))
+        return toks / (time.perf_counter() - t0), len(errs)
+
+    errors = 0
+    for _ in range(2):  # warm: jit compiles + first-touch inserts
+        _, e = await round_()
+        errors += e
+    best = 0.0
+    for _ in range(2 if quick else 3):  # the adoption-burst rounds
+        tps, e = await round_()
+        errors += e
+        best = max(best, tps)
+    st = await s.stats()
+    await s.shutdown()
+    pc = st["prefix_cache"]
+    return {"tok_s": best, "errors": errors,
+            "hit_rate": pc["hit_rate"],
+            "tier1_hits": pc.get("tier1_hits", 0),
+            "tier1_hit_share": (pc.get("tier1_hits", 0) /
+                                max(1, pc.get("hits", 0) or 1)),
+            "spills": pc.get("spills", 0),
+            "pages_restored": st["kv_plane"].get("pages_restored", 0)}
+
+
+def restore_gbps_leg():
+    # tier-1 restore bandwidth, measured straight: ship r9-sized KV
+    # pages, push them all to disk, time one batched adopt back
+    from ray_tpu.core import api
+    from ray_tpu.llm import engine as _engine
+    from ray_tpu.llm.disagg.kv_plane import adopt_pages, ship_pages
+
+    kpool, vpool = _engine.make_kv_pools(cfg, PS, 64, None)
+    m = ship_pages(kpool, vpool, list(range(48)),
+                   list(range(1, 48 * PS + 1)), page_size=PS)
+    core = api.get_core()
+    oids = [ref.id for p in m.pages for ref in p.refs.values()]
+    res = core.spill_objects(oids)
+    if not res or not all(v["ok"] for v in res.values()):
+        return 0.0
+    nbytes = sum(p.nbytes for p in m.pages)
+    t0 = time.perf_counter()
+    adopt_pages(m)
+    return nbytes / (time.perf_counter() - t0) / 1e9
+
+
+async def go():
+    out = {}
+    if MODE == "ab":
+        spill5 = await run_arm(True, 5)
+        drop5 = await run_arm(False, 5)
+        out.update({
+            "tier_hit_rate": spill5["hit_rate"],
+            "tier1_hit_share": spill5["tier1_hit_share"],
+            "tok_s_under_pressure": spill5["tok_s"],
+            "tok_s_under_pressure_nospill": drop5["tok_s"],
+            "tiering_hit_rate_nospill": drop5["hit_rate"],
+            "tiering_spills": spill5["spills"],
+            "tiering_pages_restored": spill5["pages_restored"],
+            "tiering_oom_errors": spill5["errors"] + drop5["errors"],
+        })
+    else:
+        f = int(MODE)
+        arm = await run_arm(True, f)
+        out[f"tier_hit_rate_{f}x"] = arm["hit_rate"]
+        out[f"tok_s_spill_{f}x"] = arm["tok_s"]
+        out["tiering_oom_errors"] = arm["errors"]
+    return out
+
+
+out = asyncio.run(go())
+if MODE == "ab":
+    out["restore_gbps"] = restore_gbps_leg()
+    import jax
+
+    out["tiering_platform"] = jax.devices()[0].platform
+    out["tiering_ws_bytes"] = WS
+ray_tpu.shutdown()
+print("RES=" + json.dumps(out))
+"""
+
+
+def run_tiering_bench(quick: bool) -> dict:
+    """Memory-tiering A/B (ROADMAP item 3): the r9 disagg workload with
+    the prefix-cache arena held 2x/5x/10x under the tenant working set,
+    tiering on (cold prefixes spill to disk, hits restore through the
+    batched pull path) vs off (capacity evictions re-prefill). Also
+    times raw tier-1 restore bandwidth and counts OOM/arena-full errors
+    under the concurrent adoption-burst rounds (acceptance: 0). Sweep
+    factors run as separate subprocesses (fresh cluster per arm)."""
+    out = _run_llm_child(_TIERING_BENCH_CHILD, "tiering", quick)
+    if out and not quick:
+        for f in ("2", "10"):
+            arm = _run_llm_child(_TIERING_BENCH_CHILD, f"tiering-{f}x",
+                                 quick, extra_args=(f,))
+            if arm:
+                errs = arm.pop("tiering_oom_errors", 0)
+                out["tiering_oom_errors"] = (
+                    out.get("tiering_oom_errors", 0) + errs)
+                out.update(arm)
+    return out
+
+
 def write_benchvs(micro: dict, model: dict | None,
                   llm: dict | None = None,
                   findings: int | None = None,
@@ -1809,6 +1974,8 @@ def write_benchvs(micro: dict, model: dict | None,
         "|---|---:|---:|---:|",
     ]
     for name, value in micro.items():
+        if name.startswith("tracing_"):
+            continue  # rendered as the dedicated r13 A/B section below
         base = BASELINE.get(name)
         if name == "host_memcpy_gbps":
             unit = "GB/s (host-load marker: physical ceiling ~20)"
@@ -1948,6 +2115,49 @@ def write_benchvs(micro: dict, model: dict | None,
         "python raylets on a shared box; the per-oid directory lookups "
         "it replaced were the latency term, not the byte pump).",
         "",
+    ]
+    if "tracing_overhead_us" in micro:
+        lines += [
+            "## Tracing overhead A/B (r13, fast-lane record paths)",
+            "",
+            "Wire-level trace context (protocol 2.1, README § Distributed "
+            "tracing) priced as an interleaved three-arm A/B over the exact "
+            "record paths the trace leg touches: subprocess clusters running "
+            "closed-loop sync round trips on the task fast lane and the "
+            "actor ring lane, arms alternating order per round, best-of per "
+            "arm — **off** (`RT_TRACING_ENABLED=0`), **on-but-unsampled** "
+            "(tracing on, `trace_sample_rate=0`: every record pays the "
+            "one-branch wire path and ships zero trace bytes), and "
+            "**sampled at 1%** (the Dapper production default: 1-in-100 "
+            "requests carry the 25-byte leg, a submit point span, a worker "
+            "exec span and the reply-apply `::call` span).",
+            "",
+            "| arm | task lane (µs/call) | actor lane (µs/call) |",
+            "|---|---:|---:|",
+            f"| tracing off | {micro.get('tracing_task_off_us', 0):,.1f} "
+            f"| {micro.get('tracing_actor_off_us', 0):,.1f} |",
+            f"| on, unsampled | {micro.get('tracing_task_unsampled_us', 0):,.1f} "
+            f"| {micro.get('tracing_actor_unsampled_us', 0):,.1f} |",
+            f"| sampled 1% | {micro.get('tracing_task_sampled1_us', 0):,.1f} "
+            f"| {micro.get('tracing_actor_sampled1_us', 0):,.1f} |",
+            "",
+            "`tracing_overhead_us` (unsampled − off, task lane) measured "
+            "**+12.6µs on one run and −4.9µs on the repeat** — the sign "
+            "flips run to run and the sampled arm landed *under* the "
+            "unsampled one (307.7 vs 311.9), so both deltas sit inside this "
+            "shared 2-vCPU box's ±13µs between-run noise on a ~300µs "
+            "closed-loop round trip, exactly the r12 `tunnel_calls_per_s`"
+            "/task-lane noise band. That is the acceptance claim: the "
+            "unsampled record path is byte-identical to wire 2.0 (the trace "
+            "flag is a free bit in the existing stamp field) and costs one "
+            "cached-attribute branch per submit — the chaos-gate cost "
+            "model. The priced sampled-path work (span dicts through the "
+            "existing 1Hz task-event flush, 25 wire bytes per record) is "
+            "head-gated by `trace_sample_rate`, so production pays it on 1% "
+            "of requests.",
+            "",
+        ]
+    lines += [
         "## Placement-group 2PC A/B (r10, same-host interleaved)",
         "",
         "Pre/post the PG lifecycle rework (BundleTxn parallel "
@@ -2238,6 +2448,44 @@ def write_benchvs(micro: dict, model: dict | None,
             "workers without transiting the driver.",
             "",
             ] if "llm_disagg_tokens_per_s" in llm else []) + ([
+            "### Memory tiering A/B (r16: prefix-cache arena 5x under "
+            "the tenant working set; spill-to-tier-1 on vs capacity-drop, "
+            f"platform={llm.get('tiering_platform', '?')})",
+            "",
+            "| metric | drop (tiering off) | spill (tiering on) |",
+            "|---|---:|---:|",
+            f"| tokens/s under pressure | "
+            f"{llm['tok_s_under_pressure_nospill']:,.0f} | "
+            f"**{llm['tok_s_under_pressure']:,.0f} "
+            f"({llm['tok_s_under_pressure'] / max(1e-9, llm['tok_s_under_pressure_nospill']):.2f}x)** |",
+            f"| prefix-cache hit rate | "
+            f"{llm.get('tiering_hit_rate_nospill', 0):.2f} | "
+            f"**{llm['tier_hit_rate']:.2f}** |",
+            "",
+            "Workload: 8 tenants x 384-token shared prefixes "
+            f"(working set {llm.get('tiering_ws_bytes', 0):,} KV bytes) "
+            "replayed every round against a cache arena one fifth that "
+            "size. With tiering off every capacity eviction is a "
+            "dropped subtree the next round re-prefills; with tiering "
+            "on the radix cache spills unpinned leaves to the raylet's "
+            "tier-1 and a later hit costs one sequential disk restore "
+            "through the batched pull path "
+            f"(`restore_gbps={llm.get('restore_gbps', 0):.2f}` GB/s "
+            "measured on a 48-page adopt of fully-spilled KV; "
+            f"{llm.get('tiering_spills', 0)} spills / "
+            f"{llm.get('tiering_pages_restored', 0)} pages restored "
+            f"this run, tier-1 hit share "
+            f"{llm.get('tier1_hit_share', 0):.2f}). "
+            f"`tiering_oom_errors={llm.get('tiering_oom_errors', 0)}` "
+            "across every concurrent adoption-burst round (acceptance: "
+            "0 — the pull-admission window queues restores against "
+            "arena headroom instead of letting them race it to an "
+            "arena-full). Sweep: hit rate "
+            f"{llm.get('tier_hit_rate_2x', 0):.2f} at 2x / "
+            f"{llm['tier_hit_rate']:.2f} at 5x / "
+            f"{llm.get('tier_hit_rate_10x', 0):.2f} at 10x under.",
+            "",
+            ] if "tier_hit_rate" in llm else []) + ([
             "### Speculative decoding A/B (same engine, spec off vs on; "
             "fused n-gram draft + multi-position verify)",
             "",
@@ -2366,6 +2614,12 @@ def main():
                 llm = {**(llm or {}), **disagg}
         except Exception as e:
             print(f"disagg bench failed: {e!r}", file=sys.stderr)
+        try:
+            tier = run_tiering_bench(args.quick)
+            if tier:
+                llm = {**(llm or {}), **tier}
+        except Exception as e:
+            print(f"tiering bench failed: {e!r}", file=sys.stderr)
         try:
             spec = run_spec_bench(args.quick)
             if spec:

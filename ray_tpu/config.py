@@ -48,6 +48,24 @@ class Config:
     #: directory for spilled objects ("" = <temp_dir>/<session>/spill)
     object_spilling_dir: str = ""
 
+    # --- memory tiering (spill/restore as a storage tier; ref:
+    # pull_manager.h:49 admission window, local_object_manager.h:42) ---
+    #: byte budget for concurrent restores/pulls in flight per raylet
+    #: (PullManager-shaped admission window); excess queues FIFO
+    pull_max_bytes_in_flight: int = 64 * 1024 * 1024
+    #: seconds a queued pull/restore waits for admission before it is
+    #: shed with a typed back-pressure error
+    pull_admission_timeout_s: float = 30.0
+    #: cooperative spill only claims arena-owner candidates untouched for
+    #: at least this long (keeps mid-adoption pages hot)
+    spill_cold_after_s: float = 0.25
+    #: prefix cache spills unpinned pages to tier-1 instead of dropping
+    #: them (the radix tree keeps the node; refs swap to disk)
+    prefix_cache_spill: bool = True
+    #: disk budget for tier-1 prefix-cache pages; beyond it the cache
+    #: falls back to dropping LRU tier-1 leaves (the old eviction)
+    prefix_cache_tier1_bytes: int = 1024 * 1024 * 1024
+
     # --- scheduler / raylet ---
     #: max workers a single raylet will fork
     max_workers_per_node: int = 64

@@ -465,6 +465,11 @@ class CoreClient:
             self._bg.spawn(self._fast_health_loop(), self.loop)
         self.add_latency_source("actor", self._actor_latency_snapshot,
                                 self._actor_latency_confirm)
+        # arena owners registered before the runtime came up (tiering's
+        # cooperative-spill providers) get their raylet hookup now
+        from ray_tpu.core import tiering
+
+        tiering.attach_core(self)
 
     # -------------------------------------------------------------- pubsub
     def _on_push(self, msg):
@@ -769,6 +774,76 @@ class CoreClient:
                 self._bg.spawn(self._maybe_free_object(oid), self.loop)
         return True
 
+    # ----------------------------------------- cooperative tiering routes
+    async def rpc_arena_spill_candidates(self, conn, p):
+        """The raylet asks this process's registered arena owners (prefix
+        cache, shard plane, staging trackers — core/tiering.py) for cold
+        REFERENCED objects it may trade to tier-1."""
+        from ray_tpu.core import tiering
+
+        return tiering.collect_candidates(
+            int(p.get("need", 0)),
+            float(p.get("cold_after_s", self.cfg.spill_cold_after_s)))
+
+    async def rpc_arena_spilled(self, conn, p):
+        """The raylet reports candidates it actually spilled; owners stamp
+        their manifest entries' (tier, path) legs."""
+        from ray_tpu.core import tiering
+
+        tiering.notify_spilled(p.get("spilled") or [])
+        return True
+
+    def register_spill_provider(self) -> None:
+        """Tell the local raylet this process serves arena-owner spill
+        candidates at our RPC address (idempotent raylet-side)."""
+        if self.raylet is None or self.address is None:
+            return
+        coro = self.raylet.call("register_spill_provider",
+                                {"address": list(self.address)})
+        if _in_loop(self.loop):
+            self._bg.spawn(coro, self.loop)
+        else:
+            self._run_sync(coro, timeout=10)
+
+    def spill_objects(self, oids, timeout: float = 60.0) -> dict:
+        """Explicitly spill specific sealed objects through the local
+        raylet (the prefix cache's spill-not-drop eviction). Landed
+        spills are fanned out to the tiering sinks (manifest tier-leg
+        stamping) in BOTH modes; the returned {oid hex: {"ok", "path"}}
+        map is empty when called on the event loop (the spill is spawned
+        there, result delivered via the sinks) or on failure."""
+        if self.raylet is None:
+            return {}
+        raw_ids = [o.binary() if hasattr(o, "binary") else o for o in oids]
+        payload = {"object_ids": raw_ids}
+        by_hex = {b.hex(): b for b in raw_ids}
+
+        def deliver(res: dict):
+            from ray_tpu.core import tiering
+
+            tiering.notify_spilled(
+                [{"object_id": by_hex[h], "path": v.get("path", "")}
+                 for h, v in (res or {}).items()
+                 if h in by_hex and v.get("ok")])
+
+        async def _spill_and_deliver():
+            try:
+                res = await self.raylet.call("spill_objects", payload)
+            except Exception:
+                log.debug("spill_objects request failed", exc_info=True)
+                return {}
+            deliver(res)
+            return res or {}
+
+        try:
+            if _in_loop(self.loop):
+                self._bg.spawn(_spill_and_deliver(), self.loop)
+                return {}
+            return self._run_sync(_spill_and_deliver(), timeout=timeout)
+        except Exception:
+            log.debug("spill_objects request failed", exc_info=True)
+            return {}
+
     def _new_owned_ref(self, oid: ObjectID) -> ObjectRef:
         self.on_owned_ref_created(oid)
         return ObjectRef(oid, self.address, _core=self)
@@ -937,28 +1012,40 @@ class CoreClient:
                 self._obj_locations.pop(oid, None)
         return ok
 
-    async def pull_objects_batch(self, hints: dict) -> dict:
+    async def pull_objects_batch(self, hints: dict, sizes: dict | None = None,
+                                 timeout_s: float | None = None) -> dict:
         """Batched multi-object pull through the local raylet (protocol
         2.0 ``pull_objects``): ONE round trip fetches a whole
         arg/KV-manifest set into the local store, with per-object holder
         hints (location cache + caller knowledge) and exactly one GCS
         ``kv_multi_get`` raylet-side for the unhinted miss-set.
         ``hints``: {ObjectID: holder-node-id set (may be empty)}.
-        Returns {oid hex: bool}; failures fall back to the per-object
-        pull paths of the callers. Best effort — never raises."""
+        ``sizes`` (optional {ObjectID: nbytes}) feeds the raylet's
+        byte-budget pull admission; ``timeout_s`` (optional) is the
+        admission deadline — items shed at it come back under the
+        ``"_bp"`` key ({oid hex: retry_after_s}) and tier-1 restores
+        under ``"_restored"``, both left in the returned map for callers
+        that care. Returns {oid hex: bool} plus those side-channel keys;
+        failures fall back to the per-object pull paths of the callers.
+        Best effort — never raises."""
         items = []
         for oid, hint in hints.items():
             if self.store is not None and self.store.contains(oid):
                 continue
             merged = set(b for b in (hint or ()) if b)
             merged |= self._obj_locations.get(oid, set())
-            items.append({"object_id": oid.binary(),
-                          "holders_hint": sorted(merged) or None})
+            item = {"object_id": oid.binary(),
+                    "holders_hint": sorted(merged) or None}
+            if sizes and sizes.get(oid):
+                item["nbytes"] = int(sizes[oid])
+            items.append(item)
         if not items or self.raylet is None:
             return {}
+        payload: dict = {"objects": items}
+        if timeout_s is not None:
+            payload["timeout_s"] = float(timeout_s)
         try:
-            res = await self.raylet.call("pull_objects",
-                                         {"objects": items})
+            res = await self.raylet.call("pull_objects", payload)
         except Exception:
             log.debug("batched pull failed", exc_info=True)
             return {}

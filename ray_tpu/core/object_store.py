@@ -108,6 +108,9 @@ class SharedObjectStore:
                 f"could not {'create' if create else 'connect to'} store {name}"
             )
         self._created = create
+        # python-side counters the native header has no slot for (the
+        # spill writer lives in this process, so per-process is exact)
+        self.spill_failures = 0
         path = "/dev/shm/" + name.lstrip("/")
         self._file = open(path, "r+b")
         self._mmap = mmap.mmap(self._file.fileno(), 0)
@@ -188,7 +191,13 @@ class SharedObjectStore:
         d = {name: int(out[i]) for i, name in enumerate(self.STAT_FIELDS[:n])}
         d["bytes_in_use"] = int(self.bytes_in_use)
         d["capacity"] = int(self.capacity)
+        d["spill_failures"] = int(self.spill_failures)
         return d
+
+    def note_spill_failure(self) -> None:
+        """Record one failed spill attempt (write error / chaos fault);
+        surfaced through stats() so the backoff satellite is observable."""
+        self.spill_failures += 1
 
     def list_spillable(self, max_count: int = 64) -> list[tuple[ObjectID, int]]:
         """Sealed, unreferenced objects in LRU order (spill candidates for

@@ -18,6 +18,7 @@ reference uses (ref: python/ray/cluster_utils.py:135).
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import logging
 import os
@@ -59,6 +60,119 @@ class Lease:
     pg_key: tuple | None = None  # (pg_id, bundle_index) if inside a bundle
     owner_conn: object = None  # requester's connection: leases die with it
     tpu_chips: list | None = None  # chip ids granted to this lease
+
+
+class PullBackPressure(Exception):
+    """A queued pull/restore was shed at its admission deadline. Typed so
+    the client plane can surface a serve-level BackPressureError with a
+    retry hint instead of an opaque pull failure."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.1):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class PullAdmission:
+    """PullManager-shaped admission window (ref: pull_manager.h:49):
+    bounds the BYTES of concurrent restores/pulls in flight — not the
+    request count — against a fixed budget and live arena headroom.
+    Excess requests park FIFO; a parked request past its deadline is shed
+    with :class:`PullBackPressure`, so a steal/adopt burst back-pressures
+    instead of OOMing the receiving arena mid-decode."""
+
+    def __init__(self, raylet):
+        self.raylet = raylet
+        self.max_bytes = max(1, int(raylet.cfg.pull_max_bytes_in_flight))
+        self.in_flight = 0
+        self.admitted = 0
+        self.shed = 0
+        self._q: collections.deque = collections.deque()
+        self._pumping = False
+
+    def stats(self) -> dict:
+        return {"in_flight_bytes": int(self.in_flight),
+                "queued": len(self._q),
+                "admitted": int(self.admitted), "shed": int(self.shed)}
+
+    async def acquire(self, nbytes: int, deadline: float | None = None):
+        """Admit ``nbytes`` of inbound transfer, parking FIFO until the
+        window (and the arena) has room or ``deadline`` passes."""
+        nbytes = max(1, int(nbytes))
+        if deadline is None:
+            deadline = (time.monotonic()
+                        + self.raylet.cfg.pull_admission_timeout_s)
+        if not self._q and self._try_admit(nbytes):
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._q.append((nbytes, deadline, fut))
+        if not self._pumping:
+            self._pumping = True
+            self.raylet._bg.spawn(self._pump_loop())
+        await fut
+
+    def release(self, nbytes: int):
+        self.in_flight = max(0, self.in_flight - max(1, int(nbytes)))
+        self._pump()
+
+    def _retry_hint(self) -> float:
+        queued = sum(n for n, _, _ in self._q)
+        return min(2.0, max(0.05,
+                            0.1 * (self.in_flight + queued) / self.max_bytes))
+
+    def _try_admit(self, nbytes: int) -> bool:
+        if self.in_flight + nbytes > self.max_bytes:
+            # an object larger than the whole window still admits when
+            # alone — the budget bounds concurrency, it must not strand
+            # a single oversized pull forever
+            if not (self.in_flight == 0 and nbytes > self.max_bytes):
+                return False
+        store, cfg = self.raylet.store, self.raylet.cfg
+        if store is not None and cfg.object_spilling_threshold > 0:
+            cap = max(1, store.capacity)
+            used = store.bytes_in_use + self.in_flight
+            if used + nbytes > cap:
+                # truly would not fit: park until spill frees headroom
+                self.raylet._bg.spawn(
+                    self.raylet._spill_until_low_water(extra_need=nbytes))
+                return False
+            if used + nbytes > cfg.object_spilling_threshold * cap:
+                # fits, but crosses the pressure line: admit and kick the
+                # spiller so headroom recovers behind the transfer
+                self.raylet._bg.spawn(
+                    self.raylet._spill_until_low_water(extra_need=nbytes))
+        self.in_flight += nbytes
+        self.admitted += 1
+        return True
+
+    def _pump(self):
+        now = time.monotonic()
+        while self._q:
+            nbytes, deadline, fut = self._q[0]
+            if fut.done():
+                self._q.popleft()
+                continue
+            if now >= deadline:
+                self._q.popleft()
+                self.shed += 1
+                fut.set_exception(PullBackPressure(
+                    f"pull admission shed at deadline ({self.in_flight}B in "
+                    f"flight, window {self.max_bytes}B)",
+                    retry_after_s=self._retry_hint()))
+                continue
+            if not self._try_admit(nbytes):
+                return  # strict FIFO: a blocked head parks the queue
+            self._q.popleft()
+            fut.set_result(True)
+
+    async def _pump_loop(self):
+        # deadline sheds and arena-headroom recoveries need a clock even
+        # when no release() fires; cheap poll only while anyone waits
+        try:
+            while self._q:
+                self._pump()
+                await asyncio.sleep(0.05)
+        finally:
+            self._pumping = False
 
 
 # Fixed-point resource quantum (ref: src/ray/common/scheduling/
@@ -278,13 +392,21 @@ class Raylet:
         self._spilling_now: set[ObjectID] = set()
         self._freed_while_spilling: set[ObjectID] = set()
         self._spill_failed_at: dict[ObjectID, float] = {}
+        self._spill_fail_n: dict[ObjectID, int] = {}  # consecutive failures
         base = self.cfg.object_spilling_dir or os.path.join(
             self.cfg.temp_dir, f"session_{self.session}", "spill")
         self.spill_dir = os.path.join(base, self.node_id.hex()[:12])
-        # object transfer: coalesce duplicate pulls + bound inbound streams
-        # (ref: pull_manager.h:49 admission control)
+        # cooperative spill: client processes that registered arena-owner
+        # providers (prefix cache, shard plane, staging) by RPC address
+        self._spill_providers: set[tuple] = set()
+        self._provider_conns: dict[tuple, object] = {}
+        # tier-1 peer serving: (conn, oid) -> open spill-file fd, so a
+        # concurrent unlink can't tear a chunked transfer mid-stream
+        self._spill_serves: dict[tuple, tuple] = {}
+        # object transfer: coalesce duplicate pulls + byte-budget admission
+        # of inbound restores/pulls (ref: pull_manager.h:49)
         self._active_pulls: dict[ObjectID, asyncio.Future] = {}
-        self._pull_admission = asyncio.Semaphore(4)
+        self._pull_admission = PullAdmission(self)
         self._transfer_pins: dict[tuple, bool] = {}  # (conn, oid) -> pinned
         # node tunnel (core/tunnel.py): this raylet terminates its node's
         # end of every driver<->node tunnel and routes record frames to
@@ -994,6 +1116,8 @@ class Raylet:
         self._demand_reports.pop(conn, None)
         for key in [k for k in self._transfer_pins if k[0] is conn]:
             self._release_transfer_pin(conn, key[1])
+        for key in [k for k in self._spill_serves if k[0] is conn]:
+            self._spill_serve_close(conn, key[1])
         # tunnel lanes bound over this (driver) connection die with it;
         # detach the workers so their lane state frees
         victims = [(lane, ent) for lane, ent in self._tunnel_lanes.items()
@@ -1214,15 +1338,111 @@ class Raylet:
                     (oid, sz)
                     for oid, sz in self.store.list_spillable(64)
                     # skip candidates whose spill recently failed (full
-                    # disk etc.) so the monitor doesn't hot-loop on them
-                    if self._spill_failed_at.get(oid, -1e9) < now - 30.0
+                    # disk etc.), with per-oid exponential backoff so the
+                    # monitor doesn't hot-loop on a bad disk
+                    if now - self._spill_failed_at.get(oid, -1e9)
+                    >= self._spill_backoff_s(oid)
                 ]
                 if not cands:
-                    return
+                    break
                 for oid, _sz in cands:
                     if self.store.bytes_in_use <= target:
                         return
                     await loop.run_in_executor(None, self._spill_one, oid)
+            if self.store.bytes_in_use > target:
+                # unreferenced candidates exhausted: ask registered arena
+                # owners (prefix cache, shard plane, staging trackers) to
+                # trade cold REFERENCED pages to tier-1
+                await self._cooperative_spill(
+                    self.store.bytes_in_use - target, loop)
+
+    def _spill_backoff_s(self, oid: ObjectID) -> float:
+        n = self._spill_fail_n.get(oid, 0)
+        return 0.0 if n == 0 else min(60.0, 0.5 * (2 ** (n - 1)))
+
+    def _note_spill_failure(self, oid: ObjectID):
+        self._spill_failed_at[oid] = time.monotonic()
+        self._spill_fail_n[oid] = self._spill_fail_n.get(oid, 0) + 1
+        self.store.note_spill_failure()
+
+    async def rpc_register_spill_provider(self, conn, p):
+        """A local client process declares it can serve cold arena-owner
+        spill candidates (core/tiering.py registry) at this RPC address."""
+        self._spill_providers.add(tuple(p["address"]))
+        return True
+
+    async def _provider_conn(self, addr: tuple):
+        conn = self._provider_conns.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        try:
+            conn = await rpc.connect(*addr, timeout=2.0)
+        except Exception:
+            self._spill_providers.discard(addr)
+            self._provider_conns.pop(addr, None)
+            return None
+        self._provider_conns[addr] = conn
+        return conn
+
+    async def _cooperative_spill(self, need: int, loop):
+        """Ask each registered provider for cold referenced candidates and
+        spill them; report the landed (oid, path) pairs back so owners can
+        stamp manifest tier legs. Runs under _spill_lock (caller holds)."""
+        for addr in sorted(self._spill_providers):
+            conn = await self._provider_conn(addr)
+            if conn is None:
+                continue
+            try:
+                cands = await conn.call(
+                    "arena_spill_candidates",
+                    {"need": int(need),
+                     "cold_after_s": self.cfg.spill_cold_after_s},
+                    timeout=2.0)
+            except (rpc.RpcError, OSError):
+                self._spill_providers.discard(addr)
+                self._provider_conns.pop(addr, None)
+                continue
+            spilled = []
+            for item in cands or ():
+                oid = ObjectID(item["object_id"])
+                if not self.store.contains(oid):
+                    continue
+                await loop.run_in_executor(None, self._spill_one, oid)
+                path = self._spilled.get(oid)
+                if path is not None and not self.store.contains(oid):
+                    spilled.append({"object_id": oid.binary(), "path": path})
+                    need -= int(item.get("nbytes", 0))
+            if spilled:
+                try:
+                    await conn.call("arena_spilled", {"spilled": spilled},
+                                    timeout=2.0)
+                except (rpc.RpcError, OSError):
+                    pass  # owner gone; its refs will free the files
+            if need <= 0:
+                return
+
+    async def rpc_spill_objects(self, conn, p):
+        """Explicit spill of specific sealed objects — the owner-initiated
+        leg of cooperative tiering (e.g. the prefix cache's spill-not-drop
+        eviction trades its own cold pages for headroom without waiting
+        for the monitor). Returns {oid hex: {"ok", "path"}}."""
+        loop = asyncio.get_running_loop()
+        out: dict[str, dict] = {}
+        async with self._spill_lock:
+            for raw in p.get("object_ids") or ():
+                oid = ObjectID(raw)
+                have = self.store.contains(oid)
+                if not have and oid in self._spilled:
+                    out[oid.hex()] = {"ok": True, "path": self._spilled[oid]}
+                    continue
+                if not have:
+                    out[oid.hex()] = {"ok": False, "path": ""}
+                    continue
+                await loop.run_in_executor(None, self._spill_one, oid)
+                path = self._spilled.get(oid)
+                ok = path is not None and not self.store.contains(oid)
+                out[oid.hex()] = {"ok": bool(ok), "path": path or ""}
+        return out
 
     def _spill_one(self, oid: ObjectID):
         """Move one sealed object's bytes out of the arena. Runs off-loop
@@ -1236,10 +1456,23 @@ class Raylet:
         try:
             path = self._spilled.get(oid)
             if path is None or not os.path.exists(path):
+                act = None
+                if chaos.ENABLED:
+                    # "store.spill" fault point (phase=write): error acts
+                    # like a failed disk write (backoff + counter), drop
+                    # means the file was lost after the write, delay
+                    # widens the mid-spill window
+                    try:
+                        act = chaos.point("store.spill", oid=oid.hex(),
+                                          phase="write")
+                    except chaos.ChaosError:
+                        self._note_spill_failure(oid)
+                        return
                 try:
                     buf = self.store.get_buffer(oid, timeout_ms=0)
                 except ObjectStoreError:
                     return  # raced an eviction/delete: nothing to spill
+                nbytes = len(buf)
                 path = os.path.join(self.spill_dir, oid.hex())
                 tmp = path + ".tmp"
                 try:
@@ -1248,8 +1481,9 @@ class Raylet:
                         f.write(buf)
                     os.replace(tmp, path)
                 except OSError:
-                    # disk full / unwritable: remember and move on
-                    self._spill_failed_at[oid] = time.monotonic()
+                    # disk full / unwritable: remember (with exponential
+                    # backoff) and move on
+                    self._note_spill_failure(oid)
                     try:
                         os.remove(tmp)
                     except OSError:
@@ -1257,8 +1491,18 @@ class Raylet:
                     return
                 finally:
                     self.store.release(oid)
+                if act is not None and act.kind == "drop":
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    self._note_spill_failure(oid)
+                    return
                 self._spilled[oid] = path
+                self._spill_failed_at.pop(oid, None)
+                self._spill_fail_n.pop(oid, None)
                 metrics.objects_spilled.inc()
+                metrics.spill_bytes_total.inc(nbytes)
             self.store.delete(oid)
         finally:
             with self._spill_state_lock:
@@ -1269,23 +1513,56 @@ class Raylet:
                 self._drop_spill_file(oid)
 
     def _restore_spilled(self, oid: ObjectID) -> bool:
-        """Disk -> arena (blocking; call off-loop). Leaves the file in
-        place until the object is freed, so repeated pressure cycles
-        re-spill without rewriting unchanged bytes."""
+        """Disk -> arena (blocking; call off-loop): one sequential read
+        straight into a fresh arena create, then seal — no intermediate
+        heap copy. Leaves the file in place until the object is freed, so
+        repeated pressure cycles re-spill without rewriting unchanged
+        bytes."""
         path = self._spilled.get(oid)
         if path is None:
             return False
+        if chaos.ENABLED:
+            # "store.restore" fault point (phase=read): error/drop act
+            # like an unreadable tier-1 file (this attempt fails; the
+            # puller falls back / retries), delay models slow disk
+            try:
+                act = chaos.point("store.restore", oid=oid.hex(),
+                                  phase="read")
+            except chaos.ChaosError:
+                return False
+            if act is not None and act.kind == "drop":
+                return False
         try:
-            with open(path, "rb") as f:
-                payload = f.read()
+            size = os.path.getsize(path)
         except OSError:
             self._spilled.pop(oid, None)
             return False
         try:
-            self.store.put_raw(oid, payload)
+            buf = self.store.create(oid, size)
         except ObjectStoreError:
             return self.store.contains(oid)  # raced another restore
+        ok = False
+        try:
+            with open(path, "rb") as f:
+                ok = f.readinto(buf) == size
+        except OSError:
+            ok = False
+        finally:
+            del buf
+            if ok:
+                try:
+                    self.store.seal(oid)
+                except ObjectStoreError:
+                    ok = False
+            if not ok:
+                try:
+                    self.store.delete(oid)  # abort the half-create
+                except ObjectStoreError:
+                    pass
+        if not ok:
+            return False
         metrics.objects_restored.inc()
+        metrics.restore_bytes_total.inc(size)
         return True
 
     def _drop_spill_file(self, oid: ObjectID):
@@ -1296,6 +1573,7 @@ class Raylet:
                 self._freed_while_spilling.add(oid)
                 return
             self._spill_failed_at.pop(oid, None)
+            self._spill_fail_n.pop(oid, None)
             path = self._spilled.pop(oid, None)
         if path is not None:
             try:
@@ -1574,18 +1852,29 @@ class Raylet:
         objects skip the directory entirely; the UNHINTED miss-set costs
         exactly ONE ``kv_multi_get`` (not one directory lookup per oid —
         PR 3's completion-time priming, extended to the raylet path).
-        Returns {oid hex: bool}."""
-        out: dict[str, bool] = {}
+        Each inbound transfer/restore is byte-admitted through the
+        PullAdmission window (items may carry an ``nbytes`` estimate; the
+        payload may carry ``timeout_s`` as the admission deadline). A
+        shed item reports its retry hint under the ``"_bp"`` key, and
+        items restored from tier-1 list their hexes under ``"_restored"``
+        (both safe beside the 40-char oid-hex keys).
+
+        Returns {oid hex: bool} plus the side-channel keys."""
+        out: dict = {}
         todo: list = []
         for item in p["objects"]:
             oid = ObjectID(item["object_id"])
             if self.store.contains(oid):
                 out[oid.hex()] = True
                 continue
-            todo.append((oid, set(item.get("holders_hint") or ())))
+            todo.append((oid, set(item.get("holders_hint") or ()),
+                         int(item.get("nbytes") or 0)))
         if not todo:
             return out
-        no_hint = [oid for oid, hint in todo if not hint]
+        deadline = None
+        if p.get("timeout_s") is not None:
+            deadline = time.monotonic() + float(p["timeout_s"])
+        no_hint = [oid for oid, hint, _n in todo if not hint]
         primed: dict[ObjectID, set] = {}
         if no_hint:
             try:
@@ -1602,16 +1891,38 @@ class Raylet:
                     except (pickle.UnpicklingError, TypeError, EOFError):
                         pass  # torn directory blob: a cache miss
 
-        async def one(oid: ObjectID, hint: set) -> bool:
+        restored: list[str] = []
+        bp: dict[str, float] = {}
+
+        async def one(oid: ObjectID, hint: set, nbytes: int) -> bool:
             holders = hint | primed.get(oid, set())
-            if not holders and oid not in self._spilled:
+            was_spilled = oid in self._spilled
+            if not holders and not was_spilled:
                 return False  # nowhere to pull from, nothing spilled
-            return await self._pull_one_dedup(oid, sorted(holders))
+            est = (nbytes or self._spilled_size(oid)
+                   or self.cfg.object_transfer_chunk_size)
+            try:
+                await self._pull_admission.acquire(est, deadline)
+            except PullBackPressure as e:
+                bp[oid.hex()] = e.retry_after_s
+                return False
+            try:
+                ok = await self._pull_one_dedup(oid, sorted(holders))
+            finally:
+                self._pull_admission.release(est)
+            if ok and was_spilled:
+                restored.append(oid.hex())
+            return ok
 
         results = await asyncio.gather(
-            *(one(oid, hint) for oid, hint in todo), return_exceptions=True)
-        for (oid, _), ok in zip(todo, results):
+            *(one(oid, hint, n) for oid, hint, n in todo),
+            return_exceptions=True)
+        for (oid, _h, _n), ok in zip(todo, results):
             out[oid.hex()] = ok is True
+        if restored:
+            out["_restored"] = restored
+        if bp:
+            out["_bp"] = bp
         return out
 
     async def rpc_pull_object(self, conn, p):
@@ -1624,7 +1935,29 @@ class Raylet:
         the same object coalesce onto one transfer (ref: pull_manager.h:49
         request dedup + admission control)."""
         oid = ObjectID(p["object_id"])
-        return await self._pull_one_dedup(oid, p.get("holders_hint"))
+        if self.store.contains(oid):
+            return True
+        est = self._spilled_size(oid) or self.cfg.object_transfer_chunk_size
+        try:
+            # single-object gets keep wait-then-succeed semantics: a long
+            # default deadline parks them through bursts instead of
+            # shedding (the shed path belongs to batched adoptions)
+            await self._pull_admission.acquire(est)
+        except PullBackPressure:
+            return False
+        try:
+            return await self._pull_one_dedup(oid, p.get("holders_hint"))
+        finally:
+            self._pull_admission.release(est)
+
+    def _spilled_size(self, oid: ObjectID) -> int:
+        path = self._spilled.get(oid)
+        if path is None:
+            return 0
+        try:
+            return os.path.getsize(path)
+        except OSError:
+            return 0
 
     async def _pull_one_dedup(self, oid: ObjectID, holders_hint=None) -> bool:
         """Dedup'd single-object pull: concurrent pulls of the same oid
@@ -1670,25 +2003,27 @@ class Raylet:
 
         for node in self.cluster_view:
             if node["node_id"].binary() in holders and node["node_id"] != self.node_id:
-                async with self._pull_admission:  # bound concurrent inbound
-                    try:
-                        if await self._chunked_fetch(oid, tuple(node["address"])):
-                            if register:
-                                # read-modify-write the directory so later
-                                # pulls (and the owner's free) see this copy
-                                locs = await self.gcs.call(
-                                    "kv_get",
-                                    {"ns": "obj_loc", "key": oid.hex()})
-                                merged = _p.loads(locs) if locs else set()
-                                merged.add(self.node_id.binary())
-                                await self.gcs.call(
-                                    "kv_put",
-                                    {"ns": "obj_loc", "key": oid.hex(),
-                                     "value": _p.dumps(merged)},
-                                )
-                            return True
-                    except Exception:
-                        continue
+                # byte-budget admission happened at the pull entry point
+                # (rpc_pull_object/rpc_pull_objects), so the transfer
+                # itself runs unthrottled here
+                try:
+                    if await self._chunked_fetch(oid, tuple(node["address"])):
+                        if register:
+                            # read-modify-write the directory so later
+                            # pulls (and the owner's free) see this copy
+                            locs = await self.gcs.call(
+                                "kv_get",
+                                {"ns": "obj_loc", "key": oid.hex()})
+                            merged = _p.loads(locs) if locs else set()
+                            merged.add(self.node_id.binary())
+                            await self.gcs.call(
+                                "kv_put",
+                                {"ns": "obj_loc", "key": oid.hex(),
+                                 "value": _p.dumps(merged)},
+                            )
+                        return True
+                except Exception:
+                    continue
         return False
 
     async def _chunked_fetch(self, oid: ObjectID, address: tuple) -> bool:
@@ -1785,12 +2120,47 @@ class Raylet:
                 return False
             await asyncio.sleep(0.2)
 
+    def _spill_serve_open(self, conn, oid: ObjectID):
+        """Open (and cache per (conn, oid)) this object's tier-1 file for
+        peer serving. The held fd plays the transfer pin's role: a
+        concurrent free/unlink can't tear the chunked stream, the kernel
+        keeps the inode until fetch_object_done closes it."""
+        key = (conn, oid)
+        ent = self._spill_serves.get(key)
+        if ent is not None:
+            return ent
+        if self.store.contains(oid):
+            return None  # shm copy wins: serve zero-copy from the arena
+        path = self._spilled.get(oid)
+        if path is None:
+            return None
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return None
+        ent = (f, os.fstat(f.fileno()).st_size)
+        self._spill_serves[key] = ent
+        return ent
+
+    def _spill_serve_close(self, conn, oid: ObjectID):
+        ent = self._spill_serves.pop((conn, oid), None)
+        if ent is not None:
+            try:
+                ent[0].close()
+            except OSError:
+                pass
+
     async def rpc_fetch_object_meta(self, conn, p):
         """Start of a transfer: pin the object (one store ref held for the
         whole transfer so eviction/owner-delete can't yank it mid-stream);
-        the peer releases via fetch_object_done or by disconnecting."""
+        the peer releases via fetch_object_done or by disconnecting. A
+        spilled object serves straight from its tier-1 file — no restore
+        into (so no pressure on) this node's arena; the open fd is the
+        pin."""
         oid = ObjectID(p["object_id"])
-        await self._ensure_local_bytes(oid)
+        ent = self._spill_serve_open(conn, oid)
+        if ent is not None:
+            return {"size": ent[1]}
         try:
             buf = self.store.get_buffer(oid, timeout_ms=0)
         except Exception:
@@ -1805,6 +2175,7 @@ class Raylet:
         return {"size": size}
 
     def _release_transfer_pin(self, conn, oid: ObjectID):
+        self._spill_serve_close(conn, oid)
         if self._transfer_pins.pop((conn, oid), None):
             try:
                 self.store.release(oid)
@@ -1817,13 +2188,21 @@ class Raylet:
 
     async def rpc_fetch_object_chunk(self, conn, p):
         oid = ObjectID(p["object_id"])
+        off, length = p["offset"], p["length"]
+        ent = self._spill_serve_open(conn, oid)
+        if ent is not None:
+            loop = asyncio.get_running_loop()
+            try:
+                return await loop.run_in_executor(
+                    None, os.pread, ent[0].fileno(), length, off)
+            except OSError:
+                return None
         await self._ensure_local_bytes(oid)
         try:
             buf = self.store.get_buffer(oid, timeout_ms=0)
         except Exception:
             return None
         try:
-            off, length = p["offset"], p["length"]
             return bytes(buf[off : off + length])
         finally:
             del buf
@@ -1832,6 +2211,16 @@ class Raylet:
     async def rpc_fetch_object(self, conn, p):
         """Single-frame fetch for objects at or below one chunk."""
         oid = ObjectID(p["object_id"])
+        ent = self._spill_serve_open(conn, oid)
+        if ent is not None:
+            loop = asyncio.get_running_loop()
+            try:
+                data = await loop.run_in_executor(
+                    None, os.pread, ent[0].fileno(), ent[1], 0)
+            except OSError:
+                data = None
+            self._spill_serve_close(conn, oid)
+            return data
         await self._ensure_local_bytes(oid)
         try:
             buf = self.store.get_buffer(oid, timeout_ms=0)
@@ -1900,6 +2289,14 @@ class Raylet:
                 log.debug("tunnel worker conn close failed", exc_info=True)
         self._tunnel_worker_conns.clear()
         self._tunnel_lanes.clear()
+        for pconn in list(self._provider_conns.values()):
+            try:
+                await pconn.close()
+            except Exception:
+                log.debug("spill provider conn close failed", exc_info=True)
+        self._provider_conns.clear()
+        for conn, oid in list(self._spill_serves):
+            self._spill_serve_close(conn, oid)
         await self.server.stop()
         if self.gcs is not None:
             await self.gcs.close()

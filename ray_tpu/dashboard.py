@@ -23,6 +23,7 @@ hash-routed JS app from ``dashboard_client/``, no build step):
     GET /api/timeline          chrome-trace events (load into perfetto)
     GET /api/latency           flight-recorder per-stage task latency
     GET /api/llm               LLM decode-plane panel (disagg stages + spec gauges)
+    GET /api/tiering           memory-tiering panel (spill/restore stages + tier-1 counters)
     GET /api/worker_deaths     worker postmortems (recorder event dumps)
     GET /api/workers/{id}/stack  live stack dump (py-spy role)
     GET /api/workers/{id}/heap   tracemalloc heap profile
@@ -130,6 +131,10 @@ def build_app():
     # tokens_per_step / spec_accept_rate) + rt_llm_* gauges
     app.router.add_get(
         "/api/llm", _json(lambda: _plain(state.list_llm_metrics())))
+    # memory-tiering panel: spill/restore stage windows + tier-1 byte
+    # counters and the prefix cache hit-rate gauge (state.list_tiering)
+    app.router.add_get(
+        "/api/tiering", _json(lambda: _plain(state.list_tiering())))
     app.router.add_get(
         "/api/worker_deaths",
         _json(lambda: _plain(state.list_worker_deaths())))

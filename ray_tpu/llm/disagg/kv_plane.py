@@ -38,11 +38,32 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ray_tpu.core import tiering
 from ray_tpu.core.ref import ObjectRef
 from ray_tpu.devtools import chaos
 from ray_tpu.llm.disagg import telemetry
 
 log = logging.getLogger(__name__)
+
+# shipped-but-not-yet-adopted pages are this process's coldest referenced
+# bytes; the tracker offers them to the raylet's cooperative spill
+_staging: tiering.ColdTracker | None = None
+
+
+def _staging_tracker() -> tiering.ColdTracker:
+    global _staging
+    if _staging is None:
+        _staging = tiering.ColdTracker("kv_staging")
+    return _staging
+
+
+def untrack_staging(entry: "KVPageEntry") -> None:
+    """Remove a page entry's components from this process's staging
+    tracker (the prefix cache takes ownership at insert)."""
+    if _staging is None:
+        return
+    for ref in entry.refs.values():
+        _staging.untrack(ref.id.binary())
 
 
 class KVShipError(Exception):
@@ -65,11 +86,20 @@ def _core():
 class KVPageEntry:
     """One KV page: component refs (``k``/``v``, or ``k.q``/``k.s``/
     ``v.q``/``v.s`` for int8 pools), the node whose arena sealed them,
-    and the payload byte count."""
+    and the payload byte count.
+
+    The ``(tier, spill_path, spill_offset)`` leg is ADVISORY tiering
+    metadata (core/tiering.py): tier-1 means the sealing node moved the
+    bytes to its spill directory — consumers never branch on it, the
+    object plane restores transparently on the next get/pull; the cache
+    and ledgers use it to tell a disk hit from a shm hit."""
 
     refs: dict[str, ObjectRef]
     node: bytes | None = None
     nbytes: int = 0
+    tier: int = tiering.TIER_SHM
+    spill_path: str = ""
+    spill_offset: int = 0
 
 
 @dataclass
@@ -186,8 +216,14 @@ def ship_pages(kpool, vpool, page_ids, token_ids, *, page_size: int,
                 key = side if not name else f"{side}.{name}"
                 refs[key] = core.put_value(page, prefer_shm=True)
                 nbytes += int(page.nbytes)
-        entries.append(KVPageEntry(refs=refs, node=node, nbytes=nbytes))
+        entry = KVPageEntry(refs=refs, node=node, nbytes=nbytes)
+        entries.append(entry)
         shipped += nbytes
+        if core.store is not None:
+            tracker = _staging_tracker()
+            per = max(1, nbytes // max(1, len(refs)))
+            for ref in refs.values():
+                tracker.track(ref.id.binary(), per, entry)
     m = KVPageManifest(token_ids=tuple(int(t) for t in token_ids),
                        page_size=int(page_size), kv_dtype=kv_dtype,
                        pages=entries)
@@ -232,19 +268,57 @@ def adopt_pages(manifest: KVPageManifest,
     core = _core()
     if core.store is not None:
         hints: dict = {}
+        sizes: dict = {}
+        owners: dict = {}
         for p in pages:
+            per = max(1, p.nbytes // max(1, len(p.refs)))
             for k in keys:
                 oid = p.refs[k].id
                 if not core.store.contains(oid):
                     hints.setdefault(oid, set()).add(p.node)
+                    sizes[oid] = per
+                    owners[oid.hex()] = (p, per)
         if len(hints) >= 2:
+            t_pull = time.perf_counter_ns()
             try:
-                core._run_sync(core.pull_objects_batch(hints), timeout=60)
+                res = core._run_sync(
+                    core.pull_objects_batch(
+                        hints, sizes=sizes,
+                        timeout_s=core.cfg.pull_admission_timeout_s),
+                    timeout=60)
             except Exception:
                 # loop-resident caller, or a stalled pull hitting the
                 # bridge timeout: strictly an optimization — the get
                 # below keeps its own per-ref pull/recovery fallbacks
+                res = {}
                 log.debug("batched KV prefetch skipped", exc_info=True)
+            bp = (res or {}).get("_bp")
+            if bp:
+                # the raylet's admission window shed part of this
+                # adoption: surface typed back-pressure so the scheduler
+                # retries elsewhere instead of OOMing this arena
+                from ray_tpu.serve.exceptions import BackPressureError
+
+                raise BackPressureError(
+                    f"kv adoption shed by pull admission "
+                    f"({len(bp)}/{len(hints)} pages queued past deadline)",
+                    retry_after_s=float(max(bp.values())))
+            restored = (res or {}).get("_restored") or ()
+            if restored:
+                disk_bytes = 0
+                for h in restored:
+                    ent = owners.get(h)
+                    if ent is None:
+                        continue
+                    p, per = ent
+                    disk_bytes += per
+                    # promoted back to shm by the restore
+                    p.tier = tiering.TIER_SHM
+                    p.spill_path = ""
+                telemetry.record(telemetry.RESTORE,
+                                 time.perf_counter_ns() - t_pull, disk_bytes)
+                telemetry.count(kv_disk_bytes=disk_bytes,
+                                pages_restored=len(restored))
     vals = api.get(flat)
     nk = len(keys)
     by_page = [vals[i * nk:(i + 1) * nk] for i in range(len(pages))]

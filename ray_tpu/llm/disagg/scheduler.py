@@ -92,9 +92,20 @@ class DisaggLLMServer:
         self.n_pages = n_pages
         self.default_max_tokens = default_max_tokens
         self.max_attempts = max_attempts
+        from ray_tpu.config import get_config
+        _cfg = get_config()
+        # tiering opt-in rides config (RT_PREFIX_CACHE_SPILL et al): the
+        # replica's cache spills cold pages to the raylet's tier-1
+        # instead of dropping them, so a refill-after-evict costs one
+        # disk read instead of a duplicate prefill
         self.cache = PrefixCache(page_size,
                                  capacity_bytes=prefix_cache_bytes,
-                                 kv_dtype=kv_dtype or "native")
+                                 kv_dtype=kv_dtype or "native",
+                                 spill=bool(_cfg.prefix_cache_spill),
+                                 tier1_capacity_bytes=int(
+                                     _cfg.prefix_cache_tier1_bytes),
+                                 spill_cold_after_s=float(
+                                     _cfg.spill_cold_after_s))
         model_kw = dict(kv_dtype=kv_dtype, lora_adapters=lora_adapters,
                         lora_rank=lora_rank)
         # prefill pool: async actors with enough concurrency for calls to

@@ -39,14 +39,20 @@ TPOT = "tpot"
 # state.list_task_latency() read as tokens/step and rate×1e3)
 TOKENS_PER_STEP = "tokens_per_step"
 SPEC_ACCEPT = "spec_accept_rate"
+# memory tiering (PR 18): time a spill request / a tier-1 restore took,
+# nbytes = the disk-leg payload moved
+SPILL = "spill"
+RESTORE = "restore"
 STAGES = (PREFILL_QUEUE, KV_SHIP, DECODE_QUEUE, TTFT, TPOT,
-          TOKENS_PER_STEP, SPEC_ACCEPT)
+          TOKENS_PER_STEP, SPEC_ACCEPT, SPILL, RESTORE)
 
 # ttft/tpot are request-level derived metrics: they live in the latency
 # window + Prometheus but not in the per-op recorder ring
 _REC_STAGE = {PREFILL_QUEUE: recorder.PREFILL_QUEUE,
               KV_SHIP: recorder.KV_SHIP,
-              DECODE_QUEUE: recorder.DECODE_QUEUE}
+              DECODE_QUEUE: recorder.DECODE_QUEUE,
+              SPILL: recorder.SPILL,
+              RESTORE: recorder.RESTORE}
 
 _WINDOW_CAP = 2048
 
@@ -57,7 +63,10 @@ _published = -1
 _snapped = -1
 _counters = {"kv_driver_bytes": 0, "kv_array_bytes": 0,
              "pages_shipped": 0, "pages_adopted": 0,
-             "prefills": 0, "suffix_prefills": 0, "adoptions": 0}
+             "prefills": 0, "suffix_prefills": 0, "adoptions": 0,
+             # disk-leg split of the byte ledger: payload bytes that
+             # came back from tier-1 instead of staying shm-resident
+             "kv_disk_bytes": 0, "pages_restored": 0}
 _registered_core = None
 
 
@@ -65,7 +74,7 @@ _registered_core = None
 # vocabulary): queue waits vs page movement; ttft/tpot are derived
 # request metrics, not operations — they get no span
 _SPAN_STAGE = {PREFILL_QUEUE: "queue", DECODE_QUEUE: "queue",
-               KV_SHIP: "pull"}
+               KV_SHIP: "pull", SPILL: "pull", RESTORE: "pull"}
 
 
 def record(stage: str, dur_ns: int, nbytes: int = 0,

@@ -122,12 +122,21 @@ def box_of_indices(index, global_shape: tuple) -> tuple:
 @dataclass
 class ShardEntry:
     """One unique tile: its box, the ObjectRef holding its bytes, and the
-    node whose shm arena sealed it (None when unknown/memory-resident)."""
+    node whose shm arena sealed it (None when unknown/memory-resident).
+
+    ``tier``/``spill_path``/``spill_offset`` are the storage-tier leg
+    (core/tiering.py): tier 0 = shm-resident, tier 1 = in the owning
+    raylet's spill directory. ADVISORY — consumers never branch on it
+    (``api.get``/pull restore transparently); it exists so dashboards
+    and the bench can tell a disk-resident shard from a hot one."""
 
     box: tuple
     ref: ObjectRef
     node: bytes | None = None
     nbytes: int = 0
+    tier: int = 0
+    spill_path: str = ""
+    spill_offset: int = 0
 
 
 @dataclass

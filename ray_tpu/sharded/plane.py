@@ -28,7 +28,7 @@ import time
 
 import numpy as np
 
-from ray_tpu.core import object_store
+from ray_tpu.core import object_store, tiering
 from ray_tpu.core.ref import ObjectLostError, ObjectRef
 from ray_tpu.devtools import chaos
 from ray_tpu.sharded import telemetry
@@ -47,6 +47,20 @@ def _core():
     from ray_tpu.core import api
 
     return api.get_core()
+
+
+# cold-set tracker for put_sharded seals (core/tiering.py): the raylet's
+# cooperative spill can trade cold referenced shards to tier-1 and the
+# tracker stamps each entry's (tier, spill_path) leg when they land.
+# Weakref-held — tracking never outlives the manifest.
+_cold: tiering.ColdTracker | None = None
+
+
+def _cold_tracker() -> tiering.ColdTracker:
+    global _cold
+    if _cold is None:
+        _cold = tiering.ColdTracker("shard_plane")
+    return _cold
 
 
 def _mesh_axes_of(mesh) -> dict:
@@ -152,8 +166,11 @@ def put_sharded(value, *, spec=None, mesh=None, rules=None, path: str = "",
                 "worker that owns them)")
         sv = np.ascontiguousarray(sv)
         ref = _seal_shard(core, sv, shard=i, phase="put")
-        entries.append(ShardEntry(box=box, ref=ref, node=node,
-                                  nbytes=int(sv.nbytes)))
+        entry = ShardEntry(box=box, ref=ref, node=node,
+                           nbytes=int(sv.nbytes))
+        entries.append(entry)
+        if core.store is not None:
+            _cold_tracker().track(ref.id.binary(), entry.nbytes, entry)
     m = ShardManifest(global_shape=global_shape, dtype=dtype, spec=spec_t,
                       mesh_axes=axes, shards=entries)
     telemetry.count_driver_bytes(manifest_nbytes(m))
@@ -179,6 +196,11 @@ def fetch_shard(sref: ShardedObjectRef, i: int):
             "re-materialized (put_sharded shards have no lineage; a "
             "task-produced shard's reconstruction was exhausted)"
         ) from e
+    if entry.tier == tiering.TIER_DISK:
+        # the get restored it through the raylet's spill file: the bytes
+        # are shm-resident again, promote the advisory tier leg back
+        entry.tier = tiering.TIER_SHM
+        entry.spill_path = ""
     telemetry.record(telemetry.SHARD_FETCH, time.perf_counter_ns() - t0,
                      int(getattr(value, "nbytes", 0)))
     return value

@@ -468,6 +468,25 @@ def list_llm_metrics() -> dict:
     return {"stages": stages, "gauges": gauges}
 
 
+_TIERING_STAGES = ("spill", "restore")
+_TIERING_GAUGES = ("rt_spill_bytes_total", "rt_restore_bytes_total",
+                   "rt_tier1_hit_rate", "rt_objects_spilled",
+                   "rt_objects_restored")
+
+
+def list_tiering() -> dict:
+    """Memory-tiering panel: ``spill``/``restore`` stage percentiles
+    (time a spill request / tier-1 restore took, from the same
+    ns="latency" publish the disagg stages ride) beside the cluster-wide
+    tier-1 counters — bytes spilled/restored, objects moved each way,
+    and the prefix cache's tier-1 hit rate."""
+    stages = {k: v for k, v in list_task_latency().items()
+              if k in _TIERING_STAGES}
+    gauges = {name: m for name, m in cluster_metrics().items()
+              if name in _TIERING_GAUGES}
+    return {"stages": stages, "gauges": gauges}
+
+
 def list_serve_autoscale_events(key: str | None = None) -> list[dict]:
     """Fired serve autoscale decisions (newest last), each carrying its
     cause and the signals that produced it — {key, ts, from_replicas,

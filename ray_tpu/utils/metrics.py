@@ -146,6 +146,14 @@ objects_put = Counter("rt_objects_put", "objects created via put")
 object_bytes_put = Counter("rt_object_bytes_put", "bytes written via put")
 objects_spilled = Counter("rt_objects_spilled", "objects spilled to disk")
 objects_restored = Counter("rt_objects_restored", "spilled objects restored")
+# memory tiering (PR 18): byte-granular spill/restore traffic plus the
+# prefix cache's tier-1 effectiveness (set from cache stats)
+spill_bytes_total = Counter("rt_spill_bytes_total",
+                            "bytes written to tier-1 spill files")
+restore_bytes_total = Counter("rt_restore_bytes_total",
+                              "bytes restored from tier-1 into shm arenas")
+tier1_hit_rate = Gauge("rt_tier1_hit_rate",
+                       "fraction of prefix-cache hits served from tier-1")
 task_exec_seconds = Histogram("rt_task_exec_seconds", "worker-side task execution time")
 
 # --- flight-recorder families (PR 4; see utils/recorder.py) -----------------

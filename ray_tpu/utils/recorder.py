@@ -76,6 +76,11 @@ DECODE_QUEUE = 17     # adopted request waited for a decode ring slot
 # shipped/received before dying.
 TUNNEL_TX = 18        # driver: one coalesced record frame sent to a peer node
 TUNNEL_RX = 19        # driver: one reply record frame received from a peer node
+# Memory tiering (PR 18): the disk legs of the object plane; args are
+# (duration_ns clamped u32, nbytes lo, nbytes hi) like the other byte-
+# moving stages.
+SPILL = 20            # arena pages written to a tier-1 spill file
+RESTORE = 21          # tier-1 bytes restored into a fresh arena seal
 
 STAGE_NAMES = {
     SUBMIT: "submit", RING_PUSH: "ring_push", WORKER_POP: "worker_pop",
@@ -85,7 +90,7 @@ STAGE_NAMES = {
     CHAOS: "chaos", SHARD_SEAL: "shard_seal", SHARD_FETCH: "shard_fetch",
     RESHARD: "reshard", PREFILL_QUEUE: "prefill_queue", KV_SHIP: "kv_ship",
     DECODE_QUEUE: "decode_queue", TUNNEL_TX: "tunnel_tx",
-    TUNNEL_RX: "tunnel_rx",
+    TUNNEL_RX: "tunnel_rx", SPILL: "spill", RESTORE: "restore",
 }
 
 # Reported latency stages (SAMPLE args, ns): both ring hops are covered —
