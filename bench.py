@@ -241,6 +241,70 @@ print(json.dumps({"wall_us": best}))
 """
 
 
+def _metrics_direct_overhead_us() -> float:
+    """metrics_overhead_us: the per-task cost of the metrics plumbing a
+    fast-lane task actually pays — one untagged ``Counter.inc()`` at
+    submit plus one tagged ``inc(tags={"outcome": ...})`` at reply-apply
+    (the rollup plane adds NOTHING here: counters stay cumulative dict
+    bumps; windowing happens GCS-side off the 1/s flush). Same
+    min-per-arm alternating-rounds estimator as the recorder number;
+    budget < 1.0µs/task."""
+    import time as _t
+
+    from ray_tpu.utils.metrics import Counter
+
+    N = 50_000
+    submitted = Counter("bench_m_submitted")
+    finished = Counter("bench_m_finished", tag_keys=("outcome",))
+    tags_ok = {"outcome": "ok"}
+    clock = _t.perf_counter_ns
+    sink: dict = {}
+
+    def task(i, on):
+        # baseline both arms pay: the routing dict store + pop the real
+        # submit/reply pair does around the metric bumps
+        sink[i] = i
+        sink.pop(i)
+        if on:
+            submitted.inc()
+            finished.inc(tags=tags_ok)
+
+    def one_round(on) -> float:
+        t0 = clock()
+        for i in range(N):
+            task(i, on)
+        return (clock() - t0) / N
+
+    one_round(True)
+    one_round(False)  # warm both code paths
+    on_t, off_t = [], []
+    for _ in range(7):
+        on_t.append(one_round(True))
+        off_t.append(one_round(False))
+    return max(0.0, (min(on_t) - min(off_t)) / 1e3)
+
+
+def run_metrics_overhead() -> dict[str, float]:
+    """Fresh-subprocess direct measurement (same heap-amortization
+    argument as the recorder number: this process's post-suite heap
+    would bill the counters for the harness's garbage)."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import bench, json; "
+         "print(json.dumps(bench._metrics_direct_overhead_us()))"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        capture_output=True, text=True, timeout=300)
+    if proc.returncode == 0:
+        return {"metrics_overhead_us": json.loads(
+            proc.stdout.strip().splitlines()[-1])}
+    print(f"metrics direct measure failed:\n{proc.stderr[-1000:]}",
+          file=sys.stderr)
+    return {"metrics_overhead_us": _metrics_direct_overhead_us()}
+
+
 def run_recorder_ab(quick: bool) -> dict[str, float]:
     """recorder_overhead_us: the flight recorder forced off vs on.
     The headline number is the DIRECT per-task operation delta
@@ -1779,11 +1843,10 @@ from ray_tpu.llm.disagg.scheduler import DisaggLLMServer
 from ray_tpu.models.llama import LlamaConfig
 
 quick = sys.argv[1] == "1"
-# "ab" = the 5x-under A/B (spill vs drop) + restore-bandwidth leg;
-# "2"/"10" = a single spill arm at that under-provision factor. Sweep
-# factors run as separate child invocations: actor-pool churn past two
-# servers in one driver starves leases (pre-existing, see ROADMAP).
-MODE = sys.argv[2] if len(sys.argv) > 2 else "ab"
+# All arms (5x spill/drop A/B + the 2x/10x sweep) run in THIS one
+# driver: pool leases flow back between arms now that unreferenced
+# actors are auto-killed and shutdown() kills its pools explicitly —
+# the per-factor subprocess isolation the sweep used to need is gone.
 # The r9 disagg model/page shape, but the workload is G distinct
 # shared-prefix tenants whose combined radix-tree working set is held
 # 2x/5x/10x ABOVE the prefix-cache arena budget. Every round replays
@@ -1875,36 +1938,33 @@ def restore_gbps_leg():
 
 
 async def go():
-    out = {}
-    if MODE == "ab":
-        spill5 = await run_arm(True, 5)
-        drop5 = await run_arm(False, 5)
-        out.update({
-            "tier_hit_rate": spill5["hit_rate"],
-            "tier1_hit_share": spill5["tier1_hit_share"],
-            "tok_s_under_pressure": spill5["tok_s"],
-            "tok_s_under_pressure_nospill": drop5["tok_s"],
-            "tiering_hit_rate_nospill": drop5["hit_rate"],
-            "tiering_spills": spill5["spills"],
-            "tiering_pages_restored": spill5["pages_restored"],
-            "tiering_oom_errors": spill5["errors"] + drop5["errors"],
-        })
-    else:
-        f = int(MODE)
-        arm = await run_arm(True, f)
-        out[f"tier_hit_rate_{f}x"] = arm["hit_rate"]
-        out[f"tok_s_spill_{f}x"] = arm["tok_s"]
-        out["tiering_oom_errors"] = arm["errors"]
+    spill5 = await run_arm(True, 5)
+    drop5 = await run_arm(False, 5)
+    out = {
+        "tier_hit_rate": spill5["hit_rate"],
+        "tier1_hit_share": spill5["tier1_hit_share"],
+        "tok_s_under_pressure": spill5["tok_s"],
+        "tok_s_under_pressure_nospill": drop5["tok_s"],
+        "tiering_hit_rate_nospill": drop5["hit_rate"],
+        "tiering_spills": spill5["spills"],
+        "tiering_pages_restored": spill5["pages_restored"],
+        "tiering_oom_errors": spill5["errors"] + drop5["errors"],
+    }
+    if not quick:
+        for f in (2, 10):
+            arm = await run_arm(True, f)
+            out[f"tier_hit_rate_{f}x"] = arm["hit_rate"]
+            out[f"tok_s_spill_{f}x"] = arm["tok_s"]
+            out["tiering_oom_errors"] += arm["errors"]
     return out
 
 
 out = asyncio.run(go())
-if MODE == "ab":
-    out["restore_gbps"] = restore_gbps_leg()
-    import jax
+out["restore_gbps"] = restore_gbps_leg()
+import jax
 
-    out["tiering_platform"] = jax.devices()[0].platform
-    out["tiering_ws_bytes"] = WS
+out["tiering_platform"] = jax.devices()[0].platform
+out["tiering_ws_bytes"] = WS
 ray_tpu.shutdown()
 print("RES=" + json.dumps(out))
 """
@@ -1916,19 +1976,10 @@ def run_tiering_bench(quick: bool) -> dict:
     tiering on (cold prefixes spill to disk, hits restore through the
     batched pull path) vs off (capacity evictions re-prefill). Also
     times raw tier-1 restore bandwidth and counts OOM/arena-full errors
-    under the concurrent adoption-burst rounds (acceptance: 0). Sweep
-    factors run as separate subprocesses (fresh cluster per arm)."""
-    out = _run_llm_child(_TIERING_BENCH_CHILD, "tiering", quick)
-    if out and not quick:
-        for f in ("2", "10"):
-            arm = _run_llm_child(_TIERING_BENCH_CHILD, f"tiering-{f}x",
-                                 quick, extra_args=(f,))
-            if arm:
-                errs = arm.pop("tiering_oom_errors", 0)
-                out["tiering_oom_errors"] = (
-                    out.get("tiering_oom_errors", 0) + errs)
-                out.update(arm)
-    return out
+    under the concurrent adoption-burst rounds (acceptance: 0). The
+    whole sweep shares one driver/cluster: pool leases return between
+    arms via actor-handle autokill + explicit shutdown() kills."""
+    return _run_llm_child(_TIERING_BENCH_CHILD, "tiering", quick)
 
 
 def write_benchvs(micro: dict, model: dict | None,
@@ -2318,7 +2369,12 @@ def write_benchvs(micro: dict, model: dict | None,
         "here and ~0.05µs there. recorder_ab_wall_*_us bracket the "
         "end-to-end effect (RT_RECORDER_ENABLED off vs on, fresh "
         "subprocess cluster per arm, alternating order, best-of per "
-        "arm): their delta sits inside host noise.",
+        "arm): their delta sits inside host noise. "
+        "`metrics_overhead_us` is the same-doctrine direct A/B of the "
+        "metric bumps a task pays (one untagged Counter.inc at submit + "
+        "one tagged inc at reply-apply; the GCS rollup plane adds zero "
+        "hot-path cost — windowing rides the 1/s flush). Budget < "
+        "1.0µs/task.",
         "",
         "## Chaos engine (README § Fault injection)",
         "",
@@ -2568,6 +2624,10 @@ def main():
             micro.update(run_recorder_ab(args.quick))
         except Exception as e:  # the A/B must not sink the micro numbers
             print(f"recorder A/B failed: {e!r}", file=sys.stderr)
+        try:
+            micro.update(run_metrics_overhead())
+        except Exception as e:
+            print(f"metrics overhead bench failed: {e!r}", file=sys.stderr)
         try:
             micro.update(run_chaos_bench(args.quick))
         except Exception as e:

@@ -23,7 +23,7 @@ namespace wire {
 // Wire-schema version; must match ray_tpu/utils/schema.py PROTOCOL_VERSION
 // (tests/test_wire_schema.py cross-checks the two).
 constexpr int kProtocolMajor = 2;
-constexpr int kProtocolMinor = 1;
+constexpr int kProtocolMinor = 2;
 
 // ---------------------------------------------------------------------
 // Fastpath record catalog (shm rings + node tunnels, core/fastpath.py).

@@ -304,6 +304,16 @@ class CoreClient:
         # and controller evict/replace replicas in ~one raylet reap tick
         # instead of waiting out a health-check period
         self._actor_death_listeners: list = []
+        # owner-local actor-handle refcounting (lease-starvation fix):
+        # unnamed actors created by THIS driver are auto-killed once the
+        # last local handle drops and their submitted work drains, so
+        # their CPU leases return to the pool instead of squatting until
+        # driver exit (two sequentially created 4-actor pools used to
+        # exhaust an 8-CPU node). Named/detached actors and any actor
+        # whose handle was ever serialized are exempt — a shipped handle
+        # may outlive every local one.
+        self._actor_handle_counts: dict[ActorID, int] = {}
+        self._actor_no_autokill: set[ActorID] = set()
         # placement-group state pushes ("pgs" channel, subscribed lazily
         # on the first ready()/wait): pg_id hex -> latest view, plus
         # waiter events so ready() observes PENDING→CREATED and
@@ -1915,6 +1925,18 @@ class CoreClient:
         here is bounded (capped windows, bulk bisect feed) so the flush
         can never grow past ~1ms and tax the A/B's CPU counter."""
         self._rec_enabled = recorder.enabled()  # refresh the hot-path gate
+        # arena watermark gauges (tiering registry): live/peak/capacity
+        # bytes per registered arena, sampled here so the rollup plane
+        # gets watermark history on every flush. Bounded: one provider
+        # call per arena, a handful of arenas per process.
+        from ray_tpu.core import tiering as _tiering
+
+        for aname, ast in _tiering.sample_arenas().items():
+            metrics.arena_bytes.set(ast["bytes"], tags={"arena": aname})
+            metrics.arena_peak_bytes.set(ast["peak"], tags={"arena": aname})
+            if ast["capacity"]:
+                metrics.arena_capacity_bytes.set(
+                    ast["capacity"], tags={"arena": aname})
         # native ring/store gauges first, UNGATED: the shm counters move
         # with puts/gets/ring traffic even when no new task sample landed
         ns = self.native_stats()
@@ -4359,8 +4381,82 @@ class CoreClient:
         self._actor_info[view["actor_id"]] = view
         return view
 
+    def _seed_autokill(self, spec: dict) -> None:
+        """Enroll a to-be-created actor in handle refcounting BEFORE its
+        first handle exists (ActorHandle.__init__ only counts enrolled
+        ids). Named and detached actors are reachable/alive beyond the
+        creating handle, so they never enroll."""
+        if spec["name"] is None and spec.get("lifetime") != "detached":
+            with self._rc_lock:
+                self._actor_handle_counts.setdefault(spec["actor_id"], 0)
+
+    def note_actor_handle_created(self, actor_id: ActorID) -> bool:
+        """ActorHandle.__init__ hook: count an owner-local handle.
+        Returns whether this handle participates in autokill accounting
+        (enrolled unnamed actors only; lookups of named/foreign actors
+        return False)."""
+        with self._rc_lock:
+            if self._closed or actor_id not in self._actor_handle_counts:
+                return False
+            self._actor_handle_counts[actor_id] += 1
+            return True
+
+    def note_actor_handle_shipped(self, actor_id: ActorID) -> None:
+        """ActorHandle.__reduce__ hook: a serialized handle may be alive
+        anywhere — permanently exempt the actor from autokill."""
+        with self._rc_lock:
+            self._actor_no_autokill.add(actor_id)
+
+    def note_actor_handle_dropped(self, actor_id: ActorID) -> None:
+        """ActorHandle.__del__ hook: when the LAST owner-local handle of
+        an enrolled actor drops, schedule a drain-gated kill so the
+        actor's lease flows back to the raylet."""
+        with self._rc_lock:
+            n = self._actor_handle_counts.get(actor_id)
+            if n is None:
+                return
+            self._actor_handle_counts[actor_id] = n = n - 1
+            if (n > 0 or self._closed
+                    or actor_id in self._actor_no_autokill):
+                return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self._autokill_actor(actor_id), self.loop)
+        except RuntimeError:
+            # loop already closed (interpreter exit): the GCS owner-death
+            # reap returns the lease instead
+            pass
+
+    async def _autokill_actor(self, actor_id: ActorID) -> None:
+        """Kill an unreferenced unnamed actor once its submitted work
+        drains (queued RPC specs, in-flight RPC calls, fast-lane ring
+        traffic) — never yanks a worker out from under a live call. The
+        wait is bounded: a wedged actor is left to the normal death
+        paths rather than pinning this coroutine forever."""
+        deadline = self.loop.time() + 30.0
+        while self.loop.time() < deadline:
+            lane = self._fast_actor_lanes.get(actor_id)
+            if (not self._actor_queues.get(actor_id)
+                    and not self._actor_inflight.get(actor_id)
+                    and not (lane is not None and lane.inflight)):
+                break
+            await asyncio.sleep(0.05)
+        with self._rc_lock:
+            if (self._closed
+                    or self._actor_handle_counts.get(actor_id, 0) > 0
+                    or actor_id in self._actor_no_autokill):
+                return
+            self._actor_handle_counts.pop(actor_id, None)
+        try:
+            await self.gcs.call("kill_actor", {"actor_id": actor_id,
+                                               "no_restart": True})
+        except Exception:
+            log.debug("autokill of actor %s failed", actor_id.hex(),
+                      exc_info=True)
+
     def create_actor(self, cls, args, kwargs, **opts) -> ActorHandle:
         spec = self._build_actor_spec(cls, args, kwargs, **opts)
+        self._seed_autokill(spec)
         if _in_loop(self.loop):
             # Called from the event loop (e.g. an async actor creating other
             # actors): can't block. The actor_id is chosen client-side, so
@@ -4382,6 +4478,7 @@ class CoreClient:
     async def create_actor_async(self, cls, args, kwargs, **opts) -> ActorHandle:
         """Event-loop-safe actor creation (supports get_if_exists)."""
         spec = self._build_actor_spec(cls, args, kwargs, **opts)
+        self._seed_autokill(spec)
         view = await self._register_actor(spec)
         return ActorHandle(view["actor_id"], core=self,
                            options=_handle_options(spec))

@@ -331,6 +331,13 @@ class GcsServer:
         # ns="latency" retention (satellite): last-touch stamps per key;
         # the health loop sweeps entries dead publishers left behind
         self._latency_touched: dict[str, float] = {}
+        # timeseries rollup plane (core/metrics_store.py): every
+        # ns="metrics" snapshot put folds into ring-buffered 1s/10s/60s
+        # windows here, so metric_window/prometheus rates read history
+        # instead of the latest value. Volatile like the snapshots.
+        from ray_tpu.core.metrics_store import RollupStore
+
+        self.rollups = RollupStore()
 
         # pubsub: channel -> {Connection}
         self.subs: dict[str, set[rpc.Connection]] = {}
@@ -395,6 +402,14 @@ class GcsServer:
         ok = self.kvstore.put(ns, p["key"], p["value"],
                               overwrite=p.get("overwrite", True),
                               journal=journal)
+        if ns == "metrics":
+            # rollup ingest rides the same put the snapshot already
+            # pays for (worker hex / raylet.<node> keys); a malformed
+            # blob must not fail the kv write it piggybacks on
+            try:
+                self.rollups.ingest(p["key"], pickle.loads(p["value"]))
+            except Exception:
+                log.debug("metric rollup ingest failed", exc_info=True)
         if ns == "latency":  # retention clock (see _latency_sweep)
             self._latency_touched[p["key"]] = time.monotonic()
         self.mark_dirty()
@@ -413,6 +428,23 @@ class GcsServer:
         self.mark_dirty()
         await self._commit_barrier()
         return ok
+
+    # ------------------------------------------------------- metric rollups
+    async def rpc_metric_window(self, conn, p):
+        """Windowed rate/quantile series from the rollup plane (since
+        2.2): ``{name, type, res, points}`` — see RollupStore.window."""
+        return self.rollups.window(p["name"], float(p.get("secs", 60.0)),
+                                   tags=p.get("tags"))
+
+    async def rpc_metric_names(self, conn, p):
+        """Every metric the rollup plane has seen plus the derived
+        ratio series it computes (since 2.2)."""
+        return self.rollups.names()
+
+    async def rpc_metric_export(self, conn, p):
+        """Trailing per-tag counter rates + ratio values (since 2.2) —
+        the prometheus ``:rate<secs>s`` family feed."""
+        return self.rollups.export_rates(float(p.get("secs", 10.0)))
 
     async def _commit_barrier(self):
         """Group commit (cfg.gcs_fsync off = no-op): hold this journaled
@@ -1400,6 +1432,8 @@ class GcsServer:
             try:
                 self.kvstore.put("metrics", "gcs", pickle.dumps(snap),
                                  overwrite=True, journal=False)
+                # direct kvstore puts bypass rpc_kv_put's rollup hook
+                self.rollups.ingest("gcs", snap)
             except Exception:
                 log.debug("trace metrics publish failed", exc_info=True)
 

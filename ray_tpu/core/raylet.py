@@ -393,6 +393,18 @@ class Raylet:
         self._freed_while_spilling: set[ObjectID] = set()
         self._spill_failed_at: dict[ObjectID, float] = {}
         self._spill_fail_n: dict[ObjectID, int] = {}  # consecutive failures
+        # observability plane: object-store watermark history (the spill
+        # trigger reads the recent PEAK, not one instant) plus lease
+        # lifecycle cumulatives, both published as a hand-rolled snapshot
+        # under ns="metrics" key raylet.<node> — never the process-global
+        # registry, which an in-process topology shares with the driver
+        # (same double-count hazard as the GCS's _trace_metrics_tick)
+        from ray_tpu.core.metrics_store import WatermarkTracker
+
+        self._store_watermark = WatermarkTracker()
+        self._lease_stats = {"granted": 0, "returned": 0,
+                             "owner_disconnect": 0, "worker_death": 0}
+        self._metrics_published_at = 0.0
         base = self.cfg.object_spilling_dir or os.path.join(
             self.cfg.temp_dir, f"session_{self.session}", "spill")
         self.spill_dir = os.path.join(base, self.node_id.hex()[:12])
@@ -626,6 +638,7 @@ class Raylet:
             self.idle_workers.remove(w)
         if w.lease_id is not None and w.lease_id in self.leases:
             lease = self.leases.pop(w.lease_id)
+            self._lease_stats["worker_death"] += 1
             self._free_lease_resources(lease)
             self._grant_waiters()
         await self._report_worker_death(w)
@@ -931,6 +944,7 @@ class Raylet:
         # worker we just handed out.
         owner_conn = conn if p.get("owner_bound") else None
         self.leases[lease_id] = Lease(lease_id, resources, w, pg_key, owner_conn, tpu_chips)
+        self._lease_stats["granted"] += 1
         return {
             "granted": True,
             "lease_id": lease_id,
@@ -1140,6 +1154,7 @@ class Raylet:
         dead = [l for l in self.leases.values() if l.owner_conn is conn]
         for lease in dead:
             self.leases.pop(lease.lease_id, None)
+            self._lease_stats["owner_disconnect"] += 1
             self._free_lease_resources(lease)
             w = lease.worker
             w.lease_id = None
@@ -1191,6 +1206,7 @@ class Raylet:
         lease = self.leases.pop(p["lease_id"], None)
         if lease is None:
             return False
+        self._lease_stats["returned"] += 1
         self._free_lease_resources(lease)
         w = lease.worker
         w.lease_id = None
@@ -1310,14 +1326,54 @@ class Raylet:
     async def _spill_monitor_loop(self):
         while not self._stopping:
             try:
-                usage = self.store.bytes_in_use / max(1, self.store.capacity)
+                # watermark first: the spill trigger reads the recent
+                # PEAK (1s of history) instead of whatever instant this
+                # tick sampled — a burst that allocated and briefly
+                # dipped still crosses the threshold
+                self._store_watermark.note(self.store.bytes_in_use)
+                peak = self._store_watermark.recent_peak(1.0)
+                usage = peak / max(1, self.store.capacity)
                 if usage >= self.cfg.object_spilling_threshold:
                     await self._spill_until_low_water()
+                await self._publish_raylet_metrics()
             except Exception:
                 if self._stopping:  # executor torn down mid-pass
                     return
                 traceback.print_exc()
             await asyncio.sleep(0.2)
+
+    async def _publish_raylet_metrics(self):
+        """~1/s hand-rolled snapshot into ns="metrics" (key
+        raylet.<node>): object-store watermarks + lease lifecycle
+        counters. Hand-rolled cells, NOT the process registry — the
+        in-process topology shares that registry with the driver whose
+        flush already publishes it (see _trace_metrics_tick in gcs.py
+        for the same idiom)."""
+        now = time.monotonic()
+        if now - self._metrics_published_at < 1.0 or self.gcs is None:
+            return
+        self._metrics_published_at = now
+        wm = self._store_watermark
+        tags = {"arena": "object_store"}
+        snap = {"metrics": {
+            "rt_arena_bytes": {"type": "gauge", "samples": [
+                {"tags": tags, "value": float(wm.live)}]},
+            "rt_arena_peak_bytes": {"type": "gauge", "samples": [
+                {"tags": tags, "value": float(wm.peak)}]},
+            "rt_arena_capacity_bytes": {"type": "gauge", "samples": [
+                {"tags": tags, "value": float(self.store.capacity)}]},
+            "rt_leases_active": {"type": "gauge", "samples": [
+                {"tags": {}, "value": float(len(self.leases))}]},
+            "rt_lease_events_total": {"type": "counter", "samples": [
+                {"tags": {"event": k}, "value": float(v)}
+                for k, v in self._lease_stats.items()]},
+        }}
+        try:
+            await self.gcs.call("kv_put", {
+                "ns": "metrics", "key": f"raylet.{self.node_id.hex()}",
+                "value": pickle.dumps(snap)})
+        except Exception:
+            log.debug("raylet metrics publish failed", exc_info=True)
 
     async def rpc_spill_now(self, conn, p):
         """Synchronous spill pass — pressured putters call this before a

@@ -219,6 +219,15 @@ class ActorHandle:
         self._core = core
         self._method_names = method_names
         self._options = options or {}
+        # owner-local handle refcount (core_client autokill): only
+        # handles of unnamed actors the creating driver enrolled count;
+        # at zero the core kills the actor so its lease returns
+        self._counted = False
+        if core is not None:
+            try:
+                self._counted = core.note_actor_handle_created(actor_id)
+            except AttributeError:
+                pass
 
     @property
     def actor_id(self) -> ActorID:
@@ -237,7 +246,21 @@ class ActorHandle:
         return m
 
     def __reduce__(self):
+        if self._counted:
+            try:
+                # a shipped handle may outlive every local one: the
+                # actor is permanently exempt from autokill
+                self._core.note_actor_handle_shipped(self._actor_id)
+            except Exception:  # raylint: disable=RT012 — __reduce__ during teardown must never raise
+                pass
         return (_rebuild_actor_handle, (self._actor_id, self._method_names, self._options))
+
+    def __del__(self):
+        if self._counted:
+            try:
+                self._core.note_actor_handle_dropped(self._actor_id)
+            except Exception:  # raylint: disable=RT012 — __del__ may run at interpreter exit
+                pass
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:12]})"

@@ -39,6 +39,12 @@ _providers: dict[str, Provider] = {}
 # spilled-notification sinks: name -> (oid_binary, path) -> None
 _sinks: dict[str, Callable[[bytes, str], None]] = {}
 _attached: set[int] = set()  # id(core) of clients already raylet-registered
+# arena byte accounting (observability plane): name -> () -> {"bytes": n,
+# "capacity": n | 0}. Sampled on the core client's flush timer into the
+# rt_arena_* gauges; peaks tracked per arena so watermark HISTORY (not an
+# instantaneous read) reaches the rollup plane and the dashboard.
+_stats: dict[str, Callable[[], dict]] = {}
+_watermarks: dict[str, "object"] = {}  # name -> WatermarkTracker
 
 
 def register_arena_owner(name: str, provider: Provider,
@@ -59,6 +65,49 @@ def unregister_arena_owner(name: str) -> None:
     with _lock:
         _providers.pop(name, None)
         _sinks.pop(name, None)
+        _stats.pop(name, None)
+        _watermarks.pop(name, None)
+
+
+def register_arena_stats(name: str,
+                         stats: Callable[[], dict]) -> None:
+    """Register a byte-accounting callback for arena ``name``:
+    ``() -> {"bytes": live, "capacity": total | 0}``. Idempotent; the
+    arena's watermark tracker starts fresh on (re)registration."""
+    from ray_tpu.core.metrics_store import WatermarkTracker
+
+    with _lock:
+        _stats[name] = stats
+        _watermarks[name] = WatermarkTracker()
+
+
+def sample_arenas(now: float | None = None) -> dict[str, dict]:
+    """Sample every registered arena's live bytes into its watermark
+    tracker and return ``{name: {bytes, peak, recent_peak, capacity}}``.
+    Called from the core client's 1/s flush (gauge publish) and usable
+    anywhere history beats an instantaneous read. A failing provider is
+    skipped, never raised."""
+    with _lock:
+        items = [(n, _stats[n], _watermarks[n]) for n in _stats]
+    out = {}
+    for name, fn, wm in items:
+        try:
+            st = fn() or {}
+            wm.note(float(st.get("bytes", 0)), now)
+        except Exception:
+            log.debug("arena stats provider %s failed", name, exc_info=True)
+            continue
+        out[name] = {"bytes": wm.live, "peak": wm.peak,
+                     "recent_peak": wm.recent_peak(10.0, now),
+                     "capacity": float(st.get("capacity", 0) or 0)}
+    return out
+
+
+def arena_watermark(name: str):
+    """The arena's WatermarkTracker (None when unregistered) — spill
+    policy and tests read peak history through this."""
+    with _lock:
+        return _watermarks.get(name)
 
 
 def collect_candidates(need: int, cold_after_s: float) -> list[dict]:
@@ -127,6 +176,8 @@ def _reset_for_tests() -> None:
         _providers.clear()
         _sinks.clear()
         _attached.clear()
+        _stats.clear()
+        _watermarks.clear()
 
 
 class ColdTracker:
@@ -143,6 +194,20 @@ class ColdTracker:
         # oid binary -> (ts, nbytes, weakref(entry))
         self._items: dict[bytes, tuple] = {}
         register_arena_owner(name, self.candidates, self.on_spilled)
+        register_arena_stats(name, lambda: {"bytes": self.total_bytes()})
+
+    def total_bytes(self) -> int:
+        """Tier-0 bytes this plane still holds referenced (dead entries
+        and already-spilled ones don't count against the arena)."""
+        total = 0
+        with self._lock:
+            items = list(self._items.values())
+        for _ts, nbytes, eref in items:
+            entry = eref()
+            if entry is not None and \
+                    getattr(entry, "tier", TIER_SHM) == TIER_SHM:
+                total += nbytes
+        return total
 
     def track(self, oid: bytes, nbytes: int, entry) -> None:
         with self._lock:

@@ -20,6 +20,8 @@ hash-routed JS app from ``dashboard_client/``, no build step):
     GET /api/traces            assembled request traces (newest first)
     GET /api/trace/{id}        one trace as a waterfall + critical path
     GET /api/metrics           aggregated cluster metrics
+    GET /api/metric_window     rollup timeseries (?name=&secs=&tag.k=v)
+    GET /api/metric_names      metric names known to the rollup store
     GET /api/timeline          chrome-trace events (load into perfetto)
     GET /api/latency           flight-recorder per-stage task latency
     GET /api/llm               LLM decode-plane panel (disagg stages + spec gauges)
@@ -122,6 +124,33 @@ def build_app():
         return web.Response(text=text, content_type="text/plain")
 
     app.router.add_get("/metrics", prometheus)
+
+    async def metric_window(request):
+        # rollup-plane timeseries: windowed points for one metric from
+        # the GCS RollupStore (counters as rates, histograms as
+        # mergeable quantiles, ratios as num/den) — the same series the
+        # control loops (SLO monitor, spill trigger) read
+        import asyncio
+
+        name = request.query.get("name")
+        if not name:
+            return web.json_response({"error": "name required"}, status=400)
+        tags = None
+        for k, v in request.query.items():
+            if k.startswith("tag."):
+                tags = dict(tags or {})
+                tags[k[4:]] = v
+        try:
+            win = await asyncio.to_thread(
+                state.metric_window, name,
+                float(request.query.get("secs", 60.0)), tags)
+            return web.json_response(_plain(win))
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=503)
+
+    app.router.add_get("/api/metric_window", metric_window)
+    app.router.add_get(
+        "/api/metric_names", _json(lambda: _plain(state.metric_names())))
     app.router.add_get("/api/timeline", _json(lambda: state.timeline()))
     # flight-recorder surfaces: per-stage latency percentiles and worker
     # postmortems (see utils/recorder.py, state.list_task_latency)

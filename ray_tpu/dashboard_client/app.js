@@ -309,25 +309,61 @@ views.serve = async () => {
   return h;
 };
 
+// server-side rollup history (GCS RollupStore): name -> [values].
+// Counters plot their per-second rate, histograms their p99, gauges and
+// derived ratios the value — 120s of real history, survives page loads.
+async function rollupSeries() {
+  let names;
+  try { names = await fetchJSON("/api/metric_names"); } catch (e) { return {}; }
+  const out = {};
+  await Promise.all((names || []).map(async (name) => {
+    try {
+      const win = await fetchJSON(
+        "/api/metric_window?name=" + encodeURIComponent(name) + "&secs=120");
+      const pts = win.points || [];
+      if (!pts.length) return;
+      out[name] = pts.map((p) =>
+        win.type === "counter" ? p.rate :
+        win.type === "histogram" ? (p.p99 ?? p.rate) : p.value);
+    } catch (e) { /* name raced retention */ }
+  }));
+  return out;
+}
+
 views.metrics = async () => {
   const metrics = latestMetrics;  // render() preamble already fetched it
+  const series = await rollupSeries();
   let h = `<h1>Metrics</h1>
-    <div class="muted-note">sparklines accumulate client-side while this page is open ·
+    <div class="muted-note">sparklines are server history from the GCS rollup store
+    (counters as rate/s, histograms as p99) ·
     <a class="inline" href="/metrics" target="_blank">prometheus endpoint</a></div>`;
+  // derived ratio series (accept rate, SLO breach fraction) have no
+  // registry sample — surface them first, straight from the rollups
+  const sampled = new Set(Object.keys(metrics));
+  for (const [name, vals] of Object.entries(series)) {
+    if (sampled.has(name)) continue;
+    const last = vals[vals.length - 1];
+    h += `<div><span class="dim" style="display:inline-block;width:360px">${esc(name)} <span class="dim">(derived)</span></span>
+      <span style="display:inline-block;width:120px">${esc(typeof last === "number" ? +last.toFixed(3) : last)}</span>
+      ${spark(vals)}</div>`;
+  }
   for (const [name, m] of Object.entries(metrics)) {
     if (m.type === "histogram") {
       h += `<h2>${esc(name)} <span class="dim">(histogram)</span></h2>`;
       for (const [tag, hist] of metricSamples(m)) {
         const count = (hist.counts || []).reduce((a, b) => a + b, 0);
-        h += `<div class="dim">${tag ? esc(tag) + " " : ""}count=${count} sum=${hist.sum ?? ""}</div>`;
+        h += `<div class="dim">${tag ? esc(tag) + " " : ""}count=${count} sum=${hist.sum ?? ""} ${spark(series[name])}</div>`;
       }
       continue;
     }
     for (const [tag, s] of metricSamples(m)) {
       const v = s.value;
+      // rollup series are summed across tags; show it on the first
+      // (untagged or sole) sample row, client history otherwise
+      const sv = tag ? history[name + "|" + tag] : series[name] || history[name + "|" + tag];
       h += `<div><span class="dim" style="display:inline-block;width:360px">${esc(name)}${tag ? " " + esc(tag) : ""}</span>
         <span style="display:inline-block;width:120px">${esc(typeof v === "number" ? +v.toFixed(3) : v)}</span>
-        ${spark(history[name + "|" + tag])}</div>`;
+        ${spark(sv)}</div>`;
     }
   }
   return h;

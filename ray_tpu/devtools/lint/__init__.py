@@ -15,6 +15,7 @@ Rules (see `ray_tpu lint --rules` for rationale):
   RT008 time.sleep in a remote task without max_retries
   ...
   RT018 wire prefix/flag literal absent from the schema catalog
+  RT019 metric constructed inside a hot-path root function
 
 The interprocedural pass (`ray_tpu lint --flow`, flow.py) adds
 RT020-RT023: it builds a package-wide call graph, infers per-function

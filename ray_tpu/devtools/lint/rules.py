@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT018 + flow-rule registrations RT020-RT023.
+"""raylint rules RT001-RT019 + flow-rule registrations RT020-RT023.
 
 Each AST rule is a Rule subclass registered with @register; hooks
 receive (node, ctx) from the engine's single AST walk. See
@@ -404,6 +404,40 @@ class MetricConstructedPerCall(Rule):
                        "re-registers in the global metrics registry every "
                        "call (accumulated values silently reset); hoist "
                        "the metric to module level")
+
+
+@register
+class MetricConstructedOnHotPath(Rule):
+    id = "RT019"
+    summary = "Counter/Gauge/Histogram constructed inside a hot-path root function"
+    rationale = ("the rollup plane's per-task budget (<1µs, the "
+                 "metrics_overhead_us bench arm) assumes hot paths only "
+                 "touch pre-built metric cells; constructing a metric "
+                 "inside a fast-lane pump, tunnel exec path, or serve "
+                 "handler takes the registry lock and churns the name "
+                 "table once per record — RT011's per-call class, but on "
+                 "the paths where it costs throughput, caught without "
+                 "the --flow pass")
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        name = ctx.func_name
+        if name is None:
+            return
+        from ray_tpu.devtools.lint.effects import NAMED_ROOTS
+
+        root_kind = NAMED_ROOTS.get(name)
+        if root_kind is None:
+            return
+        origin = ctx.imports.resolve(node.func)
+        if (origin and origin[0] == "ray_tpu"
+                and origin[-1] in _METRIC_CTORS
+                and "metrics" in origin[:-1]):
+            ctx.report(self, node,
+                       f"{origin[-1]}(...) constructed inside {name}() — a "
+                       f"{root_kind} root: metrics are module-level "
+                       "singletons; hot paths must only inc()/observe() "
+                       "pre-built cells (per-record construction blows the "
+                       "<1µs/task metrics budget)")
 
 
 _SHARDED_PRODUCERS = {"put_sharded", "reshard"}

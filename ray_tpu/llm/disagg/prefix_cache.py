@@ -133,6 +133,24 @@ class PrefixCache:
             tiering.register_arena_owner(self._owner_name, _provider,
                                          on_spilled=_sink)
 
+            def _stats(_r=ref):
+                c = _r()
+                if c is None:
+                    return {}
+                return {"bytes": c.bytes, "capacity": c.capacity_bytes}
+
+            def _tier1_stats(_r=ref):
+                c = _r()
+                if c is None:
+                    return {}
+                return {"bytes": c.tier1_bytes,
+                        "capacity": c.tier1_capacity_bytes}
+
+            # byte ledgers for the watermark plane: shm-resident and
+            # tier-1 arenas report separately (they fill independently)
+            tiering.register_arena_stats("prefix_cache", _stats)
+            tiering.register_arena_stats("prefix_cache_tier1", _tier1_stats)
+
     # -------------------------------------------------------------- write
     def insert(self, manifest: KVPageManifest) -> int:
         """Cache a manifest's FULL pages (the shareable span; a ragged

@@ -609,6 +609,16 @@ class DisaggLLMServer:
                 log.debug("share-group deregister failed", exc_info=True)
         refs = [w.stop.remote() for w in self.decode_pool]
         await asyncio.gather(*refs, return_exceptions=True)
+        # release the pool leases NOW: explicit kills instead of waiting
+        # for handle GC (shutdown is the one place we know no more calls
+        # are coming), so a replaced/redeployed replica's fresh pools
+        # never contend with the old pools' still-leased CPUs
+        for w in (*self.prefill_pool, *self.decode_pool):
+            try:
+                await self._gcs("kill_actor", {
+                    "actor_id": w.actor_id, "no_restart": True})
+            except Exception:
+                log.debug("pool actor kill failed", exc_info=True)
 
 
 def build_disagg_deployment(model_config, *, params=None, params_fn=None,

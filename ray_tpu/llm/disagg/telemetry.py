@@ -173,6 +173,11 @@ def publish_decode_signals(engine) -> None:
         record(TOKENS_PER_STEP, emitted * 1000 // max(1, n_steps))
         if proposed:
             record(SPEC_ACCEPT, accepted * 1_000_000 // proposed)
+            # monotonic cumulatives for the rollup plane: the GCS
+            # derives the windowed llm_spec_accept_rate series
+            # (state.metric_window) from these two counters' deltas
+            metrics.llm_spec_proposed_total.inc(proposed)
+            metrics.llm_spec_accepted_total.inc(accepted)
         count(spec_proposed=proposed, spec_accepted=accepted,
               spec_steps=n_steps, spec_tokens=emitted)
     metrics.llm_decode_tokens_in_flight.set(engine.tokens_in_flight())

@@ -554,12 +554,9 @@ class ServeController:
         slo_ms = getattr(cfg, "latency_slo_ms", None)
         if slo_ms is None:
             return
-        await self._refresh_p99()  # also refreshes _lat_windows
-        window = self._lat_windows.get(st.key)
-        if not window:
+        breach = await self._breach_fraction(st, float(slo_ms))
+        if breach is None:
             return
-        slo_ns = float(slo_ms) * 1e6
-        breach = sum(1 for v in window if v > slo_ns) / len(window)
         self._slo_monitor.observe(st.key, breach)
         alert = self._slo_monitor.check(st.key, float(slo_ms))
         if alert is None:
@@ -581,6 +578,38 @@ class ServeController:
 
             logging.getLogger(__name__).debug(
                 "slo burn publish failed", exc_info=True)
+
+    async def _breach_fraction(self, st: _DeploymentState,
+                               slo_ms: float) -> float | None:
+        """This deployment's SLO breach fraction over the recent window.
+
+        Primary source: the GCS rollup plane's derived
+        ``serve_slo_breach_fraction`` ratio (replica-side breach/request
+        counter deltas — the control loop reads its own published
+        history, the same windows ``state.metric_window`` serves).
+        Fallback: the raw ns="latency" windows (replicas that predate
+        the counters, or a rollup plane with no points yet)."""
+        from ray_tpu.core.api import get_core
+
+        try:
+            win = await get_core().gcs.call("metric_window", {
+                "name": "serve_slo_breach_fraction", "secs": 30.0,
+                "tags": {"key": st.key}})
+            pts = (win or {}).get("points") or []
+            den = sum(p["den"] for p in pts)
+            if den > 0:
+                return sum(p["num"] for p in pts) / den
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).debug(
+                "rollup breach-fraction fetch failed", exc_info=True)
+        await self._refresh_p99()  # also refreshes _lat_windows
+        window = self._lat_windows.get(st.key)
+        if not window:
+            return None
+        slo_ns = slo_ms * 1e6
+        return sum(1 for v in window if v > slo_ns) / len(window)
 
     async def get_slo_burn_events(self, key: str | None = None) -> list[dict]:
         """Bounded history of fired burn-rate alerts (newest last)."""

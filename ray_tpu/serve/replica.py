@@ -74,7 +74,8 @@ _lat_pending = -1
 _lat_registered = False
 
 
-def _record_request_latency(key: str, dur_ns: int) -> None:
+def _record_request_latency(key: str, dur_ns: int,
+                            slo_ns: float | None = None) -> None:
     global _lat_count
     with _lat_lock:
         win = _lat_windows.get(key)
@@ -82,6 +83,15 @@ def _record_request_latency(key: str, dur_ns: int) -> None:
             win = _lat_windows[key] = collections.deque(maxlen=_LAT_WINDOW)
         win.append((time.time(), dur_ns))
         _lat_count += 1
+    # monotonic cumulatives for the rollup plane: the controller's burn
+    # monitor reads the windowed serve_slo_breach_fraction ratio
+    # (breaches_delta / requests_delta) instead of re-deriving breach
+    # fractions from raw latency windows each tick
+    from ray_tpu.utils import metrics
+
+    metrics.serve_requests_total.inc(tags={"key": key})
+    if slo_ns is not None and dur_ns > slo_ns:
+        metrics.serve_slo_breaches_total.inc(tags={"key": key})
 
 
 def _serve_latency_snapshot():
@@ -157,6 +167,8 @@ class Replica:
         self.max_ongoing_requests = max_ongoing_requests
         self.max_queued_requests = max_queued_requests
         self.latency_slo_ms = latency_slo_ms
+        self._slo_ns = (None if latency_slo_ms is None
+                        else float(latency_slo_ms) * 1e6)
         self._lat_key = f"{app_name}/{deployment_name}"
         self._admission = AdmissionController(max_ongoing_requests)
         self._ongoing = 0
@@ -333,7 +345,8 @@ class Replica:
                     # (queue + exec) sample feeds the "serve" latency
                     # window the SLO autoscaler reads its p99 from
                     self._admission.observe_exec((done - t_exec) / 1e9)
-                    _record_request_latency(self._lat_key, done - t_arrival)
+                    _record_request_latency(self._lat_key, done - t_arrival,
+                                            self._slo_ns)
         finally:
             if not dequeued:  # cancelled while waiting on the gate
                 self._queued -= 1

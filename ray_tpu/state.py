@@ -303,28 +303,6 @@ def summary_tasks() -> dict:
 
 
 # ------------------------------------------------------------------- metrics
-def _snapshot_samples(m: dict) -> list[dict]:
-    """A metric's samples as [{tags, ...}], accepting both the structured
-    1.7 snapshot format ("samples") and the pre-1.7 one ("values" keyed
-    by str(tuple(sorted(tags.items()))) — readable during rollover so a
-    mixed-version cluster still aggregates)."""
-    if "samples" in m:
-        return m["samples"]
-    import ast
-
-    out = []
-    for tag_key, v in m.get("values", {}).items():
-        try:
-            tags = dict(ast.literal_eval(tag_key) or ())
-        except (ValueError, SyntaxError):
-            tags = {}
-        if isinstance(v, dict):  # old-format histogram cell
-            out.append({"tags": tags, **v})
-        else:
-            out.append({"tags": tags, "value": v})
-    return out
-
-
 def cluster_metrics() -> dict[str, Any]:
     """Aggregate the per-process metric snapshots pushed to the GCS KV.
 
@@ -345,7 +323,9 @@ def cluster_metrics() -> dict[str, Any]:
             if "boundaries" in m:
                 slot.setdefault("boundaries", m["boundaries"])
             cells = merged.setdefault(name, {})
-            for s in _snapshot_samples(m):
+            # structured samples only: the pre-1.7 stringified-tag
+            # "values" format is gone (rollups never consumed it)
+            for s in m.get("samples", []):
                 tkey = tuple(sorted(s.get("tags", {}).items()))
                 if m["type"] == "counter":
                     cell = cells.setdefault(tkey, {"value": 0.0})
@@ -363,6 +343,33 @@ def cluster_metrics() -> dict[str, Any]:
         agg[name]["samples"] = [{"tags": dict(tkey), **cell}
                                 for tkey, cell in cells.items()]
     return agg
+
+
+def metric_window(name: str, secs: float = 60.0,
+                  tags: dict | None = None) -> dict:
+    """Windowed history for one metric from the GCS rollup plane
+    (core/metrics_store.py): ``{name, type, res, points}`` with one
+    point per non-empty slot, oldest first, at the finest rollup
+    resolution (1s/10s/60s) whose retention covers ``secs``.
+
+    Counter points carry ``value`` (the slot's delta) and ``rate``
+    (delta/resolution — restart-safe: a worker restart clamps to >= 0,
+    never a negative rate). Histogram points carry ``count``/``sum``/
+    ``rate`` plus merged-bucket ``p50``/``p90``/``p99``. Gauge points
+    carry ``value`` summed across sources and tag cells (pass ``tags``
+    to read one cell, e.g. ``tags={"arena": "prefix_cache"}``). Derived
+    ratio series (``llm_spec_accept_rate``, ``serve_slo_breach_
+    fraction``) are computed slot-by-slot from their numerator/
+    denominator counter deltas — the same windows ``SLOBurnMonitor``
+    and the drafter auto-selector consume."""
+    return _call("metric_window", {"name": name, "secs": secs,
+                                   "tags": tags})
+
+
+def metric_names() -> list[dict]:
+    """Every metric the rollup plane has seen (``[{name, type}]``) plus
+    the derived ratio series it computes."""
+    return _call("metric_names")
 
 
 def prometheus_metrics() -> str:
@@ -406,6 +413,20 @@ def prometheus_metrics() -> str:
                     f"{pname}_bucket{labels(s['tags'], extra)} {cum}")
             lines.append(f"{pname}_sum{labels(s['tags'])} {s['sum']}")
             lines.append(f"{pname}_count{labels(s['tags'])} {cum}")
+    # rate families from the rollup plane: one :rate10s gauge per
+    # counter tag cell plus the derived ratio series, so a scraper gets
+    # correctly-windowed rates without PromQL over raw cumulatives
+    try:
+        exported = _call("metric_export", {"secs": 10.0})
+    except Exception:
+        exported = {}
+    for name, m in sorted(exported.items()):
+        pname = name.replace(".", "_").replace("-", "_")
+        if not pname.startswith("rt_"):
+            pname = "rt_" + pname
+        lines.append(f"# TYPE {pname}:rate10s gauge")
+        for s in m.get("samples", []):
+            lines.append(f"{pname}:rate10s{labels(s['tags'])} {s['rate']}")
     return "\n".join(lines) + "\n"
 
 
@@ -471,7 +492,8 @@ def list_llm_metrics() -> dict:
 _TIERING_STAGES = ("spill", "restore")
 _TIERING_GAUGES = ("rt_spill_bytes_total", "rt_restore_bytes_total",
                    "rt_tier1_hit_rate", "rt_objects_spilled",
-                   "rt_objects_restored")
+                   "rt_objects_restored", "rt_arena_bytes",
+                   "rt_arena_peak_bytes", "rt_arena_capacity_bytes")
 
 
 def list_tiering() -> dict:

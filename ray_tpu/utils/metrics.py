@@ -12,9 +12,10 @@ Snapshot format: each metric exports structured ``samples`` —
 ``{"tags": {...}, "value": v}`` (counters/gauges) or
 ``{"tags": {...}, "counts": [...], "sum": s}`` (histograms) — so
 ``state.prometheus_metrics()`` can emit real labels without reparsing
-stringified tag tuples. ``state.cluster_metrics`` still reads the
-pre-1.7 ``values`` format (keys were ``str(tuple(sorted(tags)))``)
-during rollover.
+stringified tag tuples, and the GCS rollup plane
+(``core/metrics_store.py``) can window counter deltas and merge
+histogram buckets across sources. Counters are monotonic cumulatives on
+the wire; rates live GCS-side (``state.metric_window``), never here.
 """
 from __future__ import annotations
 
@@ -154,6 +155,18 @@ restore_bytes_total = Counter("rt_restore_bytes_total",
                               "bytes restored from tier-1 into shm arenas")
 tier1_hit_rate = Gauge("rt_tier1_hit_rate",
                        "fraction of prefix-cache hits served from tier-1")
+# arena watermarks (rollup plane): live/peak/capacity bytes per arena the
+# tiering registry knows (core/tiering.py stats providers — prefix cache,
+# shard plane, KV staging; the raylet hand-rolls the object_store cells
+# into its own snapshot). Set at flush time from sample_arenas().
+arena_bytes = Gauge("rt_arena_bytes", "live bytes in a tiering arena",
+                    tag_keys=("arena",))
+arena_peak_bytes = Gauge("rt_arena_peak_bytes",
+                         "high-water bytes a tiering arena has held",
+                         tag_keys=("arena",))
+arena_capacity_bytes = Gauge("rt_arena_capacity_bytes",
+                             "configured capacity of a tiering arena",
+                             tag_keys=("arena",))
 task_exec_seconds = Histogram("rt_task_exec_seconds", "worker-side task execution time")
 
 # --- flight-recorder families (PR 4; see utils/recorder.py) -----------------
@@ -185,6 +198,24 @@ llm_spec_accept_rate = Gauge(
 llm_tokens_per_step = Gauge(
     "rt_llm_tokens_per_step",
     "tokens emitted per fused decode step (recent-block mean)")
+# monotonic spec-decode cumulatives: the rollup plane's derived
+# llm_spec_accept_rate series is accepted/proposed per window slot —
+# restart-safe and windowable, unlike the lifetime-ratio gauge above
+llm_spec_proposed_total = Counter(
+    "rt_llm_spec_proposed_total",
+    "draft tokens proposed to the fused spec-decode verify")
+llm_spec_accepted_total = Counter(
+    "rt_llm_spec_accepted_total",
+    "draft tokens the fused spec-decode verify accepted")
+# serve SLO cumulatives: serve_slo_breach_fraction = breaches/requests
+# per window slot (boundary-free, unlike bucketing latencies at the SLO)
+serve_requests_total = Counter(
+    "rt_serve_requests_total", "serve requests completed by a replica",
+    tag_keys=("key",))
+serve_slo_breaches_total = Counter(
+    "rt_serve_slo_breaches_total",
+    "serve requests that finished over their deployment's latency SLO",
+    tag_keys=("key",))
 # NOTE: rt_request_critical_path_us (the GCS trace assembler's per-stage
 # request-latency histogram) is deliberately NOT declared here: the GCS
 # hand-rolls its cells (core/gcs.py _trace_metrics_tick) because an

@@ -47,6 +47,12 @@ from __future__ import annotations
 # below (RECORD_PREFIXES / RECORD_FLAGS) is machine-checked against
 # _native/src/rt_wire.h so a shipped-but-uncataloged wire entry fails
 # tier-1 (PRs 10/11 both shipped one).
+# 2.2: metric rollup queries. The GCS folds every ns="metrics" snapshot
+# put into ring-buffered 1s/10s/60s windows (core/metrics_store.py) and
+# serves them back: metric_window (rate/quantile series over trailing
+# secs), metric_names (everything the rollup plane has seen + derived
+# ratio series), metric_export (trailing counter rates, the prometheus
+# :rate family feed). No record-plane changes.
 # 2.1: wire-level trace context (Dapper-style — utils/tracing.py).
 # "Q"/"R"/"A"/"C" records may carry a 25-byte trace leg
 # (<16s trace_id><8s span_id><u8 sampled>) behind their header, flagged
@@ -57,7 +63,7 @@ from __future__ import annotations
 # fast-lane calls without a lookup. Unsampled records are byte-identical
 # to 2.0 ones. Also: GCS get_trace / list_traces (the trace assembler),
 # get_task_events limit/offset/span_only pagination.
-PROTOCOL_VERSION = (2, 1)
+PROTOCOL_VERSION = (2, 2)
 
 # ------------------------------------------------------ fastpath records
 # Every record prefix byte and reply-status flag the shm rings / node
@@ -150,6 +156,23 @@ CATALOG: dict[str, dict[str, dict]] = {
             "->": "[{trace_id, root_name, start_ts, dur_ms, n_spans, "
                   "procs, sealed}] — slow-trace retention keeps the p99 "
                   "outliers past the table cap"}},
+        "metric_window": {"since": (2, 2), "fields": {
+            "name": "metric or derived-ratio name (rt_* / "
+                    "llm_spec_accept_rate / serve_slo_breach_fraction)",
+            "secs": "trailing window length; picks the finest rollup "
+                    "resolution (1s/10s/60s) whose retention covers it",
+            "tags": "dict | None — exact tag-cell filter (default: "
+                    "aggregate across cells)",
+            "->": "{name, type, res, points: [{ts, ...}]} — counter "
+                  "points carry value/rate, histograms count/sum/rate/"
+                  "p50/p90/p99, ratios value/num/den (RollupStore.window)"}},
+        "metric_names": {"since": (2, 2), "fields": {
+            "->": "[{name, type}] — every metric the rollup plane has "
+                  "seen plus its derived ratio series"}},
+        "metric_export": {"since": (2, 2), "fields": {
+            "secs": "trailing rate window (default 10)",
+            "->": "{name: {type, samples: [{tags, rate}]}} — the "
+                  "prometheus :rate<secs>s family feed"}},
     },
     # -------------------------------------------------------------- raylet
     # (ref: node_manager.proto NodeManagerService)

@@ -1,0 +1,63 @@
+"""RT019 fixture: metric construction inside hot-path root functions.
+
+Root names come from effects.NAMED_ROOTS (fast-lane pumps, tunnel exec
+paths, serve handlers). RT019 is the lexical, no---flow companion to
+RT023: it fires only when the construction is textually inside the root
+itself; construction buried in helpers is the flow pass's job.
+"""
+import ray_tpu.util.metrics
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# module level: the designed shape — pre-built cells the hot path touches
+PUMPED = Counter("pump_records_total")
+LAT = Histogram("pump_latency_s", boundaries=(0.001, 0.01, 0.1))
+
+
+def _fast_pump(records):
+    dropped = Counter("pump_dropped_total")  # expect: RT019
+    for r in records:
+        PUMPED.inc()
+        dropped.inc()
+
+
+async def handle_request(req):
+    depth = ray_tpu.util.metrics.Gauge("serve_queue_depth")  # expect: RT019
+    depth.set(len(req))
+    LAT.observe(0.002)
+
+
+def _tunnel_exec_one(rec):
+    h = Histogram("tunnel_exec_s")  # expect: RT019
+    h.observe(0.001)
+    return rec
+
+
+def fast_actor_submit_loop(lane):
+    g = Gauge("lane_inflight")  # expect: RT019
+    g.set(lane.inflight)
+
+
+def cold_path_setup():
+    # not a NAMED_ROOTS name: RT019 stays silent (RT011's territory)
+    return Counter("setup_counter")
+
+
+def _fast_pump_helper(records):
+    # name is not an exact root match: silent here, caught by --flow if
+    # a real root calls it
+    return Counter("helper_counter")
+
+
+def handle_request_streaming(req):
+    # observing pre-built cells is the sanctioned hot-path shape
+    PUMPED.inc()
+    LAT.observe(0.001)
+
+
+def rpc_tunnel_frame(frame):
+    def _lazy():
+        # nested def: constructed per *closure call*, not per frame —
+        # lexically outside the root body for RT019 (flow territory)
+        return Counter("frame_counter")
+
+    return _lazy

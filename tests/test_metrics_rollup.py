@@ -1,0 +1,302 @@
+"""Cluster timeseries plane tests (ISSUE 19): the GCS rollup store.
+
+Unit legs drive ``RollupStore``/``WatermarkTracker`` directly with
+explicit timestamps (the store is plain locked state, no asyncio):
+restart-safe counter deltas (a worker restart can never produce a
+negative rate), mergeable histogram quantiles (merged-bucket quantiles
+equal the combined-stream computation), retention/ring-wrap bounds, and
+the derived ratio series. Integration legs run the real pipeline against
+the in-process cluster: spec-decode counters published through
+``telemetry.publish_decode_signals`` must surface as a non-empty,
+correctly-rated ``state.metric_window("llm_spec_accept_rate", ...)``
+series, and the raylet's lease lifecycle counters must appear in the
+rollup plane.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.core.metrics_store import (
+    RESOLUTIONS,
+    RETENTION_SLOTS,
+    RollupStore,
+    WatermarkTracker,
+    bucket_quantile,
+)
+
+T0 = 1_700_000_000.0  # fixed, slot-aligned-ish wall epoch for unit legs
+
+
+def _counter_snap(name, cum, tags=None):
+    return {"metrics": {name: {
+        "type": "counter",
+        "samples": [{"tags": tags or {}, "value": cum}]}}}
+
+
+def _hist_snap(name, boundaries, counts, total):
+    return {"metrics": {name: {
+        "type": "histogram", "boundaries": list(boundaries),
+        "samples": [{"tags": {}, "counts": list(counts), "sum": total}]}}}
+
+
+# ----------------------------------------------------- counter restarts
+def test_counter_reset_clamps_never_negative():
+    """A worker restart (new cumulative below the old) contributes the
+    new cumulative itself — every windowed delta/rate stays >= 0 and
+    the total equals what was actually counted."""
+    st = RollupStore()
+    st.ingest("w1", _counter_snap("rt_x", 100.0), now=T0)
+    st.ingest("w1", _counter_snap("rt_x", 150.0), now=T0 + 1)
+    # restart: registry re-created, cumulative fell to 20
+    st.ingest("w1", _counter_snap("rt_x", 20.0), now=T0 + 2)
+    win = st.window("rt_x", 10, now=T0 + 2)
+    assert win["type"] == "counter" and win["points"]
+    assert all(p["rate"] >= 0 and p["value"] >= 0 for p in win["points"])
+    assert sum(p["value"] for p in win["points"]) == pytest.approx(170.0)
+
+
+def test_counter_monotonic_decrease_within_slot_skipped():
+    """An unchanged cumulative contributes nothing (delta 0 is not a
+    point), so idle metrics don't fabricate zero-rate slots."""
+    st = RollupStore()
+    st.ingest("w1", _counter_snap("rt_x", 5.0), now=T0)
+    st.ingest("w1", _counter_snap("rt_x", 5.0), now=T0 + 1)
+    win = st.window("rt_x", 10, now=T0 + 1)
+    assert sum(p["value"] for p in win["points"]) == pytest.approx(5.0)
+    assert len(win["points"]) == 1  # the unchanged publish added no slot
+
+
+def test_counter_merge_across_worker_restart_two_sources():
+    """Per-(source, tag) delta state: one worker restarting does not
+    disturb another worker's deltas in the same slot."""
+    st = RollupStore()
+    st.ingest("w1", _counter_snap("rt_x", 10.0), now=T0)
+    st.ingest("w2", _counter_snap("rt_x", 40.0), now=T0)
+    st.ingest("w1", _counter_snap("rt_x", 3.0), now=T0 + 1)   # restarted
+    st.ingest("w2", _counter_snap("rt_x", 45.0), now=T0 + 1)  # kept going
+    win = st.window("rt_x", 10, now=T0 + 1)
+    assert sum(p["value"] for p in win["points"]) == pytest.approx(
+        10 + 40 + 3 + 5)
+    assert all(p["rate"] >= 0 for p in win["points"])
+
+
+# -------------------------------------------------- histogram merging
+def test_histogram_merge_matches_single_stream_quantiles():
+    """Bucket-wise merged deltas from two sources yield the same
+    quantiles as one stream holding the combined observations."""
+    bounds = (0.001, 0.01, 0.1, 1.0)
+    st = RollupStore()
+    # source A: 10 obs in bucket 1, 2 in bucket 3
+    st.ingest("a", _hist_snap("rt_h", bounds, [0, 10, 0, 2, 0], 1.0),
+              now=T0)
+    # source B: 5 obs in bucket 0, 3 in bucket 2
+    st.ingest("b", _hist_snap("rt_h", bounds, [5, 0, 3, 0, 0], 0.5),
+              now=T0)
+    win = st.window("rt_h", 10, now=T0)
+    assert len(win["points"]) == 1
+    pt = win["points"][0]
+    combined = [5, 10, 3, 2, 0]
+    assert pt["count"] == sum(combined)
+    assert pt["sum"] == pytest.approx(1.5)
+    for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+        assert pt[key] == pytest.approx(
+            bucket_quantile(bounds, combined, q))
+    # cumulative growth on one source windows only the delta
+    st.ingest("a", _hist_snap("rt_h", bounds, [0, 12, 0, 2, 0], 1.2),
+              now=T0 + 1)
+    win = st.window("rt_h", 10, now=T0 + 1)
+    assert win["points"][-1]["count"] == 2
+    # restart (counts fell): the whole new cumulative is the delta
+    st.ingest("a", _hist_snap("rt_h", bounds, [1, 0, 0, 0, 0], 0.01),
+              now=T0 + 2)
+    assert st.window("rt_h", 10, now=T0 + 2)["points"][-1]["count"] == 1
+
+
+# ------------------------------------------------- retention/ring wrap
+def test_retention_evicts_and_window_respects_bounds():
+    st = RollupStore()
+    for i in range(6):
+        st.ingest("w", _counter_snap("rt_x", float(i + 1)), now=T0 + i)
+    # jump far past 1s retention: the next ingest evicts everything old
+    late = T0 + RETENTION_SLOTS[1] + 100
+    st.ingest("w", _counter_snap("rt_x", 100.0), now=late)
+    assert all(len(st._slots[r]) <= RETENTION_SLOTS[r] + 1
+               for r in RESOLUTIONS)
+    win = st.window("rt_x", 30, now=late)
+    # only the late point is inside the trailing 30s
+    assert len(win["points"]) == 1
+    assert win["points"][0]["value"] == pytest.approx(100.0 - 6.0)
+    # the coarse resolutions kept the early slots (retention covers them)
+    win60 = st.window("rt_x", 3600, now=late)
+    assert sum(p["value"] for p in win60["points"]) == pytest.approx(100.0)
+
+
+def test_window_picks_finest_covering_resolution():
+    st = RollupStore()
+    st.ingest("w", _counter_snap("rt_x", 1.0), now=T0)
+    assert st.window("rt_x", 10, now=T0)["res"] == 1
+    assert st.window("rt_x", 180, now=T0)["res"] == 1
+    assert st.window("rt_x", 181, now=T0)["res"] == 10
+    assert st.window("rt_x", 3600, now=T0)["res"] == 10
+    assert st.window("rt_x", 7200, now=T0)["res"] == 60
+
+
+# --------------------------------------------------------- gauges/tags
+def test_gauge_sums_sources_and_tag_filter_selects_cell():
+    st = RollupStore()
+    snap = {"metrics": {"rt_arena_bytes": {"type": "gauge", "samples": [
+        {"tags": {"arena": "a"}, "value": 100.0},
+        {"tags": {"arena": "b"}, "value": 7.0}]}}}
+    st.ingest("w1", snap, now=T0)
+    st.ingest("w2", {"metrics": {"rt_arena_bytes": {
+        "type": "gauge",
+        "samples": [{"tags": {"arena": "a"}, "value": 50.0}]}}}, now=T0)
+    allcells = st.window("rt_arena_bytes", 10, now=T0)["points"][0]
+    assert allcells["value"] == pytest.approx(157.0)
+    only_a = st.window("rt_arena_bytes", 10, tags={"arena": "a"},
+                       now=T0)["points"][0]
+    assert only_a["value"] == pytest.approx(150.0)
+    assert st.window("rt_arena_bytes", 10, tags={"arena": "zz"},
+                     now=T0)["points"] == []
+
+
+# ------------------------------------------------------ derived ratios
+def test_ratio_window_accept_rate_survives_restart():
+    st = RollupStore()
+
+    def pub(src, prop, acc, now):
+        st.ingest(src, {"metrics": {
+            "rt_llm_spec_proposed_total": {
+                "type": "counter",
+                "samples": [{"tags": {}, "value": prop}]},
+            "rt_llm_spec_accepted_total": {
+                "type": "counter",
+                "samples": [{"tags": {}, "value": acc}]}}}, now=now)
+
+    pub("w", 100.0, 80.0, T0)
+    pub("w", 200.0, 140.0, T0 + 1)      # slot delta: 100 prop / 60 acc
+    pub("w", 40.0, 30.0, T0 + 2)        # restart: 40 prop / 30 acc
+    win = st.window("llm_spec_accept_rate", 10, now=T0 + 2)
+    assert win["type"] == "ratio"
+    by_ts = {p["ts"]: p for p in win["points"]}
+    assert by_ts[int(T0)]["value"] == pytest.approx(0.8)
+    assert by_ts[int(T0 + 1)]["value"] == pytest.approx(0.6)
+    assert by_ts[int(T0 + 2)]["value"] == pytest.approx(0.75)
+    assert all(0.0 <= p["value"] <= 1.0 for p in win["points"])
+    names = {r["name"]: r for r in st.names()}
+    assert names["llm_spec_accept_rate"]["type"] == "ratio"
+
+
+def test_export_rates_shapes():
+    st = RollupStore()
+    st.ingest("w", _counter_snap("rt_x", 30.0, tags={"k": "v"}), now=T0)
+    out = st.export_rates(secs=10.0, now=T0)
+    assert out["rt_x"]["samples"][0]["tags"] == {"k": "v"}
+    assert out["rt_x"]["samples"][0]["rate"] == pytest.approx(3.0)
+
+
+# --------------------------------------------------- watermark tracker
+def test_watermark_tracker_live_peak_and_ring():
+    w = WatermarkTracker(ring_slots=5, slot_s=1.0)
+    w.note(100, now=T0)
+    w.note(400, now=T0 + 1)
+    w.note(50, now=T0 + 2)
+    assert w.live == 50 and w.peak == 400
+    assert w.recent_peak(10, now=T0 + 2) == 400
+    # ring wraps: the 400 sample ages out of the 5-slot ring, lifetime
+    # peak stays
+    for i in range(3, 9):
+        w.note(60, now=T0 + i)
+    assert w.recent_peak(5, now=T0 + 8) == 60
+    assert w.peak == 400
+    assert len(w.series(100, now=T0 + 8)) <= 6
+    # empty-window fallback reports current live
+    w2 = WatermarkTracker()
+    w2.note(10, now=T0)
+    assert w2.recent_peak(1.0, now=T0 + 500) == 10
+
+
+# ------------------------------------------------- cluster integration
+@pytest.fixture(scope="module")
+def rt():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class _FakeSpecEngine:
+    """Just enough engine surface for publish_decode_signals: one
+    drained spec block of 40 proposed / 30 accepted draft tokens."""
+
+    def __init__(self):
+        self._blocks = [(4, 34, 40, 30)]  # (n_steps, emitted, prop, acc)
+
+    def spec_stats(self, drain=False):
+        blocks, self._blocks = self._blocks, []
+        return {"blocks": blocks, "spec_proposed": 40,
+                "spec_accepted": 30, "spec_accept_rate": 0.75}
+
+    def tokens_in_flight(self):
+        return 0
+
+
+def test_metric_window_spec_accept_rate_end_to_end(rt):
+    """Acceptance: the spec-decode counters published by the decode
+    plane surface as a non-empty, correctly-rated
+    ``state.metric_window("llm_spec_accept_rate", ...)`` series via the
+    real pipeline (registry -> flush kv_put -> RollupStore -> RPC)."""
+    from ray_tpu import state
+    from ray_tpu.llm.disagg import telemetry
+
+    telemetry.publish_decode_signals(_FakeSpecEngine())
+
+    @rt.remote
+    def tick():
+        return 1
+
+    win = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        rt.get(tick.remote())  # keep the task-event flush timer busy
+        win = state.metric_window("llm_spec_accept_rate", 60)
+        if win["points"]:
+            break
+        time.sleep(0.3)
+    assert win and win["points"], "accept-rate window never materialized"
+    total_num = sum(p["num"] for p in win["points"])
+    total_den = sum(p["den"] for p in win["points"])
+    assert total_den >= 40 and total_num / total_den == pytest.approx(
+        0.75, abs=0.05)
+    assert all(0.0 <= p["value"] <= 1.0 for p in win["points"])
+    names = {r["name"] for r in state.metric_names()}
+    assert "llm_spec_accept_rate" in names
+
+
+def test_lease_lifecycle_counters_in_rollup_plane(rt):
+    """The raylet's hand-rolled snapshot (lease grant/return counters +
+    object-store watermark gauges) lands in the rollup plane under its
+    own source key."""
+    from ray_tpu import state
+
+    @rt.remote
+    def f():
+        return 1
+
+    assert rt.get(f.remote()) == 1
+    win = None
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        rt.get(f.remote())
+        win = state.metric_window("rt_lease_events_total", 120,
+                                  tags={"event": "granted"})
+        if win["points"]:
+            break
+        time.sleep(0.3)
+    assert win and win["points"], "lease counters never reached rollups"
+    assert sum(p["value"] for p in win["points"]) >= 1
+    gauges = state.metric_window("rt_arena_bytes", 120,
+                                 tags={"arena": "object_store"})
+    assert gauges["type"] == "gauge"

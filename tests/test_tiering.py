@@ -320,6 +320,49 @@ def test_pinned_pages_never_spill(rt):
     np.testing.assert_array_equal(ray_tpu.get(m.pages[0].refs["k"]), page)
 
 
+def test_arena_watermarks_track_spill_restore_cycle(rt):
+    """The tiering arena watermarks (rollup plane, ISSUE 19) track peak
+    bytes through a spill/restore pressure cycle: live bytes move from
+    the shm arena to tier-1 on spill and back on a tier-1 hit, while the
+    shm watermark's peak remembers the pre-spill high-water mark."""
+    from ray_tpu.llm.disagg.kv_plane import KVPageEntry, KVPageManifest
+
+    core = _core()
+    page = np.arange(4096, dtype=np.float32)
+    pages = []
+    for _ in range(3):
+        refs = {"k": core.put_value(page.copy(), prefer_shm=True),
+                "v": core.put_value(page.copy(), prefer_shm=True)}
+        pages.append(KVPageEntry(refs=refs, nbytes=2 * page.nbytes))
+    toks = list(range(0, 3 * PS))
+    m = KVPageManifest(token_ids=tuple(toks), page_size=PS,
+                       kv_dtype="native", pages=pages)
+    c = PrefixCache(PS, capacity_bytes=1 << 30, spill=True,
+                    spill_cold_after_s=0.0)
+    c.insert(m)
+    st = tiering.sample_arenas()
+    live0 = st["prefix_cache"]["bytes"]
+    assert live0 == c.bytes > 0
+    assert st["prefix_cache"]["capacity"] == c.capacity_bytes
+    # pressure: push the whole radix tree to tier-1
+    assert c.spill_all() >= 1
+    st = tiering.sample_arenas()
+    assert st["prefix_cache"]["bytes"] < live0
+    assert st["prefix_cache_tier1"]["bytes"] > 0
+    # the shm arena's watermark remembers the pre-spill high water
+    wm = tiering.arena_watermark("prefix_cache")
+    assert wm is not None and wm.peak >= live0
+    assert st["prefix_cache"]["peak"] >= live0
+    # restore: a tier-1 hit promotes the pages back into the shm arena
+    pm = c.lookup(toks)
+    assert pm is not None
+    adopt_pages(pm, role="prefill")
+    c.release(pm)
+    st = tiering.sample_arenas()
+    assert st["prefix_cache"]["bytes"] == c.bytes > 0
+    assert tiering.arena_watermark("prefix_cache").live == c.bytes
+
+
 # ------------------------------------------- freed-while-spilling orphan
 def test_freed_while_spilling_leaves_no_orphan_file(rt):
     """Freeing an object while its spill write is in flight must not
