@@ -1709,6 +1709,128 @@ def run_serve_llm_bench(quick: bool) -> dict:
     return _run_llm_child(_SERVE_LLM_BENCH_CHILD, "serve-llm", quick)
 
 
+_SERVE_LLM_STREAM_CHILD = r"""
+import json, sys, time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.utils.recorder import percentile
+
+quick = sys.argv[1] == "1"
+ray_tpu.init(num_cpus=8)
+
+
+# --- wire-plane chunk overhead, no LLM noise: ONE deployment streaming
+# N small chunks ("G" records on the ring) vs returning the same N as a
+# single unary list — per-chunk overhead = (stream - unary) / N.
+@serve.deployment(num_replicas=1)
+class Chunks:
+    def gen(self, n):
+        for i in range(n):
+            yield i
+
+    def unary(self, n):
+        return list(range(n))
+
+
+h = serve.run(Chunks.bind(), name="chunks")
+N = 256 if quick else 512
+for _ in range(3):  # warm: lanes, stream sinks, reply pump
+    assert list(h.gen.stream_chunks(N))[-1] == N - 1
+    ray_tpu.get(h.unary.remote(N), timeout=60)
+best_s = best_u = float("inf")
+for _ in range(5):  # interleaved best-of: same host weather both arms
+    t0 = time.perf_counter()
+    xs = list(h.gen.stream_chunks(N))
+    best_s = min(best_s, time.perf_counter() - t0)
+    assert len(xs) == N
+    t0 = time.perf_counter()
+    ray_tpu.get(h.unary.remote(N), timeout=60)
+    best_u = min(best_u, time.perf_counter() - t0)
+chunk_overhead_us = (best_s - best_u) / N * 1e6
+serve.delete("chunks")
+
+# --- LLM streaming A/B against the aggregated engine deployment:
+# stream_deltas (one "G" chunk per fused decode block) interleaved
+# with the unary __call__ on the SAME prompts — token identity is
+# asserted per pair; TTFC is measured client-side beside a unary
+# max_tokens=1 request (the externally observable TTFT: routing +
+# prefill + one block for both).
+from ray_tpu.llm.serving import build_llm_engine_deployment
+
+cfg = LlamaConfig(vocab_size=512, d_model=128, n_heads=4, n_layers=2,
+                  n_kv_heads=4, d_ff=256, max_seq_len=256,
+                  dtype="float32")
+app = build_llm_engine_deployment(cfg, max_batch=8, page_size=8,
+                                  n_pages=128, max_seq_len=256)
+lh = serve.run(app, name="sllm")
+rng = np.random.default_rng(7)
+prompts = [[int(x) for x in rng.integers(1, 500, 12)]
+           for _ in range(12 if quick else 24)]
+MT = 24
+for p in prompts[:2]:  # warm: prefill/decode compiles, stream path
+    ray_tpu.get(lh.remote({"prompt_tokens": p, "max_tokens": MT}),
+                timeout=300)
+    list(lh.stream_deltas.stream_chunks(
+        {"prompt_tokens": p, "max_tokens": MT}))
+
+ttfc, gaps, ttft1, identical = [], [], [], 0
+n_chunks = 0
+for p in prompts:
+    req = {"prompt_tokens": p, "max_tokens": MT}
+    ref = ray_tpu.get(lh.remote(dict(req)),
+                      timeout=300)["completion_tokens"]
+    t0 = time.perf_counter()
+    ray_tpu.get(lh.remote({"prompt_tokens": p, "max_tokens": 1}),
+                timeout=300)
+    ttft1.append(time.perf_counter() - t0)
+    toks = []
+    t0 = last = time.perf_counter()
+    for d in lh.stream_deltas.stream_chunks(dict(req)):
+        now = time.perf_counter()
+        if not toks:
+            ttfc.append(now - t0)
+        elif d["tokens"]:
+            gaps.append(now - last)
+        last = now
+        toks += list(d["tokens"])
+        n_chunks += 1
+    identical += toks == ref
+
+assert identical == len(prompts), (identical, len(prompts))
+out = {
+    "serve_stream_chunk_overhead_us": chunk_overhead_us,
+    "serve_stream_chunks_per_req": n_chunks / len(prompts),
+    "serve_stream_tokens_identical": identical,
+    "serve_stream_ttfc_p50_ms": percentile(sorted(ttfc), 0.5) * 1e3,
+    "serve_stream_ttfc_p99_ms": percentile(sorted(ttfc), 0.99) * 1e3,
+    "serve_stream_gap_p50_ms": percentile(sorted(gaps), 0.5) * 1e3,
+    "serve_stream_gap_p99_ms": percentile(sorted(gaps), 0.99) * 1e3,
+    "serve_stream_unary_ttft1_p50_ms": percentile(sorted(ttft1),
+                                                  0.5) * 1e3,
+}
+out["serve_stream_ttfc_vs_ttft1"] = (
+    out["serve_stream_ttfc_p50_ms"]
+    / max(1e-9, out["serve_stream_unary_ttft1_p50_ms"]))
+print("RES=" + json.dumps(out))
+ray_tpu.shutdown()
+"""
+
+
+def run_serve_llm_streaming(quick: bool) -> dict:
+    """Streaming serve arm (ROADMAP item 2 acceptance): token deltas as
+    "G" chunk records end to end. Reports client-side TTFC p50/p99
+    beside a unary max_tokens=1 TTFT proxy (acceptance: ratio ~1),
+    inter-chunk gap percentiles, per-chunk wire overhead from a
+    no-LLM stream-vs-unary interleaved A/B, and asserts every streamed
+    completion token-identical to its unary twin."""
+    return _run_llm_child(_SERVE_LLM_STREAM_CHILD, "serve-llm-stream",
+                          quick)
+
+
 _DISAGG_BENCH_CHILD = r"""
 import asyncio, json, sys, time
 
@@ -2692,6 +2814,13 @@ def main():
                 llm = {**(llm or {}), **sllm}
         except Exception as e:
             print(f"serve-llm bench failed: {e!r}", file=sys.stderr)
+        try:
+            sstream = run_serve_llm_streaming(args.quick)
+            if sstream:
+                llm = {**(llm or {}), **sstream}
+        except Exception as e:
+            print(f"serve-llm streaming bench failed: {e!r}",
+                  file=sys.stderr)
 
     root = os.path.dirname(os.path.abspath(__file__))
     out_path = os.path.join(root, "bench_results.json")

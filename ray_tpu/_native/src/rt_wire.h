@@ -23,7 +23,7 @@ namespace wire {
 // Wire-schema version; must match ray_tpu/utils/schema.py PROTOCOL_VERSION
 // (tests/test_wire_schema.py cross-checks the two).
 constexpr int kProtocolMajor = 2;
-constexpr int kProtocolMinor = 2;
+constexpr int kProtocolMinor = 3;
 
 // ---------------------------------------------------------------------
 // Fastpath record catalog (shm rings + node tunnels, core/fastpath.py).
@@ -39,9 +39,20 @@ constexpr char kRecPrefixTaskPickleTs = 'Q'; // task, C-pickled + u64 stamp
 constexpr char kRecPrefixTaskPackedTs = 'R'; // task, packed + u64 stamp
 constexpr char kRecPrefixActorPickle = 'A';  // actor, C-pickled + seq hdr
 constexpr char kRecPrefixActorPacked = 'C';  // actor, packed + seq hdr
+constexpr char kRecPrefixChunk = 'G';        // stream chunk (2.3): 'A'
+// header shape (seq slot = per-stream chunk index, same trace bit),
+// body <16s task_id><u32 status> + payload
 constexpr uint32_t kReplyFlagStamped = 0x100;  // 16-byte stage stamp follows
 constexpr uint32_t kReplyFlagSeqed = 0x200;    // u32 echoed seq follows
 constexpr uint32_t kReplyFlagTraced = 0x400;   // 25-byte trace leg follows
+// Reply status CODES (low bits below the flag bits), cataloged since
+// 2.3 — utils/schema.py RECORD_STATUS mirrors these.
+constexpr uint32_t kReplyStatusOk = 0;        // payload = packed value
+constexpr uint32_t kReplyStatusOkShm = 1;     // sealed in the node arena
+constexpr uint32_t kReplyStatusErr = 2;       // payload = pickled error
+constexpr uint32_t kReplyStatusNeedSlow = 3;  // declined: RPC path owns it
+constexpr uint32_t kReplyStatusChunk = 4;     // 'G' only: inline item
+constexpr uint32_t kReplyStatusChunkShm = 5;  // 'G' only: sealed item
 // Record-side trace flag (2.1): bit 63 of the u64 t_submit field of
 // "Q"/"R"/"A"/"C" records — set = a 25-byte trace leg
 // (<16s trace_id><8s span_id><u8 sampled>) follows the record header.

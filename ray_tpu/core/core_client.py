@@ -245,6 +245,69 @@ class FastLaneDeclined(Exception):
     re-dispatches it over the RPC plane."""
 
 
+class _FastStreamSink:
+    """Loop-confined reorder buffer for one fast-lane stream (2.3 "G"
+    records). Chunks may arrive out of order — a single chunk can spill
+    over RPC while later chunks keep landing on the ring — so the sink
+    buffers by per-stream chunk index and releases in order. The
+    terminal reply (ordinary "A"-plane record carrying
+    ``pack_stream_fin(nchunks)``) is held until every chunk below
+    ``nchunks`` has been released, which restores the worker's emit
+    order without any per-chunk seq from the lane counter.
+
+    All mutation happens on the owner loop (pushes arrive via the
+    ``_fast_wake_q`` drain), so no lock. ``dead`` flips when the
+    consumer abandons the stream; pushes after that only free orphaned
+    shm seals."""
+
+    __slots__ = ("task_id", "lane", "q", "expect", "pending",
+                 "fin", "fin_n", "dead")
+
+    def __init__(self, task_id, lane):
+        self.task_id = task_id
+        self.lane = lane
+        self.q: asyncio.Queue = asyncio.Queue()
+        self.expect = 0          # next chunk index to release
+        self.pending: dict = {}  # out-of-order chunks by index
+        self.fin = None          # held terminal (status, payload)
+        self.fin_n = None        # chunk count the terminal promised
+        self.dead = False
+
+    def push(self, status, payload) -> None:
+        from ray_tpu.core import fastpath
+
+        if status in (fastpath.CHUNK, fastpath.CHUNK_SHM):
+            seq, body = payload
+            if seq < self.expect or seq in self.pending:
+                return  # duplicate delivery (spill-RPC timeout re-send)
+            self.pending[seq] = (status, body)
+            while self.expect in self.pending:
+                st, b = self.pending.pop(self.expect)
+                self.q.put_nowait(("chunk", st, b, self.expect))
+                self.expect += 1
+            self._maybe_fin()
+            return
+        # terminal: OK carries pack_stream_fin(nchunks) and must wait
+        # for the tail chunks; ERR / NEED_SLOW / None (lane broke) end
+        # the stream immediately — consumed chunks are never replayed
+        if status == fastpath.OK:
+            self.fin = (status, payload)
+            self.fin_n = fastpath.unpack_stream_fin(payload)
+            if self.fin_n is None:  # malformed fin: fail the stream
+                self.fin = None
+                self.q.put_nowait(("fin", None, None, None))
+                return
+            self._maybe_fin()
+        else:
+            self.q.put_nowait(("fin", status, payload, None))
+
+    def _maybe_fin(self) -> None:
+        if self.fin is not None and self.expect >= self.fin_n:
+            status, payload = self.fin
+            self.fin = None
+            self.q.put_nowait(("fin", status, payload, None))
+
+
 class ActorCallTemplate:
     """Frozen per-(handle, method) submission state — the actor-call
     analogue of api.SubmitTemplate (ref: actor_task_submitter.h:75 cached
@@ -426,6 +489,13 @@ class CoreClient:
         self._fast_loop_waiters: dict[ObjectID, asyncio.Future] = {}
         self._fast_wake_q: list = []
         self._fast_wake_armed = False
+        # streaming fast lane (2.3): oid -> _FastStreamSink for live
+        # streams (guarded by _fast_cv like the waiters); tombstones of
+        # abandoned-but-unfinished streams so late CHUNK_SHM records
+        # free their seals instead of leaking (FIFO-capped — a stream's
+        # tombstone clears for good when its terminal lands)
+        self._fast_stream_sinks: dict[ObjectID, Any] = {}
+        self._fast_stream_dead: dict[ObjectID, Any] = {}
         # ---- cross-node node tunnels (core/tunnel.py) ----
         # TunnelClient created lazily on first remote lane; tunnel actor
         # lanes register in _fast_actor_lanes beside ring lanes and reuse
@@ -2572,6 +2642,229 @@ class CoreClient:
             raise FastLaneDeclined()
         raise rpc.ConnectionLost("fast lane broke mid-request")
 
+    # -------------------------------------------------- streaming fast lane
+    def fast_actor_submit_stream(self, actor_id: ActorID, method: str,
+                                 args, kwargs, tmpl=None):
+        """LOOP-thread fast STREAM submit (2.3): the generator analogue
+        of :meth:`fast_actor_submit_loop`. The record goes out with a
+        ``gm:`` method key, the worker drives the generator and flushes
+        one "G" chunk record per yielded item (token deltas per fused
+        decode block in the LLM case), and the stream's terminal is an
+        ordinary reply on the lane's seq machinery. No per-item
+        ObjectRef, memory-store entry, or task event — a chunk is two
+        ring stores and one queue put end to end; only oversized items
+        seal into the node arena and ride a CHUNK_SHM descriptor.
+
+        Same untracked contract as the unary loop submit: no automatic
+        replay on a broken lane (the serve router owns the request
+        lifecycle), and RPC fallback is only valid while nothing has
+        been consumed — a NEED_SLOW terminal means the worker declined
+        before executing, so the per-item ObjectRef generator plane may
+        re-dispatch safely.
+
+        Returns ``(task_id, sink)`` for :meth:`fast_actor_stream`, or
+        None — this call takes the per-item RPC generator path (no live
+        lane, non-generator method, pending/remote ref args, oversized
+        record)."""
+        from ray_tpu.core import fastpath
+
+        lane = tmpl.lane if tmpl is not None else None
+        if lane is None or lane.broken or lane.retired:
+            lane = self._fast_actor_lanes.get(actor_id)
+            if lane is None or lane.broken or lane.retired:
+                if tmpl is not None:
+                    tmpl.lane = None
+                return None
+            if tmpl is not None:
+                tmpl.lane = lane
+        mt = lane.methods
+        if mt is not None:
+            v = mt.get(method)
+            if v is None or v[0] != "gen":
+                return None  # not a generator method on this worker
+        has_ref = any(isinstance(a, ObjectRef) for a in args)
+        if not has_ref and kwargs:
+            has_ref = any(isinstance(v, ObjectRef) for v in kwargs.values())
+        if has_ref:
+            args, kwargs, ok = self._fast_resolve_ref_args(args, kwargs)
+            if not ok:
+                return None
+        task_id = TaskID.generate_actor()
+        tid = task_id.binary()
+        now_ns = time.perf_counter_ns()
+        t0 = now_ns if self._rec_enabled else 0
+        mkey = b"gm:" + method.encode()
+        seq = next(lane.seq_counter)
+        lane.next_seq = seq + 1
+        pins = None
+        tunnel = getattr(lane.ring, "tunnel", False)
+        trace = (self._trace_submit_leg(
+            task_id, method, "tunnel" if tunnel else "ring")
+            if self._trace_on else b"")
+        oid = ObjectID.for_task_return(task_id, 0)
+        try:
+            rec = fastpath.pack_actor_task(tid, mkey, args, kwargs, t0,
+                                           seq, trace)
+        except Exception:
+            self._trace_pending.pop(oid, None)
+            return None  # unpicklable args: RPC generator path
+        if len(rec) > self.cfg.tunnel_inline_max and tunnel:
+            shrunk = self._tunnel_shrink_args(args, kwargs)
+            if shrunk is not None:
+                s_args, s_kwargs, pins = shrunk
+                try:
+                    rec = fastpath.pack_actor_task(
+                        tid, mkey, s_args, s_kwargs, t0, seq, trace)
+                except Exception:
+                    self._trace_pending.pop(oid, None)
+                    return None
+        if len(rec) > min(self.cfg.fastpath_record_max,
+                          fastpath.POP_BUF_BYTES - 64):
+            self._trace_pending.pop(oid, None)
+            return None
+        if pins:
+            self._tunnel_pins[task_id] = pins
+        sink = _FastStreamSink(task_id, lane)
+        with self._fast_cv:
+            self._fast_stream_sinks[oid] = sink
+        self._fast_last_submit = now_ns
+        ok = self._fast_register_and_push(
+            lane, task_id, rec, ("serve", actor_id, method),
+            defer=False, t0=t0, track=False)
+        if ok is None:
+            with self._fast_cv:
+                self._fast_stream_sinks.pop(oid, None)
+            self._tunnel_pins.pop(task_id, None)
+            self._trace_pending.pop(oid, None)
+            return None
+        metrics.actor_calls.inc()
+        return task_id, sink
+
+    async def fast_actor_stream(self, task_id: TaskID, sink, timeout=None):
+        """Consume a fast-lane stream: async-iterates the call's yielded
+        items in the worker's emit order. ``timeout`` bounds the WHOLE
+        stream (first chunk through terminal), raising GetTimeoutError.
+        A clean exhaustion returns after the terminal; a remote error
+        raises the stream's typed exception; a NEED_SLOW terminal raises
+        :class:`FastLaneDeclined` (nothing executed — safe to
+        re-dispatch over the per-item RPC generator plane); a lane break
+        raises ``rpc.ConnectionLost`` — chunks already consumed are
+        never replayed. Early exit (``aclose`` / ``break`` /
+        GeneratorExit) abandons the stream: the worker is told to stop
+        pumping and late shm chunks free instead of leaking."""
+        from ray_tpu.core import fastpath
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while True:
+                if deadline is None:
+                    kind, status, payload, cseq = await sink.q.get()
+                else:
+                    try:
+                        kind, status, payload, cseq = await asyncio.wait_for(
+                            sink.q.get(),
+                            max(0.0, deadline - time.monotonic()))
+                    except asyncio.TimeoutError:
+                        raise GetTimeoutError(
+                            "timed out waiting for stream chunk") from None
+                if kind == "chunk":
+                    if status == fastpath.CHUNK:
+                        yield serialization.unpack(payload)
+                    else:  # CHUNK_SHM: sealed under return index seq+1
+                        ref = self._fast_adopt_chunk_seal(
+                            ObjectID.for_task_return(task_id, cseq + 1),
+                            payload)
+                        (value,) = await self.get_async(
+                            [ref], None if deadline is None
+                            else max(0.05, deadline - time.monotonic()))
+                        yield value
+                    continue
+                if status == fastpath.OK:
+                    return
+                if status == fastpath.ERR:
+                    try:
+                        err = pickle.loads(payload)
+                    except Exception as e:
+                        err = TaskError(f"stream failed: {e!r}")
+                    raise err
+                if status == fastpath.NEED_SLOW:
+                    raise FastLaneDeclined()
+                raise rpc.ConnectionLost("fast lane broke mid-stream")
+        finally:
+            self.fast_stream_abandon(task_id, sink)
+
+    def fast_stream_abandon(self, task_id: TaskID, sink) -> None:
+        """Loop-side, idempotent stream teardown — runs on clean
+        exhaustion AND on mid-stream disconnect. Unhooks the sink,
+        tombstones a still-live stream so late chunks free their seals
+        instead of leaking, frees everything queued-but-unconsumed, and
+        best-effort tells a ring lane's worker to stop pumping
+        (``stream_abandon`` RPC). Tunnel streams have no worker
+        connection here — the serve layer cancels via
+        ``cancel_request``, and a closed sink stops the pump on its
+        next push anyway."""
+        from ray_tpu.core import fastpath
+
+        if sink.dead:
+            return
+        sink.dead = True
+        oid = ObjectID.for_task_return(task_id, 0)
+        live = False
+        with self._fast_cv:
+            if self._fast_stream_sinks.pop(oid, None) is not None:
+                live = True
+                self._fast_stream_dead[oid] = sink
+                while len(self._fast_stream_dead) > 512:
+                    self._fast_stream_dead.pop(
+                        next(iter(self._fast_stream_dead)))
+        # adopt-and-drop every unconsumed shm chunk (reorder buffer +
+        # delivery queue) so the arena copies free now
+        for cseq, (st, body) in list(sink.pending.items()):
+            if st == fastpath.CHUNK_SHM:
+                self._fast_adopt_chunk_seal(
+                    ObjectID.for_task_return(task_id, cseq + 1), body)
+        sink.pending.clear()
+        sink.fin = None
+        while not sink.q.empty():
+            kind, st, body, cseq = sink.q.get_nowait()
+            if kind == "chunk" and st == fastpath.CHUNK_SHM:
+                self._fast_adopt_chunk_seal(
+                    ObjectID.for_task_return(task_id, cseq + 1), body)
+        if live:
+            w = getattr(sink.lane, "worker", None)
+            conn = getattr(w, "conn", None) if w is not None else None
+            if conn is not None and not conn._closed:
+                async def _notify():
+                    try:
+                        await conn.call("stream_abandon",
+                                        {"task_ids": [task_id.binary()]})
+                    except (rpc.ConnectionLost, OSError):
+                        # best-effort: a dying worker's pump also stops
+                        # on the closed ring / dead sink
+                        pass
+                self._bg.spawn(_notify(), self.loop)
+
+    def _fast_adopt_chunk_seal(self, oid: ObjectID, payload: bytes):
+        """Adopt a CHUNK_SHM seal into the owned-object plane at consume
+        time: create the entry + location hint the migrate drain makes
+        for an OK_SHM reply (chunks skip the migrate queue — no
+        per-chunk task events by design) and mint the ref whose read and
+        eventual drop ride the normal owned path. Dropping the returned
+        ref immediately frees an orphaned seal."""
+        from ray_tpu.core import fastpath
+
+        ent = self.memory_store.get(oid)
+        if ent is None:
+            ent = _MemEntry()
+            self.memory_store[oid] = ent
+        if not ent.ready.is_set():
+            ent.in_shm = True
+            size, holder = fastpath.unpack_shm_desc(payload)
+            holder = holder or self.node_id.binary()
+            self._obj_locations.setdefault(oid, set()).add(holder)
+            ent.ready.set()
+        return self._new_owned_ref(oid)
+
     def _queue_loop_wakes(self, items) -> None:
         """Thread-safe: queue router-future resolutions and arm the loop
         drain at most once — while reply traffic flows the drain lingers
@@ -2608,7 +2901,17 @@ class CoreClient:
                 self._fast_wake_armed = False
                 return
         for fut, status, payload, oid in batch:
-            if not fut.done():
+            if type(fut) is _FastStreamSink:
+                if not fut.dead:
+                    fut.push(status, payload)
+                elif status == fastpath.CHUNK_SHM:
+                    # chunk for an abandoned stream: adopt-and-drop the
+                    # orphaned seal so the arena copy frees
+                    cseq, body = payload
+                    self._fast_adopt_chunk_seal(
+                        ObjectID.for_task_return(fut.task_id, cseq + 1),
+                        body)
+            elif not fut.done():
                 fut.set_result((status, payload))
             elif status == fastpath.OK_SHM:
                 self._new_owned_ref(oid)  # dropped at once: frees the seal
@@ -2715,8 +3018,38 @@ class CoreClient:
         tspans = None  # sampled completions: wire-level call spans
         with self._fast_cv:
             for rec in recs:
-                tid_b, status, payload, stamp, seq, trc = \
-                    fastpath.unpack_reply(rec)
+                if rec[:1] == b"G":
+                    # 2.3 stream chunk probe. A chunk never pops
+                    # inflight / oid-lane / pins — the stream's terminal
+                    # (an ordinary reply on the lane's seq machinery)
+                    # owns all of that. Routing demands a full 16-byte
+                    # task-id match against a registered sink, so a
+                    # genuine reply whose tid happens to start with
+                    # 0x47 ('G') falls through to the reply parse.
+                    g = fastpath.unpack_chunk(rec)
+                    if g is not None:
+                        coid = ObjectID.for_task_return(TaskID(g[0]), 0)
+                        sink = (self._fast_stream_sinks.get(coid)
+                                or self._fast_stream_dead.get(coid))
+                        if sink is not None:
+                            if wake is None:
+                                wake = []
+                            # payload slot = (chunk_seq, body); the sink
+                            # reorders on the loop side
+                            wake.append((sink, g[1], (g[3], g[2]), coid))
+                            continue
+                    try:
+                        tid_b, status, payload, stamp, seq, trc = \
+                            fastpath.unpack_reply(rec)
+                    except Exception:
+                        # an ownerless chunk (late duplicate after the
+                        # terminal cleared the stream) that does not
+                        # parse as a reply: drop it, never kill the
+                        # whole batch
+                        continue
+                else:
+                    tid_b, status, payload, stamp, seq, trc = \
+                        fastpath.unpack_reply(rec)
                 task_id = TaskID(tid_b)
                 light = lane.inflight.pop(task_id, None)
                 if self._tunnel_pins:
@@ -2749,6 +3082,18 @@ class CoreClient:
                         if wake is None:
                             wake = []
                         wake.append((fut, status, payload, oid))
+                if self._fast_stream_sinks or self._fast_stream_dead:
+                    # stream terminal: deliver fin to a live sink (held
+                    # there until the chunk tail drains); an abandoned
+                    # stream's tombstone clears for good — nothing after
+                    # the terminal will ever reference its seals
+                    sink = self._fast_stream_sinks.pop(oid, None)
+                    if sink is not None:
+                        if wake is None:
+                            wake = []
+                        wake.append((sink, status, payload, oid))
+                    else:
+                        self._fast_stream_dead.pop(oid, None)
                 if seq is not None and light is not None:
                     # out-of-order completion accounting (async actors
                     # reply as each method finishes): seq below the high
@@ -2841,7 +3186,26 @@ class CoreClient:
         by_lane: dict[int, tuple] = {}
         with self._fast_cv:
             for rec in p["records"]:
-                tid_b = fastpath.unpack_reply(rec)[0]
+                if rec[:1] == b"G":
+                    # spilled stream chunk: route on the sink's lane
+                    # (chunks are untracked — no _fast_oid_lane entry
+                    # pops for them, the terminal owns that)
+                    g = fastpath.unpack_chunk(rec)
+                    if g is not None:
+                        soid = ObjectID.for_task_return(TaskID(g[0]), 0)
+                        sink = (self._fast_stream_sinks.get(soid)
+                                or self._fast_stream_dead.get(soid))
+                        if sink is not None:
+                            lane = sink.lane
+                            by_lane.setdefault(
+                                id(lane), (lane, []))[1].append(rec)
+                            continue
+                    try:
+                        tid_b = fastpath.unpack_reply(rec)[0]
+                    except Exception:
+                        continue  # ownerless chunk: drop
+                else:
+                    tid_b = fastpath.unpack_reply(rec)[0]
                 oid = ObjectID.for_task_return(TaskID(tid_b), 0)
                 ent = self._fast_oid_lane.get(oid)
                 if ent is not None:
@@ -3085,6 +3449,14 @@ class CoreClient:
                         # broken mid-flight: fast_actor_await raises
                         # ConnectionLost, the router's policy owns replay
                         wake.append((fut, None, None, oid))
+                    if self._fast_stream_sinks:
+                        sink = self._fast_stream_sinks.pop(oid, None)
+                        if sink is not None:
+                            # stream dying with the lane: the broken
+                            # sentinel ends iteration with
+                            # ConnectionLost — chunks already consumed
+                            # are never replayed
+                            wake.append((sink, None, None, oid))
             self._fast_cv.notify_all()
         if wake:
             self._queue_loop_wakes(wake)

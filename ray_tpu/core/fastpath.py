@@ -80,6 +80,14 @@ OK_SHM = 1    # result sealed into the node's shm arena under the return
 #               at completion time; empty payload = size unknown)
 ERR = 2       # payload = pickled TaskError
 NEED_SLOW = 3  # func not executable on the fast path: resubmit via RPC
+# streaming chunk statuses (wire 2.3): carried by "G" chunk records ONLY
+# (pack_chunk) — never by terminal reply records, so the four statuses
+# above keep their exact meaning for every non-stream consumer
+CHUNK = 4      # payload = one packed yielded item
+CHUNK_SHM = 5  # oversized item sealed in the node arena under the
+#                chunk's derived oid (return index chunk_seq + 1 of the
+#                call's task id — index 0 stays the terminal reply's);
+#                payload = pack_shm_size / pack_shm_desc like OK_SHM
 
 _ST_OK = 0
 _ST_TIMEOUT = -4
@@ -161,6 +169,10 @@ class RingPair:
             self._exit()
 
     def push_raw(self, which: int, framed: bytes, timeout_ms: int = -1) -> int:
+        if chaos.ENABLED:
+            st = _chaos_push(which, len(framed))
+            if st:
+                return st
         if not self._enter():
             return _ST_CLOSED
         try:
@@ -511,6 +523,70 @@ def unpack_reply(rec: bytes):
         off += TRACE_LEN
     return (task_id, status & ~(STAMPED | SEQED | TRACED), rec[off:],
             stamp, seq, trace)
+
+
+_CHDR = struct.Struct("<16sI")  # chunk body header: task_id, status
+
+
+def pack_chunk(task_id: bytes, status: int, payload: bytes,
+               chunk_seq: int, t_ns: int = 0, trace: bytes = b"") -> bytes:
+    """Streaming chunk record ("G", wire 2.3): one seq-matched partial
+    completion of a stream-called generator method, flushed per yielded
+    item. The header is byte-for-byte the "A"/"C" shape —
+    ``<u32 chunk_seq><u64 t_emit_ns>`` with the same TRACE_BIT trace leg
+    — so the rings and tunnels order chunks with the machinery they
+    already have; the seq slot carries the PER-STREAM chunk index
+    (monotonic from 0), not the lane call seq. The body is the reply
+    shape: ``<16s task_id><u32 status>`` + payload, status CHUNK
+    (inline packed item) or CHUNK_SHM (shm size/desc — the item sealed
+    under return index chunk_seq + 1 of the call's task id). The
+    stream's END is NOT a "G" record: an ordinary :func:`pack_reply`
+    terminal (OK + pack_stream_fin / ERR) closes it on the lane's
+    normal seq machinery. An unsampled chunk (no trace leg) is
+    byte-identical to one packed before tracing existed — the leg costs
+    nothing unless the request is sampled."""
+    if trace:
+        return (b"G" + _AHDR.pack(chunk_seq, t_ns | TRACE_BIT) + trace
+                + _CHDR.pack(task_id, status) + payload)
+    return (b"G" + _AHDR.pack(chunk_seq, t_ns)
+            + _CHDR.pack(task_id, status) + payload)
+
+
+def unpack_chunk(rec: bytes):
+    """-> (task_id, status, payload, chunk_seq, t_emit_ns, trace), or
+    None when ``rec`` is not a well-formed "G" record. Callers that
+    share a stream with reply records probe with this FIRST and fall
+    back to :func:`unpack_reply` — a reply's leading task-id byte may
+    collide with 'G', so chunk routing additionally requires the parsed
+    task id to match a registered stream (16 random bytes: a stray
+    match is ~2^-128)."""
+    if rec[:1] != b"G" or len(rec) < 33:
+        return None
+    chunk_seq, t_ns = _AHDR.unpack_from(rec, 1)
+    off = 13
+    trace = b""
+    if t_ns & TRACE_BIT:
+        t_ns &= ~TRACE_BIT
+        trace = rec[off:off + TRACE_LEN]
+        off += TRACE_LEN
+    if len(rec) < off + 20:
+        return None
+    task_id, status = _CHDR.unpack_from(rec, off)
+    return task_id, status, rec[off + 20:], chunk_seq, t_ns, trace
+
+
+def pack_stream_fin(nchunks: int) -> bytes:
+    """Terminal OK payload of a stream call: the total chunk count, so
+    the driver's sink can assert every chunk landed (ring + RPC-spill
+    interleavings may reorder; the sink reorders by chunk_seq and the
+    count closes the stream exactly once)."""
+    return _SEQ.pack(nchunks)
+
+
+def unpack_stream_fin(payload: bytes) -> int | None:
+    if len(payload) >= 4:
+        return _SEQ.unpack_from(payload)[0]
+    return None
 
 
 def pack_shm_size(size: int) -> bytes:

@@ -591,21 +591,34 @@ class Worker:
         for rec in recs:
             tid, mkey, args, kwargs, t_sub, seq, trc = \
                 fastpath.unpack_actor_task(rec)
-            mname = mkey[3:].decode()  # b"am:<method>"
+            stream = mkey[:3] == b"gm:"  # stream-called generator (2.3)
+            mname = mkey[3:].decode()  # b"am:<method>" / b"gm:<method>"
             verdict = None if state["downgraded"] or inst is None \
                 else self._actor_fast_verdict(mname)
-            if verdict is None or verdict[0] == "gen":
+            if verdict is None or (verdict[0] == "gen") is not stream:
                 # Sticky for the in-flight tail: replies stream back in
                 # ring order from here, the driver requeues them over RPC
                 # in FIFO order and retires the lane. Reaching this means
                 # the driver's copy of the eligibility table missed the
                 # method (added after attach) — the ordinary tables keep
-                # generators off the ring entirely.
+                # generators off the ring entirely (and stream submits
+                # ON it: a "gm:" record whose method is no longer a
+                # generator downgrades the same way).
                 state["downgraded"] = True
                 replies.append(fastpath.pack_reply(
                     tid, fastpath.NEED_SLOW, b"", seq=seq))
                 t_prev = time.perf_counter_ns()  # skipped record: don't
                 # bill its handling to the next record's deserialize
+                continue
+            if stream:
+                # generator drive always lives on the loop: chunks flush
+                # through _fast_reply_one as the method yields, beside
+                # any async batch-mates (per-stream chunk seq keeps the
+                # driver's ordering; lane FIFO only covers dispatch)
+                dispatch_items.append((tid, mname, "gen", verdict[1],
+                                       args, kwargs, t_sub, t_pop, seq,
+                                       trc))
+                t_prev = time.perf_counter_ns()
                 continue
             kind, group = verdict
             if (kind == "async" or group
@@ -687,6 +700,11 @@ class Worker:
         finishes, out of order with its batch-mates."""
         from ray_tpu.core import fastpath
 
+        if kind == "gen":  # stream-called generator ("gm:" record, 2.3)
+            await self._fast_exec_stream(ring, tid, mname, group, args,
+                                         kwargs, t_sub, t_pop, seq, trc,
+                                         transport)
+            return
         inst = self.actor_instance
         span = (self._fast_exec_span(trc, tid, mname, transport)
                 if self._trace_on else None)
@@ -750,13 +768,16 @@ class Worker:
             seq=seq, node=getattr(ring, "_desc_node", None), trace=trc)
         await self._fast_reply_one(ring, rep)
 
-    async def _fast_reply_one(self, ring, rec: bytes):
+    async def _fast_reply_one(self, ring, rec: bytes) -> bool:
         """Completion push for one out-of-order reply, loop-side (the
         ring mutex makes the pump thread + loop concurrent producers
         safe). Mirrors _fast_push_replies' semantics without blocking
         the loop: non-blocking pushes with short async backoffs, then
         the RPC spill once the result ring has stayed full past the
-        spill deadline."""
+        spill deadline. Returns False when the ring is CLOSED (the
+        driver broke the lane — its recovery owns whatever did not
+        land); stream pumps use that to stop flushing chunks to a
+        consumer that is gone."""
         from ray_tpu.core import fastpath
 
         framed = fastpath.frame_one(rec)
@@ -765,20 +786,299 @@ class Worker:
                     + max(1, self.cfg.fastpath_reply_spill_ms) / 1000.0)
         while True:
             took = ring.push_batch(fastpath.REP, framed, 0)
-            if took < 0 or took >= len(framed):
-                return  # delivered, or ring closed (driver recovery owns it)
+            if took < 0:
+                return False  # ring closed (driver recovery owns it)
+            if took >= len(framed):
+                return True  # delivered
             if loop.time() >= deadline:
                 owner = getattr(ring, "_owner_addr", None)
                 if owner is not None:
                     try:
                         await self._send_spilled_results(owner, [rec])
-                        return
+                        return True
                     except Exception:
                         # driver unreachable over RPC too: keep nudging
                         # the ring until it closes (break-lane recovery)
                         log.debug("ooo result spill failed", exc_info=True)
                 deadline = loop.time() + 0.1
             await asyncio.sleep(0.002)
+
+    async def _fast_reply_burst(self, ring, recs) -> bool:
+        """Push a burst of stream chunk records in ONE ring lock round
+        and at most one consumer wake (rt_ring_push_batch takes whole
+        records) — on a small host the per-push wake syscalls alone cost
+        a context switch each. Whatever does not fit immediately falls
+        back to the per-record spill-backed push."""
+        from ray_tpu.core import fastpath
+
+        if len(recs) == 1:
+            return await self._fast_reply_one(ring, recs[0])
+        framed = [fastpath.frame_one(r) for r in recs]
+        buf = b"".join(framed)
+        took = ring.push_batch(fastpath.REP, buf, 0)
+        if took < 0:
+            return False  # ring closed (driver recovery owns it)
+        if took >= len(buf):
+            return True
+        i, off = 0, 0  # took lands on a whole-record boundary
+        while off < took:
+            off += len(framed[i])
+            i += 1
+        for rec in recs[i:]:
+            if not await self._fast_reply_one(ring, rec):
+                return False
+        return True
+
+    async def _fast_exec_stream(self, ring, tid, mname, group, args,
+                                kwargs, t_sub, t_pop, seq, trc=b"",
+                                transport="ring"):
+        """Drive one stream-called generator method ("gm:" record, wire
+        2.3): one "G" chunk record per yielded item through
+        :meth:`_fast_reply_one` (ring or tunnel sink — the same
+        spill-backed push out-of-order replies use), then ONE ordinary
+        terminal reply (OK + chunk count, or ERR) on the lane's seq
+        machinery. Async generators run on the loop; sync generators
+        pull each item on the actor's executor/group pool (where the
+        RPC path would run them). The drive stops early when the
+        driver abandons the stream (rpc_stream_abandon — client
+        disconnect) or the ring closes under us; either way the user
+        generator is closed so GeneratorExit reaches its finally (the
+        cancellation surface: an LLM stream's finally frees its decode
+        slot)."""
+        from ray_tpu.core import fastpath
+
+        inst = self.actor_instance
+        inline_max = self.cfg.fastpath_inline_result_max
+        node = getattr(ring, "_desc_node", None)
+        aborts = getattr(self, "_fast_stream_aborts", None)
+        if aborts is None:
+            aborts = self._fast_stream_aborts = set()
+        span = (self._fast_exec_span(trc, tid, mname, transport)
+                if self._trace_on else None)
+        loop = asyncio.get_running_loop()
+        t_x0 = time.perf_counter_ns()
+        nchunks = 0
+        agen = it = None
+        pending = None  # in-flight agen.__anext__ carried between bursts
+        ok, err = True, None
+        try:
+            if chaos.ENABLED:
+                chaos.point("worker.exec", name=mname, fast=1, stream=1)
+            m = getattr(inst, mname)
+            if group and group not in self._group_execs:
+                raise TaskError(
+                    f"concurrency group {group!r} not declared on this "
+                    f"actor (declared: {sorted(self._group_execs)})")
+            if span is not None:
+                span.__enter__()
+            executor = (self._group_execs[group] if group
+                        else self.executor)
+            if inspect.isasyncgenfunction(m):
+                agen = m(*args, **kwargs)
+            else:
+                gen = await loop.run_in_executor(
+                    executor, lambda: m(*args, **kwargs))
+                if hasattr(gen, "__anext__"):
+                    agen = gen  # method returned an async generator
+                else:
+                    it = iter(gen)
+            _end = object()
+
+            def _pull_batch(nmax=64, budget_s=5e-4):
+                # amortize the executor round-trip (~hundreds of µs of
+                # thread wakeups) over every item a fast sync generator
+                # has ready: keep pulling until the time budget or nmax.
+                # A slow generator exits after ONE item (its next() alone
+                # blows the budget), so per-chunk latency is unchanged
+                # where it matters and throughput-bound streams stop
+                # paying a threadpool hop per chunk. A mid-batch user
+                # exception is DEFERRED, never raised here: the already-
+                # pulled prefix must flush as chunks before the error
+                # becomes the stream's terminal.
+                out = []
+                err = None
+                t0 = time.perf_counter()
+                try:
+                    while len(out) < nmax:
+                        out.append(next(it))
+                        if time.perf_counter() - t0 >= budget_s:
+                            break
+                except StopIteration:
+                    out.append(_end)
+                except BaseException as e:  # noqa: BLE001 — deferred
+                    err = e
+                return out, err
+
+            async def _drive(coro, f):
+                # finish a partially-stepped __anext__ coroutine in THIS
+                # task — context continuity: the generator body may hold
+                # contextvar tokens (serve's deadline), so every step
+                # must run under one Context, which rules out wrapping
+                # the coroutine in a fresh Task
+                while True:
+                    if f is not None and hasattr(
+                            f, "_asyncio_future_blocking"):
+                        f._asyncio_future_blocking = False
+                        try:
+                            await f
+                        except BaseException:  # raylint: disable=RT012 — not a swallow: the frame re-raises from f.result() at the next send
+                            pass
+                    else:
+                        await asyncio.sleep(0)
+                    try:
+                        f = coro.send(None)
+                    except StopIteration as si:
+                        return si.value
+
+            done = False
+            defer_err = None  # user error held until its prefix flushes
+            while not done:
+                if tid in aborts:
+                    break  # consumer is gone: close the generator below
+                if agen is not None:
+                    items = []
+                    if pending is not None:
+                        coro, f = pending
+                        pending = None
+                        try:
+                            items.append(await _drive(coro, f))
+                        except StopAsyncIteration:
+                            done = True
+                        except BaseException as e:  # noqa: BLE001
+                            defer_err = e
+                            done = True
+                    # greedy ready-drain: step __anext__ synchronously —
+                    # a producer with items buffered (the serve replica
+                    # wrapper's pool batch, a decode block) yields each
+                    # without suspending, so the whole backlog lands in
+                    # ONE burst (one ring push + one consumer wake)
+                    # instead of a push per item
+                    while not done and len(items) < 64:
+                        coro = agen.__anext__()
+                        try:
+                            f = coro.send(None)
+                        except StopIteration as si:
+                            items.append(si.value)
+                            continue
+                        except StopAsyncIteration:
+                            done = True
+                            break
+                        except BaseException as e:  # noqa: BLE001
+                            defer_err = e
+                            done = True
+                            break
+                        # producer suspended: flush what is ready now;
+                        # the parked step resumes after the burst lands
+                        if items:
+                            pending = (coro, f)
+                        else:
+                            try:
+                                items.append(await _drive(coro, f))
+                            except StopAsyncIteration:
+                                done = True
+                            except BaseException as e:  # noqa: BLE001
+                                defer_err = e
+                                done = True
+                        break
+                else:
+                    items, defer_err = await loop.run_in_executor(
+                        executor, _pull_batch)
+                    if defer_err is not None:
+                        done = True
+                burst = []
+                for item in items:
+                    if item is _end:
+                        done = True
+                        break
+                    burst.append(self._fast_pack_chunk(
+                        tid, item, inline_max, nchunks, node, trc))
+                    nchunks += 1
+                if burst and not await self._fast_reply_burst(ring, burst):
+                    return  # ring closed: recovery owns it
+            if defer_err is not None:
+                raise defer_err
+            if span is not None:
+                span.__exit__(None, None, None)
+        except BaseException as e:  # noqa: BLE001 — reply on
+            ok, err = False, e
+            if span is not None and span._token is not None:
+                span.__exit__(type(e), e, None)
+        finally:
+            aborts.discard(tid)
+            if agen is not None:
+                if pending is not None:
+                    # a parked __anext__ is mid-flight inside the
+                    # generator: close the step (GeneratorExit reaches
+                    # the body's finally) or aclose would see it
+                    # "already running"
+                    try:
+                        pending[0].close()
+                    except BaseException:  # raylint: disable=RT012 — cleanup: aclose below reports the real failure
+                        pass
+                try:
+                    await agen.aclose()
+                except BaseException:  # noqa: BLE001 — cleanup only
+                    log.debug("stream aclose failed", exc_info=True)
+            elif it is not None:
+                try:
+                    await loop.run_in_executor(None, it.close)
+                except BaseException:  # noqa: BLE001 — cleanup only
+                    log.debug("stream close failed", exc_info=True)
+        t_x1 = time.perf_counter_ns()
+        stamp = (fastpath.pack_stamp(t_pop - t_sub, max(0, t_x0 - t_pop),
+                                     t_x1 - t_x0) if t_sub else b"")
+        if ok:
+            rep = fastpath.pack_reply(tid, fastpath.OK,
+                                      fastpath.pack_stream_fin(nchunks),
+                                      stamp, seq, trc)
+        else:
+            rep = fastpath.pack_reply(tid, fastpath.ERR,
+                                      self._fast_pack_error(err), stamp,
+                                      seq, trc)
+        await self._fast_reply_one(ring, rep)
+
+    def _fast_pack_chunk(self, tid: bytes, item, inline_max: int,
+                         chunk_seq: int, node: bytes | None,
+                         trc: bytes = b"") -> bytes:
+        """Pack one yielded item as a "G" chunk record: inline when it
+        fits, else sealed into the node arena under return index
+        chunk_seq + 1 (index 0 stays the terminal reply's) and shipped
+        as a shm size/desc — exactly the OK_SHM economics, per chunk.
+        An unpackable item raises, which ends the stream with a terminal
+        ERR — loud at the consumer, never a silent skip."""
+        from ray_tpu.core import fastpath
+
+        t_ns = time.perf_counter_ns()
+        try:
+            meta, buffers = serialization.dumps_with_buffers(item)
+            size = serialization.total_size(meta, buffers)
+            payload = _pack_bytes(meta, buffers, size)
+            if size <= inline_max:
+                return fastpath.pack_chunk(tid, fastpath.CHUNK, payload,
+                                           chunk_seq, t_ns, trc)
+            oid = ObjectID.for_task_return(TaskID(tid), chunk_seq + 1)
+            if not self.core.store.contains(oid):
+                self.core.store.put_raw(oid, payload)
+            return fastpath.pack_chunk(
+                tid, fastpath.CHUNK_SHM,
+                fastpath.pack_shm_desc(size, node) if node is not None
+                else fastpath.pack_shm_size(size),
+                chunk_seq, t_ns, trc)
+        except Exception as e:
+            raise TaskError(f"unpackable stream item: {e!r}") from e
+
+    async def rpc_stream_abandon(self, conn, p):
+        """Driver-side consumer of an open stream went away (client
+        disconnect, sink aclose): stop flushing its chunks and close
+        the user generator at the next yield point. Best-effort notify
+        — an id that never arrives just means the stream runs to its
+        natural end against a closed ring."""
+        aborts = getattr(self, "_fast_stream_aborts", None)
+        if aborts is None:
+            aborts = self._fast_stream_aborts = set()
+        for tid in p.get("task_ids", ()):
+            aborts.add(bytes(tid))
+        return True
 
     # -------------------------------------------- node tunnel (core/tunnel.py)
     async def rpc_tunnel_attach(self, conn, p):
@@ -927,6 +1227,20 @@ class Worker:
             mname = mkey[3:].decode()
             verdict = None if st["downgraded"] or inst is None \
                 else self._actor_fast_verdict(mname)
+            if (mkey[:3] == b"gm:" and verdict is not None
+                    and verdict[0] == "gen"):
+                # stream call mixed into a sync serial batch: the
+                # generator drive lives on the loop (chunks flush as it
+                # yields) — stream calls are unordered by contract, so
+                # hopping out of the serial batch is safe
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._tunnel_exec_record_on_loop(st, rec, t_pop),
+                        self.core.loop)
+                except RuntimeError:
+                    return  # loop gone (worker exit)
+                t_prev = time.perf_counter_ns()
+                continue
             if verdict is None or verdict[0] != "sync" or verdict[1]:
                 st["downgraded"] = True
                 replies.append(fastpath.pack_reply(
@@ -1089,11 +1403,12 @@ class Worker:
             tid, mkey, args, kwargs, t_sub, seq, trc = \
                 fastpath.unpack_actor_task(rec)
             t_sub = self._tunnel_t_sub(t_sub, t_pop)
+            stream = mkey[:3] == b"gm:"  # stream-called generator (2.3)
             mname = mkey[3:].decode()
             verdict = None
             if not st["downgraded"] and self.actor_instance is not None:
                 verdict = self._actor_fast_verdict(mname)
-            if verdict is None or verdict[0] == "gen":
+            if verdict is None or (verdict[0] == "gen") is not stream:
                 # sticky, like the ring pump: executing later records
                 # while an earlier one replays over RPC would reorder
                 # the caller's calls
@@ -1108,8 +1423,9 @@ class Worker:
                     tid, fastpath.ERR, self._fast_pack_error(e), seq=seq))
                 return
             await self._fast_exec_dispatched(
-                sink, tid, mname, verdict[0], verdict[1], args, kwargs,
-                t_sub, t_pop, seq, trc, "tunnel")
+                sink, tid, mname, "gen" if stream else verdict[0],
+                verdict[1], args, kwargs, t_sub, t_pop, seq, trc,
+                "tunnel")
             return
         # plain task record ("Q"/"R"/"P"/"S")
         tid, func_id, args, kwargs, t_sub, trc = fastpath.unpack_task(rec)

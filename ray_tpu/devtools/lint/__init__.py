@@ -16,6 +16,7 @@ Rules (see `ray_tpu lint --rules` for rationale):
   ...
   RT018 wire prefix/flag literal absent from the schema catalog
   RT019 metric constructed inside a hot-path root function
+  RT024 whole stream materialized into a list on the request path
 
 The interprocedural pass (`ray_tpu lint --flow`, flow.py) adds
 RT020-RT023: it builds a package-wide call graph, infers per-function

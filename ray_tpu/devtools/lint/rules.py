@@ -1,4 +1,4 @@
-"""raylint rules RT001-RT019 + flow-rule registrations RT020-RT023.
+"""raylint rules RT001-RT019/RT024 + flow-rule registrations RT020-RT023.
 
 Each AST rule is a Rule subclass registered with @register; hooks
 receive (node, ctx) from the engine's single AST walk. See
@@ -817,6 +817,91 @@ class WireSchemaLiteralDrift(Rule):
                            f"{name} = {v.value:#x} defines a status flag "
                            "absent from schema.RECORD_FLAGS — catalog it "
                            "(value + since-version) in the same commit")
+
+
+# stream producers: attribute calls that return an incremental stream —
+# the handle-level planes (.stream() per-item refs, .stream_chunks() "G"
+# chunk records, .stream_deltas() producer) and the router legs beneath
+# them. The attribute shape is unresolvable through imports (the receiver
+# is a handle in a local), so RT024 gates on uses_framework like RT003.
+_STREAM_PRODUCERS = {"stream", "stream_chunks", "stream_deltas",
+                     "route_streaming", "route_streaming_async",
+                     "route_stream_chunks"}
+
+
+@register
+class WholeStreamMaterialized(Rule):
+    id = "RT024"
+    summary = ("whole stream materialized into a list inside a function "
+               "body")
+    rationale = ("the streaming plane exists so chunks reach the consumer "
+                 "as they are produced — TTFC tracks the FIRST decode "
+                 "block and memory stays one chunk deep; `[x async for x "
+                 "in stream]` or `list(stream)` buffers every chunk "
+                 "before the caller sees one, so time-to-first-chunk "
+                 "silently becomes total generation latency and the "
+                 "buffer grows with max_tokens — a unary call with "
+                 "streaming overhead; consume incrementally (async for) "
+                 "or call the unary method")
+
+    def __init__(self):
+        self._streams: set[str] = set()
+
+    def on_functiondef(self, node: ast.FunctionDef, ctx: Context):
+        # per-function forward flow, the RT014 binding idiom: names bound
+        # from stream-producer calls are streams until rebound
+        self._streams.clear()
+
+    on_asyncfunctiondef = on_functiondef
+
+    def _is_producer(self, node: ast.AST, ctx: Context) -> bool:
+        return (ctx.uses_framework
+                and isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _STREAM_PRODUCERS)
+
+    def on_assign(self, node: ast.Assign, ctx: Context):
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            return
+        name = node.targets[0].id
+        if self._is_producer(node.value, ctx):
+            self._streams.add(name)
+        else:
+            self._streams.discard(name)
+
+    def _check_source(self, it: ast.AST, node: ast.AST, how: str,
+                      ctx: Context) -> bool:
+        if isinstance(it, ast.Name) and it.id in self._streams:
+            src = it.id
+        elif self._is_producer(it, ctx):
+            src = f".{it.func.attr}(...)"
+        else:
+            return False
+        ctx.report(self, node,
+                   f"{how} over the stream {src} buffers every chunk "
+                   "before the caller sees the first one (TTFC becomes "
+                   "total latency, memory grows with the generation); "
+                   "consume it incrementally with `async for` / `for`, "
+                   "or use the unary method")
+        return True
+
+    def on_listcomp(self, node, ctx: Context):
+        if not ctx.func_depth:
+            return
+        for gen in node.generators:
+            if self._check_source(gen.iter, node, "a comprehension", ctx):
+                return
+
+    on_setcomp = on_listcomp
+
+    def on_call(self, node: ast.Call, ctx: Context):
+        func = node.func
+        if (not ctx.func_depth or not node.args
+                or not (isinstance(func, ast.Name) and func.id == "list"
+                        and ctx.imports.resolve(func) is None)):
+            return
+        self._check_source(node.args[0], node, "list()", ctx)
 
 
 # ------------------------------------------- RT020-RT023: flow-pass rules

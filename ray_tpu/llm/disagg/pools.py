@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ray_tpu.core.ref import ObjectLostError
+from ray_tpu.devtools import chaos
 from ray_tpu.llm import engine as _engine
 from ray_tpu.llm.disagg import telemetry
 from ray_tpu.llm.disagg.kv_plane import (
@@ -375,6 +376,10 @@ class DecodeWorker:
             max_waiting=max_waiting, kv_dtype=kv_dtype,
             spec_enable=spec_enable, spec_k=spec_k, spec_ngram=spec_ngram,
             spec_drafter=spec_drafter)
+        # live streaming decodes by scheduler-chosen key: the explicit
+        # cancel path for streams riding the per-item RPC fallback (the
+        # fast lane's abandon reaches the generator's finally directly)
+        self._stream_rids: dict[str, int] = {}
 
     async def decode_adopted(self, token_ids, manifest: KVPageManifest,
                              extra: KVPageManifest | None = None,
@@ -420,6 +425,71 @@ class DecodeWorker:
         # the scheduler's and the dashboard's numbers fresh
         telemetry.publish_decode_signals(self.engine)
         return out
+
+    async def decode_adopted_stream(self, token_ids,
+                                    manifest: KVPageManifest,
+                                    extra: KVPageManifest | None = None,
+                                    first_token: int = 0, *,
+                                    max_tokens: int = 32,
+                                    temperature: float = 0.0,
+                                    adapter: str | None = None,
+                                    cancel_key: str = ""):
+        """Streaming twin of :meth:`decode_adopted`: yields token-id
+        DELTAS, one list per fused decode block (the engine's
+        ``stream_blocks`` coalescing), concatenating to exactly what
+        ``decode_adopted`` would have returned. Closing the stream — the
+        worker pump's GeneratorExit when the consumer abandons the "G"
+        chunk stream, or :meth:`cancel_decode` with ``cancel_key`` on the
+        RPC fallback plane — cancels the engine request: the decode slot
+        and its KV pages free at the next block boundary, with zero
+        duplicate prefill spent."""
+        from ray_tpu.serve.exceptions import BackPressureError
+
+        await self.engine.start()
+        loop = asyncio.get_running_loop()
+        try:
+            k_stack, v_stack = await loop.run_in_executor(
+                None, adopt_pages, manifest, extra)
+        except ObjectLostError as e:
+            raise KVShipError(f"adopt: sealed pages lost: {e}") from None
+        try:
+            rid = self.engine.submit_prefilled(
+                [int(t) for t in token_ids], k_stack, v_stack,
+                int(first_token), max_tokens=max_tokens,
+                temperature=temperature, adapter=adapter)
+        except _engine.EngineFull as e:
+            raise BackPressureError(
+                f"decode engine full: {e}",
+                retry_after_s=0.05 * (1 + len(self.engine.waiting)),
+            ) from None
+        if cancel_key:
+            self._stream_rids[cancel_key] = rid
+        t_submit = time.perf_counter_ns()
+        first = True
+        try:
+            async for blk in self.engine.stream_blocks(rid):
+                if chaos.ENABLED:
+                    chaos.point("llm.decode_block", n_tokens=len(blk))
+                if first:
+                    first = False
+                    telemetry.record(telemetry.DECODE_QUEUE,
+                                     time.perf_counter_ns() - t_submit)
+                yield blk
+        finally:
+            self.engine.cancel(rid)  # no-op once finished
+            if cancel_key:
+                self._stream_rids.pop(cancel_key, None)
+            telemetry.publish_decode_signals(self.engine)
+
+    def cancel_decode(self, cancel_key: str) -> bool:
+        """Cancel a live streaming decode by the scheduler's key —
+        the mid-stream disconnect path for streams on the per-item RPC
+        fallback, where no ring abandon reaches the generator."""
+        rid = self._stream_rids.get(cancel_key)
+        if rid is None:
+            return False
+        self.engine.cancel(rid)
+        return True
 
     def headroom(self) -> dict:
         telemetry.publish_decode_signals(self.engine)

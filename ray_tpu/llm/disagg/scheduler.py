@@ -247,7 +247,11 @@ class DisaggLLMServer:
                     # admit while the probe is in flight is "unseen"
                     est_t, est_p = self._est_tokens[i], self._est_pages[i]
                     try:
-                        hr = await self._pool_call(w, "headroom", (), {})
+                        # bounded: a probe hung on a killed worker's
+                        # half-broken lane must not wedge the loop (the
+                        # respawned worker needs the NEXT probe)
+                        hr = await asyncio.wait_for(
+                            self._pool_call(w, "headroom", (), {}), 2.0)
                     except Exception:
                         continue  # dead/restarting worker: keep stale
                     self._signals[i] = {
@@ -358,6 +362,47 @@ class DisaggLLMServer:
                 except FastLaneDeclined:
                     pass  # stale method table: RPC below, lane survives
         return await getattr(handle, method).remote(*args, **kwargs)
+
+    async def _pool_stream(self, handle, method, args, kwargs):
+        """Streaming twin of :meth:`_pool_call`: yields the pool worker
+        generator's items. Fast path = ONE "G"-chunked stream on the
+        worker's ring/tunnel lane (``fast_actor_submit_stream``) — token
+        deltas hop scheduler<-decode with no per-item ObjectRef; fallback
+        = the per-item ObjectRef plane. A NEED_SLOW decline provably
+        precedes execution, so the fallback re-dispatch never duplicates
+        decode work."""
+        from ray_tpu.core import api as _api
+        from ray_tpu.core.core_client import FastLaneDeclined
+
+        core = _api.get_core()
+        try:
+            on_core = asyncio.get_running_loop() is core.loop
+        except RuntimeError:
+            on_core = False
+        if on_core and getattr(core.cfg, "fastpath_enabled", False):
+            out = core.fast_actor_submit_stream(handle.actor_id, method,
+                                                args, kwargs)
+            if out is not None:
+                agen = core.fast_actor_stream(out[0], out[1])
+                try:
+                    try:
+                        async for item in agen:
+                            yield item
+                        return
+                    except FastLaneDeclined:
+                        pass  # stale method table: RPC below, nothing ran
+                finally:
+                    await agen.aclose()
+        gen = getattr(handle, method).options(
+            num_returns="streaming").remote(*args, **kwargs)
+        try:
+            async for ref in gen:
+                (item,) = await core.get_async([ref])
+                yield item
+        finally:
+            aclose = getattr(gen, "aclose", None)
+            if aclose is not None:
+                await aclose()
 
     def _backpressure(self, n_need: int):
         from ray_tpu.serve.exceptions import BackPressureError
@@ -507,6 +552,177 @@ class DisaggLLMServer:
         finally:
             self.cache.release(prefix_m)
 
+    async def stream(self, request: dict):
+        """Streaming disagg completion: ``{"tokens": [...]}`` deltas —
+        one per fused decode block, hopping decode ring -> scheduler ->
+        replica as "G" chunk records — then a terminal delta carrying
+        ``usage``. Concatenated tokens are identical to ``__call__``'s
+        ``completion_tokens``.
+
+        Fault contract: initial routing (prefill + decode admission)
+        reuses the bounded retry/steal machinery unchanged; once a delta
+        has been consumed the stream is NEVER replayed — a decode-worker
+        death mid-stream surfaces as a typed
+        :class:`~ray_tpu.serve.streaming.StreamBrokenError`. Abandoning
+        the stream (client disconnect) cancels the decode — the slot and
+        KV pages free at the next block boundary — with zero duplicate
+        prefills spent."""
+        from ray_tpu.serve.exceptions import BackPressureError
+        from ray_tpu.serve.streaming import StreamBrokenError
+
+        toks = [int(t) for t in request["prompt_tokens"]]
+        if not toks:
+            raise ValueError("empty prompt")
+        mt = int(request.get("max_tokens", self.default_max_tokens))
+        temp = float(request.get("temperature", 0.0))
+        adapter = request.get("model")
+        t_arr = time.perf_counter_ns()
+        self.requests += 1
+        self._ensure_signal_loop()
+        n_need = -(-(len(toks) + mt) // self.PS)
+        if n_need > self._capacity:
+            raise ValueError(
+                f"request needs {n_need} KV pages but decode pools hold "
+                f"{self._capacity}")
+        cancel_key = f"{self._uuid}:{self.requests}"
+        excluded: set[int] = set()
+        f_excluded: set[str] = set()
+        prefix_m = None
+        manifest = extra = first = None
+        t_first = None
+        last_err = None
+        target = None
+        completed = False
+        n_out = 0
+        try:
+            for attempt in range(self.max_attempts + 1):
+                widx = self._pick_decode(n_need, excluded)
+                fkey = fhandle = None
+                if widx is None:
+                    picked = self._pick_foreign(n_need, f_excluded)
+                    if picked is not None:
+                        fkey, fhandle = picked
+                if widx is None and fhandle is None and excluded:
+                    excluded.clear()
+                    widx = self._pick_decode(n_need, excluded)
+                if widx is None and fhandle is None:
+                    self._backpressure(n_need)
+                if widx is not None:
+                    self._est_pages[widx] += n_need
+                    self._est_tokens[widx] += mt
+                try:
+                    if manifest is None:
+                        try:
+                            (manifest, extra, first,
+                             prefix_m) = await self._prefill(
+                                toks, temp, adapter)
+                        except Exception as e:  # noqa: BLE001 — prefill leg
+                            last_err = e
+                            if isinstance(e, (KVShipError,
+                                              ObjectLostError)):
+                                self.cache.invalidate(toks)
+                                prefix_m = None
+                                continue
+                            if _is_worker_death(e):
+                                continue
+                            raise
+                        if attempt:
+                            self.duplicate_prefills += 1
+                            telemetry.count(duplicate_prefills=1)
+                        if t_first is None:
+                            t_first = time.perf_counter_ns()
+                            telemetry.record(telemetry.TTFT,
+                                             t_first - t_arr)
+                    target = (self.decode_pool[widx]
+                              if widx is not None else fhandle)
+                    with telemetry.traced("disagg::decode"):
+                        async for blk in self._pool_stream(
+                                target, "decode_adopted_stream",
+                                (toks, manifest, extra, first),
+                                dict(max_tokens=mt, temperature=temp,
+                                     adapter=adapter,
+                                     cancel_key=cancel_key)):
+                            n_out += len(blk)
+                            yield {"tokens": blk}
+                    t_done = time.perf_counter_ns()
+                    if n_out > 1:
+                        telemetry.record(telemetry.TPOT,
+                                         (t_done - t_first) // (n_out - 1))
+                    if widx is not None:
+                        self.decode_tokens[widx] += n_out
+                    else:
+                        self.stolen += 1
+                        self.stolen_tokens += n_out
+                    pages = list(manifest.pages) + (
+                        list(extra.pages) if extra else [])
+                    if pages and len(toks) >= self.PS:
+                        self.cache.insert(KVPageManifest(
+                            token_ids=tuple(toks), page_size=self.PS,
+                            kv_dtype=self.cache.kv_dtype, pages=pages))
+                    completed = True
+                    yield {
+                        "tokens": [],
+                        "done": True,
+                        "usage": {
+                            "prompt_tokens": len(toks),
+                            "completion_tokens": n_out,
+                            "cached_prefix_tokens": (prefix_m.n_tokens
+                                                     if prefix_m else 0),
+                            "latency_s": (t_done - t_arr) / 1e9,
+                            "ttft_s": (t_first - t_arr) / 1e9,
+                            "decode_worker": (widx if widx is not None
+                                              else f"steal:{fkey}"),
+                            "attempts": attempt + 1,
+                        },
+                    }
+                    return
+                except Exception as e:  # noqa: BLE001 — decode leg
+                    if n_out:
+                        # consumed deltas are never replayed: surface the
+                        # break typed, with how far the stream got
+                        if _is_worker_death(e):
+                            raise StreamBrokenError(
+                                f"decode stream broke after {n_out} "
+                                f"token(s): {e}",
+                                chunks_consumed=n_out) from e
+                        raise
+                    last_err = e
+                    if isinstance(e, (KVShipError, ObjectLostError)):
+                        self.cache.release(prefix_m)
+                        self.cache.invalidate(toks)
+                        prefix_m = manifest = extra = first = None
+                        continue
+                    if _is_worker_death(e):
+                        if widx is not None:
+                            excluded.add(widx)
+                        else:
+                            f_excluded.add(fkey)
+                            self._foreign.pop(fkey, None)
+                        self.decode_retries += 1
+                        continue
+                    if isinstance(e, BackPressureError):
+                        if widx is not None:
+                            excluded.add(widx)
+                        else:
+                            f_excluded.add(fkey)
+                        continue
+                    raise
+                finally:
+                    if widx is not None:
+                        self._est_pages[widx] -= n_need
+                        self._est_tokens[widx] -= mt
+            raise last_err
+        finally:
+            self.cache.release(prefix_m)
+            if not completed and target is not None:
+                # abandoned/broken mid-flight: free the decode slot NOW.
+                # The ring plane's abandon already closed the worker's
+                # generator; this reaches streams on the RPC fallback.
+                try:
+                    target.cancel_decode.remote(cancel_key)  # raylint: disable=RT003 — best-effort cancel; the stream's remainder is discarded either way
+                except Exception:  # raylint: disable=RT012 — worker may be gone; its stream died with it
+                    pass
+
     async def _prefill(self, toks, temp, adapter):
         """Cache-aware prefill: longest cached page prefix rides the
         suffix path; a miss runs the full prompt. Returns
@@ -562,6 +778,10 @@ class DisaggLLMServer:
     async def stats(self) -> dict:
         """Scheduler + cache + pool-wide KV-plane counters (the byte
         ledger summed across every worker process)."""
+        # monitoring counts as interest in fresh decode signals: keep the
+        # probe loop alive so ``decode_signals`` tracks live workers (a
+        # respawned worker replaces its dead predecessor's stale entry)
+        self._ensure_signal_loop()
         refs = [w.disagg_counters.remote()
                 for w in (*self.prefill_pool, *self.decode_pool)]
         vals = await asyncio.gather(*refs, return_exceptions=True)

@@ -846,9 +846,52 @@ class ContinuousBatchingEngine:
             # mid-stream must not make cancel() a no-op on a live request
             self._done.pop(req_id, None)
 
+    async def stream_blocks(self, req_id: int):
+        """Block-coalesced stream: lists of token ids, one per wake.
+
+        ``_emit_block`` pushes a whole fused ``lax.scan`` block's tokens
+        into the request queue in one synchronous burst, so draining the
+        queue greedily after the first await yields exactly one delta per
+        fused decode block (per accepted run in spec mode). This is the
+        streaming-serve producer shape: one "G" chunk record per BLOCK on
+        the wire instead of one per token — token-identical to
+        ``stream()``, at block-granularity overhead."""
+        req = self._reqs.get(req_id)
+        if req is None:
+            req = self._done[req_id]
+        try:
+            while True:
+                item = await req.out.get()
+                if item is None:
+                    if self.error is not None and not req.finished:
+                        raise RuntimeError("engine loop died") from self.error
+                    return
+                blk = [item]
+                while True:
+                    try:
+                        nxt = req.out.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    if nxt is None:
+                        # terminal already queued behind the block
+                        yield blk
+                        if self.error is not None and not req.finished:
+                            raise RuntimeError(
+                                "engine loop died") from self.error
+                        return
+                    blk.append(nxt)
+                yield blk
+        finally:
+            self._done.pop(req_id, None)
+
     async def generate(self, prompt_tokens: list[int], **kw) -> list[int]:
         rid = self.submit(prompt_tokens, **kw)
-        return [t async for t in self.stream(rid)]
+        out: list[int] = []
+        # block-granular drain: one loop wake per fused decode block
+        # instead of one per token
+        async for blk in self.stream_blocks(rid):
+            out.extend(blk)
+        return out
 
     def cancel(self, req_id: int):
         req = self._reqs.get(req_id)
@@ -1226,6 +1269,10 @@ class ContinuousBatchingEngine:
                         self._free_slot(i)
                 if self._admit_wave():
                     carry = None
+                    # the wave just emitted each admitted request's
+                    # prefill token: let consumers flush it (TTFC) before
+                    # the next decode dispatch occupies the loop thread
+                    await asyncio.sleep(0)
             active = np.array([r is not None for r in self.slot_req])
             if not active.any():
                 drain()
@@ -1390,6 +1437,9 @@ class ContinuousBatchingEngine:
                         self._free_slot(i)
                 if self._admit_wave():
                     carry = None
+                    # flush the just-emitted prefill tokens (TTFC) before
+                    # the next spec dispatch occupies the loop thread
+                    await asyncio.sleep(0)
             active = np.array([r is not None for r in self.slot_req])
             if not active.any():
                 drain()

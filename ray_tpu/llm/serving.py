@@ -144,7 +144,12 @@ class LLMEngineServer:
         await self._ensure_started()
         t0 = time.monotonic()
         rid = self._submit(request)
-        out = [t async for t in self.engine.stream(rid)]
+        # block-granular drain: the engine emits whole fused decode
+        # blocks host-side, so draining per block costs one loop wake per
+        # block instead of one per token
+        out: list[int] = []
+        async for blk in self.engine.stream_blocks(rid):
+            out.extend(blk)
         return {
             "completion_tokens": out,
             "usage": {
@@ -156,11 +161,45 @@ class LLMEngineServer:
 
     async def stream(self, request: dict):
         """Async generator of token ids — served to callers through the
-        handle's .stream() (one ObjectRef per token)."""
+        handle's .stream() (one ObjectRef per token). An abandoned
+        consumer cancels the request: the decode slot and its KV pages
+        free at the next block boundary, not when the generation would
+        have finished."""
         await self._ensure_started()
         rid = self._submit(request)
-        async for tok in self.engine.stream(rid):
-            yield tok
+        try:
+            async for tok in self.engine.stream(rid):
+                yield tok
+        finally:
+            self.engine.cancel(rid)  # no-op once finished
+
+    async def stream_deltas(self, request: dict):
+        """Streaming-serve producer: one ``{"tokens": [...]}`` delta per
+        fused decode block (served as one "G" chunk record each through
+        the handle's ``.stream_chunks()``), then a terminal delta with
+        ``usage``. Token-identical to ``__call__``'s completion_tokens.
+        Closing the stream mid-generation cancels the engine request —
+        the replica wrapper's GeneratorExit reaches the ``finally`` here
+        and the decode slot frees at the next block boundary."""
+        await self._ensure_started()
+        t0 = time.monotonic()
+        rid = self._submit(request)
+        n = 0
+        try:
+            async for blk in self.engine.stream_blocks(rid):
+                n += len(blk)
+                yield {"tokens": blk}
+            yield {
+                "tokens": [],
+                "done": True,
+                "usage": {
+                    "prompt_tokens": len(request["prompt_tokens"]),
+                    "completion_tokens": n,
+                    "latency_s": time.monotonic() - t0,
+                },
+            }
+        finally:
+            self.engine.cancel(rid)  # no-op once finished
 
     def engine_stats(self) -> dict:
         return {"steps": self.engine.steps, "tokens_out": self.engine.tokens_out,

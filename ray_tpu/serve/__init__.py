@@ -43,6 +43,7 @@ from ray_tpu.serve.schema import (
     build_config,
     deploy_config,
 )
+from ray_tpu.serve.streaming import ServeStream, StreamBrokenError
 
 __all__ = [
     "AutoscalingConfig",
@@ -57,6 +58,8 @@ __all__ = [
     "RequestTimeoutError",
     "ServeApplicationSchema",
     "ServeDeploySchema",
+    "ServeStream",
+    "StreamBrokenError",
     "build_config",
     "deploy_config",
     "RayServeException",

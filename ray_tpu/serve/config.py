@@ -122,6 +122,15 @@ class DeploymentConfig:
     #: sheds work that cannot start inside it. None = no SLO: batching
     #: stays fixed-size, the autoscaler falls back to queue depth alone.
     latency_slo_ms: float | None = None
+    # --- streaming SLOs (serve/streaming, wire 2.3) ---
+    #: time-to-first-chunk budget for streaming requests (arrival ->
+    #: first yielded item). None = inherit latency_slo_ms: a stream's
+    #: first token races the whole-response budget by default.
+    ttfc_slo_ms: float | None = None
+    #: inter-chunk gap budget — breaches mean the stream STALLS
+    #: mid-generation (decode batches saturating). None = gaps are
+    #: recorded (p99 observable) but never counted as breaches.
+    interchunk_slo_ms: float | None = None
 
     def __post_init__(self):
         if self.max_request_retries < 0:
@@ -134,6 +143,10 @@ class DeploymentConfig:
             raise ValueError("max_queued_requests must be >= -1")
         if self.latency_slo_ms is not None and self.latency_slo_ms <= 0:
             raise ValueError("latency_slo_ms must be > 0 (None = no SLO)")
+        if self.ttfc_slo_ms is not None and self.ttfc_slo_ms <= 0:
+            raise ValueError("ttfc_slo_ms must be > 0 (None = inherit)")
+        if self.interchunk_slo_ms is not None and self.interchunk_slo_ms <= 0:
+            raise ValueError("interchunk_slo_ms must be > 0 (None = off)")
         if isinstance(self.retry_on, str):
             self.retry_on = (self.retry_on,)
         else:
@@ -152,6 +165,8 @@ class DeploymentConfig:
             # projects queue delay from these two plus probed metrics
             "max_ongoing_requests": self.max_ongoing_requests,
             "latency_slo_ms": self.latency_slo_ms,
+            "ttfc_slo_ms": self.ttfc_slo_ms,
+            "interchunk_slo_ms": self.interchunk_slo_ms,
         }
 
     def initial_replicas(self) -> int:

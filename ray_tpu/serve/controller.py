@@ -346,6 +346,8 @@ class ServeController:
                     getattr(cfg, "max_queued_requests", -1),
                     getattr(cfg, "latency_slo_ms", None),
                     st.app_name,
+                    getattr(cfg, "ttfc_slo_ms", None),
+                    getattr(cfg, "interchunk_slo_ms", None),
                 )
             )
             st.replicas[rid] = {
@@ -482,16 +484,29 @@ class ServeController:
             if now - ts > 3.0:  # stale reporter
                 st.handle_queued.pop(rid, None)
         slo_ms = getattr(cfg, "latency_slo_ms", None)
-        if slo_ms is not None:
+        # streaming signals share the latency plane under prefixed keys
+        # (serve/streaming/slo.py): pick whichever signal — unary e2e,
+        # TTFC, or inter-chunk gap — is burning hottest against ITS
+        # budget, so a deployment whose streams stall upscales even while
+        # its unary p99 looks healthy
+        signals = self._slo_signals(st.key, cfg)
+        if signals:
             await self._refresh_p99()
+        best = None
+        for key, budget in signals:
+            p = self._p99.get(key)
+            if p is not None and (best is None or p / budget > best[0]):
+                best = (p / budget, p, budget)
+        p99_ms, sig_slo = ((best[1], best[2]) if best is not None
+                           else (self._p99.get(st.key), slo_ms))
         decision = self._autoscaler.decide(
             st.key,
             current=st.target_replicas,
             auto=auto,
             ongoing=float(sum(st.metrics.values())),
             handle_queued=float(sum(q for q, _ in st.handle_queued.values())),
-            p99_ms=self._p99.get(st.key),
-            slo_ms=slo_ms,
+            p99_ms=p99_ms,
+            slo_ms=sig_slo,
             lifetime_total=sum(st.totals.values()) if st.totals else None,
         )
         if decision is None:
@@ -545,24 +560,42 @@ class ServeController:
             logging.getLogger(__name__).debug(
                 "serve p99 refresh failed", exc_info=True)
 
-    async def _slo_tick(self, st: _DeploymentState):
-        """One burn-rate observation + alert check for one deployment
-        (deployments without a latency_slo_ms have no latency SLO to
-        burn). Fired alerts ride the ``slo_burn`` pubsub channel and a
-        bounded ns="serve" kv history — the autoscale fan-out shape."""
-        cfg = st.spec["config"]
+    @staticmethod
+    def _slo_signals(key: str, cfg) -> list[tuple[str, float]]:
+        """(latency-plane key, budget_ms) pairs with a configured budget:
+        unary e2e, streaming TTFC (inheriting the unary budget when
+        unset, matching the replica-side default), inter-chunk gap."""
         slo_ms = getattr(cfg, "latency_slo_ms", None)
-        if slo_ms is None:
-            return
-        breach = await self._breach_fraction(st, float(slo_ms))
-        if breach is None:
-            return
-        self._slo_monitor.observe(st.key, breach)
-        alert = self._slo_monitor.check(st.key, float(slo_ms))
-        if alert is None:
-            return
-        self._slo_burn_events.append(alert.to_dict())
-        del self._slo_burn_events[:-AUTOSCALE_EVENTS_CAP]
+        ttfc_ms = getattr(cfg, "ttfc_slo_ms", None)
+        if ttfc_ms is None:
+            ttfc_ms = slo_ms
+        gap_ms = getattr(cfg, "interchunk_slo_ms", None)
+        return [(k, float(b)) for k, b in
+                ((key, slo_ms), (f"ttfc:{key}", ttfc_ms),
+                 (f"gap:{key}", gap_ms))
+                if b is not None]
+
+    async def _slo_tick(self, st: _DeploymentState):
+        """One burn-rate observation + alert check per SLO signal of one
+        deployment — unary e2e, streaming TTFC, inter-chunk gap; each
+        burns independently against its own budget under its own monitor
+        key (a stalling stream fires ``gap:<key>`` without touching the
+        unary alert state). Fired alerts ride the ``slo_burn`` pubsub
+        channel and a bounded ns="serve" kv history — the autoscale
+        fan-out shape."""
+        for key, budget in self._slo_signals(st.key, st.spec["config"]):
+            breach = await self._breach_fraction(st, budget, key=key)
+            if breach is None:
+                continue
+            self._slo_monitor.observe(key, breach)
+            alert = self._slo_monitor.check(key, budget)
+            if alert is None:
+                continue
+            self._slo_burn_events.append(alert.to_dict())
+            del self._slo_burn_events[:-AUTOSCALE_EVENTS_CAP]
+            await self._publish_burn(alert)
+
+    async def _publish_burn(self, alert) -> None:
         from ray_tpu.core.api import get_core
 
         try:
@@ -579,9 +612,12 @@ class ServeController:
             logging.getLogger(__name__).debug(
                 "slo burn publish failed", exc_info=True)
 
-    async def _breach_fraction(self, st: _DeploymentState,
-                               slo_ms: float) -> float | None:
-        """This deployment's SLO breach fraction over the recent window.
+    async def _breach_fraction(self, st: _DeploymentState, slo_ms: float,
+                               key: str | None = None) -> float | None:
+        """One signal's SLO breach fraction over the recent window
+        (``key`` defaults to the deployment's unary e2e key; streaming
+        signals pass ``ttfc:<key>`` / ``gap:<key>`` — the replica-side
+        counters are tagged with the same prefixed keys).
 
         Primary source: the GCS rollup plane's derived
         ``serve_slo_breach_fraction`` ratio (replica-side breach/request
@@ -591,10 +627,11 @@ class ServeController:
         the counters, or a rollup plane with no points yet)."""
         from ray_tpu.core.api import get_core
 
+        key = key or st.key
         try:
             win = await get_core().gcs.call("metric_window", {
                 "name": "serve_slo_breach_fraction", "secs": 30.0,
-                "tags": {"key": st.key}})
+                "tags": {"key": key}})
             pts = (win or {}).get("points") or []
             den = sum(p["den"] for p in pts)
             if den > 0:
@@ -605,7 +642,7 @@ class ServeController:
             logging.getLogger(__name__).debug(
                 "rollup breach-fraction fetch failed", exc_info=True)
         await self._refresh_p99()  # also refreshes _lat_windows
-        window = self._lat_windows.get(st.key)
+        window = self._lat_windows.get(key)
         if not window:
             return None
         slo_ns = slo_ms * 1e6

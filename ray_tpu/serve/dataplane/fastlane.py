@@ -50,9 +50,10 @@ class ReplicaLane:
     """
 
     __slots__ = ("actor_id", "_tmpl", "fast_calls", "rpc_calls",
-                 "traced_calls")
+                 "traced_calls", "fast_streams", "rpc_streams")
 
     METHOD = "handle_request"
+    STREAM_METHOD = "handle_request_streaming"
 
     def __init__(self, actor_id):
         self.actor_id = actor_id
@@ -62,6 +63,10 @@ class ReplicaLane:
         # sampled requests whose wire trace leg rode this lane (2.1):
         # the proof the fast lane is no longer trace-invisible
         self.traced_calls = 0
+        # streams that rode "G" chunk records vs the per-item ObjectRef
+        # fallback (wire 2.3)
+        self.fast_streams = 0
+        self.rpc_streams = 0
 
     def submit(self, core, args: tuple):
         """Try the ring: returns ``(task_id, future)`` (decode with
@@ -87,9 +92,26 @@ class ReplicaLane:
                     self.traced_calls += 1
         return out
 
+    def submit_stream(self, core, args: tuple):
+        """Try the ring for a streaming request: returns
+        ``(task_id, sink)`` (consume with ``core.fast_actor_stream``) or
+        None → per-item ObjectRef fallback for this stream. Chunks ride
+        the same lane as the unary calls — "G" records interleave with
+        "A"/"C" replies on the ring/tunnel, ordered by the lane's seq
+        machinery, no per-chunk ObjectRef or task event."""
+        out = core.fast_actor_submit_stream(
+            self.actor_id, self.STREAM_METHOD, args, {})
+        if out is None:
+            self.rpc_streams += 1
+        else:
+            self.fast_streams += 1
+        return out
+
     def stats(self) -> dict:
         return {"fast_calls": self.fast_calls, "rpc_calls": self.rpc_calls,
-                "traced_calls": self.traced_calls}
+                "traced_calls": self.traced_calls,
+                "fast_streams": self.fast_streams,
+                "rpc_streams": self.rpc_streams}
 
     def transport(self, core) -> str:
         """Which plane currently serves this replica: "ring" (same-node

@@ -10,6 +10,10 @@ surface mirrors the reference's RayServeAPIService:
     /rayserve.ServeAPI/Healthz       b"" -> b"ok"
     /rayserve.ServeAPI/ListApplications  b"" -> pickle({app: [deployments]})
     /rayserve.ServeAPI/Call          pickle(request dict) -> pickle(reply)
+    /rayserve.ServeAPI/CallStream    pickle(request dict) -> stream of
+                                     pickle({"chunk": ...}) frames
+                                     (server-streaming; one frame per
+                                     "G" chunk record off the wire)
 
         request: {"app": str, "deployment": str, "method": str (opt),
                   "args": tuple, "kwargs": dict,
@@ -45,16 +49,23 @@ class GrpcProxy:
             "Healthz": self._healthz,
             "ListApplications": self._list_applications,
             "Call": self._call,
+            "CallStream": self._call_stream,
         }
+        streaming = {"CallStream"}
 
         class _Handler(grpc.GenericRpcHandler):
             def service(self, call_details):
                 prefix = f"/{SERVICE}/"
                 if not call_details.method.startswith(prefix):
                     return None
-                fn = handlers.get(call_details.method[len(prefix):])
+                name = call_details.method[len(prefix):]
+                fn = handlers.get(name)
                 if fn is None:
                     return None
+                if name in streaming:
+                    return grpc.unary_stream_rpc_method_handler(
+                        fn, request_deserializer=None,
+                        response_serializer=None)
                 return grpc.unary_unary_rpc_method_handler(
                     fn, request_deserializer=None,
                     response_serializer=None)
@@ -116,6 +127,54 @@ class GrpcProxy:
             return pickle.dumps({"error": str(e), "status": 503})
         except Exception as e:  # noqa: BLE001 — ingress must answer
             return pickle.dumps({"error": str(e), "status": 500})
+
+    async def _call_stream(self, request: bytes, context):
+        """Server-streaming leg of :meth:`_call`: one response frame per
+        chunk the deployment generator yields. Pre-first-chunk failures
+        map to the same canonical codes as Call; after the first frame
+        an error becomes a terminal ``{"error": ..., "chunks": n}``
+        frame (chunks already on the wire are never replayed). A client
+        cancel surfaces here as CancelledError, which closes the
+        ServeStream — mid-stream disconnect frees the replica's decode
+        slot before the generation would have finished."""
+        import grpc
+
+        from ray_tpu.serve.handle import DeploymentHandle, RayServeException
+        from ray_tpu.serve.exceptions import (
+            BackPressureError,
+            RequestTimeoutError,
+        )
+
+        n = 0
+        try:
+            req = pickle.loads(request)
+            handle = DeploymentHandle(
+                req["deployment"], app_name=req.get("app", "default"),
+                multiplexed_model_id=req.get("multiplexed_model_id", ""))
+            stream = handle._stream(
+                req.get("method") or "__call__",
+                tuple(req.get("args", ())), dict(req.get("kwargs", {})))
+            try:
+                async for chunk in stream:
+                    n += 1
+                    yield pickle.dumps({"chunk": chunk})
+            finally:
+                await stream.aclose()
+        except BackPressureError as e:
+            context.set_code(grpc.StatusCode.RESOURCE_EXHAUSTED)
+            context.set_details(str(e))
+            context.set_trailing_metadata((
+                ("retry-after",
+                 f"{getattr(e, 'retry_after_s', 1.0):.3f}"),))
+            yield pickle.dumps({"error": str(e), "status": 429, "chunks": n})
+        except RequestTimeoutError as e:
+            context.set_code(grpc.StatusCode.DEADLINE_EXCEEDED)
+            context.set_details(str(e))
+            yield pickle.dumps({"error": str(e), "status": 504, "chunks": n})
+        except RayServeException as e:
+            yield pickle.dumps({"error": str(e), "status": 503, "chunks": n})
+        except Exception as e:  # noqa: BLE001 — ingress must answer
+            yield pickle.dumps({"error": str(e), "status": 500, "chunks": n})
 
     async def shutdown(self) -> bool:
         if self._server is not None:
@@ -180,6 +239,54 @@ class GrpcIngressClient:
             raise RuntimeError(f"serve error {reply.get('status')}: "
                                f"{reply['error']}")
         return reply["result"]
+
+    def call_stream(self, deployment: str, *args, app: str = "default",
+                    method: str = "", multiplexed_model_id: str = "",
+                    timeout: float = 300.0, **kwargs):
+        """Generator of chunk values from a streaming deployment method.
+        Closing the generator mid-stream cancels the RPC — the server
+        handler sees CancelledError and the replica's decode slot frees
+        before the generation finishes. Typed serve errors re-raise with
+        the same taxonomy as :meth:`call`."""
+        import grpc
+
+        from ray_tpu.serve.exceptions import (
+            BackPressureError,
+            RequestTimeoutError,
+        )
+
+        fn = self._channel.unary_stream(f"/{SERVICE}/CallStream")
+        call = fn(pickle.dumps({
+            "app": app, "deployment": deployment, "method": method,
+            "args": args, "kwargs": kwargs,
+            "multiplexed_model_id": multiplexed_model_id,
+        }), timeout=timeout)
+        try:
+            for frame in call:
+                reply = pickle.loads(frame)
+                if "error" in reply:
+                    raise RuntimeError(
+                        f"serve error {reply.get('status')}: "
+                        f"{reply['error']}")
+                yield reply["chunk"]
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                retry_after = 0.1
+                try:
+                    trailers = dict(e.trailing_metadata() or ())
+                    retry_after = float(trailers.get("retry-after",
+                                                     retry_after))
+                except (TypeError, ValueError):
+                    pass  # malformed trailer: keep the default hint
+                raise BackPressureError(
+                    e.details() or "overloaded",
+                    retry_after_s=retry_after) from None
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise RequestTimeoutError(
+                    e.details() or "deadline exceeded") from None
+            raise
+        finally:
+            call.cancel()  # no-op if complete; mid-stream: propagates
 
     def close(self):
         self._channel.close()

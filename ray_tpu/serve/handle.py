@@ -559,6 +559,10 @@ class _Router:
                 "fast_calls": sum(l.fast_calls for l in self.lanes.values()),
                 "rpc_calls": self.rpc_routed,
                 "admission_shed": self.admission_shed,
+                "fast_streams": sum(l.fast_streams
+                                    for l in self.lanes.values()),
+                "rpc_streams": sum(l.rpc_streams
+                                   for l in self.lanes.values()),
             }
 
     def _admission_shed_check(self, deadline: float | None, exclude: set):
@@ -977,6 +981,134 @@ class _Router:
                 if self.inflight.get(rid, 0) > 0:
                     self.inflight[rid] -= 1
 
+    async def route_stream_chunks(self, method: str, args: tuple,
+                                  kwargs: dict, model_id: str = "",
+                                  hint: str = "",
+                                  _inherited_deadline: float | None = None):
+        """Streaming fast path (wire 2.3): async generator of CHUNK VALUES.
+
+        Dispatch rides the replica's fast lane as one "G"-chunked stream
+        (``ReplicaLane.submit_stream``) — per yielded item the worker pump
+        flushes one chunk record onto the same ring/tunnel the unary
+        calls use, and this coroutine consumes them through
+        ``CoreClient.fast_actor_stream``. No per-item ObjectRef,
+        memory-store entry, or task event. A NEED_SLOW decline (stale
+        worker method table — provably before execution) re-dispatches
+        the WHOLE stream over the per-item ObjectRef plane on the same
+        replica.
+
+        Fault contract: only initial routing is fault-tolerant. Once a
+        chunk has been consumed the stream is never replayed — a lane or
+        replica death surfaces as :class:`StreamBrokenError` carrying the
+        consumed count. Early consumer exit (``aclose`` / GC / HTTP
+        disconnect) cancels replica-side: the ring path abandons the pump
+        (the wrapper's GeneratorExit frees the decode slot), and a
+        best-effort unordered ``cancel_request`` sheds a still-queued
+        stream before user code runs."""
+        from ray_tpu.core.core_client import FastLaneDeclined
+        from ray_tpu.core.ref import GetTimeoutError
+        from ray_tpu.serve.streaming import StreamBrokenError
+
+        self._ensure_poll_loop()
+        await self._ensure_ft()
+        core = _core()
+        deadline = self._compute_deadline(_inherited_deadline)
+        request_id = f"{self._router_id}-{next(self._req_counter)}"
+        self._admission_shed_check(deadline, set())
+        rid, actor = await self._pick_replica(model_id, set(), deadline, hint)
+        timeout_s = (None if deadline is None
+                     else max(0.0, deadline - time.monotonic()))
+        wait_s = (None if deadline is None
+                  else max(0.05, deadline - time.monotonic()))
+        call_args = (method, args, kwargs, model_id, timeout_s, request_id)
+        with self.lock:
+            self.inflight[rid] = self.inflight.get(rid, 0) + 1
+        consumed = 0
+        completed = False
+        lane = None
+        try:
+            out = None
+            if fastlane_enabled():
+                lane = self._lane_for(rid, actor)
+                out = lane.submit_stream(core, call_args)
+            if out is not None:
+                task_id, sink = out
+                agen = core.fast_actor_stream(task_id, sink, wait_s)
+                try:
+                    try:
+                        async for item in agen:
+                            consumed += 1
+                            yield item
+                        completed = True
+                        return
+                    except FastLaneDeclined:
+                        # NEED_SLOW precedes execution: nothing consumed,
+                        # nothing ran — safe to re-dispatch the whole
+                        # stream over RPC (and un-count the ring stream:
+                        # fast_streams is bench/test evidence)
+                        lane.fast_streams -= 1
+                        lane.rpc_streams += 1
+                    except GetTimeoutError:
+                        raise RequestTimeoutError(
+                            f"stream deadline exceeded on replica {rid} of "
+                            f"{self.app_name}/{self.deployment_name} after "
+                            f"{consumed} chunk(s)") from None
+                    except Exception as e:
+                        if _is_replica_failure(e):
+                            raise StreamBrokenError(
+                                f"stream broke on replica {rid} of "
+                                f"{self.app_name}/{self.deployment_name} "
+                                f"after {consumed} chunk(s): {e}",
+                                chunks_consumed=consumed) from e
+                        raise
+                finally:
+                    await agen.aclose()
+            # per-item ObjectRef fallback (no lane, ineligible args, or
+            # NEED_SLOW decline) — same replica, same request_id, so the
+            # replica-side admission/cancel machinery sees one request
+            self.rpc_routed += 1
+            gen = actor.handle_request_streaming.options(
+                num_returns="streaming").remote(*call_args)
+            try:
+                async for ref in gen:
+                    try:
+                        (item,) = await core.get_async([ref], wait_s)
+                    except GetTimeoutError:
+                        raise RequestTimeoutError(
+                            f"stream deadline exceeded on replica {rid} of "
+                            f"{self.app_name}/{self.deployment_name} after "
+                            f"{consumed} chunk(s)") from None
+                    except Exception as e:
+                        if consumed and _is_replica_failure(e):
+                            raise StreamBrokenError(
+                                f"stream broke on replica {rid} of "
+                                f"{self.app_name}/{self.deployment_name} "
+                                f"after {consumed} chunk(s): {e}",
+                                chunks_consumed=consumed) from e
+                        raise
+                    consumed += 1
+                    yield item
+                completed = True
+            finally:
+                aclose = getattr(gen, "aclose", None)
+                if aclose is not None:
+                    await aclose()
+        finally:
+            if not completed:
+                # abandoned or broken mid-flight: shed a still-queued
+                # stream / stop an executing one at its next yield.
+                # Unordered so the marker overtakes the stream's own
+                # in-flight record (same reasoning as _cancel_loser).
+                try:
+                    core.submit_actor_task(  # raylint: disable=RT003 — best-effort cancel; the stream's remainder is discarded either way
+                        actor, "cancel_request", (request_id,), {},
+                        unordered=True)
+                except Exception:  # raylint: disable=RT012 — replica may be gone; its stream died with it
+                    pass
+            with self.lock:
+                if self.inflight.get(rid, 0) > 0:
+                    self.inflight[rid] -= 1
+
 
 def _is_replica_failure(e: Exception) -> bool:
     """True for failures that mean "the replica is gone", as opposed to
@@ -1038,6 +1170,27 @@ class _MethodCaller:
             return router.route_streaming_async(self._method, args, kwargs)
         return router.route_streaming(self._method, args, kwargs)
 
+    def stream_chunks(self, *args, **kwargs):
+        """Streaming fast path (wire 2.3): returns a
+        :class:`~ray_tpu.serve.streaming.ServeStream` of chunk VALUES —
+        items ride the replica's shm ring / node tunnel as "G" chunk
+        records with no per-item ObjectRef; the per-item plane
+        (:meth:`stream`) remains the wire-level fallback. Iterate
+        ``async for`` on the core loop or plainly from the driver;
+        ``close()``/``aclose()`` (or just dropping it) cancels
+        mid-stream, freeing the replica's decode slot before the
+        generation finishes."""
+        from ray_tpu.serve.streaming import ServeStream
+
+        router = _router_for(self._handle.app_name,
+                             self._handle.deployment_name)
+        inherited = serve_context.current_deadline()
+        agen = router.route_stream_chunks(
+            self._method, args, kwargs,
+            self._handle.multiplexed_model_id, self._handle.routing_hint,
+            _inherited_deadline=inherited)
+        return ServeStream(agen, core=_core())
+
 
 class DeploymentHandle:
     """User-facing handle; composable across deployments (ref:
@@ -1086,6 +1239,11 @@ class DeploymentHandle:
         return router.route_sync(method, args, kwargs,
                                  self.multiplexed_model_id,
                                  self.routing_hint)
+
+    def _stream(self, method: str, args: tuple, kwargs: dict):
+        """Ingress-internal ``stream_chunks`` by method name (dunder
+        names like ``__call__`` can't route through ``__getattr__``)."""
+        return _MethodCaller(self, method).stream_chunks(*args, **kwargs)
 
     def __reduce__(self):
         return (DeploymentHandle,

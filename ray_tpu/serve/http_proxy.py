@@ -90,8 +90,11 @@ class HttpProxy:
         else:
             body = None
         handle = DeploymentHandle(deployment, app_name=app_name)
+        args = (body,) if body is not None else ()
+        if ("text/event-stream" in request.headers.get("Accept", "")
+                or request.query.get("stream", "") in ("1", "true")):
+            return await self._handle_sse(request, handle, method, args)
         try:
-            args = (body,) if body is not None else ()
             result = await handle._invoke(method, args, {})
             return web.json_response({"result": result})
         except BackPressureError as e:
@@ -109,6 +112,79 @@ class HttpProxy:
             return web.json_response({"error": str(e)}, status=503)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=500)
+
+    async def _handle_sse(self, request, handle, method: str, args: tuple):
+        """Server-Sent-Events leg of :meth:`_handle` (``Accept:
+        text/event-stream`` or ``?stream=1``). Each chunk the deployment
+        generator yields — one "G" record on the wire — becomes one SSE
+        ``data:`` frame; the stream ends with ``data: [DONE]``. Errors
+        raised before the first chunk keep their unary status codes
+        (429/504/503/500); once the 200 header is out they become a
+        terminal ``event: error`` frame instead. A client disconnect
+        surfaces as a failed write, and closing the ServeStream in the
+        ``finally`` cancels the replica-side generator — the decode slot
+        frees at the next block boundary, not at end of generation."""
+        import math
+
+        from aiohttp import web
+
+        from ray_tpu.serve.handle import RayServeException
+        from ray_tpu.serve.exceptions import (
+            BackPressureError,
+            RequestTimeoutError,
+        )
+        from ray_tpu.serve.streaming import SSE_DONE, sse_event
+
+        stream = handle._stream(method, args, {})
+        resp = None
+        try:
+            try:
+                async for chunk in stream:
+                    if resp is None:
+                        resp = web.StreamResponse(headers={
+                            "Content-Type": "text/event-stream",
+                            "Cache-Control": "no-cache",
+                            "X-Accel-Buffering": "no",
+                        })
+                        await resp.prepare(request)
+                    await resp.write(sse_event(chunk))
+            except ConnectionResetError:
+                # client went away mid-stream; the finally below closes
+                # the ServeStream, which propagates the cancel upstream
+                return resp
+            except BackPressureError as e:
+                if resp is None:
+                    return web.json_response(
+                        {"error": str(e)}, status=429,
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(
+                                     getattr(e, "retry_after_s", 1.0))))})
+                await resp.write(sse_event({"error": str(e)}, event="error"))
+            except RequestTimeoutError as e:
+                if resp is None:
+                    return web.json_response({"error": str(e)}, status=504)
+                await resp.write(sse_event({"error": str(e)}, event="error"))
+            except RayServeException as e:
+                if resp is None:
+                    return web.json_response({"error": str(e)}, status=503)
+                await resp.write(sse_event({"error": str(e)}, event="error"))
+            except Exception as e:
+                if resp is None:
+                    return web.json_response({"error": str(e)}, status=500)
+                await resp.write(sse_event({"error": str(e)}, event="error"))
+            else:
+                if resp is None:
+                    # empty stream: still a valid SSE exchange
+                    resp = web.StreamResponse(headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                    })
+                    await resp.prepare(request)
+                await resp.write(SSE_DONE)
+            await resp.write_eof()
+            return resp
+        finally:
+            await stream.aclose()
 
     async def shutdown(self) -> bool:
         if self._runner is not None:

@@ -156,7 +156,9 @@ class Replica:
                  user_config: dict | None = None,
                  max_queued_requests: int = -1,
                  latency_slo_ms: float | None = None,
-                 app_name: str = "default"):
+                 app_name: str = "default",
+                 ttfc_slo_ms: float | None = None,
+                 interchunk_slo_ms: float | None = None):
         from ray_tpu.serve.handle import DeploymentHandle
 
         cls = cloudpickle.loads(serialized_cls)
@@ -169,6 +171,13 @@ class Replica:
         self.latency_slo_ms = latency_slo_ms
         self._slo_ns = (None if latency_slo_ms is None
                         else float(latency_slo_ms) * 1e6)
+        # streaming SLOs: TTFC defaults to the unary budget (first token
+        # racing the whole-response SLO is the conservative choice);
+        # inter-chunk gaps only breach when explicitly configured
+        self._ttfc_slo_ns = (self._slo_ns if ttfc_slo_ms is None
+                             else float(ttfc_slo_ms) * 1e6)
+        self._gap_slo_ns = (None if interchunk_slo_ms is None
+                            else float(interchunk_slo_ms) * 1e6)
         self._lat_key = f"{app_name}/{deployment_name}"
         self._admission = AdmissionController(max_ongoing_requests)
         self._ongoing = 0
@@ -353,30 +362,133 @@ class Replica:
             self._ongoing -= 1
 
     async def handle_request_streaming(self, method: str, args: tuple,
-                                       kwargs: dict):
-        """Streaming requests: the user method must be an async generator;
-        items ride the actor streaming-generator plane back to the caller
-        (ref: serve streaming responses over ReportGeneratorItemReturns)."""
+                                       kwargs: dict,
+                                       multiplexed_model_id: str = "",
+                                       timeout_s: float | None = None,
+                                       request_id: str = ""):
+        """Streaming requests: the user method must be a generator (sync
+        or async); items flow back as "G" chunk records on the serve fast
+        lane, or per-item over the actor streaming-generator plane on the
+        RPC fallback (ref: serve streaming responses over
+        ReportGeneratorItemReturns).
+
+        Cancellation: :meth:`cancel_request` on a streaming id takes
+        effect BETWEEN yields — the wrapper stops iterating, which closes
+        the user generator (``GeneratorExit`` -> its ``finally`` frees
+        the decode slot / KV pages) long before the generation would have
+        finished. Abandoned consumers reach the same path: the worker
+        pump closes this wrapper when the ring closes or the driver sends
+        ``stream_abandon``."""
         if chaos.ENABLED:
             chaos.point("serve.handle_request", method=method,
                         deployment=self.deployment_name,
                         replica=self.replica_id, streaming=True)
         if self._gate is None:
             self._gate = asyncio.Semaphore(self.max_ongoing_requests)
-        self._admit()
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        self._admit(deadline)
+        t_arrival = time.perf_counter_ns()
         self._ongoing += 1
         self._total += 1
         self._queued += 1
+        if multiplexed_model_id:
+            from ray_tpu.serve.multiplex import _set_request_model_id
+
+            _set_request_model_id(multiplexed_model_id)
         dequeued = False
         try:
             async with self._gate:
                 self._queued -= 1
                 dequeued = True
+                self._check_shed(deadline, request_id)
                 self._executing += 1
                 try:
-                    fn = getattr(self.user, method) if method else self.user
-                    async for item in fn(*args, **kwargs):
-                        yield item
+                    from ray_tpu.serve.streaming.slo import StreamLatencyTracker
+
+                    lat = StreamLatencyTracker(
+                        self._lat_key, self._ttfc_slo_ns, self._gap_slo_ns,
+                        t_arrival_ns=t_arrival)
+                    token = serve_context.set_deadline(deadline)
+                    try:
+                        fn = getattr(self.user, method) if method else self.user
+                        it = fn(*args, **kwargs)
+                        if hasattr(it, "__aiter__"):
+                            # the finally runs on normal exhaustion AND on
+                            # GeneratorExit from an abandoned consumer —
+                            # either way the user generator's own finally
+                            # (engine cancel, KV free) fires now, not at GC
+                            try:
+                                async for item in it:
+                                    lat.on_chunk()
+                                    yield item
+                                    if (request_id
+                                            and request_id in self._cancelled):
+                                        self._cancelled.pop(request_id, None)
+                                        self._shed += 1
+                                        break
+                            finally:
+                                aclose = getattr(it, "aclose", None)
+                                if aclose is not None:
+                                    await aclose()
+                        else:
+                            # sync generator: step it on the pool so a
+                            # blocking user body can't stall the actor loop
+                            loop = asyncio.get_running_loop()
+                            ctx = contextvars.copy_context()
+                            _END = object()
+                            def _pull_batch(nmax=64, budget_s=5e-4):
+                                # amortize the pool round-trip (~hundreds
+                                # of µs of thread wakeups) over every item
+                                # a fast generator has ready; a slow one
+                                # returns after ONE item (its next() alone
+                                # blows the budget) so chunk latency is
+                                # unchanged where it matters. A mid-batch
+                                # user exception is deferred so the pulled
+                                # prefix still streams out before it
+                                # becomes the terminal.
+                                out = []
+                                err = None
+                                t0 = time.perf_counter()
+                                try:
+                                    while len(out) < nmax:
+                                        out.append(next(it))
+                                        if (time.perf_counter() - t0
+                                                >= budget_s):
+                                            break
+                                except StopIteration:
+                                    out.append(_END)
+                                except BaseException as e:  # noqa: BLE001
+                                    err = e
+                                return out, err
+
+                            done = False
+                            try:
+                                while not done:
+                                    items, err = await loop.run_in_executor(
+                                        self._pool,
+                                        lambda: ctx.run(_pull_batch))
+                                    for item in items:
+                                        if item is _END:
+                                            done = True
+                                            break
+                                        lat.on_chunk()
+                                        yield item
+                                        if (request_id
+                                                and request_id
+                                                in self._cancelled):
+                                            self._cancelled.pop(
+                                                request_id, None)
+                                            self._shed += 1
+                                            done = True
+                                            break
+                                    if err is not None:
+                                        raise err
+                            finally:
+                                close = getattr(it, "close", None)
+                                if close is not None:
+                                    close()
+                    finally:
+                        serve_context.reset_deadline(token)
                 finally:
                     self._executing -= 1
         finally:

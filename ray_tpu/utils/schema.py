@@ -63,7 +63,21 @@ from __future__ import annotations
 # fast-lane calls without a lookup. Unsampled records are byte-identical
 # to 2.0 ones. Also: GCS get_trace / list_traces (the trace assembler),
 # get_task_events limit/offset/span_only pagination.
-PROTOCOL_VERSION = (2, 2)
+# 2.3: streaming plane. Stream-called generator methods ride the actor
+# lanes as ordinary "A"/"C" records whose method key uses the "gm:"
+# marker (vs "am:"); the worker pumps flush one "G" chunk record per
+# yielded item (core/fastpath.py pack_chunk — the "A" header shape with
+# the seq slot carrying the per-stream chunk index, same TRACE_BIT trace
+# leg) with body status CHUNK (inline packed item) or CHUNK_SHM
+# (oversized item sealed under return index chunk_seq + 1, payload =
+# shm size/desc like OK_SHM), then ONE ordinary terminal reply (OK +
+# <u32 nchunks> / ERR) on the lane's seq machinery. Reply STATUS CODES
+# are now cataloged (RECORD_STATUS below, mirrored by rt_wire.h
+# kReplyStatus*) beside the prefix/flag bytes. Also: worker
+# stream_abandon (driver stops an open stream's pump mid-flight —
+# client disconnect), serve-level mid-stream cancellation rides the
+# existing cancel_request actor method.
+PROTOCOL_VERSION = (2, 3)
 
 # ------------------------------------------------------ fastpath records
 # Every record prefix byte and reply-status flag the shm rings / node
@@ -78,6 +92,28 @@ RECORD_PREFIXES: dict[str, dict] = {
     "R": {"since": (1, 7), "doc": "task record, packed, u64 submit stamp"},
     "A": {"since": (1, 8), "doc": "actor record, C-pickled, <u32 seq, u64 t>"},
     "C": {"since": (1, 8), "doc": "actor record, packed, <u32 seq, u64 t>"},
+    "G": {"since": (2, 3), "doc": "stream chunk, 'A' header shape with the "
+                                  "seq slot = per-stream chunk index, body "
+                                  "<16s task_id><u32 status> + payload"},
+}
+# Reply status CODES (low bits of the reply/chunk status word, below the
+# flag bits): cataloged since 2.3 alongside the flags — rt_wire.h mirrors
+# them as kReplyStatus* and tests/test_wire_schema.py asserts parity in
+# both directions like the prefixes/flags.
+RECORD_STATUS: dict[str, dict] = {
+    "OK": {"value": 0, "since": (1, 3), "doc": "payload = packed value"},
+    "OK_SHM": {"value": 1, "since": (1, 3),
+               "doc": "result sealed in the node arena; payload = "
+                      "shm size (1.7) / <Q size><16s node> desc (2.0)"},
+    "ERR": {"value": 2, "since": (1, 3), "doc": "payload = pickled error"},
+    "NEED_SLOW": {"value": 3, "since": (1, 3),
+                  "doc": "declined without executing: RPC path owns it"},
+    "CHUNK": {"value": 4, "since": (2, 3),
+              "doc": "'G' records only: one inline packed stream item"},
+    "CHUNK_SHM": {"value": 5, "since": (2, 3),
+                  "doc": "'G' records only: oversized item sealed under "
+                         "return index chunk_seq + 1; payload = shm "
+                         "size/desc"},
 }
 RECORD_FLAGS: dict[str, dict] = {
     "STAMPED": {"value": 0x100, "since": (1, 7),
@@ -260,6 +296,16 @@ CATALOG: dict[str, dict[str, dict]] = {
         "get_log": {"since": (1, 1), "fields": {
             "worker_id": "hex (prefix ok)", "stream": "out|err",
             "tail": "int bytes", "->": "str | None"}},
+        "register_spill_provider": {"since": (2, 2), "fields": {
+            "address": "(host, port) — a local client process that can "
+                       "serve cold arena-owner spill candidates "
+                       "(core/tiering.py registry; shipped in the 2.2-era "
+                       "memory-tiering work, cataloged late)"}},
+        "spill_objects": {"since": (2, 2), "fields": {
+            "object_ids": "[bytes] — owner-initiated spill of specific "
+                          "sealed objects (prefix-cache spill-not-drop "
+                          "eviction)",
+            "->": "{oid hex: {ok, path}}"}},
         "spill_now": {"since": (1, 2), "fields": {
             "need": "int bytes of headroom wanted — spill pass runs to "
                     "low-water (ref: local_object_manager.h:42)"}},
@@ -291,6 +337,15 @@ CATALOG: dict[str, dict[str, dict]] = {
         "generator_item": {"since": (1, 0), "fields": {
             "task_id": "TaskID", "index": "int", "item": "packed | None",
             "done": "bool"}},
+        "arena_spill_candidates": {"since": (2, 2), "fields": {
+            "need": "int bytes of headroom wanted",
+            "cold_after_s": "float — age gate for cold candidates",
+            "->": "[(oid bytes, nbytes)] cold REFERENCED objects the "
+                  "registered arena owners (core/tiering.py) may trade "
+                  "to tier-1 (cataloged late, 2.2-era tiering)"}},
+        "arena_spilled": {"since": (2, 2), "fields": {
+            "spilled": "[(oid bytes, path, offset)] — owners stamp their "
+                       "manifest entries' (tier, path) legs"}},
     },
     # ------------------------------------------------------------- worker
     # (ref: core_worker.proto PushTask + worker-side control)
@@ -332,6 +387,13 @@ CATALOG: dict[str, dict[str, dict]] = {
                       "tunnel_replies pushes on the same connection"}},
         "tunnel_detach": {"since": (2, 0), "fields": {
             "lanes": "[lane ids] to drop (notify)"}},
+        "stream_abandon": {"since": (2, 3), "fields": {
+            "task_ids": "[TaskID bytes] — open stream calls whose driver-"
+                        "side consumer went away (client disconnect / "
+                        "stream aclose): the pump stops flushing chunks "
+                        "and closes the user generator (GeneratorExit "
+                        "surfaces in its finally) instead of streaming "
+                        "to nobody (notify, best-effort)"}},
         "dump_stack": {"since": (1, 3), "fields": {}},
         "heap_profile": {"since": (1, 4), "fields": {
             "action": "start | snapshot | stop (tracemalloc control)",

@@ -1,0 +1,54 @@
+"""RT024 fixture: whole-stream materialization on the request path.
+
+In scope because it imports ray_tpu (the .stream*/route_streaming
+attribute shapes are unresolvable through imports, like RT003's
+.remote())."""
+import ray_tpu  # noqa: F401
+
+
+async def materialize_async(handle):
+    s = handle.chat.stream_chunks({"prompt": [1]})
+    return [d async for d in s]  # expect: RT024
+
+
+def materialize_list(handle):
+    gen = handle.chat.stream(5)
+    return list(gen)  # expect: RT024
+
+
+async def materialize_direct(handle):
+    return [d async for d in handle.chat.stream_deltas(5)]  # expect: RT024
+
+
+def materialize_router(router):
+    chunks = router.route_streaming("m", (), {})
+    return list(chunks)  # expect: RT024
+
+
+async def materialize_set(handle):
+    s = handle.chat.stream_chunks(5)
+    return {d["i"] async for d in s}  # expect: RT024
+
+
+async def consume_incrementally(handle):
+    # the fix idiom: per-chunk consumption keeps TTFC at first-block
+    out = 0
+    async for d in handle.chat.stream_chunks(5):
+        out += len(d["tokens"])
+    return out
+
+
+def rebound_name_is_clean(handle):
+    s = handle.chat.stream(5)
+    s = [1, 2, 3]  # rebinding clears the taint
+    return list(s)
+
+
+def unrelated_list_is_clean(xs):
+    return list(xs)
+
+
+def generator_expression_is_clean(handle):
+    # a genexp stays lazy — chunks still flow one at a time
+    s = handle.chat.stream_chunks(5)
+    return sum(len(d["tokens"]) for d in s)

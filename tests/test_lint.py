@@ -28,11 +28,11 @@ PACKAGE = os.path.join(REPO, "ray_tpu")
 AST_RULES = ["RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
              "RT007", "RT008", "RT009", "RT010", "RT011", "RT012",
              "RT013", "RT014", "RT015", "RT016", "RT017", "RT018",
-             "RT019"]
+             "RT019", "RT024"]
 # flow-pass rules: registered for the table, fired by flow.analyze_paths
 # (covered by the lint_fixtures/flow/ package below, not rtNNN.py files)
 FLOW_RULES = ["RT020", "RT021", "RT022", "RT023"]
-ALL_RULES = AST_RULES + FLOW_RULES
+ALL_RULES = sorted(AST_RULES + FLOW_RULES)
 
 _EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+)")
 

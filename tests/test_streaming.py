@@ -1,0 +1,671 @@
+"""Streaming plane tests (wire 2.3 "G" chunk records).
+
+Covers every layer of the stream path:
+
+- driver plane: fast_actor_submit_stream / fast_actor_stream over the
+  shm ring (sync + async generators, CHUNK_SHM spill, typed mid-stream
+  errors, abandon, eligibility gates, unary interleave)
+- serve plane: handle.<m>.stream_chunks sync/async iteration, mid-stream
+  cancellation, per-lane stream counters, and the TTFC / inter-chunk
+  SLO stages the replica records
+- ingress: SSE frames over the HTTP proxy and server-streaming over the
+  gRPC proxy, with client-disconnect cancellation through both
+- LLM: block-granular token deltas (one per fused decode block),
+  streamed-vs-unary token identity, decode-slot release on cancel —
+  aggregated engine and disaggregated scheduler
+- chaos: the seeded stream_disconnect plan SIGKILLs a decode worker
+  mid-stream under a mixed streaming/unary workload; surviving streams
+  stay token-identical to the chaos-free reference, broken streams
+  surface consumed-chunks-only prefixes (never replayed), cancelled
+  streams drain their decode slots, and no prefill runs twice.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import api
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PLAN = os.path.join(HERE, "plans", "stream_disconnect.json")
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=32)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(rt):
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+# ---------------------------------------------------------- driver plane
+@ray_tpu.remote(num_cpus=0)
+class Gen:
+    def ping(self, i):
+        return i + 1
+
+    def count(self, n):
+        for i in range(n):
+            yield i * 2
+
+    async def acount(self, n):
+        for i in range(n):
+            yield i * 3
+
+    def big(self, n):
+        for i in range(n):
+            yield np.full(300_000, i, dtype=np.uint8)
+
+    def boom(self, n):
+        yield 1
+        raise ValueError("boom after first")
+
+
+@pytest.fixture(scope="module")
+def gen_actor(rt):
+    """One Gen actor with a warmed fast lane for the driver-plane tests."""
+    core = api.get_core()
+    h = Gen.remote()
+    assert ray_tpu.get(h.ping.remote(1), timeout=60) == 2
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        lane = core._fast_actor_lanes.get(h.actor_id)
+        if lane is not None and not lane.broken and lane.methods:
+            return core, h
+        ray_tpu.get(h.ping.remote(0), timeout=60)
+        time.sleep(0.1)
+    pytest.fail("fast lane never attached")
+
+
+async def _consume(core, actor_id, method, n, early=None):
+    out = core.fast_actor_submit_stream(actor_id, method, (n,), {})
+    assert out is not None, f"submit_stream declined for {method}"
+    task_id, sink = out
+    items = []
+    agen = core.fast_actor_stream(task_id, sink, timeout=60)
+    try:
+        async for x in agen:
+            items.append(x)
+            if early is not None and len(items) >= early:
+                break
+    finally:
+        await agen.aclose()
+    return items
+
+
+def test_stream_sync_generator(gen_actor):
+    core, h = gen_actor
+    vals = core._run_sync(_consume(core, h.actor_id, "count", 6), 60)
+    assert vals == [0, 2, 4, 6, 8, 10]
+
+
+def test_stream_async_generator(gen_actor):
+    core, h = gen_actor
+    vals = core._run_sync(_consume(core, h.actor_id, "acount", 5), 60)
+    assert vals == [0, 3, 6, 9, 12]
+
+
+def test_stream_oversized_chunks_ride_shm(gen_actor):
+    """Items over the inline cap ship as CHUNK_SHM seals, adopted and
+    read through the owned-object plane at consume time."""
+    core, h = gen_actor
+    vals = core._run_sync(_consume(core, h.actor_id, "big", 3), 60)
+    assert [int(v[0]) for v in vals] == [0, 1, 2]
+    assert all(len(v) == 300_000 for v in vals)
+
+
+def test_stream_midstream_error_is_typed_and_never_replays(gen_actor):
+    """A user exception after the first chunk surfaces as the terminal
+    typed error; the consumed chunk stays consumed."""
+    core, h = gen_actor
+
+    async def case():
+        out = core.fast_actor_submit_stream(h.actor_id, "boom", (3,), {})
+        task_id, sink = out
+        items = []
+        try:
+            async for x in core.fast_actor_stream(task_id, sink, timeout=60):
+                items.append(x)
+        except Exception as e:  # noqa: BLE001 — asserting the type below
+            return items, f"{type(e).__name__}: {e}"
+        return items, None
+
+    items, err = core._run_sync(case(), 60)
+    assert items == [1]
+    assert err is not None and "boom after first" in err
+
+
+def test_stream_abandon_stops_pump_and_frees_sink(gen_actor):
+    core, h = gen_actor
+    vals = core._run_sync(
+        _consume(core, h.actor_id, "count", 100_000, early=3), 60)
+    assert vals == [0, 2, 4]
+    deadline = time.monotonic() + 10
+    while core._fast_stream_sinks and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert not core._fast_stream_sinks, core._fast_stream_sinks
+
+
+def test_stream_eligibility_gates(gen_actor):
+    """Unary methods refuse stream submit; generator methods refuse the
+    unary fast loop (they fall to RPC streaming instead)."""
+    core, h = gen_actor
+    assert core.fast_actor_submit_stream(h.actor_id, "ping", (1,), {}) is None
+    assert core.fast_actor_submit_loop(h.actor_id, "count", (1,), {}) is None
+
+
+def test_stream_interleaves_with_unary_fast_calls(gen_actor):
+    core, h = gen_actor
+
+    async def interleave():
+        out = core.fast_actor_submit_stream(h.actor_id, "count", (20,), {})
+        task_id, sink = out
+        agen = core.fast_actor_stream(task_id, sink, timeout=60)
+        got = []
+        async for x in agen:
+            got.append(x)
+            o2 = core.fast_actor_submit_loop(
+                h.actor_id, "ping", (len(got),), {})
+            if o2 is not None:
+                t2, f2 = o2
+                assert await core.fast_actor_await(
+                    t2, f2, timeout=60) == len(got) + 1
+        return got
+
+    got = core._run_sync(interleave(), 90)
+    assert got == [i * 2 for i in range(20)]
+
+
+# ----------------------------------------------------------- serve plane
+@serve.deployment(num_replicas=1)
+class Tok:
+    async def gen(self, n):
+        for i in range(n):
+            yield {"token": i, "text": f"t{i}"}
+
+    def sgen(self, n):
+        for i in range(n):
+            yield i * 2
+
+    def unary(self, x):
+        return x + 1
+
+
+def test_serve_stream_chunks_end_to_end(rt):
+    handle = serve.run(Tok.bind(), name="stream")
+    assert ray_tpu.get(handle.unary.remote(1), timeout=60) == 2
+
+    # sync driver-side iteration; context manager closes on exit
+    with handle.gen.stream_chunks(5) as s:
+        got = list(s)
+    assert [g["token"] for g in got] == [0, 1, 2, 3, 4]
+
+    # sync generator methods stream the same way
+    assert list(handle.sgen.stream_chunks(4)) == [0, 2, 4, 6]
+
+    # early close mid-stream cancels without wedging the replica
+    s = handle.gen.stream_chunks(100_000)
+    assert next(s)["token"] == 0
+    s.close()
+
+    # unary traffic still flows beside/after the streams
+    assert ray_tpu.get(handle.unary.remote(5), timeout=60) == 6
+
+    from ray_tpu.serve.handle import _router_for
+
+    stats = _router_for("stream", "Tok").lane_stats()
+    assert stats["fast_streams"] >= 1, stats
+
+
+def test_serve_stream_records_ttfc_and_gap_stages(rt):
+    """The replica wrapper feeds TTFC and inter-chunk gaps into the
+    latency plane under prefixed keys, ready for the controller's
+    p99/burn machinery."""
+    handle = serve.run(Tok.bind(), name="slostream")
+    assert [g["token"] for g in handle.gen.stream_chunks(6)] == list(range(6))
+    core = api.get_core()
+
+    async def stages():
+        import pickle
+
+        gcs = core.gcs
+        keys = await gcs.call("kv_keys", {"ns": "latency", "prefix": ""})
+        keys = [k for k in keys if k.endswith(".serve")]
+        blobs = await gcs.call("kv_multi_get",
+                               {"ns": "latency", "keys": keys})
+        out = set()
+        for k in keys:
+            b = blobs.get(k)
+            if b:
+                out |= set(pickle.loads(b).get("stages", {}))
+        return out
+
+    deadline = time.monotonic() + 20
+    seen = set()
+    while time.monotonic() < deadline:
+        seen = asyncio.run_coroutine_threadsafe(
+            stages(), core.loop).result(30)
+        if (any(s == "serve_ttfc:slostream/Tok" for s in seen)
+                and any(s == "serve_gap:slostream/Tok" for s in seen)):
+            return
+        time.sleep(0.5)
+    pytest.fail(f"ttfc/gap stages never published: {sorted(seen)}")
+
+
+def test_streaming_slo_config_round_trip(rt):
+    from ray_tpu.serve.config import DeploymentConfig
+
+    cfg = DeploymentConfig(ttfc_slo_ms=80.0, interchunk_slo_ms=25.0)
+    assert cfg.request_ft()["ttfc_slo_ms"] == 80.0
+    with pytest.raises(ValueError):
+        DeploymentConfig(ttfc_slo_ms=0.0)
+    with pytest.raises(ValueError):
+        DeploymentConfig(interchunk_slo_ms=-1.0)
+
+
+def test_controller_slo_signal_enumeration(rt):
+    """ttfc defaults to the unary budget; gap only burns when set."""
+    from ray_tpu.serve.controller import ServeController
+
+    class _C:
+        latency_slo_ms = 200.0
+        ttfc_slo_ms = None
+        interchunk_slo_ms = None
+
+    sig = ServeController._slo_signals("app/Dep", _C())
+    assert ("app/Dep", 200.0) in sig
+    assert ("ttfc:app/Dep", 200.0) in sig
+    assert not any(k.startswith("gap:") for k, _ in sig)
+    _C.ttfc_slo_ms = 50.0
+    _C.interchunk_slo_ms = 10.0
+    sig = dict(ServeController._slo_signals("app/Dep", _C()))
+    assert sig["ttfc:app/Dep"] == 50.0 and sig["gap:app/Dep"] == 10.0
+
+
+# --------------------------------------------------------------- ingress
+@serve.deployment(num_replicas=1)
+class SseTok:
+    def __init__(self):
+        self.closed = 0
+
+    async def gen(self, n):
+        try:
+            for i in range(int(n)):
+                yield {"i": i}
+                await asyncio.sleep(0.02)
+        except GeneratorExit:
+            self.closed += 1
+            raise
+
+    def closed_count(self):
+        return self.closed
+
+
+def _sse_request(host, port, path, body, headers=None):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=60)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json",
+                          **(headers or {})})
+    return conn, conn.getresponse()
+
+
+def test_http_sse_ingress_streams_and_cancels(rt):
+    from ray_tpu.serve.http_proxy import start_http_proxy
+
+    handle = serve.run(SseTok.bind(), name="sse")
+    host, port = start_http_proxy(port=0)
+
+    # ?stream=1 produces SSE frames terminated by [DONE]
+    conn, r = _sse_request(host, port, "/sse/SseTok/gen?stream=1", 5)
+    assert r.status == 200
+    assert "text/event-stream" in (r.getheader("Content-Type") or "")
+    raw = r.read().decode()
+    conn.close()
+    frames = [ln[6:] for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert frames[-1] == "[DONE]"
+    assert [json.loads(f) for f in frames[:-1]] == [{"i": i}
+                                                    for i in range(5)]
+
+    # Accept: text/event-stream negotiates the same path
+    conn, r = _sse_request(host, port, "/sse/SseTok/gen", 3,
+                           headers={"Accept": "text/event-stream"})
+    assert r.status == 200
+    raw = r.read().decode()
+    conn.close()
+    assert raw.count("data: ") == 4  # 3 chunks + DONE
+
+    # client disconnect mid-stream reaches the replica generator
+    conn, r = _sse_request(host, port, "/sse/SseTok/gen?stream=1", 500)
+    assert r.read(10)
+    conn.close()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if ray_tpu.get(handle.closed_count.remote(), timeout=30) >= 1:
+            return
+        time.sleep(0.2)
+    pytest.fail("SSE disconnect never cancelled the replica generator")
+
+
+def test_grpc_ingress_server_streaming_and_cancel(rt):
+    from ray_tpu.serve.grpc_proxy import GrpcIngressClient, start_grpc_proxy
+
+    handle = serve.run(SseTok.bind(), name="gsse")
+    host, port = start_grpc_proxy(port=0)
+    client = GrpcIngressClient(host, port)
+    try:
+        assert client.healthz()
+        vals = list(client.call_stream("SseTok", 5, app="gsse",
+                                       method="gen"))
+        assert vals == [{"i": i} for i in range(5)]
+
+        base = ray_tpu.get(handle.closed_count.remote(), timeout=30)
+        g = client.call_stream("SseTok", 500, app="gsse", method="gen")
+        assert next(g) == {"i": 0}
+        g.close()  # cancels the RPC -> CancelledError server-side
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if ray_tpu.get(handle.closed_count.remote(),
+                           timeout=30) >= base + 1:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("gRPC cancel never reached the replica generator")
+
+        # unary surface unchanged next to the stream method
+        assert client.call("SseTok", app="gsse",
+                           method="closed_count") >= base + 1
+    finally:
+        client.close()
+
+
+# ------------------------------------------------------------- LLM plane
+@pytest.fixture(scope="module")
+def tiny_llm():
+    import jax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init
+
+    cfg = LlamaConfig.tiny()
+    return cfg, llama_init(jax.random.PRNGKey(0), cfg)
+
+
+def test_llm_engine_stream_deltas_block_granular(rt, tiny_llm):
+    """stream_deltas is token-identical to the unary completion, emits
+    one delta per fused decode block (not per token), and frees the
+    decode slot + KV pages when the consumer disconnects mid-stream."""
+    from ray_tpu.llm import build_llm_engine_deployment
+
+    cfg, params = tiny_llm
+    app = build_llm_engine_deployment(
+        cfg, params=params, max_batch=4, page_size=8, n_pages=64,
+        max_seq_len=128)
+    serve.run(app, name="llm_engine")
+    handle = serve.get_deployment_handle("LLMEngineServer", "llm_engine")
+    req = {"prompt_tokens": [1, 2, 3], "max_tokens": 24}
+
+    ref = ray_tpu.get(handle.remote(dict(req)),
+                      timeout=300)["completion_tokens"]
+    assert len(ref) == 24
+
+    deltas = list(handle.stream_deltas.stream_chunks(dict(req)))
+    toks = [t for d in deltas for t in d["tokens"]]
+    assert deltas[-1].get("done") is True
+    assert toks == ref, (toks, ref)
+    assert deltas[-1]["usage"]["completion_tokens"] == 24
+    # block coalescing: far fewer deltas than tokens
+    assert len(deltas) - 1 < 24
+
+    # mid-stream disconnect frees the decode slot at a block boundary
+    s = handle.stream_deltas.stream_chunks(
+        {"prompt_tokens": [1, 2, 3], "max_tokens": 64})
+    assert next(s)["tokens"]
+    s.close()
+    deadline = time.monotonic() + 30
+    st = None
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(handle.engine_stats.remote(), timeout=60)
+        if st["waiting"] == 0 and st["free_pages"] == 63:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"decode slot never freed: {st}")
+
+    from ray_tpu.serve.handle import _router_for
+
+    stats = _router_for("llm_engine", "LLMEngineServer").lane_stats()
+    assert stats["fast_streams"] >= 1, stats
+
+
+def test_disagg_stream_token_identity_and_cancel(rt, tiny_llm):
+    """The disaggregated scheduler's stream(): deltas concatenate to the
+    unary output (through the prefix cache), and a client cancel frees
+    the decode slot (tokens-in-flight drains to zero)."""
+    from ray_tpu.llm.disagg import build_disagg_deployment
+
+    cfg, params = tiny_llm
+    app = build_disagg_deployment(
+        cfg, params=params, n_prefill=1, n_decode=1, max_batch=2,
+        page_size=8, n_pages=64, max_seq_len=128)
+    serve.run(app, name="disagg")
+    handle = serve.get_deployment_handle("DisaggLLMServer", "disagg")
+    prompt = list(range(1, 20))
+    req = {"prompt_tokens": prompt, "max_tokens": 12}
+
+    ref = ray_tpu.get(handle.remote(dict(req)),
+                      timeout=300)["completion_tokens"]
+    assert len(ref) == 12
+
+    deltas = list(handle.stream.stream_chunks(dict(req)))
+    toks = [t for d in deltas for t in d["tokens"]]
+    assert deltas[-1].get("done") is True
+    assert toks == ref, (toks, ref)
+    assert deltas[-1]["usage"]["cached_prefix_tokens"] > 0
+
+    s = handle.stream.stream_chunks(
+        {"prompt_tokens": prompt, "max_tokens": 60})
+    assert next(s)["tokens"]
+    s.close()
+    deadline = time.monotonic() + 30
+    st = None
+    while time.monotonic() < deadline:
+        st = ray_tpu.get(handle.stats.remote(), timeout=60)
+        sigs = [x for x in st["decode_signals"] if x]
+        if sigs and all(x["tokens_in_flight"] == 0 for x in sigs):
+            break
+        time.sleep(0.3)
+    else:
+        pytest.fail(f"decode never drained: {st}")
+    assert st["duplicate_prefills"] == 0, st
+
+
+# ------------------------------------------------------- seeded chaos plan
+_CHAOS_CHILD = r"""
+import json, time
+import jax
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models.llama import LlamaConfig, llama_init
+
+cfg = LlamaConfig(vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+                  n_kv_heads=4, d_ff=256, max_seq_len=512, dtype="float32")
+params = llama_init(jax.random.PRNGKey(0), cfg)
+ray_tpu.init(num_cpus=16)
+
+from ray_tpu.llm.disagg import build_disagg_deployment
+
+# ONE decode worker: chaos rule counters are per-process, so a single
+# pool makes the eligible-exec sequence deterministic — A's stream exec
+# is #1, B's #2, and D's (#3, "after": 2) fires the kill while B is
+# still mid-decode. The pool's max_restarts then respawns the worker,
+# which serves D's retry, the cancel leg, and the reference phase.
+app = build_disagg_deployment(cfg, params=params, n_prefill=1, n_decode=1,
+                              max_batch=4, page_size=8, n_pages=64,
+                              max_seq_len=128)
+serve.run(app, name="disagg")
+h = serve.get_deployment_handle("DisaggLLMServer", "disagg")
+SHARED = list(range(1, 17))
+
+def req(k, mt):
+    return {"prompt_tokens": SHARED + [k], "max_tokens": mt}
+
+# warmup: compiles prefill/decode graphs (decode_adopted, not eligible
+# for the plan rule) so chaos-phase timing is dispatch-bound
+ray_tpu.get(h.remote(req(90, 8)), timeout=600)
+
+out = {}
+# mixed workload: unary requests in flight beside the streams
+urefs = [h.remote(req(50 + i, 6)) for i in range(3)]
+
+# streams A and B: first delta consumed => both mid-decode
+streams = {}
+for key in ("A", "B"):
+    s = h.stream.stream_chunks(req(ord(key), 100))
+    first = next(s)
+    assert first["tokens"], (key, first)
+    streams[key] = (s, list(first["tokens"]))
+
+def drain(s, toks):
+    try:
+        for d in s:
+            toks.extend(d["tokens"])
+        return {"status": "ok", "tokens": toks}
+    except Exception as e:
+        return {"status": "broken", "tokens": toks,
+                "error": f"{type(e).__name__}: {e}"}
+
+# D's decode exec is the 3rd eligible call -> the plan SIGKILLs D's
+# decode worker at exec start (pre-first-chunk), mid-stream for the
+# co-located A-or-B; D's own retry on the survivor is transparent
+sd = h.stream.stream_chunks(req(ord("D"), 8))
+out["D"] = drain(sd, [])
+
+for key, (s, toks) in streams.items():
+    out[key] = drain(s, toks)
+
+# client disconnect: C runs on the respawned worker, cancels mid-stream
+# (retry the submit while the pool is still restarting after the kill)
+deadline = time.time() + 120
+while True:
+    sc = h.stream.stream_chunks(req(ord("C"), 100))
+    try:
+        firstc = next(sc)
+        break
+    except Exception:
+        sc.close()
+        if time.time() > deadline:
+            raise
+        time.sleep(1.0)
+assert firstc["tokens"]
+sc.close()
+out["C"] = {"status": "cancelled", "tokens": list(firstc["tokens"])}
+
+for i, r in enumerate(urefs):
+    out["U%d" % i] = {"status": "ok",
+                      "tokens": ray_tpu.get(r, timeout=600)
+                      ["completion_tokens"]}
+
+# cancelled + broken streams must drain their decode slots
+deadline = time.time() + 60
+drained = False
+st = None
+while time.time() < deadline:
+    st = ray_tpu.get(h.stats.remote(), timeout=60)
+    sigs = [x for x in st["decode_signals"] if x]
+    if sigs and all(x["tokens_in_flight"] == 0 for x in sigs):
+        drained = True
+        break
+    time.sleep(0.3)
+
+# chaos-free reference: the rule is spent (max_fires=1) and temp-0
+# decode is deterministic, so unary replies are the oracle
+ref = {}
+for key, mt in (("A", 100), ("B", 100), ("C", 100), ("D", 8)):
+    ref[key] = ray_tpu.get(h.remote(req(ord(key), mt)),
+                           timeout=600)["completion_tokens"]
+for i in range(3):
+    ref["U%d" % i] = ray_tpu.get(h.remote(req(50 + i, 6)),
+                                 timeout=600)["completion_tokens"]
+
+print("RES=" + json.dumps({
+    "out": out, "ref": ref, "drained": drained,
+    "duplicate_prefills": st["duplicate_prefills"]}), flush=True)
+serve.shutdown()
+ray_tpu.shutdown()
+"""
+
+
+def test_stream_disconnect_plan(tmp_path):
+    """Acceptance: under the checked-in seeded plan (decode worker
+    SIGKILLed at a stream exec) with a mixed streaming/unary workload —
+    surviving streams are token-identical to the chaos-free reference,
+    broken streams surface a typed error holding only already-consumed
+    chunks (a strict prefix, never replayed), the cancelled and broken
+    streams free their decode slots, and zero prefills run twice."""
+    log_dir = str(tmp_path / "chaos")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "RT_CHAOS_ENABLED": "1",
+           "RT_CHAOS_PLAN": PLAN, "RT_CHAOS_LOG_DIR": log_dir}
+    proc = subprocess.run([sys.executable, "-c", _CHAOS_CHILD], env=env,
+                          capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RES=")][0]
+    res = json.loads(line[4:])
+    out, ref = res["out"], res["ref"]
+
+    # every unary request completed token-identical despite the kill
+    for i in range(3):
+        k = f"U{i}"
+        assert out[k]["status"] == "ok" and out[k]["tokens"] == ref[k], k
+
+    statuses = {k: v["status"] for k, v in out.items() if k in "ABD"}
+    # the kill struck the decode worker mid-stream: >=1 in-flight
+    # stream broke with a typed error
+    broken = [k for k in ("A", "B") if out[k]["status"] == "broken"]
+    assert broken, statuses
+    for k in ("A", "B"):
+        if out[k]["status"] == "ok":
+            assert out[k]["tokens"] == ref[k], k
+        else:
+            got = out[k]["tokens"]
+            # consumed chunks only, never replayed: a strict prefix
+            assert got == ref[k][:len(got)] and len(got) < len(ref[k]), k
+            assert "StreamBrokenError" in out[k]["error"], out[k]
+
+    # D triggered the kill at its own exec start (pre-first-chunk):
+    # either the scheduler's retry landed it on the respawned worker
+    # token-identical, or it failed typed with NOTHING consumed — in no
+    # case does a partially-dead stream replay or corrupt tokens
+    if out["D"]["status"] == "ok":
+        assert out["D"]["tokens"] == ref["D"], out["D"]
+    else:
+        assert out["D"]["tokens"] == [], out["D"]
+
+    # cancelled stream: consumed prefix only, slots drained to zero
+    assert out["C"]["tokens"] == ref["C"][:len(out["C"]["tokens"])]
+    assert res["drained"], res
+    assert res["duplicate_prefills"] == 0, res
+
+    # the plan must actually have struck, or this proves nothing
+    from ray_tpu.devtools.chaos.cli import read_events
+
+    events = read_events(log_dir)
+    kills = [e for e in events if e["action"] == "kill"
+             and e["point"] == "worker.exec"]
+    assert kills and kills[0]["ctx"]["name"] == "decode_adopted_stream"

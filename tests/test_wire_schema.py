@@ -81,9 +81,25 @@ def test_record_prefixes_and_flags_cataloged_everywhere():
     assert fastpath.STAMPED == schema.RECORD_FLAGS["STAMPED"]["value"]
     assert fastpath.SEQED == schema.RECORD_FLAGS["SEQED"]["value"]
     assert fastpath.TRACED == schema.RECORD_FLAGS["TRACED"]["value"]
+    # reply status CODES (2.3): rt_wire.h <-> schema.py <-> live packers
+    hdr_status = {name: int(val) for name, val in re.findall(
+        r"constexpr uint32_t kReplyStatus(\w+) = (\d+);", text)}
+    assert hdr_status, "rt_wire.h lost its reply-status catalog"
+    norm = {"Ok": "OK", "OkShm": "OK_SHM", "Err": "ERR",
+            "NeedSlow": "NEED_SLOW", "Chunk": "CHUNK",
+            "ChunkShm": "CHUNK_SHM"}
+    assert {norm[k]: v for k, v in hdr_status.items()} == {
+        k: v["value"] for k, v in schema.RECORD_STATUS.items()}, (
+        f"reply statuses drifted: rt_wire.h={hdr_status} "
+        f"schema.py={schema.RECORD_STATUS}")
+    for name, info in schema.RECORD_STATUS.items():
+        assert getattr(fastpath, name) == info["value"]
+    # status codes must stay below the flag bits
+    assert max(v["value"] for v in schema.RECORD_STATUS.values()) < min(
+        v["value"] for v in schema.RECORD_FLAGS.values())
     # every cataloged prefix decodes through the live unpackers
     for prefix in schema.RECORD_PREFIXES:
-        assert prefix in "PSQRAC"
+        assert prefix in "PSQRACG"
     # and the packers emit only cataloged prefixes
     tid = b"\0" * 16
     emitted = {
@@ -93,9 +109,47 @@ def test_record_prefixes_and_flags_cataloged_everywhere():
         fastpath.pack_task(tid, b"f", ({1, 2},), None, 5)[0:1],
         fastpath.pack_actor_task(tid, b"am:m", (1,), None, 0, 0)[0:1],
         fastpath.pack_actor_task(tid, b"am:m", ({1},), None, 0, 0)[0:1],
+        fastpath.pack_chunk(tid, fastpath.CHUNK, b"x", 0)[0:1],
     }
-    assert emitted == {b"P", b"S", b"Q", b"R", b"A", b"C"}
+    assert emitted == {b"P", b"S", b"Q", b"R", b"A", b"C", b"G"}
     assert {p.decode() for p in emitted} == set(schema.RECORD_PREFIXES)
+
+
+def test_chunk_record_round_trips_and_unsampled_stays_identical():
+    """2.3 "G" chunk records: round-trip with and without the trace leg;
+    an unsampled chunk is byte-identical to one packed with no tracing
+    arguments at all (the leg is free unless sampled), and the malformed
+    probe path returns None instead of raising."""
+    from ray_tpu.core import fastpath
+    from ray_tpu.utils import tracing
+
+    tid = b"\x22" * 16
+    leg = tracing.pack_ctx("ab" * 16, "cd" * 8, True)
+    for status, payload in ((fastpath.CHUNK, b"tok"),
+                            (fastpath.CHUNK_SHM,
+                             fastpath.pack_shm_desc(4096, b"\x07" * 16))):
+        for cseq in (0, 7, 0xFFFF):
+            plain = fastpath.pack_chunk(tid, status, payload, cseq, 5)
+            traced = fastpath.pack_chunk(tid, status, payload, cseq, 5,
+                                         trace=leg)
+            got_p = fastpath.unpack_chunk(plain)
+            got_t = fastpath.unpack_chunk(traced)
+            assert got_p[:4] == got_t[:4] == (tid, status, payload, cseq)
+            assert got_p[4] == got_t[4] == 5
+            assert got_p[5] == b"" and got_t[5] == leg
+    # unsampled = byte-identical to the no-trace-argument encoding
+    assert fastpath.pack_chunk(tid, fastpath.CHUNK, b"x", 3) == \
+        fastpath.pack_chunk(tid, fastpath.CHUNK, b"x", 3, trace=b"")
+    # the header is the "A" shape: same struct, same trace bit position
+    a = fastpath.pack_actor_task(tid, b"am:m", (1,), None, 5, 3)
+    g = fastpath.pack_chunk(tid, fastpath.CHUNK, b"x", 3, 5)
+    assert a[1:13] == g[1:13]
+    # terminal fin payload round-trips
+    assert fastpath.unpack_stream_fin(fastpath.pack_stream_fin(42)) == 42
+    # probe path: replies and truncated junk return None, never raise
+    rep = fastpath.pack_reply(tid, fastpath.OK, b"pay")
+    assert fastpath.unpack_chunk(rep) is None
+    assert fastpath.unpack_chunk(b"G" + b"\x00" * 10) is None
 
 
 def test_trace_leg_round_trips_and_untraced_records_unchanged():
